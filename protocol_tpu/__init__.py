@@ -1,0 +1,28 @@
+"""protocol_tpu — a TPU-native trust-graph framework.
+
+A ground-up re-design of the capabilities of kumavis/protocol ("ZK Eigen
+Trust", Rust + halo2): signed-attestation ingestion, byzantine-robust opinion
+filtering, EigenTrust global-trust convergence, threshold checks, and a
+ZK-circuit layer — with the convergence computation lifted onto TPU via
+JAX/XLA/Pallas behind a ``ConvergeBackend`` seam.
+
+Package layout (mirrors SURVEY.md §7 architecture):
+
+- ``utils``    — prime fields, keccak, errors (host-exact building blocks)
+- ``crypto``   — native crypto oracles: Poseidon, Rescue-Prime, secp256k1
+                 ECDSA, BabyJubJub EdDSA, Merkle trees
+- ``models``   — the EigenTrust set/opinion/threshold semantics
+                 (reference: eigentrust-zk/src/circuits/{dynamic_sets,opinion,
+                 threshold}/native.rs)
+- ``ops``      — TPU compute: dense/sparse converge kernels, batched field
+                 ops, batched Poseidon / ECDSA
+- ``parallel`` — device-mesh sharding: row-sharded SpMV power iteration with
+                 ICI collectives (shard_map + psum/all_gather)
+- ``client``   — the SDK facade: attestation codecs, storage, eth utils,
+                 chain ingestion (reference: eigentrust/src/*)
+- ``cli``      — command-line front end (reference: eigentrust-cli/src/*)
+- ``zk``       — constraint-system layer: circuits, gadgets, MockProver,
+                 KZG/BN254 (reference: eigentrust-zk circuit side)
+"""
+
+__version__ = "0.1.0"

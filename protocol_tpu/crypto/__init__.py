@@ -1,0 +1,29 @@
+"""Native (host, exact) cryptographic oracles.
+
+Each module here is the correctness twin of a TPU-batched implementation in
+``protocol_tpu.ops`` — the same native-vs-accelerated equivalence strategy
+the reference uses between its ``native.rs`` twins and halo2 chipsets
+(SURVEY.md §4 pattern 2).
+"""
+
+from .poseidon import Poseidon, PoseidonSponge, poseidon_params
+from .secp256k1 import (
+    AffinePoint,
+    EcdsaKeypair,
+    EcdsaVerifier,
+    PublicKey,
+    Signature,
+    SECP256K1_GENERATOR,
+)
+
+__all__ = [
+    "Poseidon",
+    "PoseidonSponge",
+    "poseidon_params",
+    "AffinePoint",
+    "EcdsaKeypair",
+    "EcdsaVerifier",
+    "PublicKey",
+    "Signature",
+    "SECP256K1_GENERATOR",
+]

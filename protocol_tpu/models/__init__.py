@@ -1,0 +1,22 @@
+"""Trust-model semantics: attestations, opinions, the EigenTrust dynamic
+set, and threshold checks — the framework's "model family"."""
+
+from .eigentrust import (
+    Attestation,
+    SignedAttestation,
+    Opinion,
+    EigenTrustSet,
+    HASHER_WIDTH,
+)
+from .threshold import Threshold, decompose_big_decimal, compose_big_decimal
+
+__all__ = [
+    "Attestation",
+    "SignedAttestation",
+    "Opinion",
+    "EigenTrustSet",
+    "HASHER_WIDTH",
+    "Threshold",
+    "decompose_big_decimal",
+    "compose_big_decimal",
+]

@@ -1,0 +1,29 @@
+"""Host-exact building blocks: prime fields, keccak-256, error types."""
+
+from .fields import (
+    BN254_FR_MODULUS,
+    BN254_FQ_MODULUS,
+    SECP256K1_P,
+    SECP256K1_N,
+    FieldElement,
+    Fr,
+    SecpBase,
+    SecpScalar,
+    make_field,
+)
+from .keccak import keccak256
+from .errors import EigenError
+
+__all__ = [
+    "BN254_FR_MODULUS",
+    "BN254_FQ_MODULUS",
+    "SECP256K1_P",
+    "SECP256K1_N",
+    "FieldElement",
+    "Fr",
+    "SecpBase",
+    "SecpScalar",
+    "make_field",
+    "keccak256",
+    "EigenError",
+]

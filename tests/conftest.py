@@ -1,0 +1,13 @@
+"""Test harness config.
+
+Sharding tests run on a virtual 8-device CPU mesh (the driver dry-runs the
+real multi-chip path separately via ``__graft_entry__.dryrun_multichip``).
+Environment must be set before anything imports jax.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()

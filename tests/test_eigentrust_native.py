@@ -1,0 +1,215 @@
+"""Native EigenTrustSet semantics tests.
+
+Mirrors the reference's algorithm-behavior test layer
+(eigentrust-zk/src/circuits/dynamic_sets/native.rs #[cfg(test)]): set
+dynamics, filtering, redistribution, conservation, and field-vs-rational
+parity.
+"""
+
+from fractions import Fraction
+
+import pytest
+
+from protocol_tpu.utils import Fr
+from protocol_tpu.crypto.secp256k1 import EcdsaKeypair
+from protocol_tpu.models import Attestation, EigenTrustSet, SignedAttestation
+
+DOMAIN = Fr(42)
+NUM_NEIGHBOURS = 4
+NUM_ITERATIONS = 20
+INITIAL_SCORE = 1000
+
+
+def make_set(n=NUM_NEIGHBOURS, iters=NUM_ITERATIONS):
+    return EigenTrustSet(n, iters, INITIAL_SCORE, DOMAIN)
+
+
+def sign_opinion(kp: EcdsaKeypair, addresses, scores):
+    """Build a full signed opinion row for `kp` over slot addresses."""
+    out = []
+    for addr, score in zip(addresses, scores):
+        if addr.is_zero():
+            out.append(None)
+            continue
+        att = Attestation(addr, DOMAIN, Fr(score), Fr.zero())
+        sig = kp.sign(int(att.hash()))
+        out.append(SignedAttestation(att, sig))
+    return out
+
+
+def submit_opinion(et, kp, addresses, scores):
+    return et.update_op(kp.public_key, sign_opinion(kp, addresses, scores))
+
+
+@pytest.fixture(scope="module")
+def keypairs():
+    return [EcdsaKeypair(i + 1000) for i in range(NUM_NEIGHBOURS)]
+
+
+def test_add_remove_member():
+    et = make_set()
+    a, b = Fr(11), Fr(22)
+    et.add_member(a)
+    with pytest.raises(AssertionError):
+        et.add_member(a)
+    et.add_member(b)
+    assert et.set[0][0] == a and et.set[1][0] == b
+    et.remove_member(a)
+    assert et.set[0][0].is_zero()
+    # freed slot is reused first
+    et.add_member(Fr(33))
+    assert et.set[0][0] == Fr(33)
+
+
+def test_converge_requires_two_peers():
+    et = make_set()
+    et.add_member(Fr(11))
+    with pytest.raises(AssertionError):
+        et.converge()
+
+
+def test_two_peers_mutual_trust(keypairs):
+    """Two peers attesting only each other end at the initial score."""
+    et = make_set()
+    kp0, kp1 = keypairs[0], keypairs[1]
+    addr0, addr1 = kp0.public_key.to_address(), kp1.public_key.to_address()
+    et.add_member(addr0)
+    et.add_member(addr1)
+
+    addresses = [a for a, _ in et.set]
+    submit_opinion(et, kp0, addresses, [0, 10, 0, 0])
+    submit_opinion(et, kp1, addresses, [10, 0, 0, 0])
+
+    scores = et.converge()
+    assert scores[0] == Fr(INITIAL_SCORE)
+    assert scores[1] == Fr(INITIAL_SCORE)
+    assert scores[2].is_zero() and scores[3].is_zero()
+
+    rational = et.converge_rational()
+    assert rational[0] == Fraction(INITIAL_SCORE)
+    assert rational[1] == Fraction(INITIAL_SCORE)
+
+
+def test_missing_opinions_redistributed(keypairs):
+    """Peers without opinions get uniform rows — everyone stays equal."""
+    et = make_set()
+    addrs = [kp.public_key.to_address() for kp in keypairs[:3]]
+    for a in addrs:
+        et.add_member(a)
+    # no opinions at all: all rows redistributed uniformly
+    scores = et.converge()
+    assert scores[0] == scores[1] == scores[2] == Fr(INITIAL_SCORE)
+
+
+def test_self_attestation_nulled(keypairs):
+    """A peer rating itself gets that score zeroed before normalization."""
+    et = make_set()
+    kp0, kp1 = keypairs[0], keypairs[1]
+    addr0, addr1 = kp0.public_key.to_address(), kp1.public_key.to_address()
+    et.add_member(addr0)
+    et.add_member(addr1)
+    addresses = [a for a, _ in et.set]
+
+    # kp0 rates itself 100 and kp1 10 -> self score must be dropped
+    submit_opinion(et, kp0, addresses, [100, 10, 0, 0])
+    submit_opinion(et, kp1, addresses, [10, 0, 0, 0])
+    filtered = et.filter_peers_ops()
+    assert filtered[addr0][0].is_zero()
+    assert filtered[addr0][1] == Fr(10)
+
+    scores = et.converge()
+    total = sum((s for s in scores), Fr.zero())
+    assert total == Fr(2 * INITIAL_SCORE)
+
+
+def test_score_about_nonmember_nulled(keypairs):
+    et = make_set()
+    kp0, kp1 = keypairs[0], keypairs[1]
+    addr0, addr1 = kp0.public_key.to_address(), kp1.public_key.to_address()
+    et.add_member(addr0)
+    et.add_member(addr1)
+    addresses = [a for a, _ in et.set]
+
+    # scores about empty slots 2,3 must be nulled
+    submit_opinion(et, kp0, addresses, [0, 10, 0, 0])
+    submit_opinion(et, kp1, addresses, [10, 0, 0, 0])
+    # manually inject garbage about an empty slot
+    et.ops[addr0][2] = Fr(55)
+    filtered = et.filter_peers_ops()
+    assert filtered[addr0][2].is_zero()
+
+
+def test_invalid_signature_scores_nulled(keypairs):
+    """An opinion signed by the wrong key contributes zero scores, and the
+    row is then redistributed (byzantine robustness)."""
+    et = make_set()
+    kp0, kp1 = keypairs[0], keypairs[1]
+    addr0, addr1 = kp0.public_key.to_address(), kp1.public_key.to_address()
+    et.add_member(addr0)
+    et.add_member(addr1)
+    addresses = [a for a, _ in et.set]
+
+    # kp0's attestations signed with kp1's key -> invalid -> nulled
+    bad_row = sign_opinion(kp1, addresses, [0, 10, 0, 0])
+    et.update_op(kp0.public_key, bad_row)
+    assert all(s.is_zero() for s in et.ops[addr0])
+
+    submit_opinion(et, kp1, addresses, [10, 0, 0, 0])
+    scores = et.converge()  # redistribution keeps the system running
+    total = sum((s for s in scores), Fr.zero())
+    assert total == Fr(2 * INITIAL_SCORE)
+
+
+def test_field_rational_parity(keypairs):
+    """Field scores are the rational scores mapped through Fr:
+    s_field == num * den^{-1} (mod p) — the homomorphism the threshold
+    circuit relies on (threshold/native.rs check_threshold)."""
+    et = make_set()
+    addrs = [kp.public_key.to_address() for kp in keypairs]
+    for a in addrs:
+        et.add_member(a)
+    addresses = [a for a, _ in et.set]
+
+    rows = [
+        [0, 7, 3, 1],
+        [2, 0, 5, 5],
+        [9, 1, 0, 4],
+        [1, 1, 8, 0],
+    ]
+    for kp, row in zip(keypairs, rows):
+        submit_opinion(et, kp, addresses, row)
+
+    field_scores = et.converge()
+    rational_scores = et.converge_rational()
+    for fs, rs in zip(field_scores, rational_scores):
+        expected = Fr(rs.numerator) * Fr(rs.denominator).invert()
+        assert fs == expected
+
+
+def test_opinion_hash_changes_with_scores(keypairs):
+    et = make_set()
+    kp0, kp1 = keypairs[0], keypairs[1]
+    et.add_member(kp0.public_key.to_address())
+    et.add_member(kp1.public_key.to_address())
+    addresses = [a for a, _ in et.set]
+
+    h1 = submit_opinion(et, kp0, addresses, [0, 10, 0, 0])
+    h2 = submit_opinion(et, kp0, addresses, [0, 11, 0, 0])
+    assert h1 != h2
+
+
+def test_remove_member_resets_scores(keypairs):
+    et = make_set()
+    addrs = [kp.public_key.to_address() for kp in keypairs[:3]]
+    for a in addrs:
+        et.add_member(a)
+    addresses = [a for a, _ in et.set]
+    submit_opinion(et, keypairs[0], addresses, [0, 5, 5, 0])
+    submit_opinion(et, keypairs[1], addresses, [5, 0, 5, 0])
+    submit_opinion(et, keypairs[2], addresses, [5, 5, 0, 0])
+
+    et.remove_member(addrs[2])
+    scores = et.converge()
+    assert scores[2].is_zero()
+    total = sum((s for s in scores), Fr.zero())
+    assert total == Fr(2 * INITIAL_SCORE)

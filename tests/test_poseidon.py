@@ -1,0 +1,70 @@
+"""Poseidon native oracle tests: determinism, sponge semantics."""
+
+from protocol_tpu.utils import Fr
+from protocol_tpu.crypto import Poseidon, PoseidonSponge
+from protocol_tpu.crypto.grain import generate_poseidon_params
+
+
+def test_params_deterministic_and_shaped():
+    rc1, mds1 = generate_poseidon_params(Fr.MODULUS, 5, 8, 60)
+    rc2, mds2 = generate_poseidon_params(Fr.MODULUS, 5, 8, 60)
+    assert rc1 == rc2 and mds1 == mds2
+    assert len(rc1) == (8 + 60) * 5
+    assert len(mds1) == 5 and all(len(row) == 5 for row in mds1)
+    # constants look uniform-ish: no repeats, none tiny
+    assert len(set(rc1)) == len(rc1)
+
+
+def test_permutation_deterministic_and_nontrivial():
+    inputs = [Fr(i + 1) for i in range(5)]
+    out1 = Poseidon(inputs).finalize()
+    out2 = Poseidon(inputs).finalize()
+    assert out1 == out2
+    assert out1 != inputs
+    # a single-bit input change diffuses
+    inputs2 = [Fr(2), Fr(2), Fr(3), Fr(4), Fr(5)]
+    assert Poseidon(inputs2).finalize()[0] != out1[0]
+
+
+def test_hash_convenience_pads():
+    h1 = Poseidon.hash([Fr(1), Fr(2)])
+    h2 = Poseidon([Fr(1), Fr(2), Fr.zero(), Fr.zero(), Fr.zero()]).finalize()[0]
+    assert h1 == h2
+
+
+def test_sponge_absorbs_in_width_chunks():
+    # one chunk == directly permuting state+chunk
+    sponge = PoseidonSponge()
+    inputs = [Fr(i) for i in range(5)]
+    sponge.update(inputs)
+    out = sponge.squeeze()
+    assert out == Poseidon(inputs).finalize()[0]
+
+    # empty sponge absorbs a single zero
+    empty = PoseidonSponge()
+    zero_chunk = Poseidon([Fr.zero()] * 5).finalize()[0]
+    assert empty.squeeze() == zero_chunk
+
+
+def test_sponge_multi_chunk_chains_state():
+    a = [Fr(i + 1) for i in range(5)]
+    b = [Fr(i + 6) for i in range(5)]
+
+    sponge = PoseidonSponge()
+    sponge.update(a)
+    sponge.update(b)
+    out = sponge.squeeze()
+
+    # manual: state = permute(a); state = permute(state + b); out = state[0]
+    st = Poseidon(a).finalize()
+    st2 = Poseidon([x + y for x, y in zip(st, b)]).finalize()
+    assert out == st2[0]
+
+    # squeeze is stateful across calls
+    sponge2 = PoseidonSponge()
+    sponge2.update(a)
+    first = sponge2.squeeze()
+    sponge2.update(b)
+    second = sponge2.squeeze()
+    assert first == Poseidon(a).finalize()[0]
+    assert second == st2[0]

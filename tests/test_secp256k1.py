@@ -1,0 +1,95 @@
+"""secp256k1 / ECDSA native oracle tests."""
+
+import pytest
+
+from protocol_tpu.crypto.secp256k1 import (
+    AffinePoint,
+    EcdsaKeypair,
+    EcdsaVerifier,
+    PublicKey,
+    Signature,
+    SECP256K1_GENERATOR,
+    recover_public_key,
+    N,
+)
+
+
+def test_generator_on_curve_and_order():
+    g = SECP256K1_GENERATOR
+    assert g.on_curve()
+    assert g.mul(N).is_identity()
+    assert g.mul(2) == g.double()
+    assert g.add(g.neg()).is_identity()
+
+
+def test_known_eth_address():
+    # The canonical privkey=1 Ethereum address.
+    kp = EcdsaKeypair(1)
+    assert kp.public_key.to_address_bytes().hex() == (
+        "7e5f4552091a69125d5dfcb7b8c2659029395bdf"
+    )
+
+
+def test_sign_verify_roundtrip():
+    kp = EcdsaKeypair.generate()
+    msg = 0xDEADBEEF12345678
+    sig = kp.sign(msg)
+    assert EcdsaVerifier(sig, msg, kp.public_key).verify()
+    # wrong message fails
+    assert not EcdsaVerifier(sig, msg + 1, kp.public_key).verify()
+    # wrong key fails
+    other = EcdsaKeypair.generate()
+    assert not EcdsaVerifier(sig, msg, other.public_key).verify()
+
+
+def test_low_s_normalization():
+    kp = EcdsaKeypair.generate()
+    for msg in range(20):
+        sig = kp.sign(msg)
+        assert sig.s <= (N + 1) // 2
+        assert EcdsaVerifier(sig, msg, kp.public_key).verify()
+
+
+def test_recover_public_key():
+    kp = EcdsaKeypair.generate()
+    msg = 123456789
+    sig = kp.sign(msg)
+    recovered = recover_public_key(sig, msg)
+    assert recovered.point == kp.public_key.point
+    assert recovered.to_address() == kp.public_key.to_address()
+
+
+def test_recovery_id_parity_tracks_low_s_flip():
+    # recover must work across many signatures (both parities occur)
+    kp = EcdsaKeypair.generate()
+    parities = set()
+    for msg in range(12):
+        sig = kp.sign(msg)
+        parities.add(sig.rec_id)
+        assert recover_public_key(sig, msg).point == kp.public_key.point
+    assert parities == {0, 1}
+
+
+def test_signature_wire_format():
+    sig = Signature(r=123, s=456, rec_id=1)
+    data = sig.to_bytes()
+    assert len(data) == 65
+    assert Signature.from_bytes(data) == sig
+
+
+def test_placeholder_signature_invalid():
+    kp = EcdsaKeypair.generate()
+    assert not EcdsaVerifier(Signature.placeholder(), 42, kp.public_key).verify()
+    # default pubkey never validates
+    assert not EcdsaVerifier(kp.sign(42), 42, PublicKey()).verify()
+
+
+def test_lift_x_rejects_non_residue():
+    # x=5 has no curve point (5^3+7=132 is a QR? just assert behavior is
+    # consistent: either lift succeeds and is on curve, or raises)
+    for x in range(2, 8):
+        try:
+            pt = AffinePoint.lift_x(x, False)
+        except ValueError:
+            continue
+        assert pt.on_curve()

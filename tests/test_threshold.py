@@ -1,0 +1,56 @@
+"""Threshold native semantics tests (threshold/native.rs test layer)."""
+
+from fractions import Fraction
+
+import pytest
+
+from protocol_tpu.utils import Fr
+from protocol_tpu.models import (
+    Threshold,
+    compose_big_decimal,
+    decompose_big_decimal,
+)
+
+
+def test_decompose_compose_roundtrip():
+    value = 123456789 * 10**80 + 42
+    limbs = decompose_big_decimal(value, 2, 72)
+    composed = compose_big_decimal(limbs, 72)
+    assert int(composed) == value % Fr.MODULUS
+    # limb 0 is least significant
+    assert int(limbs[0]) == value % 10**72
+
+
+def test_decompose_overflow_raises():
+    with pytest.raises(AssertionError):
+        decompose_big_decimal(10**144, 2, 72)
+
+
+def _threshold_for(ratio: Fraction, threshold: int) -> Threshold:
+    score = Fr(ratio.numerator) * Fr(ratio.denominator).invert()
+    return Threshold(score, ratio, Fr(threshold))
+
+
+def test_score_above_threshold():
+    ratio = Fraction(1500, 1)  # score 1500
+    assert _threshold_for(ratio, 1000).check_threshold()
+    assert not _threshold_for(ratio, 1501).check_threshold()
+
+
+def test_fractional_score_threshold():
+    ratio = Fraction(2500, 3)  # ~833.3
+    assert _threshold_for(ratio, 800).check_threshold()
+    assert not _threshold_for(ratio, 900).check_threshold()
+
+
+def test_threshold_out_of_range_rejected():
+    ratio = Fraction(1500, 1)
+    with pytest.raises(AssertionError):
+        _threshold_for(ratio, 4 * 1000).check_threshold()
+
+
+def test_score_field_consistency_enforced():
+    ratio = Fraction(1500, 1)
+    bad = Threshold(Fr(7), ratio, Fr(100))  # wrong field score
+    with pytest.raises(AssertionError):
+        bad.check_threshold()

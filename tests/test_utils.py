@@ -1,0 +1,58 @@
+"""Field and keccak unit tests."""
+
+import pytest
+
+from protocol_tpu.utils import Fr, SecpScalar, keccak256, EigenError
+from protocol_tpu.utils.fields import BN254_FR_MODULUS
+
+
+def test_field_basic_arithmetic():
+    a, b = Fr(7), Fr(5)
+    assert int(a + b) == 12
+    assert int(a - b) == 2
+    assert int(a * b) == 35
+    assert int(-a) == BN254_FR_MODULUS - 7
+    assert (b - a - b + a).is_zero()
+
+
+def test_field_inverse():
+    a = Fr(123456789)
+    assert a * a.invert() == Fr.one()
+    assert Fr.zero().invert_or_zero() == Fr.zero()
+    with pytest.raises(ZeroDivisionError):
+        Fr.zero().invert()
+
+
+def test_field_bytes_roundtrip():
+    a = Fr.random()
+    assert Fr.from_bytes_le(a.to_bytes_le()) == a
+    with pytest.raises(ValueError):
+        Fr.from_bytes_le(b"\xff" * 32)
+
+
+def test_field_uniform_reduction():
+    # 64-byte wide reduce: value mod p
+    data = b"\xff" * 64
+    v = int.from_bytes(data, "little") % BN254_FR_MODULUS
+    assert int(Fr.from_uniform_bytes_le(data)) == v
+
+
+def test_keccak256_vectors():
+    # Known Keccak-256 (Ethereum) vectors
+    assert keccak256(b"").hex() == (
+        "c5d2460186f7233c927e7db2dcc703c0e500b653ca82273b7bfad8045d85a470"
+    )
+    assert keccak256(b"abc").hex() == (
+        "4e03657aea45a94fc7d47ba826c8d667c0d1e6e33a64a036ec44f58fa12d6c45"
+    )
+    # multi-block input (> 136-byte rate)
+    long = b"a" * 300
+    assert len(keccak256(long)) == 32
+    assert keccak256(long) != keccak256(b"a" * 299)
+
+
+def test_error_kinds():
+    err = EigenError("parsing_error", "bad hex")
+    assert err.kind == "parsing_error"
+    with pytest.raises(ValueError):
+        EigenError("nonsense_kind")
