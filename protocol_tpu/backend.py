@@ -1,0 +1,130 @@
+"""The ConvergeBackend seam.
+
+SURVEY.md §7 / BASELINE.json north star: cut a backend boundary at the
+reference's ``EigenTrustSet::converge`` so the exact small-set semantics
+(``backend=native``) and the TPU path (``backend=jax``) are interchangeable
+consumers of the same filtered opinion data.
+
+All backends consume the *filtered* opinion matrix (redistribution rows
+already materialized by ``EigenTrustSet.filter_peers_ops`` — or, at scale,
+the raw edge list which ``graph.filter_edges`` filters with identical
+semantics) and return real-valued scores. The field-exact path stays on
+``EigenTrustSet.converge`` itself — field scores are not float-approximable
+(SURVEY.md §7.3) and are computed host-side or via ``ops.limb`` batched
+field kernels for witnesses.
+"""
+
+from __future__ import annotations
+
+from abc import ABC, abstractmethod
+from fractions import Fraction
+from typing import Sequence
+
+import numpy as np
+
+
+class ConvergeBackend(ABC):
+    """Strategy interface for the real-valued convergence computation."""
+
+    @abstractmethod
+    def converge(
+        self,
+        matrix: Sequence[Sequence[float]],
+        initial_score: float,
+        num_iterations: int,
+    ) -> np.ndarray:
+        """Run the power iteration on a filtered opinion matrix."""
+
+
+class NativeRationalBackend(ConvergeBackend):
+    """Exact rational arithmetic — the correctness oracle
+    (converge_rational, dynamic_sets/native.rs:340-392)."""
+
+    def converge(self, matrix, initial_score, num_iterations):
+        exact = self.converge_exact(matrix, initial_score, num_iterations)
+        return np.array([float(x) for x in exact])
+
+    def converge_exact(self, matrix, initial_score, num_iterations):
+        """Same, returning the Fractions (for threshold decomposition).
+
+        Float entries are lifted exactly via ``Fraction(v)`` (binary
+        expansion), so the oracle is substitutable for any matrix the JAX
+        backends accept.
+        """
+        n = len(matrix)
+        norm = []
+        for row in matrix:
+            row_sum = sum(Fraction(v) for v in row) or Fraction(1)
+            norm.append([Fraction(v) / row_sum for v in row])
+        s = [Fraction(initial_score)] * n
+        for _ in range(num_iterations):
+            s = [sum(norm[j][i] * s[j] for j in range(n)) for i in range(n)]
+        return s
+
+
+class JaxDenseBackend(ConvergeBackend):
+    """Dense device power iteration — MXU matvec per step. Right for
+    fully-connected sets up to a few thousand peers."""
+
+    def __init__(self, dtype=None):
+        import jax.numpy as jnp
+
+        self.dtype = dtype or jnp.float32
+
+    def converge(self, matrix, initial_score, num_iterations):
+        import jax.numpy as jnp
+
+        from .ops.converge import converge_dense_fixed
+
+        m = np.asarray(matrix, dtype=np.float64)
+        sums = m.sum(axis=1, keepdims=True)
+        has_row = sums[:, 0] > 0
+        c = jnp.asarray(m / np.where(sums == 0, 1.0, sums), dtype=self.dtype)
+        s0 = jnp.asarray(has_row, dtype=self.dtype) * float(initial_score)
+        return np.asarray(converge_dense_fixed(c, s0, num_iterations))
+
+
+class JaxSparseBackend(ConvergeBackend):
+    """Bucketed-ELL gather-SpMV power iteration — the scale path.
+
+    Accepts a dense filtered matrix (converted to edges) through the
+    common interface; large graphs should use :meth:`converge_edges`
+    directly with raw edge arrays.
+    """
+
+    def __init__(self, dtype=None):
+        import jax.numpy as jnp
+
+        self.dtype = dtype or jnp.float32
+
+    def converge(self, matrix, initial_score, num_iterations):
+        m = np.asarray(matrix, dtype=np.float64)
+        src, dst = np.nonzero(m)
+        # peers with a nonzero row are the valid ones post-filtering
+        valid = m.sum(axis=1) > 0
+        return self.converge_edges(
+            m.shape[0], src, dst, m[src, dst], valid, initial_score, num_iterations
+        )
+
+    def converge_edges(
+        self, n, src, dst, val, valid, initial_score, num_iterations, tol=None,
+        alpha: float = 0.0,
+    ):
+        import jax.numpy as jnp
+
+        from .graph import build_operator
+        from .ops.converge import (
+            converge_sparse_adaptive,
+            converge_sparse_fixed,
+            operator_arrays,
+        )
+
+        op = build_operator(n, src, dst, val, valid)
+        arrs = operator_arrays(op, dtype=self.dtype, alpha=alpha)
+        s0 = jnp.asarray(op.valid, dtype=self.dtype) * float(initial_score)
+        if tol is None:
+            return np.asarray(converge_sparse_fixed(arrs, s0, num_iterations))
+        scores, iters, delta = converge_sparse_adaptive(
+            arrs, s0, tol=tol, max_iterations=num_iterations
+        )
+        return np.asarray(scores), int(iters), float(delta)

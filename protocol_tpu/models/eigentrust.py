@@ -236,6 +236,22 @@ class EigenTrustSet:
         assert sum_initial == sum_final, "score conservation violated"
         return s
 
+    def converge_float(self, backend=None):
+        """Real-valued convergence through the ConvergeBackend seam.
+
+        ``backend=None`` uses the exact rational oracle; pass a
+        ``protocol_tpu.backend`` instance (e.g. JaxDenseBackend) to run the
+        same filtered matrix on TPU.
+        """
+        valid_peers = sum(1 for a, _ in self.set if not a.is_zero())
+        assert valid_peers >= 2, "Insufficient peers for calculation!"
+        if backend is None:
+            from ..backend import NativeRationalBackend
+
+            backend = NativeRationalBackend()
+        matrix, _ = self.opinion_matrix()
+        return backend.converge(matrix, self.initial_score, self.num_iterations)
+
     def converge_rational(self) -> list:
         """Exact rational twin; empty-row denominators become 1
         (native.rs:366-377)."""

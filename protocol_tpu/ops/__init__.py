@@ -1,0 +1,20 @@
+"""TPU compute kernels: converge (dense + bucketed-ELL SpMV), and batched
+crypto/field primitives."""
+
+from .converge import (
+    converge_dense_fixed,
+    converge_dense_adaptive,
+    converge_sparse_fixed,
+    converge_sparse_adaptive,
+    operator_arrays,
+    spmv,
+)
+
+__all__ = [
+    "converge_dense_fixed",
+    "converge_dense_adaptive",
+    "converge_sparse_fixed",
+    "converge_sparse_adaptive",
+    "operator_arrays",
+    "spmv",
+]

@@ -1,0 +1,150 @@
+"""EigenTrust convergence kernels — the TPU side of the ConvergeBackend seam.
+
+The reference's hot loop (``dynamic_sets/native.rs:319-329``) is a dense
+O(I·N²) nested Python-style loop in the BN254 field; here the real-valued
+twin runs as:
+
+- **dense**: ``s ← s @ C`` under ``lax.fori_loop`` / ``lax.while_loop`` —
+  an MXU matvec per iteration; right choice for fully-connected sets up to
+  a few thousand peers.
+- **sparse**: gather-SpMV over the degree-bucketed ELL transpose built by
+  ``protocol_tpu.graph.build_operator`` — pure gathers + row reductions
+  (VPU-friendly, no scatters), with the dangling-mass rank-1 correction
+  applied implicitly.
+
+Both come in fixed-iteration form (reference parity: exactly
+NUM_ITERATIONS steps, ``circuits/mod.rs:41``) and adaptive form (converge
+to an L1 tolerance — the deliberate semantic extension BASELINE.md's north
+star asks for).
+
+All functions are jit-compiled with static shapes; iteration counts are
+static (unrolled loop bounds) or carried as while_loop state.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..graph import EllOperator
+
+
+def operator_arrays(
+    op: EllOperator, dtype=jnp.float32, alpha: float = 0.0, pretrust=None
+) -> dict:
+    """Device-ready pytree of an EllOperator's array leaves.
+
+    ``alpha``/``pretrust`` enable the damped iteration
+    s ← (1-α)·(Cᵀs + dangling-correction) + α·p. α=0 (default) is the
+    reference's undamped semantics (native.rs:319-329); α>0 is the standard
+    EigenTrust pre-trust mixing (BASELINE.json north star) which guarantees
+    geometric convergence at rate (1-α) regardless of graph spectrum.
+    ``pretrust`` defaults to uniform over valid peers, scaled so total mass
+    is conserved for any s with sum(s) = sum(pretrust).
+    """
+    if pretrust is None:
+        pretrust = op.valid.astype('float64') / max(op.n_valid, 1)
+    return {
+        "bucket_idx": tuple(jnp.asarray(b) for b in op.bucket_idx),
+        "bucket_val": tuple(jnp.asarray(b, dtype=dtype) for b in op.bucket_val),
+        "row_pos": jnp.asarray(op.row_pos),
+        "valid": jnp.asarray(op.valid, dtype=dtype),
+        "dangling": jnp.asarray(op.dangling, dtype=dtype),
+        "n_valid": jnp.asarray(float(op.n_valid), dtype=dtype),
+        "alpha": jnp.asarray(float(alpha), dtype=dtype),
+        "pretrust": jnp.asarray(pretrust, dtype=dtype),
+    }
+
+
+def spmv(arrs: dict, s: jnp.ndarray) -> jnp.ndarray:
+    """One application of the normalized trust operator: returns Cᵀs with
+    the dangling-mass correction.
+
+    Per bucket: gather source scores, weight, reduce along the padded
+    width. Bucket outputs concatenate (plus a zero slot for in-degree-0
+    rows) and a permutation gather restores row order.
+    """
+    parts = [
+        (val * s[idx]).sum(axis=-1)
+        for idx, val in zip(arrs["bucket_idx"], arrs["bucket_val"])
+    ]
+    parts.append(jnp.zeros((1,), dtype=s.dtype))
+    flat = jnp.concatenate(parts)
+    base = flat[arrs["row_pos"]]
+
+    # dangling peers redistribute uniformly to every *other* valid peer
+    # (reference native.rs:263-278, as an implicit rank-1 update)
+    d_mass = jnp.sum(s * arrs["dangling"])
+    denom = jnp.maximum(arrs["n_valid"] - 1.0, 1.0)
+    corr = (d_mass - arrs["dangling"] * s) / denom
+    propagated = base + corr * arrs["valid"]
+
+    # damped mixing with the pre-trust distribution (α=0 → pure reference
+    # semantics); pretrust is scaled by the current total mass so the
+    # conservation invariant holds exactly for any α
+    alpha = arrs["alpha"]
+    total = jnp.sum(s * arrs["valid"])
+    return (1.0 - alpha) * propagated + alpha * arrs["pretrust"] * total
+
+
+@partial(jax.jit, static_argnames=("num_iterations",))
+def converge_sparse_fixed(arrs: dict, s0: jnp.ndarray, num_iterations: int):
+    """Reference-parity fixed-iteration power iteration on the sparse op."""
+    return lax.fori_loop(0, num_iterations, lambda _, s: spmv(arrs, s), s0)
+
+
+@partial(jax.jit, static_argnames=("max_iterations",))
+def converge_sparse_adaptive(
+    arrs: dict, s0: jnp.ndarray, tol: float = 1e-6, max_iterations: int = 100
+):
+    """Iterate until the relative L1 delta ≤ tol (or max_iterations).
+
+    Returns (scores, iterations_run, final_relative_delta).
+    """
+    norm = jnp.maximum(jnp.sum(jnp.abs(s0)), 1.0)
+
+    def cond(state):
+        _, i, delta = state
+        return (delta > tol) & (i < max_iterations)
+
+    def body(state):
+        s, i, _ = state
+        s_next = spmv(arrs, s)
+        delta = jnp.sum(jnp.abs(s_next - s)) / norm
+        return s_next, i + 1, delta
+
+    s, iters, delta = lax.while_loop(cond, body, (s0, jnp.int32(0), jnp.asarray(jnp.inf, s0.dtype)))
+    return s, iters, delta
+
+
+@partial(jax.jit, static_argnames=("num_iterations",))
+def converge_dense_fixed(c_norm: jnp.ndarray, s0: jnp.ndarray, num_iterations: int):
+    """Dense fixed-iteration twin: s ← s @ C (row-stochastic C).
+
+    ``s @ C`` computes new_s[i] = Σⱼ C[j,i]·s[j] — identical index
+    convention to the reference loop (native.rs:322-326).
+    """
+    return lax.fori_loop(0, num_iterations, lambda _, s: s @ c_norm, s0)
+
+
+@partial(jax.jit, static_argnames=("max_iterations",))
+def converge_dense_adaptive(
+    c_norm: jnp.ndarray, s0: jnp.ndarray, tol: float = 1e-6, max_iterations: int = 100
+):
+    norm = jnp.maximum(jnp.sum(jnp.abs(s0)), 1.0)
+
+    def cond(state):
+        _, i, delta = state
+        return (delta > tol) & (i < max_iterations)
+
+    def body(state):
+        s, i, _ = state
+        s_next = s @ c_norm
+        delta = jnp.sum(jnp.abs(s_next - s)) / norm
+        return s_next, i + 1, delta
+
+    s, iters, delta = lax.while_loop(cond, body, (s0, jnp.int32(0), jnp.asarray(jnp.inf, s0.dtype)))
+    return s, iters, delta

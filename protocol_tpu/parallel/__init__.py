@@ -1,0 +1,18 @@
+"""Device-mesh parallelism: row-sharded converge with ICI collectives."""
+
+from .mesh import make_mesh, rows_axis
+from .converge import (
+    ShardedOperator,
+    build_sharded_operator,
+    sharded_converge_fixed,
+    sharded_converge_adaptive,
+)
+
+__all__ = [
+    "make_mesh",
+    "rows_axis",
+    "ShardedOperator",
+    "build_sharded_operator",
+    "sharded_converge_fixed",
+    "sharded_converge_adaptive",
+]

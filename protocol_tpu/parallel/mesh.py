@@ -1,0 +1,34 @@
+"""Mesh construction helpers.
+
+The reference has no distributed compute (SURVEY.md §2.4) — this axis is
+net-new architecture. The convergence workload shards by graph *rows*
+(peers); the score vector is re-assembled per iteration with an all-gather
+over ICI, and scalar reductions (dangling mass, L1 delta, conservation
+checks) ride psum. Across hosts, JAX's standard multi-process runtime
+(``jax.distributed.initialize``) extends the same mesh over DCN — the
+collectives are identical, XLA routes them.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+from jax.sharding import Mesh
+
+# single mesh axis name used across the framework
+rows_axis = "rows"
+
+
+def make_mesh(n_devices: int | None = None, devices=None) -> Mesh:
+    """A 1-D mesh over the first ``n_devices`` devices (default: all).
+
+    For multi-host meshes, callers initialize ``jax.distributed`` first;
+    ``jax.devices()`` then spans all processes and ICI/DCN placement is
+    XLA's concern, not ours.
+    """
+    devices = list(devices if devices is not None else jax.devices())
+    if n_devices is not None:
+        assert n_devices <= len(devices), "not enough devices"
+        devices = devices[:n_devices]
+    return Mesh(np.array(devices), (rows_axis,))

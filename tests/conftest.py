@@ -1,13 +1,24 @@
 """Test harness config.
 
-Sharding tests run on a virtual 8-device CPU mesh (the driver dry-runs the
-real multi-chip path separately via ``__graft_entry__.dryrun_multichip``).
-Environment must be set before anything imports jax.
+Tests run on a virtual 8-device CPU mesh (the driver dry-runs the real
+multi-chip path separately via ``__graft_entry__.dryrun_multichip``).
+
+The ambient environment force-registers the TPU tunnel platform via
+sitecustomize *before* conftest runs, so setting JAX_PLATFORMS in
+``os.environ`` is too late — the override must go through jax.config.
+float64 is enabled globally: parity tests compare against the exact
+rational oracle at f64 precision (the TPU bench path stays f32).
 """
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# env vars still help any subprocesses tests may spawn
+os.environ["JAX_PLATFORMS"] = "cpu"
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_enable_x64", True)

@@ -1,0 +1,165 @@
+"""TPU converge path tests (CPU backend, float64 for tight parity).
+
+The core invariant (SURVEY.md §4): reference-exact path (rational oracle)
+vs accelerated path (JAX dense / sparse / sharded) on identical inputs.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from protocol_tpu.backend import (
+    JaxDenseBackend,
+    JaxSparseBackend,
+    NativeRationalBackend,
+)
+from protocol_tpu.graph import (
+    barabasi_albert_edges,
+    build_operator,
+    dense_normalized,
+    filter_edges,
+)
+from protocol_tpu.ops.converge import (
+    converge_sparse_adaptive,
+    converge_sparse_fixed,
+    operator_arrays,
+    spmv,
+)
+
+INITIAL_SCORE = 1000.0
+ITERS = 20
+
+
+def random_matrix(n, density=1.0, seed=0):
+    """A filtered-style opinion matrix: zero diagonal, nonneg entries."""
+    rng = np.random.default_rng(seed)
+    m = rng.integers(0, 10, size=(n, n)).astype(np.float64)
+    mask = rng.random((n, n)) < density
+    m *= mask
+    np.fill_diagonal(m, 0)
+    # ensure every row has at least one entry (valid peers w/ opinions)
+    for i in range(n):
+        if m[i].sum() == 0:
+            m[i, (i + 1) % n] = 1
+    return m
+
+
+def test_dense_backend_matches_rational():
+    m = random_matrix(16)
+    exact = NativeRationalBackend().converge(m.astype(int).tolist(), INITIAL_SCORE, ITERS)
+    dense = JaxDenseBackend(dtype=jnp.float64).converge(m, INITIAL_SCORE, ITERS)
+    np.testing.assert_allclose(dense, exact, rtol=1e-9)
+    # conservation
+    assert abs(dense.sum() - 16 * INITIAL_SCORE) < 1e-6
+
+
+def test_sparse_backend_matches_dense():
+    m = random_matrix(64, density=0.2, seed=1)
+    dense = JaxDenseBackend(dtype=jnp.float64).converge(m, INITIAL_SCORE, ITERS)
+    sparse = JaxSparseBackend(dtype=jnp.float64).converge(m, INITIAL_SCORE, ITERS)
+    np.testing.assert_allclose(sparse, dense, rtol=1e-9)
+
+
+def test_dangling_correction_matches_explicit_redistribution():
+    """A peer with no out-edges: sparse implicit correction must equal the
+    reference's dense uniform-1 redistribution row."""
+    n = 8
+    m = random_matrix(n, seed=2)
+    dangler = 3
+    m[dangler, :] = 0  # no opinions
+
+    # reference semantics: materialize the uniform row
+    m_ref = m.copy()
+    m_ref[dangler, :] = 1.0
+    m_ref[dangler, dangler] = 0.0
+    dense = JaxDenseBackend(dtype=jnp.float64).converge(m_ref, INITIAL_SCORE, ITERS)
+
+    # sparse path: dangler has no edges; implicit correction
+    src, dst = np.nonzero(m)
+    sparse = JaxSparseBackend(dtype=jnp.float64).converge_edges(
+        n, src, dst, m[src, dst], np.ones(n, bool), INITIAL_SCORE, ITERS
+    )
+    np.testing.assert_allclose(sparse, dense, rtol=1e-9)
+
+
+def test_invalid_peers_excluded():
+    n = 6
+    m = random_matrix(n, seed=3)
+    valid = np.array([True] * 4 + [False] * 2)
+    src, dst = np.nonzero(m)
+    scores = JaxSparseBackend(dtype=jnp.float64).converge_edges(
+        n, src, dst, m[src, dst], valid, INITIAL_SCORE, ITERS
+    )
+    assert scores[4] == 0 and scores[5] == 0
+    assert abs(scores.sum() - 4 * INITIAL_SCORE) < 1e-6
+
+
+def test_adaptive_converges_to_tolerance():
+    """Damped iteration (alpha>0) reaches tolerance geometrically — the
+    north-star formula t ← (1-a)Cᵀt + a·p."""
+    src, dst, val = barabasi_albert_edges(500, 4, seed=4)
+    op = build_operator(500, src, dst, val)
+    arrs = operator_arrays(op, dtype=jnp.float64, alpha=0.1)
+    s0 = jnp.asarray(op.valid, dtype=jnp.float64) * INITIAL_SCORE
+    scores, iters, delta = converge_sparse_adaptive(arrs, s0, tol=1e-8, max_iterations=500)
+    assert float(delta) <= 1e-8
+    assert 0 < int(iters) < 500
+    # conservation within float tolerance
+    assert abs(float(scores.sum()) - op.n_valid * INITIAL_SCORE) < 1e-4
+
+
+def test_damping_conserves_mass_and_changes_fixed_point():
+    src, dst, val = barabasi_albert_edges(200, 3, seed=7)
+    op = build_operator(200, src, dst, val)
+    s0 = jnp.asarray(op.valid, dtype=jnp.float64) * INITIAL_SCORE
+    undamped = operator_arrays(op, dtype=jnp.float64, alpha=0.0)
+    damped = operator_arrays(op, dtype=jnp.float64, alpha=0.15)
+    s_u = spmv(undamped, s0)
+    s_d = spmv(damped, s0)
+    assert abs(float(s_u.sum()) - float(s0.sum())) < 1e-6
+    assert abs(float(s_d.sum()) - float(s0.sum())) < 1e-6
+    assert not np.allclose(np.asarray(s_u), np.asarray(s_d))
+
+
+def test_spmv_conserves_mass():
+    src, dst, val = barabasi_albert_edges(300, 3, seed=5)
+    op = build_operator(300, src, dst, val)
+    arrs = operator_arrays(op, dtype=jnp.float64)
+    s0 = jnp.asarray(op.valid, dtype=jnp.float64) * INITIAL_SCORE
+    s1 = spmv(arrs, s0)
+    assert abs(float(s1.sum()) - float(s0.sum())) < 1e-6
+
+
+def test_filter_edges_semantics():
+    n = 5
+    src = np.array([0, 0, 1, 2, 2, 3])
+    dst = np.array([0, 1, 2, 0, 4, 1])  # 0->0 self; 2->4 invalid dst
+    val = np.array([5.0, 5.0, 3.0, 2.0, 2.0, 0.0])  # 3->1 zero value
+    valid = np.array([True, True, True, True, False])
+    fsrc, fdst, w, vmask, dangling = filter_edges(n, src, dst, val, valid)
+    # kept: 0->1, 1->2, 2->0
+    assert sorted(zip(fsrc.tolist(), fdst.tolist())) == [(0, 1), (1, 2), (2, 0)]
+    # peer 3's only edge had value 0 -> dangling; peer 4 invalid, not dangling
+    assert dangling.tolist() == [False, False, False, True, False]
+    # weights row-normalized
+    np.testing.assert_allclose(w, [1.0, 1.0, 1.0])
+
+
+def test_duplicate_edges_summed():
+    n = 3
+    src = np.array([0, 0, 1])
+    dst = np.array([1, 1, 0])
+    val = np.array([2.0, 3.0, 1.0])
+    fsrc, fdst, w, _, _ = filter_edges(n, src, dst, val)
+    assert len(fsrc) == 2  # 0->1 merged
+    np.testing.assert_allclose(sorted(w.tolist()), [1.0, 1.0])
+
+
+def test_bucketing_covers_all_edges():
+    src, dst, val = barabasi_albert_edges(1000, 5, seed=6)
+    op = build_operator(1000, src, dst, val)
+    total_nonzero = sum(int((b != 0).sum()) for b in op.bucket_val)
+    fsrc, fdst, w, _, _ = filter_edges(1000, src, dst, val)
+    assert total_nonzero == int((w != 0).sum())
