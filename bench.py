@@ -42,19 +42,11 @@ def main():
     parser.add_argument("--repeats", type=int, default=3)
     args = parser.parse_args()
 
-    import os
+    from protocol_tpu.utils.platform import honor_jax_platforms_env
+
+    honor_jax_platforms_env()
 
     import jax
-
-    # honor JAX_PLATFORMS even when a sitecustomize pre-registered another
-    # platform (lets the bench smoke-run on CPU: JAX_PLATFORMS=cpu)
-    want = os.environ.get("JAX_PLATFORMS")
-    if want:
-        try:
-            jax.config.update("jax_platforms", want)
-        except RuntimeError:
-            pass
-
     import jax.numpy as jnp
 
     from protocol_tpu.graph import barabasi_albert_edges, build_operator
@@ -116,7 +108,13 @@ def main():
             }
         )
     )
+    # a wall-clock for a run that never hit the advertised tolerance is not
+    # a valid headline number — fail loudly (meta on stderr has the delta)
+    if not meta["converged"]:
+        print("BENCH FAILED: did not converge to tolerance", file=sys.stderr)
+        return 1
+    return 0
 
 
 if __name__ == "__main__":
-    main()
+    sys.exit(main())

@@ -48,8 +48,8 @@ class NativeRationalBackend(ConvergeBackend):
         """Same, returning the Fractions (for threshold decomposition).
 
         Float entries are lifted exactly via ``Fraction(v)`` (binary
-        expansion), so the oracle is substitutable for any matrix the JAX
-        backends accept.
+        expansion). Like all backends, expects a *filtered* opinion matrix
+        (zero row ⇔ empty slot that receives no trust).
         """
         n = len(matrix)
         norm = []
@@ -74,12 +74,12 @@ class JaxDenseBackend(ConvergeBackend):
     def converge(self, matrix, initial_score, num_iterations):
         import jax.numpy as jnp
 
+        from .graph import dense_normalized
         from .ops.converge import converge_dense_fixed
 
         m = np.asarray(matrix, dtype=np.float64)
-        sums = m.sum(axis=1, keepdims=True)
-        has_row = sums[:, 0] > 0
-        c = jnp.asarray(m / np.where(sums == 0, 1.0, sums), dtype=self.dtype)
+        c = jnp.asarray(dense_normalized(m), dtype=self.dtype)
+        has_row = m.sum(axis=1) > 0
         s0 = jnp.asarray(has_row, dtype=self.dtype) * float(initial_score)
         return np.asarray(converge_dense_fixed(c, s0, num_iterations))
 
@@ -100,8 +100,20 @@ class JaxSparseBackend(ConvergeBackend):
     def converge(self, matrix, initial_score, num_iterations):
         m = np.asarray(matrix, dtype=np.float64)
         src, dst = np.nonzero(m)
-        # peers with a nonzero row are the valid ones post-filtering
+        # Contract: `matrix` is a *filtered* opinion matrix (zero row ⇔
+        # empty slot). A zero-row peer that still receives trust would be
+        # interpreted differently by the edge path (its in-edges dropped,
+        # trusters renormalized) than by the dense/rational twins (mass
+        # received then vanishing) — reject rather than silently diverge.
         valid = m.sum(axis=1) > 0
+        receives = m.sum(axis=0) > 0
+        bad = np.nonzero(~valid & receives)[0]
+        if len(bad):
+            raise ValueError(
+                f"matrix is not filtered: zero-row peers {bad.tolist()} still "
+                "receive trust; run it through EigenTrustSet.filter_peers_ops "
+                "or use converge_edges with an explicit valid mask"
+            )
         return self.converge_edges(
             m.shape[0], src, dst, m[src, dst], valid, initial_score, num_iterations
         )

@@ -1,0 +1,5 @@
+"""Command-line front end (reference: the ``eigentrust-cli`` crate)."""
+
+from .main import main, build_parser
+
+__all__ = ["main", "build_parser"]

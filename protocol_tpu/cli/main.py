@@ -1,0 +1,391 @@
+"""CLI dispatcher: the 15 verbs of the reference CLI plus --backend.
+
+Mirrors ``eigentrust-cli/src/cli.rs`` (Mode enum :78-110 and handlers
+:236-678): attest, attestations, bandada, deploy, et-proof,
+et-proving-key, et-verify, kzg-params, local-scores, scores, show,
+th-proof, th-proving-key, th-verify, update.
+
+Additions over the reference: a ``--backend {native,jax,jax-sparse}`` flag
+on the score verbs (the ConvergeBackend seam), and a file-persisted local
+chain (``node_url = "memory"``) so the full flow runs without an Ethereum
+node. The reference's handle_update bug (writing ``domain`` into
+``as_address``, cli.rs:639-643) is deliberately not replicated.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+from ..client import (
+    AttestationRecord,
+    Client,
+    ClientConfig,
+    CSVFileStorage,
+    JSONFileStorage,
+    LocalChain,
+    ScoreRecord,
+)
+from ..utils.errors import EigenError
+from .fs import EigenFile, assets_dir, load_mnemonic
+
+ET_PARAMS_K = 14  # circuit degree for the EigenTrust circuit (see zk layer)
+TH_PARAMS_K = 15
+
+
+def build_parser() -> argparse.ArgumentParser:
+    parser = argparse.ArgumentParser(
+        prog="protocol-tpu",
+        description="TPU-native EigenTrust: attestations, scores, proofs",
+    )
+    parser.add_argument("--assets", help="assets directory (default ./assets)")
+    sub = parser.add_subparsers(dest="command", required=True)
+
+    p = sub.add_parser("attest", help="sign and publish an attestation")
+    p.add_argument("--to", required=True, help="attested peer address (0x..)")
+    p.add_argument("--score", required=True, type=int, help="score value 0..255")
+    p.add_argument("--message", default="0x" + "00" * 32, help="optional 32-byte message")
+
+    sub.add_parser("attestations", help="fetch attestations into attestations.csv")
+
+    p = sub.add_parser("bandada", help="threshold-gated Bandada group membership")
+    p.add_argument("--action", choices=["add", "remove"], required=True)
+    p.add_argument("--identity-commitment", required=True)
+    p.add_argument("--address", required=True, help="peer address to check")
+
+    sub.add_parser("deploy", help="deploy the AttestationStation (local chain)")
+
+    sub.add_parser("et-proof", help="generate the EigenTrust proof")
+    sub.add_parser("et-proving-key", help="generate the EigenTrust proving key")
+    sub.add_parser("et-verify", help="verify the EigenTrust proof")
+
+    p = sub.add_parser("kzg-params", help="generate KZG params")
+    p.add_argument("--k", type=int, required=True, help="circuit degree 2^k rows")
+
+    p = sub.add_parser("local-scores", help="score attestations.csv offline")
+    p.add_argument("--backend", choices=["native", "jax", "jax-sparse"], default="native")
+
+    p = sub.add_parser("scores", help="fetch attestations and compute scores")
+    p.add_argument("--backend", choices=["native", "jax", "jax-sparse"], default="native")
+
+    sub.add_parser("show", help="print the current config")
+
+    p = sub.add_parser("th-proof", help="generate the Threshold proof")
+    p.add_argument("--peer", required=True, help="peer address (0x..)")
+    p.add_argument("--threshold", type=int, required=True)
+    sub.add_parser("th-proving-key", help="generate the Threshold proving key")
+    sub.add_parser("th-verify", help="verify the Threshold proof")
+
+    p = sub.add_parser("update", help="update a config field")
+    for fld in ClientConfig.__dataclass_fields__:
+        p.add_argument(f"--{fld.replace('_', '-')}", dest=fld)
+
+    return parser
+
+
+# --- context helpers ------------------------------------------------------
+
+
+def _load_config(files: EigenFile) -> ClientConfig:
+    path = files.config_json()
+    if path.exists():
+        return ClientConfig.from_dict(JSONFileStorage(path).load())
+    return ClientConfig()
+
+
+def _save_config(files: EigenFile, config: ClientConfig) -> None:
+    JSONFileStorage(files.config_json()).save(config.to_dict())
+
+
+def _make_client(files: EigenFile, config: ClientConfig) -> Client:
+    chain = None
+    if config.node_url == "memory":
+        path = files.chain_json()
+        if path.exists():
+            chain = LocalChain.from_json(JSONFileStorage(path).load())
+        else:
+            chain = LocalChain()
+    return Client(config, load_mnemonic(), chain=chain)
+
+
+def _save_chain(files: EigenFile, client: Client) -> None:
+    if isinstance(client.chain, LocalChain):
+        JSONFileStorage(files.chain_json()).save(client.chain.to_json())
+
+
+def _parse_address(value: str) -> bytes:
+    raw = bytes.fromhex(value.removeprefix("0x"))
+    if len(raw) != 20:
+        raise EigenError("parsing_error", f"bad address: {value}")
+    return raw
+
+
+def _load_attestations(files: EigenFile) -> list:
+    storage = CSVFileStorage(files.attestations_csv(), AttestationRecord)
+    return [record.to_signed() for record in storage.load()]
+
+
+def _fetch_attestations(files: EigenFile, client: Client) -> list:
+    atts = client.get_attestations()
+    records = [AttestationRecord.from_signed(a) for a in atts]
+    CSVFileStorage(files.attestations_csv(), AttestationRecord).save(records)
+    return atts
+
+
+def _write_scores(files: EigenFile, scores: list) -> None:
+    records = [ScoreRecord.from_score(s) for s in scores]
+    CSVFileStorage(files.scores_csv(), ScoreRecord).save(records)
+
+
+def _compute_scores(client: Client, atts: list, backend_name: str) -> list:
+    """Score through the chosen ConvergeBackend; 'native' is the exact
+    reference path, 'jax'/'jax-sparse' run the float path on device and
+    are reported alongside the exact rational scores."""
+    scores = client.calculate_scores(atts)
+    if backend_name != "native":
+        from ..utils.platform import honor_jax_platforms_env
+
+        honor_jax_platforms_env()
+
+        from ..backend import JaxDenseBackend, JaxSparseBackend
+
+        backend = JaxDenseBackend() if backend_name == "jax" else JaxSparseBackend()
+        matrix, _ = _setup_matrix(client, atts)
+        float_scores = backend.converge(
+            matrix, client.initial_score, client.num_iterations
+        )
+        for i, score in enumerate(scores):
+            ratio = float(score.ratio)
+            dev = float(float_scores[i])
+            if abs(dev - ratio) > 1e-3 * max(ratio, 1.0):
+                raise EigenError(
+                    "verification_error",
+                    f"backend {backend_name} diverged from the exact path at "
+                    f"peer {i}: {dev} vs {ratio}",
+                )
+    return scores
+
+
+def _setup_matrix(client: Client, atts: list):
+    """Filtered opinion matrix for the device backends."""
+    setup = client.et_circuit_setup(atts)
+    domain = client.get_scalar_domain()
+    from ..models.eigentrust import EigenTrustSet
+
+    et = EigenTrustSet(
+        client.num_neighbours, client.num_iterations, client.initial_score, domain
+    )
+    from ..client.eth import scalar_from_address
+
+    for addr in setup.address_set:
+        et.add_member(scalar_from_address(addr))
+    for i, addr in enumerate(setup.address_set):
+        pk = setup.pub_keys[i]
+        if pk is not None:
+            et.update_op(pk, setup.attestation_matrix[i])
+    return et.opinion_matrix()
+
+
+# --- handlers -------------------------------------------------------------
+
+
+def handle_attest(args, files, config):
+    client = _make_client(files, config)
+    tx = client.attest(
+        _parse_address(args.to),
+        args.score,
+        bytes.fromhex(args.message.removeprefix("0x")),
+    )
+    _save_chain(files, client)
+    print(f"attestation submitted: {tx}")
+
+
+def handle_attestations(args, files, config):
+    client = _make_client(files, config)
+    atts = _fetch_attestations(files, client)
+    print(f"saved {len(atts)} attestations to {files.attestations_csv()}")
+
+
+def handle_scores(args, files, config, local: bool):
+    client = _make_client(files, config)
+    atts = _load_attestations(files) if local else _fetch_attestations(files, client)
+    scores = _compute_scores(client, atts, args.backend)
+    _write_scores(files, scores)
+    for s in scores:
+        print(f"0x{s.address.hex()}  {float(s.ratio):.6f}")
+    print(f"saved {len(scores)} scores to {files.scores_csv()}")
+
+
+def handle_bandada(args, files, config):
+    from .bandada import BandadaApi
+
+    storage = CSVFileStorage(files.scores_csv(), ScoreRecord)
+    target = args.address.lower()
+    record = next(
+        (r for r in storage.load() if r.peer_address.lower() == target), None
+    )
+    if record is None:
+        raise EigenError("validation_error", f"no score for {args.address}")
+    threshold = int(config.band_th)
+    score = int(record.numerator) // int(record.denominator)
+    if args.action == "add":
+        if score < threshold:
+            raise EigenError(
+                "validation_error",
+                f"score {score} below band threshold {threshold}",
+            )
+        BandadaApi(config.band_url).add_member(
+            config.band_id, args.identity_commitment
+        )
+        print(f"added {args.identity_commitment} to group {config.band_id}")
+    else:
+        BandadaApi(config.band_url).remove_member(
+            config.band_id, args.identity_commitment
+        )
+        print(f"removed {args.identity_commitment} from group {config.band_id}")
+
+
+def handle_deploy(args, files, config):
+    from ..utils.keccak import keccak256
+
+    if config.node_url != "memory":
+        raise EigenError(
+            "contract_error",
+            "deploying to a live node needs contract bytecode; point node_url"
+            " at an existing AttestationStation via `update --as-address`",
+        )
+    address = keccak256(b"protocol_tpu.attestation_station")[12:]
+    config.as_address = "0x" + address.hex()
+    _save_config(files, config)
+    print(f"local AttestationStation at {config.as_address}")
+
+
+def handle_update(args, files, config):
+    changed = []
+    for fld in ClientConfig.__dataclass_fields__:
+        value = getattr(args, fld, None)
+        if value is not None:
+            setattr(config, fld, int(value) if fld == "chain_id" else value)
+            changed.append(fld)
+    if not changed:
+        raise EigenError("config_error", "no config fields given")
+    _save_config(files, config)
+    print(f"updated: {', '.join(changed)}")
+
+
+def handle_show(args, files, config):
+    print(json.dumps(config.to_dict(), indent=2))
+
+
+def handle_kzg_params(args, files, config):
+    from ..zk import api as zk
+
+    data = zk.generate_kzg_params(args.k)
+    path = files.kzg_params(args.k)
+    path.write_bytes(data)
+    print(f"wrote {path} ({len(data)} bytes)")
+
+
+def handle_et_pk(args, files, config):
+    from ..zk import api as zk
+
+    params = files.read(files.kzg_params(ET_PARAMS_K))
+    pk = zk.generate_et_pk(params)
+    files.et_proving_key().write_bytes(pk)
+    print(f"wrote {files.et_proving_key()}")
+
+
+def handle_et_proof(args, files, config):
+    from ..zk import api as zk
+
+    client = _make_client(files, config)
+    atts = _load_attestations(files)
+    setup = client.et_circuit_setup(atts)
+    params = files.read(files.kzg_params(ET_PARAMS_K))
+    pk = files.read(files.et_proving_key())
+    proof = zk.generate_et_proof(params, pk, setup)
+    files.et_proof().write_bytes(proof)
+    files.et_public_inputs().write_bytes(setup.pub_inputs.to_bytes())
+    print(f"wrote {files.et_proof()} and {files.et_public_inputs()}")
+
+
+def handle_et_verify(args, files, config):
+    from ..zk import api as zk
+
+    params = files.read(files.kzg_params(ET_PARAMS_K))
+    pk = files.read(files.et_proving_key())
+    proof = files.read(files.et_proof())
+    pub_inputs = files.read(files.et_public_inputs())
+    ok = zk.verify_et(params, pk, pub_inputs, proof)
+    print("EigenTrust proof: VALID" if ok else "EigenTrust proof: INVALID")
+    return 0 if ok else 1
+
+
+def handle_th_pk(args, files, config):
+    from ..zk import api as zk
+
+    params = files.read(files.kzg_params(TH_PARAMS_K))
+    pk = zk.generate_th_pk(params)
+    files.th_proving_key().write_bytes(pk)
+    print(f"wrote {files.th_proving_key()}")
+
+
+def handle_th_proof(args, files, config):
+    from ..zk import api as zk
+
+    client = _make_client(files, config)
+    atts = _load_attestations(files)
+    setup = client.th_circuit_setup(
+        atts, _parse_address(args.peer), args.threshold
+    )
+    params = files.read(files.kzg_params(TH_PARAMS_K))
+    pk = files.read(files.th_proving_key())
+    proof = zk.generate_th_proof(params, pk, setup)
+    files.th_proof().write_bytes(proof)
+    files.th_public_inputs().write_bytes(setup.pub_inputs.to_bytes())
+    print(f"wrote {files.th_proof()} and {files.th_public_inputs()}")
+
+
+def handle_th_verify(args, files, config):
+    from ..zk import api as zk
+
+    params = files.read(files.kzg_params(TH_PARAMS_K))
+    pk = files.read(files.th_proving_key())
+    proof = files.read(files.th_proof())
+    pub_inputs = files.read(files.th_public_inputs())
+    ok = zk.verify_th(params, pk, pub_inputs, proof)
+    print("Threshold proof: VALID" if ok else "Threshold proof: INVALID")
+    return 0 if ok else 1
+
+
+HANDLERS = {
+    "attest": handle_attest,
+    "attestations": handle_attestations,
+    "bandada": handle_bandada,
+    "deploy": handle_deploy,
+    "et-proof": handle_et_proof,
+    "et-proving-key": handle_et_pk,
+    "et-verify": handle_et_verify,
+    "kzg-params": handle_kzg_params,
+    "show": handle_show,
+    "th-proof": handle_th_proof,
+    "th-proving-key": handle_th_pk,
+    "th-verify": handle_th_verify,
+    "update": handle_update,
+}
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    files = EigenFile(assets_dir(args.assets))
+    config = _load_config(files)
+    try:
+        if args.command == "scores":
+            return handle_scores(args, files, config, local=False) or 0
+        if args.command == "local-scores":
+            return handle_scores(args, files, config, local=True) or 0
+        return HANDLERS[args.command](args, files, config) or 0
+    except EigenError as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 1

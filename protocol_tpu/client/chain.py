@@ -1,0 +1,248 @@
+"""Chain access: the AttestationStation contract surface.
+
+Two implementations of one interface (the reference binds the real
+contract via ethers-rs abigen, ``eigentrust/src/att_station.rs``):
+
+- :class:`LocalChain` — in-process simulation of the AttestationStation
+  semantics (attestations mapping + AttestationCreated logs). This is the
+  framework's fast "fake backend" for tests and local development; the
+  reference's equivalent is spawning a real Anvil devnet per test
+  (SURVEY.md §4 layer 5).
+- :class:`RpcChain` — a JSON-RPC client (eth_getLogs / raw-tx submission)
+  speaking to a real node, with hand-rolled ABI coding for
+  ``attest((address,bytes32,bytes)[])`` and the
+  ``AttestationCreated(address,address,bytes32,bytes)`` event.
+"""
+
+from __future__ import annotations
+
+import json
+import urllib.request
+from dataclasses import dataclass, field
+
+from ..utils.errors import EigenError
+from ..utils.keccak import keccak256
+
+# event AttestationCreated(address indexed creator, address indexed about,
+#                          bytes32 indexed key, bytes val)
+EVENT_SIGNATURE = "AttestationCreated(address,address,bytes32,bytes)"
+EVENT_TOPIC = "0x" + keccak256(EVENT_SIGNATURE.encode()).hex()
+ATTEST_SELECTOR = keccak256(b"attest((address,bytes32,bytes)[])")[:4]
+
+
+@dataclass
+class AttestationLog:
+    """One decoded AttestationCreated event."""
+
+    creator: bytes  # 20
+    about: bytes  # 20
+    key: bytes  # 32
+    val: bytes
+    block_number: int = 0
+
+
+class AttestationStation:
+    """Interface both chains implement."""
+
+    def attest(self, creator: bytes, entries: list) -> str:
+        """entries: [(about20, key32, payload_bytes)]; returns tx hash."""
+        raise NotImplementedError
+
+    def get_attestation(self, creator: bytes, about: bytes, key: bytes) -> bytes:
+        raise NotImplementedError
+
+    def get_logs(self, from_block: int = 0) -> list:
+        raise NotImplementedError
+
+
+class LocalChain(AttestationStation):
+    """In-memory AttestationStation with contract-equivalent semantics."""
+
+    def __init__(self):
+        self.store: dict = {}  # (creator, about, key) -> val
+        self.logs: list = []
+        self.block = 0
+
+    def attest(self, creator: bytes, entries: list) -> str:
+        self.block += 1
+        for about, key, val in entries:
+            self.store[(creator, about, key)] = val
+            self.logs.append(
+                AttestationLog(creator, about, key, val, self.block)
+            )
+        digest = keccak256(
+            creator + b"".join(a + k + v for a, k, v in entries)
+        )
+        return "0x" + digest.hex()
+
+    def get_attestation(self, creator: bytes, about: bytes, key: bytes) -> bytes:
+        return self.store.get((creator, about, key), b"")
+
+    def get_logs(self, from_block: int = 0) -> list:
+        return [log for log in self.logs if log.block_number >= from_block]
+
+    # -- persistence (lets the CLI run a durable local chain without a
+    # node; the reference's equivalent is an external Anvil devnet) -------
+    def to_json(self) -> dict:
+        return {
+            "block": self.block,
+            "logs": [
+                {
+                    "creator": log.creator.hex(),
+                    "about": log.about.hex(),
+                    "key": log.key.hex(),
+                    "val": log.val.hex(),
+                    "block_number": log.block_number,
+                }
+                for log in self.logs
+            ],
+        }
+
+    @classmethod
+    def from_json(cls, data: dict) -> "LocalChain":
+        chain = cls()
+        chain.block = data.get("block", 0)
+        for row in data.get("logs", []):
+            log = AttestationLog(
+                creator=bytes.fromhex(row["creator"]),
+                about=bytes.fromhex(row["about"]),
+                key=bytes.fromhex(row["key"]),
+                val=bytes.fromhex(row["val"]),
+                block_number=row["block_number"],
+            )
+            chain.logs.append(log)
+            chain.store[(log.creator, log.about, log.key)] = log.val
+        return chain
+
+
+# --- minimal ABI coding ---------------------------------------------------
+
+
+def _pad32(data: bytes) -> bytes:
+    return data + b"\x00" * (-len(data) % 32)
+
+
+def _uint(v: int) -> bytes:
+    return v.to_bytes(32, "big")
+
+
+def abi_encode_attest(entries: list) -> bytes:
+    """Calldata for attest((address,bytes32,bytes)[])."""
+    # each element tuple is dynamic (contains bytes) → array stores offsets
+    elements = []
+    for about, key, val in entries:
+        # tuple head: about, key, offset-of-val (3 words); tail: len + data
+        elem = (
+            _pad32(b"\x00" * 12 + about)
+            + key
+            + _uint(3 * 32)
+            + _uint(len(val))
+            + _pad32(val)
+        )
+        elements.append(elem)
+    heads = []
+    offset = 32 * len(elements)
+    for elem in elements:
+        heads.append(_uint(offset))
+        offset += len(elem)
+    array = _uint(len(elements)) + b"".join(heads) + b"".join(elements)
+    return ATTEST_SELECTOR + _uint(32) + array
+
+
+def abi_decode_bytes(data: bytes) -> bytes:
+    """Decode a single dynamic `bytes` return/data value."""
+    if len(data) < 64:
+        raise EigenError("parsing_error", "short ABI bytes payload")
+    offset = int.from_bytes(data[:32], "big")
+    length = int.from_bytes(data[offset : offset + 32], "big")
+    return data[offset + 32 : offset + 32 + length]
+
+
+class RpcChain(AttestationStation):
+    """JSON-RPC AttestationStation client (HTTP, stdlib only)."""
+
+    def __init__(self, node_url: str, contract_address: bytes, chain_id: int = 31337):
+        self.node_url = node_url
+        self.contract_address = contract_address
+        self.chain_id = chain_id
+        self._id = 0
+
+    # -- raw rpc -----------------------------------------------------------
+    def rpc(self, method: str, params: list):
+        self._id += 1
+        body = json.dumps(
+            {"jsonrpc": "2.0", "id": self._id, "method": method, "params": params}
+        ).encode()
+        req = urllib.request.Request(
+            self.node_url, data=body, headers={"Content-Type": "application/json"}
+        )
+        try:
+            with urllib.request.urlopen(req, timeout=30) as resp:
+                reply = json.loads(resp.read())
+        except OSError as e:
+            raise EigenError("connection_error", str(e)) from e
+        if "error" in reply:
+            raise EigenError("network_error", str(reply["error"]))
+        return reply["result"]
+
+    # -- AttestationStation surface ---------------------------------------
+    def attest_signed(self, keypair, entries: list) -> str:
+        """Sign and submit an attest() call from `keypair`."""
+        from .eth import address_from_public_key, sign_legacy_tx
+
+        sender = "0x" + address_from_public_key(keypair.public_key).hex()
+        nonce = int(self.rpc("eth_getTransactionCount", [sender, "pending"]), 16)
+        gas_price = int(self.rpc("eth_gasPrice", []), 16)
+        raw = sign_legacy_tx(
+            keypair,
+            nonce=nonce,
+            gas_price=gas_price,
+            gas=2_000_000,
+            to=self.contract_address,
+            value=0,
+            data=abi_encode_attest(entries),
+            chain_id=self.chain_id,
+        )
+        return self.rpc("eth_sendRawTransaction", ["0x" + raw.hex()])
+
+    def attest(self, creator: bytes, entries: list) -> str:
+        raise EigenError(
+            "keys_error",
+            "RpcChain needs a signing key; use attest_signed(keypair, entries)",
+        )
+
+    def get_attestation(self, creator: bytes, about: bytes, key: bytes) -> bytes:
+        selector = keccak256(b"attestations(address,address,bytes32)")[:4]
+        data = selector + _pad32(b"\x00" * 12 + creator) + _pad32(b"\x00" * 12 + about) + key
+        result = self.rpc(
+            "eth_call",
+            [{"to": "0x" + self.contract_address.hex(), "data": "0x" + data.hex()}, "latest"],
+        )
+        return abi_decode_bytes(bytes.fromhex(result.removeprefix("0x")))
+
+    def get_logs(self, from_block: int = 0) -> list:
+        raw_logs = self.rpc(
+            "eth_getLogs",
+            [
+                {
+                    "fromBlock": hex(from_block),
+                    "toBlock": "latest",
+                    "address": "0x" + self.contract_address.hex(),
+                    "topics": [EVENT_TOPIC],
+                }
+            ],
+        )
+        out = []
+        for log in raw_logs:
+            topics = log["topics"]
+            data = bytes.fromhex(log["data"].removeprefix("0x"))
+            out.append(
+                AttestationLog(
+                    creator=bytes.fromhex(topics[1].removeprefix("0x"))[-20:],
+                    about=bytes.fromhex(topics[2].removeprefix("0x"))[-20:],
+                    key=bytes.fromhex(topics[3].removeprefix("0x")),
+                    val=abi_decode_bytes(data),
+                    block_number=int(log["blockNumber"], 16),
+                )
+            )
+        return out

@@ -254,19 +254,11 @@ class EigenTrustSet:
 
     def converge_rational(self) -> list:
         """Exact rational twin; empty-row denominators become 1
-        (native.rs:366-377)."""
-        matrix, valid = self.opinion_matrix()
-        n = self.num_neighbours
+        (native.rs:366-377). Delegates to the NativeRationalBackend oracle
+        so the rational algorithm lives in exactly one place."""
+        from ..backend import NativeRationalBackend
 
-        ops_norm = []
-        for i in range(n):
-            row_sum = sum(matrix[i]) or 1
-            ops_norm.append([Fraction(v, row_sum) for v in matrix[i]])
-
-        s = [Fraction(self.initial_score) for _ in range(n)]
-        for _ in range(self.num_iterations):
-            s = [
-                sum(ops_norm[j][i] * s[j] for j in range(n))
-                for i in range(n)
-            ]
-        return s
+        matrix, _ = self.opinion_matrix()
+        return NativeRationalBackend().converge_exact(
+            matrix, self.initial_score, self.num_iterations
+        )

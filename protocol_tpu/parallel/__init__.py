@@ -4,6 +4,7 @@ from .mesh import make_mesh, rows_axis
 from .converge import (
     ShardedOperator,
     build_sharded_operator,
+    place_sharded,
     sharded_converge_fixed,
     sharded_converge_adaptive,
 )
@@ -13,6 +14,7 @@ __all__ = [
     "rows_axis",
     "ShardedOperator",
     "build_sharded_operator",
+    "place_sharded",
     "sharded_converge_fixed",
     "sharded_converge_adaptive",
 ]

@@ -1,0 +1,22 @@
+"""Runtime platform selection helpers."""
+
+from __future__ import annotations
+
+import os
+
+
+def honor_jax_platforms_env() -> None:
+    """Make JAX_PLATFORMS effective even when a sitecustomize has already
+    pre-registered a different platform (this machine's TPU tunnel does:
+    the env var alone is read too early to win). Call before first device
+    use; safe no-op when the env var is unset or backends are already
+    initialized."""
+    want = os.environ.get("JAX_PLATFORMS")
+    if not want:
+        return
+    import jax
+
+    try:
+        jax.config.update("jax_platforms", want)
+    except RuntimeError:
+        pass

@@ -1,0 +1,6 @@
+"""ZK layer: constraint system, gadgets, circuits, and the KZG/PLONK
+proving stack (reference: the ``eigentrust-zk`` crate's circuit side).
+
+Round-1 status: the proving stack lands incrementally — see ``api`` for
+the stable facade the CLI and Client call.
+"""

@@ -1,0 +1,46 @@
+"""Stable facade over the proving stack for the CLI / Client layers.
+
+Byte-level artifacts in, byte-level artifacts out — the CLI persists them
+via the EigenFile layout exactly like the reference persists halo2's
+serialized params/keys/proofs (eigentrust-cli/src/fs.rs:50-84).
+"""
+
+from __future__ import annotations
+
+from ..utils.errors import EigenError
+
+
+def _not_ready(what: str):
+    raise EigenError(
+        "proving_error",
+        f"{what}: the PLONK/KZG proving stack is still landing; "
+        "track protocol_tpu.zk",
+    )
+
+
+def generate_kzg_params(k: int) -> bytes:
+    _not_ready("kzg-params")
+
+
+def generate_et_pk(params: bytes) -> bytes:
+    _not_ready("et-proving-key")
+
+
+def generate_et_proof(params: bytes, pk: bytes, setup) -> bytes:
+    _not_ready("et-proof")
+
+
+def verify_et(params: bytes, pk: bytes, pub_inputs: bytes, proof: bytes) -> bool:
+    _not_ready("et-verify")
+
+
+def generate_th_pk(params: bytes) -> bytes:
+    _not_ready("th-proving-key")
+
+
+def generate_th_proof(params: bytes, pk: bytes, setup) -> bytes:
+    _not_ready("th-proof")
+
+
+def verify_th(params: bytes, pk: bytes, pub_inputs: bytes, proof: bytes) -> bool:
+    _not_ready("th-verify")

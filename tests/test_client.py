@@ -1,0 +1,215 @@
+"""Client SDK tests: codecs, key derivation, chain roundtrip, facade.
+
+Mirrors the reference's network-dependent integration layer (SURVEY.md §4
+layer 5) with LocalChain standing in for the Anvil devnet.
+"""
+
+import pytest
+
+from protocol_tpu.client import (
+    AttestationData,
+    AttestationRecord,
+    Client,
+    ClientConfig,
+    CSVFileStorage,
+    LocalChain,
+    ScoreRecord,
+    SignatureData,
+    SignedAttestationData,
+    ecdsa_keypairs_from_mnemonic,
+    scalar_from_address,
+)
+from protocol_tpu.client.chain import abi_encode_attest, abi_decode_bytes, ATTEST_SELECTOR
+from protocol_tpu.client.eth import rlp_encode, mnemonic_to_seed
+from protocol_tpu.utils import EigenError, Fr
+
+# anvil/hardhat's well-known development mnemonic — used as a BIP-39/32
+# test vector (account 0 address is public knowledge)
+TEST_MNEMONIC = "test test test test test test test test test test test junk"
+ANVIL_ADDR0 = "f39fd6e51aad88f6f4ce6ab8827279cfffb92266"
+
+DOMAIN = bytes(range(20))
+
+
+def make_client(mnemonic=TEST_MNEMONIC, chain=None, **kw):
+    config = ClientConfig(domain="0x" + DOMAIN.hex())
+    return Client(config, mnemonic, chain=chain, **kw)
+
+
+def test_mnemonic_derivation_matches_anvil():
+    kps = ecdsa_keypairs_from_mnemonic(TEST_MNEMONIC, 1)
+    assert kps[0].public_key.to_address_bytes().hex() == ANVIL_ADDR0
+
+
+def test_mnemonic_seed_is_bip39():
+    # BIP-39 reference vector (Trezor test vectors, entropy 0x00...00):
+    seed = mnemonic_to_seed(
+        "abandon abandon abandon abandon abandon abandon abandon abandon "
+        "abandon abandon abandon about",
+        passphrase="TREZOR",
+    )
+    assert seed.hex().startswith("c55257c360c07c72029aebc1b53c05ed")
+
+
+def test_attestation_raw_roundtrip():
+    att = AttestationData(
+        about=b"\x11" * 20, domain=DOMAIN, value=7, message=b"\x22" * 32
+    )
+    raw = att.to_bytes()
+    assert len(raw) == 73
+    assert AttestationData.from_bytes(raw) == att
+    with pytest.raises(EigenError):
+        AttestationData.from_bytes(raw[:-1])
+
+
+def test_payload_codec_66_and_98():
+    sig = SignatureData(b"\x01" * 32, b"\x02" * 32, 1)
+    # zero message -> 66-byte payload
+    att = AttestationData(about=b"\x11" * 20, domain=DOMAIN, value=9)
+    signed = SignedAttestationData(att, sig)
+    payload = signed.to_payload()
+    assert len(payload) == 66
+    decoded = SignedAttestationData.from_log(att.about, att.get_key(), payload)
+    assert decoded == signed
+
+    # nonzero message -> 98-byte payload
+    att2 = AttestationData(
+        about=b"\x11" * 20, domain=DOMAIN, value=9, message=b"\x33" * 32
+    )
+    signed2 = SignedAttestationData(att2, sig)
+    payload2 = signed2.to_payload()
+    assert len(payload2) == 98
+    assert SignedAttestationData.from_log(att2.about, att2.get_key(), payload2) == signed2
+
+    with pytest.raises(EigenError):
+        SignedAttestationData.from_log(att.about, att.get_key(), payload + b"\x00")
+
+
+def test_attestation_key_has_domain_prefix():
+    att = AttestationData(domain=DOMAIN)
+    key = att.get_key()
+    assert key == b"eigen_trust_" + DOMAIN
+    assert len(key) == 32
+
+
+def test_scalar_embedding_conventions():
+    addr = bytes.fromhex(ANVIL_ADDR0)
+    fr = scalar_from_address(addr)
+    assert int(fr) == int.from_bytes(addr, "big")
+    att = AttestationData(about=addr, domain=DOMAIN, value=255)
+    scalar = att.to_scalar()
+    assert int(scalar.about) == int.from_bytes(addr, "big")
+    assert int(scalar.value) == 255
+
+
+def test_rlp_known_vectors():
+    assert rlp_encode(b"dog") == bytes.fromhex("83646f67")
+    assert rlp_encode([]) == bytes.fromhex("c0")
+    assert rlp_encode(b"") == bytes.fromhex("80")
+    assert rlp_encode(0) == bytes.fromhex("80")
+    assert rlp_encode(1024) == bytes.fromhex("820400")
+    assert rlp_encode([b"cat", b"dog"]) == bytes.fromhex("c88363617483646f67")
+
+
+def test_abi_attest_encoding_shape():
+    entries = [(b"\xaa" * 20, b"\xbb" * 32, b"\xcc" * 66)]
+    data = abi_encode_attest(entries)
+    assert data[:4] == ATTEST_SELECTOR
+    # array offset word then length word
+    assert int.from_bytes(data[4:36], "big") == 32
+    assert int.from_bytes(data[36:68], "big") == 1
+    # element tuple: about | key | val_offset(=96) | val_len | val_data
+    elem = data[68 + 32 :]  # skip the element-offset head word
+    assert elem[12:32] == b"\xaa" * 20
+    assert elem[32:64] == b"\xbb" * 32
+    assert int.from_bytes(elem[64:96], "big") == 96
+    val_len = int.from_bytes(elem[96:128], "big")
+    assert elem[128 : 128 + val_len] == b"\xcc" * 66
+
+
+def test_attest_and_score_flow_on_local_chain():
+    """Full reference flow on the chain simulation: N clients attest,
+    logs decode, scores computed — SURVEY §3.1's scores call stack."""
+    chain = LocalChain()
+    mnemonics = [
+        TEST_MNEMONIC,
+        "legal winner thank year wave sausage worth useful legal winner thank yellow",
+        "letter advice cage absurd amount doctor acoustic avoid letter advice cage above",
+    ]
+    clients = [make_client(m, chain) for m in mnemonics]
+    addrs = [c.signer.public_key.to_address_bytes() for c in clients]
+
+    # everyone rates everyone else
+    ratings = {0: [0, 8, 2], 1: [5, 0, 5], 2: [3, 7, 0]}
+    for i, client in enumerate(clients):
+        for j, score in enumerate(ratings[i]):
+            if i != j:
+                client.attest(addrs[j], score)
+
+    atts = clients[0].get_attestations()
+    assert len(atts) == 6
+
+    scores = clients[0].calculate_scores(atts)
+    assert len(scores) == 3
+    total = sum(s.ratio for s in scores)
+    assert total == 3 * 1000
+    assert {s.address for s in scores} == set(addrs)
+    # field score consistent with rational
+    for s in scores:
+        expected = Fr(s.numerator) * Fr(s.denominator).invert()
+        assert int(expected) == int.from_bytes(s.score_fr, "big")
+
+
+def test_threshold_verification_flow():
+    chain = LocalChain()
+    m2 = "legal winner thank year wave sausage worth useful legal winner thank yellow"
+    c1, c2 = make_client(TEST_MNEMONIC, chain), make_client(m2, chain)
+    a1 = c1.signer.public_key.to_address_bytes()
+    a2 = c2.signer.public_key.to_address_bytes()
+    c1.attest(a2, 10)
+    c2.attest(a1, 10)
+    atts = c1.get_attestations()
+    # both converge to 1000
+    assert c1.verify_threshold(atts, a1, 900)
+    assert not c1.verify_threshold(atts, a1, 1100)
+    with pytest.raises(EigenError):
+        c1.verify_threshold(atts, b"\x99" * 20, 900)
+
+
+def test_too_many_participants_rejected():
+    chain = LocalChain()
+    client = make_client(chain=chain, num_neighbours=2)
+    mnems = [
+        TEST_MNEMONIC,
+        "legal winner thank year wave sausage worth useful legal winner thank yellow",
+        "letter advice cage absurd amount doctor acoustic avoid letter advice cage above",
+    ]
+    clients = [make_client(m, chain, num_neighbours=2) for m in mnems]
+    addrs = [c.signer.public_key.to_address_bytes() for c in clients]
+    for i, c in enumerate(clients):
+        c.attest(addrs[(i + 1) % 3], 5)
+    atts = client.get_attestations()
+    with pytest.raises(EigenError):
+        client.calculate_scores(atts)
+
+
+def test_storage_roundtrips(tmp_path):
+    sig = SignatureData(b"\x01" * 32, b"\x02" * 32, 1)
+    att = AttestationData(about=b"\x11" * 20, domain=DOMAIN, value=9)
+    signed = SignedAttestationData(att, sig)
+    record = AttestationRecord.from_signed(signed)
+
+    storage = CSVFileStorage(tmp_path / "atts.csv", AttestationRecord)
+    storage.save([record])
+    loaded = storage.load()
+    assert len(loaded) == 1
+    assert loaded[0].to_signed() == signed
+
+    score_storage = CSVFileStorage(tmp_path / "scores.csv", ScoreRecord)
+    rec = ScoreRecord("0xaa", "0xbb", "3", "2", "1")
+    score_storage.save([rec])
+    assert score_storage.load() == [rec]
+
+    missing = CSVFileStorage(tmp_path / "nope.csv", ScoreRecord)
+    with pytest.raises(EigenError):
+        missing.load()
