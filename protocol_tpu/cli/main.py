@@ -114,11 +114,18 @@ def _save_chain(files: EigenFile, client: Client) -> None:
         JSONFileStorage(files.chain_json()).save(client.chain.to_json())
 
 
-def _parse_address(value: str) -> bytes:
-    raw = bytes.fromhex(value.removeprefix("0x"))
-    if len(raw) != 20:
-        raise EigenError("parsing_error", f"bad address: {value}")
+def _parse_hex(value: str, length: int, what: str) -> bytes:
+    try:
+        raw = bytes.fromhex(value.removeprefix("0x"))
+    except ValueError as e:
+        raise EigenError("parsing_error", f"bad {what} (not hex): {value}") from e
+    if len(raw) != length:
+        raise EigenError("parsing_error", f"bad {what} (need {length} bytes): {value}")
     return raw
+
+
+def _parse_address(value: str) -> bytes:
+    return _parse_hex(value, 20, "address")
 
 
 def _load_attestations(files: EigenFile) -> list:
@@ -141,8 +148,10 @@ def _write_scores(files: EigenFile, scores: list) -> None:
 def _compute_scores(client: Client, atts: list, backend_name: str) -> list:
     """Score through the chosen ConvergeBackend; 'native' is the exact
     reference path, 'jax'/'jax-sparse' run the float path on device and
-    are reported alongside the exact rational scores."""
-    scores = client.calculate_scores(atts)
+    are reported alongside the exact rational scores. One circuit setup
+    serves both paths (per-attestation ECDSA recovery dominates)."""
+    setup = client.et_circuit_setup(atts)
+    scores = client.scores_from_setup(setup)
     if backend_name != "native":
         from ..utils.platform import honor_jax_platforms_env
 
@@ -151,7 +160,7 @@ def _compute_scores(client: Client, atts: list, backend_name: str) -> list:
         from ..backend import JaxDenseBackend, JaxSparseBackend
 
         backend = JaxDenseBackend() if backend_name == "jax" else JaxSparseBackend()
-        matrix, _ = _setup_matrix(client, atts)
+        matrix, _ = setup.opinion
         float_scores = backend.converge(
             matrix, client.initial_score, client.num_iterations
         )
@@ -167,26 +176,6 @@ def _compute_scores(client: Client, atts: list, backend_name: str) -> list:
     return scores
 
 
-def _setup_matrix(client: Client, atts: list):
-    """Filtered opinion matrix for the device backends."""
-    setup = client.et_circuit_setup(atts)
-    domain = client.get_scalar_domain()
-    from ..models.eigentrust import EigenTrustSet
-
-    et = EigenTrustSet(
-        client.num_neighbours, client.num_iterations, client.initial_score, domain
-    )
-    from ..client.eth import scalar_from_address
-
-    for addr in setup.address_set:
-        et.add_member(scalar_from_address(addr))
-    for i, addr in enumerate(setup.address_set):
-        pk = setup.pub_keys[i]
-        if pk is not None:
-            et.update_op(pk, setup.attestation_matrix[i])
-    return et.opinion_matrix()
-
-
 # --- handlers -------------------------------------------------------------
 
 
@@ -195,7 +184,7 @@ def handle_attest(args, files, config):
     tx = client.attest(
         _parse_address(args.to),
         args.score,
-        bytes.fromhex(args.message.removeprefix("0x")),
+        _parse_hex(args.message, 32, "message"),
     )
     _save_chain(files, client)
     print(f"attestation submitted: {tx}")

@@ -73,6 +73,9 @@ class ETSetup:
     pub_keys: list  # [PublicKey | None]
     pub_inputs: ETPublicInputs
     rational_scores: list  # [Fraction]
+    # (matrix, valid): filtered opinion rows as plain ints + slot mask —
+    # the hand-off to ConvergeBackend, computed once during setup.
+    opinion: tuple = None
 
 
 @dataclass

@@ -98,13 +98,17 @@ class Client:
         return self.keypairs[0]
 
     def get_scalar_domain(self) -> Fr:
-        raw = bytes.fromhex(self.config.domain.removeprefix("0x"))
-        if len(raw) != 20:
-            raise EigenError("config_error", "domain must be 20 bytes of hex")
+        raw = self._domain_bytes()
         return Fr.from_bytes_le(raw[::-1] + b"\x00" * 12)
 
     def _domain_bytes(self) -> bytes:
-        return bytes.fromhex(self.config.domain.removeprefix("0x"))
+        try:
+            raw = bytes.fromhex(self.config.domain.removeprefix("0x"))
+        except ValueError as e:
+            raise EigenError("config_error", "domain is not valid hex") from e
+        if len(raw) != 20:
+            raise EigenError("config_error", "domain must be 20 bytes of hex")
+        return raw
 
     # --- write path (lib.rs attest :152-198) ------------------------------
     def attest(self, about: bytes, value: int, message: bytes = b"\x00" * 32) -> str:
@@ -118,33 +122,46 @@ class Client:
 
         # sanity: recover must give back our own address (lib.rs:176-178)
         recovered = signed.recover_public_key()
+        attestor = address_from_public_key(recovered)
         own = address_from_public_key(self.signer.public_key)
-        if address_from_public_key(recovered) != own:
+        if attestor != own:
             raise EigenError("attestation_error", "self-recovery mismatch")
 
-        attestor, about_addr, key, payload = signed.to_tx_data()
+        about_addr = att.about
+        key = att.get_key()
+        payload = signed.to_payload()
         if hasattr(self.chain, "attest_signed"):
             return self.chain.attest_signed(self.signer, [(about_addr, key, payload)])
         return self.chain.attest(attestor, [(about_addr, key, payload)])
 
     # --- read path (lib.rs get_logs/get_attestations :607-645) ------------
     def get_attestations(self, from_block: int = 0) -> list:
+        """Fetch and decode this domain's attestations only — the reference
+        filters logs by topic3 == build_att_key(domain) (lib.rs:633-645);
+        foreign-domain attestations must never reach the opinion layer."""
+        from .attestation import DOMAIN_PREFIX
+
+        expected_key = DOMAIN_PREFIX + self._domain_bytes()
         logs = self.chain.get_logs(from_block)
         return [
             SignedAttestationData.from_log(log.about, log.key, log.val)
             for log in logs
+            if log.key == expected_key
         ]
 
     # --- circuit setup (lib.rs et_circuit_setup :339-466) -----------------
     def et_circuit_setup(self, attestations: Sequence[SignedAttestationData]) -> ETSetup:
         n = self.num_neighbours
 
-        # participant set: BTreeSet ordering = sorted unique addresses
+        # participant set: BTreeSet ordering = sorted unique addresses.
+        # Recover each pubkey exactly once (EC scalar mults dominate setup).
         pub_key_map: dict = {}
+        origins: list = []
         participants: set = set()
         for signed in attestations:
             pk = signed.recover_public_key()
             origin = address_from_public_key(pk)
+            origins.append(origin)
             pub_key_map[origin] = pk
             participants.add(origin)
             participants.add(signed.attestation.about)
@@ -172,8 +189,7 @@ class Client:
 
         # attestation matrix in participant order
         matrix: list = [[None] * n for _ in range(n)]
-        for signed in attestations:
-            origin = address_from_public_key(signed.recover_public_key())
+        for signed, origin in zip(attestations, origins):
             i = address_set.index(origin)
             j = address_set.index(signed.attestation.about)
             matrix[i][j] = signed.to_signed_scalar()
@@ -190,6 +206,7 @@ class Client:
             if pk is not None:
                 op_hashes.append(et.update_op(pk, matrix[i]))
 
+        opinion = et.opinion_matrix()
         rational_scores = et.converge_rational()
         field_scores = et.converge()
 
@@ -198,11 +215,15 @@ class Client:
         opinions_hash = sponge.squeeze()
 
         pub_inputs = ETPublicInputs(scalar_set, field_scores, domain, opinions_hash)
-        return ETSetup(address_set, matrix, pub_keys, pub_inputs, rational_scores)
+        return ETSetup(
+            address_set, matrix, pub_keys, pub_inputs, rational_scores, opinion
+        )
 
     # --- scores (lib.rs calculate_scores :201-236) ------------------------
     def calculate_scores(self, attestations: Sequence[SignedAttestationData]) -> list:
-        setup = self.et_circuit_setup(attestations)
+        return self.scores_from_setup(self.et_circuit_setup(attestations))
+
+    def scores_from_setup(self, setup: ETSetup) -> list:
         scores = []
         for addr, score_fr, ratio in zip(
             setup.address_set, setup.pub_inputs.scores, setup.rational_scores

@@ -45,10 +45,15 @@ class CSVFileStorage(Storage):
                 out = []
                 for row in reader:
                     extra = set(row) - names
-                    if extra:
+                    missing = names - set(row)
+                    if extra or missing:
                         raise EigenError(
-                            "parsing_error", f"unknown CSV columns: {sorted(extra)}"
+                            "parsing_error",
+                            f"CSV columns mismatch: extra={sorted(extra)}"
+                            f" missing={sorted(missing)}",
                         )
+                    if any(v is None for v in row.values()):
+                        raise EigenError("parsing_error", "short CSV row")
                     out.append(self.record_type(**row))
                 return out
         except OSError as e:

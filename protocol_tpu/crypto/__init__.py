@@ -7,6 +7,17 @@ the reference uses between its ``native.rs`` twins and halo2 chipsets
 """
 
 from .poseidon import Poseidon, PoseidonSponge, poseidon_params
+from .rescue_prime import RescuePrime, RescuePrimeSponge, rescue_prime_params
+from .edwards import EdwardsPoint, ProjectivePoint
+from .eddsa import (
+    EddsaPublicKey,
+    EddsaSecretKey,
+    EddsaSignature,
+    random_keypair as eddsa_random_keypair,
+    sign as eddsa_sign,
+    verify as eddsa_verify,
+)
+from .merkle import MerklePath, MerkleTree
 from .secp256k1 import (
     AffinePoint,
     EcdsaKeypair,
@@ -20,6 +31,19 @@ __all__ = [
     "Poseidon",
     "PoseidonSponge",
     "poseidon_params",
+    "RescuePrime",
+    "RescuePrimeSponge",
+    "rescue_prime_params",
+    "EdwardsPoint",
+    "ProjectivePoint",
+    "EddsaPublicKey",
+    "EddsaSecretKey",
+    "EddsaSignature",
+    "eddsa_random_keypair",
+    "eddsa_sign",
+    "eddsa_verify",
+    "MerklePath",
+    "MerkleTree",
     "AffinePoint",
     "EcdsaKeypair",
     "EcdsaVerifier",

@@ -160,6 +160,32 @@ def test_attest_and_score_flow_on_local_chain():
         assert int(expected) == int.from_bytes(s.score_fr, "big")
 
 
+def test_foreign_domain_attestations_filtered():
+    """get_attestations must drop logs from other domains (the reference
+    filters by topic3 == build_att_key(domain), lib.rs:633-645) — a single
+    cross-domain attestation must not poison scoring."""
+    chain = LocalChain()
+    m2 = "legal winner thank year wave sausage worth useful legal winner thank yellow"
+    c1, c2 = make_client(TEST_MNEMONIC, chain), make_client(m2, chain)
+    a1 = c1.signer.public_key.to_address_bytes()
+    a2 = c2.signer.public_key.to_address_bytes()
+    c1.attest(a2, 10)
+    c2.attest(a1, 10)
+
+    # third party attests under a different domain on the same station
+    other = Client(
+        ClientConfig(domain="0x" + "ff" * 20),
+        "letter advice cage absurd amount doctor acoustic avoid letter advice cage above",
+        chain=chain,
+    )
+    other.attest(a1, 9)
+
+    atts = c1.get_attestations()
+    assert len(atts) == 2  # foreign-domain log dropped
+    scores = c1.calculate_scores(atts)  # must not raise
+    assert len(scores) == 2
+
+
 def test_threshold_verification_flow():
     chain = LocalChain()
     m2 = "legal winner thank year wave sausage worth useful legal winner thank yellow"

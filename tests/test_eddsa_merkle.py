@@ -1,0 +1,174 @@
+"""EdDSA/BabyJubJub, Rescue-Prime, and Merkle tree tests.
+
+Mirrors the reference's native test coverage for these components
+(eigentrust-zk/src/eddsa/native.rs tests, edwards/native.rs tests,
+rescue_prime/native/mod.rs tests, merkle_tree/native.rs tests).
+"""
+
+from protocol_tpu.crypto.edwards import EdwardsPoint, SUBORDER
+from protocol_tpu.crypto.eddsa import (
+    EddsaSecretKey,
+    EddsaSignature,
+    random_keypair,
+    sign,
+    verify,
+)
+from protocol_tpu.crypto.merkle import MerklePath, MerkleTree
+from protocol_tpu.crypto.poseidon import Poseidon
+from protocol_tpu.crypto.rescue_prime import RescuePrime, RescuePrimeSponge
+from protocol_tpu.utils.fields import Fr
+
+
+# --- edwards curve ---------------------------------------------------------
+
+def test_b8_and_generator_on_curve():
+    assert EdwardsPoint.b8().is_on_curve()
+    assert EdwardsPoint.generator().is_on_curve()
+
+
+def test_b8_has_suborder():
+    # l * B8 == identity, and no smaller power of two of it is
+    assert EdwardsPoint.b8().mul_scalar(SUBORDER).affine() == EdwardsPoint.identity()
+
+
+def test_generator_is_8_times_cofactor_of_b8():
+    # G has full order; 8·G should land in the prime-order subgroup: l·(8·G) = O
+    g8 = EdwardsPoint.generator().mul_scalar(8).affine()
+    assert g8.mul_scalar(SUBORDER).affine() == EdwardsPoint.identity()
+
+
+def test_add_matches_double():
+    p = EdwardsPoint.b8().projective()
+    assert p.add(p).affine() == p.double().affine()
+
+
+def test_scalar_mul_distributes():
+    b8 = EdwardsPoint.b8()
+    p5 = b8.mul_scalar(5).affine()
+    p2 = b8.mul_scalar(2).affine()
+    p3 = b8.mul_scalar(3).affine()
+    assert p2.projective().add(p3.projective()).affine() == p5
+
+
+def test_identity_is_neutral():
+    b8 = EdwardsPoint.b8().projective()
+    ident = EdwardsPoint.identity().projective()
+    assert b8.add(ident).affine() == EdwardsPoint.b8()
+
+
+# --- eddsa -----------------------------------------------------------------
+
+def test_sign_and_verify():
+    sk, pk = random_keypair()
+    m = Fr(31337)
+    sig = sign(sk, pk, m)
+    assert verify(sig, pk, m)
+
+
+def test_deterministic_keys_and_signatures():
+    sk1 = EddsaSecretKey.from_byte_array(b"seed")
+    sk2 = EddsaSecretKey.from_byte_array(b"seed")
+    assert sk1 == sk2
+    m = Fr(7)
+    assert sign(sk1, sk1.public(), m) == sign(sk2, sk2.public(), m)
+
+
+def test_verify_rejects_wrong_message():
+    sk, pk = random_keypair()
+    sig = sign(sk, pk, Fr(1))
+    assert not verify(sig, pk, Fr(2))
+
+
+def test_verify_rejects_wrong_key():
+    sk, pk = random_keypair()
+    _, pk2 = random_keypair()
+    sig = sign(sk, pk, Fr(1))
+    assert not verify(sig, pk2, Fr(1))
+
+
+def test_verify_rejects_oversized_s():
+    sk, pk = random_keypair()
+    sig = sign(sk, pk, Fr(1))
+    bad = EddsaSignature(sig.big_r, sig.s + 2 * SUBORDER)
+    assert not verify(bad, pk, Fr(1))
+
+
+def test_key_raw_roundtrip():
+    sk, pk = random_keypair()
+    assert EddsaSecretKey.from_raw(sk.to_raw()) == sk
+    from protocol_tpu.crypto.eddsa import EddsaPublicKey
+    assert EddsaPublicKey.from_raw(pk.to_raw()) == pk
+
+
+# --- rescue prime ----------------------------------------------------------
+
+def test_rescue_prime_deterministic_and_width_checked():
+    inputs = [Fr(i) for i in range(5)]
+    out1 = RescuePrime(inputs).permute()
+    out2 = RescuePrime(inputs).permute()
+    assert out1 == out2
+    assert len(out1) == 5
+
+
+def test_rescue_prime_differs_from_poseidon():
+    inputs = [Fr(i) for i in range(5)]
+    assert RescuePrime(inputs).permute() != Poseidon(inputs).permute()
+
+
+def test_rescue_prime_sbox_inverse_roundtrip():
+    from protocol_tpu.crypto.rescue_prime import rescue_prime_params
+    _, _, inv5 = rescue_prime_params()
+    x = 123456789
+    assert pow(pow(x, 5, Fr.MODULUS), inv5, Fr.MODULUS) == x
+
+
+def test_rescue_sponge_absorbs_multiple_chunks():
+    sponge = RescuePrimeSponge()
+    sponge.update([Fr(i) for i in range(7)])  # > one WIDTH-5 chunk
+    a = sponge.squeeze()
+    sponge2 = RescuePrimeSponge()
+    sponge2.update([Fr(i) for i in range(7)])
+    assert a == sponge2.squeeze()
+
+
+# --- merkle tree -----------------------------------------------------------
+
+def test_merkle_arity2_path():
+    leaves = [Fr(i + 100) for i in range(8)]
+    tree = MerkleTree(leaves, height=3, arity=2)
+    path = MerklePath.find_path(tree, 4)
+    assert path.value == Fr(104)
+    assert path.verify(arity=2)
+    assert path.path_arr[tree.height][0] == tree.root
+
+
+def test_merkle_arity3_path():
+    leaves = [Fr(i) for i in range(20)]
+    tree = MerkleTree(leaves, height=3, arity=3)
+    path = MerklePath.find_path(tree, 7)
+    assert path.verify(arity=3)
+    assert path.path_arr[tree.height][0] == tree.root
+
+
+def test_merkle_single_leaf():
+    tree = MerkleTree([Fr(42)], height=0, arity=2)
+    path = MerklePath.find_path(tree, 0)
+    assert path.verify(arity=2)
+    assert tree.root == Fr(42)
+
+
+def test_merkle_tamper_detected():
+    leaves = [Fr(i) for i in range(8)]
+    tree = MerkleTree(leaves, height=3, arity=2)
+    path = MerklePath.find_path(tree, 2)
+    path.path_arr[0][0] = Fr(999)
+    assert not path.verify(arity=2)
+
+
+def test_merkle_rescue_hasher():
+    leaves = [Fr(i) for i in range(4)]
+    t_pos = MerkleTree(leaves, height=2, arity=2, hasher=Poseidon)
+    t_res = MerkleTree(leaves, height=2, arity=2, hasher=RescuePrime)
+    assert t_pos.root != t_res.root
+    path = MerklePath.find_path(t_res, 1)
+    assert path.verify(arity=2, hasher=RescuePrime)
