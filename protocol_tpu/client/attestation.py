@@ -138,14 +138,3 @@ class SignedAttestationData:
             self.attestation.to_scalar(), self.signature.to_signature()
         )
 
-    def to_tx_data(self):
-        """(attestor, about, key, payload) for AttestationStation.attest."""
-        from .eth import address_from_public_key
-
-        pk = self.recover_public_key()
-        return (
-            address_from_public_key(pk),
-            self.attestation.about,
-            self.attestation.get_key(),
-            self.to_payload(),
-        )

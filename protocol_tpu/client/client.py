@@ -153,6 +153,14 @@ class Client:
     def et_circuit_setup(self, attestations: Sequence[SignedAttestationData]) -> ETSetup:
         n = self.num_neighbours
 
+        # Defense in depth: scoring must only ever see this client's domain
+        # regardless of where the attestation list came from (fetch filters
+        # too, but CSV files / direct callers bypass that layer).
+        domain_bytes = self._domain_bytes()
+        attestations = [
+            s for s in attestations if s.attestation.domain == domain_bytes
+        ]
+
         # participant set: BTreeSet ordering = sorted unique addresses.
         # Recover each pubkey exactly once (EC scalar mults dominate setup).
         pub_key_map: dict = {}

@@ -49,11 +49,18 @@ class MerkleTree:
 
 
 class MerklePath:
-    """Inclusion path: the full ``arity``-wide sibling group per level."""
+    """Inclusion path: the full ``arity``-wide sibling group per level.
 
-    def __init__(self, value: FieldElement, path_arr: list):
+    The tree's arity/hasher/field bind to the path at ``find_path`` time,
+    so ``verify()`` cannot be called with mismatched parameters."""
+
+    def __init__(self, value: FieldElement, path_arr: list, arity: int = 2,
+                 hasher: type = Poseidon, field: type = Fr):
         self.value = value
         self.path_arr = path_arr  # (height+1) rows; last row = [root, 0...]
+        self.arity = arity
+        self.hasher = hasher
+        self.field = field
 
     @classmethod
     def find_path(cls, tree: MerkleTree, value_index: int) -> "MerklePath":
@@ -68,14 +75,13 @@ class MerklePath:
             idx //= tree.arity
         last = [tree.root] + [tree.field.zero()] * (tree.arity - 1)
         path_arr.append(last)
-        return cls(value, path_arr)
+        return cls(value, path_arr, tree.arity, tree.hasher, tree.field)
 
-    def verify(self, arity: int = 2, hasher: type = Poseidon,
-               field: type = Fr) -> bool:
+    def verify(self) -> bool:
         ok = True
         for i in range(len(self.path_arr) - 1):
-            group = self.path_arr[i][:arity]
-            inputs = group + [field.zero()] * (WIDTH - len(group))
-            digest = hasher(inputs, WIDTH, field).finalize()[0]
+            group = self.path_arr[i][: self.arity]
+            inputs = group + [self.field.zero()] * (WIDTH - len(group))
+            digest = self.hasher(inputs, WIDTH, self.field).finalize()[0]
             ok &= digest in self.path_arr[i + 1]
         return ok

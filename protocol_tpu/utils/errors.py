@@ -32,6 +32,9 @@ class EigenError(Exception):
             "read_write_error",
             "recovery_error",
             "backend_error",
+            # framework-specific: circuit construction/satisfiability
+            # (the reference surfaces these as halo2 VerifyFailure values)
+            "circuit_error",
         }
     )
 

@@ -1,0 +1,508 @@
+"""PLONK proving system over the framework's main gate.
+
+The reference proves with halo2 (PSE fork): a PLONKish arithmetization
+whose core is the ``MainChip`` 5-advice/8-fixed gate
+(``eigentrust-zk/src/gadgets/main.rs:1-113``)
+
+    q_a·a + q_b·b + q_c·c + q_d·d + q_e·e
+      + q_mul_ab·a·b + q_mul_cd·c·d + q_const = 0
+
+plus equality (copy) constraints and instance columns. This module is a
+from-scratch implementation of that proving stack shape on the
+framework's own KZG/BN254 layer (``kzg.py``/``bn254.py``):
+
+- the same 5-wire main gate (so every MainChip-style gadget ports 1:1),
+- copy constraints via the PLONK permutation argument (5-coset grand
+  product),
+- public inputs as a PI(X) polynomial folded into the gate,
+- GWC-style batched KZG openings at {x, ωx},
+- Poseidon Fiat–Shamir transcript (``transcript.py``),
+- blinding by multiples of Z_H (GWC19), so identities hold on all of H.
+
+``check_satisfied`` is the MockProver twin: the reference's test
+strategy runs every circuit through ``MockProver::assert_satisfied``
+(SURVEY.md §4 pattern 1-2); large circuits here do the same while real
+prove/verify runs cover small instances (the reference `#[ignore]`s its
+real-prover tests for the same cost reason, §4.4).
+"""
+
+from __future__ import annotations
+
+import secrets
+from dataclasses import dataclass
+
+from ..utils.errors import EigenError
+from ..utils.fields import BN254_FR_MODULUS
+from .domain import EvaluationDomain, poly_eval
+from .kzg import (
+    KZGParams,
+    g1_from_bytes,
+    g1_to_bytes,
+    open_batch,
+    verify_batch,
+)
+from .transcript import PoseidonTranscript
+
+R = BN254_FR_MODULUS
+
+SELECTORS = ("q_a", "q_b", "q_c", "q_d", "q_e", "q_mul_ab", "q_mul_cd", "q_const")
+NUM_WIRES = 5
+QUOTIENT_CHUNKS = 6
+MIN_K = 3  # quotient degree bound 5n+7 < 6n needs n ≥ 8
+
+
+class ConstraintSystem:
+    """Row-based circuit builder: wires + selectors + copies + publics.
+
+    Cells are (wire, row) pairs. ``add_row`` appends a gate row; wires
+    default to 0 and selectors to 0, so padding rows trivially satisfy
+    the gate.
+    """
+
+    def __init__(self):
+        self.wires: list = [[] for _ in range(NUM_WIRES)]
+        self.selectors: dict = {name: [] for name in SELECTORS}
+        self.copies: list = []
+        self.public_rows: list = []  # (row, value); value lives in wire 0
+
+    @property
+    def num_rows(self) -> int:
+        return len(self.wires[0])
+
+    def add_row(self, values=(), **selectors) -> int:
+        row = self.num_rows
+        vals = [int(v) % R for v in values]
+        vals += [0] * (NUM_WIRES - len(vals))
+        for w in range(NUM_WIRES):
+            self.wires[w].append(vals[w])
+        for name in SELECTORS:
+            self.selectors[name].append(int(selectors.pop(name, 0)) % R)
+        if selectors:
+            raise EigenError("circuit_error", f"unknown selectors {selectors}")
+        return row
+
+    def copy(self, cell_a, cell_b) -> None:
+        """Equality-constrain two cells; values must already agree."""
+        (wa, ra), (wb, rb) = cell_a, cell_b
+        if self.wires[wa][ra] != self.wires[wb][rb]:
+            raise EigenError(
+                "circuit_error",
+                f"copy constraint between unequal cells {cell_a}={self.wires[wa][ra]}"
+                f" and {cell_b}={self.wires[wb][rb]}",
+            )
+        self.copies.append((cell_a, cell_b))
+
+    def public_input(self, value: int) -> int:
+        """Dedicated row `a − value = 0`; returns the row (cell (0, row))."""
+        value = int(value) % R
+        row = self.add_row([value], q_a=1)
+        self.public_rows.append(row)
+        return row
+
+    def public_values(self) -> list:
+        return [self.wires[0][row] for row in self.public_rows]
+
+    # --- MockProver twin --------------------------------------------------
+    def check_satisfied(self, public_inputs=None) -> None:
+        """Raise EigenError on the first unsatisfied row/copy/public."""
+        pubs = list(public_inputs) if public_inputs is not None else self.public_values()
+        if len(pubs) != len(self.public_rows):
+            raise EigenError("circuit_error", "public input arity mismatch")
+        pi_by_row = dict(zip(self.public_rows, pubs))
+        s = self.selectors
+        for i in range(self.num_rows):
+            a, b, c, d, e = (self.wires[w][i] for w in range(NUM_WIRES))
+            acc = (
+                s["q_a"][i] * a + s["q_b"][i] * b + s["q_c"][i] * c
+                + s["q_d"][i] * d + s["q_e"][i] * e
+                + s["q_mul_ab"][i] * a * b + s["q_mul_cd"][i] * c * d
+                + s["q_const"][i]
+                - pi_by_row.get(i, 0)
+            ) % R
+            if acc != 0:
+                raise EigenError("circuit_error", f"gate unsatisfied at row {i}")
+        for (wa, ra), (wb, rb) in self.copies:
+            if self.wires[wa][ra] != self.wires[wb][rb]:
+                raise EigenError(
+                    "circuit_error", f"copy violated: ({wa},{ra}) vs ({wb},{rb})"
+                )
+
+
+def _batch_inv(values: list) -> list:
+    """Montgomery batch inversion; zeros map to zero."""
+    prods = []
+    acc = 1
+    for v in values:
+        prods.append(acc)
+        if v:
+            acc = acc * v % R
+    inv = pow(acc, -1, R)
+    out = [0] * len(values)
+    for i in range(len(values) - 1, -1, -1):
+        if values[i]:
+            out[i] = inv * prods[i] % R
+            inv = inv * values[i] % R
+    return out
+
+
+def _find_coset_shifts(n: int, count: int) -> list:
+    """k₀=1 plus `count−1` values in distinct nontrivial cosets of H,
+    checked directly (kᵢⁿ ≠ 1 and (kᵢ/kⱼ)ⁿ ≠ 1) rather than derived
+    from number theory."""
+    shifts = [1]
+    candidate = 2
+    while len(shifts) < count:
+        ok = pow(candidate, n, R) != 1 and all(
+            pow(candidate * pow(s, -1, R) % R, n, R) != 1 for s in shifts[1:]
+        )
+        if ok:
+            shifts.append(candidate)
+        candidate += 1
+    return shifts
+
+
+@dataclass
+class ProvingKey:
+    """Keygen output; doubles as the verifying key (fixed and σ
+    polynomials are public circuit data — the verifier evaluates them
+    directly instead of checking committed evals)."""
+
+    k: int
+    fixed_coeffs: dict  # selector name -> coeffs
+    sigma_coeffs: list  # per wire
+    sigma_evals: list  # per wire, row form (for the prover's z build)
+    shifts: list
+    public_rows: list
+
+    def domain(self) -> EvaluationDomain:
+        return EvaluationDomain(self.k)
+
+    def to_bytes(self) -> bytes:
+        import json
+
+        payload = {
+            "k": self.k,
+            "fixed": {name: coeffs for name, coeffs in self.fixed_coeffs.items()},
+            "sigma": self.sigma_coeffs,
+            "sigma_evals": self.sigma_evals,
+            "shifts": self.shifts,
+            "public_rows": self.public_rows,
+        }
+        return json.dumps(payload).encode()
+
+    @classmethod
+    def from_bytes(cls, data: bytes) -> "ProvingKey":
+        import json
+
+        p = json.loads(data.decode())
+        return cls(p["k"], p["fixed"], p["sigma"], p["sigma_evals"],
+                   p["shifts"], p["public_rows"])
+
+
+def keygen(cs: ConstraintSystem, k: int | None = None) -> ProvingKey:
+    """Fixed/σ polynomial construction (halo2 ``keygen_pk`` equivalent,
+    reference ``utils.rs:174-186``)."""
+    rows = cs.num_rows
+    if k is None:
+        k = max(MIN_K, (max(rows, 1) - 1).bit_length())
+    n = 1 << k
+    if rows > n:
+        raise EigenError("circuit_error", f"{rows} rows exceed 2^{k}")
+    d = EvaluationDomain(k)
+
+    fixed_coeffs = {}
+    for name in SELECTORS:
+        col = cs.selectors[name] + [0] * (n - rows)
+        fixed_coeffs[name] = d.ifft(col)
+
+    # permutation: merge copy cycles with union-find + pointer swap
+    shifts = _find_coset_shifts(n, NUM_WIRES)
+    parent: dict = {}
+
+    def find(x):
+        while parent.get(x, x) != x:
+            parent[x] = parent.get(parent[x], parent[x])
+            x = parent[x]
+        return x
+
+    nxt = {}
+    for w in range(NUM_WIRES):
+        for r in range(n):
+            nxt[(w, r)] = (w, r)
+    for a, b in cs.copies:
+        ra, rb = find(a), find(b)
+        if ra == rb:
+            continue
+        parent[ra] = rb
+        nxt[a], nxt[b] = nxt[b], nxt[a]
+
+    omegas = d.elements()
+    sigma_evals = []
+    sigma_coeffs = []
+    for w in range(NUM_WIRES):
+        col = []
+        for r in range(n):
+            tw, tr = nxt[(w, r)]
+            col.append(shifts[tw] * omegas[tr] % R)
+        sigma_evals.append(col)
+        sigma_coeffs.append(d.ifft(col))
+
+    return ProvingKey(k, fixed_coeffs, sigma_coeffs, sigma_evals, shifts,
+                      list(cs.public_rows))
+
+
+# --- proof object ---------------------------------------------------------
+
+@dataclass
+class Proof:
+    wire_commits: list  # 5 G1
+    z_commit: tuple
+    t_commits: list  # QUOTIENT_CHUNKS G1
+    wire_evals: list  # 5 at x
+    z_eval: int
+    z_next_eval: int
+    t_evals: list  # chunks at x
+    w_x: tuple  # batch witness at x
+    w_wx: tuple  # batch witness at ωx
+
+    def to_bytes(self) -> bytes:
+        out = []
+        for pt in self.wire_commits + [self.z_commit] + self.t_commits:
+            out.append(g1_to_bytes(pt))
+        for v in self.wire_evals + [self.z_eval, self.z_next_eval] + self.t_evals:
+            out.append(int(v).to_bytes(32, "little"))
+        out.append(g1_to_bytes(self.w_x))
+        out.append(g1_to_bytes(self.w_wx))
+        return b"".join(out)
+
+    @classmethod
+    def from_bytes(cls, data: bytes) -> "Proof":
+        npts = NUM_WIRES + 1 + QUOTIENT_CHUNKS
+        pts = [g1_from_bytes(data[i * 64 : (i + 1) * 64]) for i in range(npts)]
+        off = npts * 64
+        nevals = NUM_WIRES + 2 + QUOTIENT_CHUNKS
+        evals = [
+            int.from_bytes(data[off + i * 32 : off + (i + 1) * 32], "little")
+            for i in range(nevals)
+        ]
+        off += nevals * 32
+        w_x = g1_from_bytes(data[off : off + 64])
+        w_wx = g1_from_bytes(data[off + 64 : off + 128])
+        return cls(
+            pts[:NUM_WIRES], pts[NUM_WIRES], pts[NUM_WIRES + 1 :],
+            evals[:NUM_WIRES], evals[NUM_WIRES], evals[NUM_WIRES + 1],
+            evals[NUM_WIRES + 2 :], w_x, w_wx,
+        )
+
+
+def _blind(coeffs: list, n: int, count: int) -> list:
+    """Add (b₀ + b₁X + …)·Z_H — evaluations on H are unchanged, the
+    polynomial is hidden (GWC19 blinding)."""
+    out = list(coeffs) + [0] * (n + count - len(coeffs))
+    for i in range(count):
+        b = secrets.randbelow(R)
+        out[i] = (out[i] - b) % R
+        out[n + i] = (out[n + i] + b) % R
+    return out
+
+
+def _pi_evals(cs_public_rows, pubs, n) -> list:
+    evals = [0] * n
+    for row, value in zip(cs_public_rows, pubs):
+        evals[row] = (-int(value)) % R
+    return evals
+
+
+def prove(params: KZGParams, pk: ProvingKey, cs: ConstraintSystem,
+          public_inputs=None) -> bytes:
+    d = pk.domain()
+    n = d.n
+    if cs.num_rows > n:
+        raise EigenError("proving_error", "circuit larger than key domain")
+    pubs = list(public_inputs) if public_inputs is not None else cs.public_values()
+    tr = PoseidonTranscript()
+    for v in pubs:
+        tr.absorb_fr(v)
+
+    # round 1: wire polynomials
+    wire_vals = [col + [0] * (n - cs.num_rows) for col in cs.wires]
+    wire_coeffs = [_blind(d.ifft(col), n, 2) for col in wire_vals]
+    wire_commits = [params.commit(c) for c in wire_coeffs]
+    for cm in wire_commits:
+        tr.absorb_point(cm)
+    beta = tr.challenge()
+    gamma = tr.challenge()
+
+    # round 2: permutation grand product
+    omegas = d.elements()
+    numer = [1] * n
+    denom = [1] * n
+    for w in range(NUM_WIRES):
+        kw = pk.shifts[w]
+        sw = pk.sigma_evals[w]
+        col = wire_vals[w]
+        for i in range(n):
+            numer[i] = numer[i] * ((col[i] + beta * kw * omegas[i] + gamma) % R) % R
+            denom[i] = denom[i] * ((col[i] + beta * sw[i] + gamma) % R) % R
+    denom_inv = _batch_inv(denom)
+    z_vals = [1] * n
+    for i in range(n - 1):
+        z_vals[i + 1] = z_vals[i] * numer[i] % R * denom_inv[i] % R
+    assert z_vals[-1] * numer[-1] % R * denom_inv[-1] % R == 1, "perm wrap"
+    z_coeffs = _blind(d.ifft(z_vals), n, 3)
+    z_commit = params.commit(z_coeffs)
+    tr.absorb_point(z_commit)
+    alpha = tr.challenge()
+
+    # round 3: quotient on an 8n coset
+    de = EvaluationDomain(pk.k + 3)
+    shift = _find_coset_shifts(de.n, 2)[1]
+
+    def ext(coeffs):
+        return de.coset_fft(coeffs, shift)
+
+    wires_e = [ext(c) for c in wire_coeffs]
+    z_e = ext(z_coeffs)
+    zw_coeffs = [c * pow(d.omega, i, R) % R for i, c in enumerate(z_coeffs)]
+    zw_e = ext(zw_coeffs)
+    fixed_e = {name: ext(c) for name, c in pk.fixed_coeffs.items()}
+    sigma_e = [ext(c) for c in pk.sigma_coeffs]
+    pi_e = ext(d.ifft(_pi_evals(pk.public_rows, pubs, n)))
+
+    xs = []
+    x = shift
+    for _ in range(de.n):
+        xs.append(x)
+        x = x * de.omega % R
+    zh = [(pow(x, n, R) - 1) % R for x in xs]
+    zh_inv = _batch_inv(zh)
+    l0_den = _batch_inv([n * (x - 1) % R for x in xs])
+
+    t_evals_ext = []
+    for i in range(de.n):
+        a, b, c, dd, e = (wires_e[w][i] for w in range(NUM_WIRES))
+        gate = (
+            fixed_e["q_a"][i] * a + fixed_e["q_b"][i] * b + fixed_e["q_c"][i] * c
+            + fixed_e["q_d"][i] * dd + fixed_e["q_e"][i] * e
+            + fixed_e["q_mul_ab"][i] * a * b + fixed_e["q_mul_cd"][i] * c * dd
+            + fixed_e["q_const"][i] + pi_e[i]
+        ) % R
+        pn = z_e[i]
+        pd = zw_e[i]
+        for w in range(NUM_WIRES):
+            wv = wires_e[w][i]
+            pn = pn * ((wv + beta * pk.shifts[w] * xs[i] + gamma) % R) % R
+            pd = pd * ((wv + beta * sigma_e[w][i] + gamma) % R) % R
+        perm = (pn - pd) % R
+        l0 = zh[i] * l0_den[i] % R
+        total = (gate + alpha * perm + alpha * alpha % R * l0 * (z_e[i] - 1)) % R
+        t_evals_ext.append(total * zh_inv[i] % R)
+
+    t_coeffs = de.coset_ifft(t_evals_ext, shift)
+    for c in t_coeffs[QUOTIENT_CHUNKS * n :]:
+        assert c == 0, "quotient degree overflow"
+    chunks = [t_coeffs[i * n : (i + 1) * n] for i in range(QUOTIENT_CHUNKS)]
+    t_commits = [params.commit(ch) for ch in chunks]
+    for cm in t_commits:
+        tr.absorb_point(cm)
+    zeta = tr.challenge()
+
+    # round 4: evaluations
+    wire_evals = [poly_eval(c, zeta) for c in wire_coeffs]
+    z_eval = poly_eval(z_coeffs, zeta)
+    z_next = poly_eval(z_coeffs, zeta * d.omega % R)
+    t_evals = [poly_eval(ch, zeta) for ch in chunks]
+    for v in wire_evals + [z_eval, z_next] + t_evals:
+        tr.absorb_fr(v)
+    v_ch = tr.challenge()
+    tr.challenge()  # u: verifier-side cross-point fold; squeezed here only
+    # to keep prover/verifier transcripts in lockstep
+
+    openings = open_batch(
+        params,
+        [(zeta, wire_coeffs + [z_coeffs] + chunks),
+         (zeta * d.omega % R, [z_coeffs])],
+        v_ch,
+    )
+    proof = Proof(wire_commits, z_commit, t_commits, wire_evals, z_eval,
+                  z_next, t_evals, openings[0].witness, openings[1].witness)
+    return proof.to_bytes()
+
+
+def verify(params: KZGParams, pk: ProvingKey, public_inputs, proof_bytes: bytes) -> bool:
+    try:
+        proof = Proof.from_bytes(proof_bytes)
+    except (ValueError, IndexError):
+        return False
+    d = pk.domain()
+    n = d.n
+    pubs = [int(v) % R for v in public_inputs]
+    if len(pubs) != len(pk.public_rows):
+        return False
+
+    tr = PoseidonTranscript()
+    for v in pubs:
+        tr.absorb_fr(v)
+    for cm in proof.wire_commits:
+        tr.absorb_point(cm)
+    beta = tr.challenge()
+    gamma = tr.challenge()
+    tr.absorb_point(proof.z_commit)
+    alpha = tr.challenge()
+    for cm in proof.t_commits:
+        tr.absorb_point(cm)
+    zeta = tr.challenge()
+    for v in proof.wire_evals + [proof.z_eval, proof.z_next_eval] + proof.t_evals:
+        tr.absorb_fr(v)
+    v_ch = tr.challenge()
+    u_ch = tr.challenge()
+
+    # fixed/σ/PI evaluations from public key material
+    fixed = {name: poly_eval(c, zeta) for name, c in pk.fixed_coeffs.items()}
+    sigma = [poly_eval(c, zeta) for c in pk.sigma_coeffs]
+    zh = (pow(zeta, n, R) - 1) % R
+    if zh == 0:
+        return False
+    pi = 0
+    lag = d.lagrange_evals(zeta, pk.public_rows)
+    for row, value in zip(pk.public_rows, pubs):
+        pi = (pi - value * lag[row]) % R
+
+    a, b, c, dd, e = proof.wire_evals
+    gate = (
+        fixed["q_a"] * a + fixed["q_b"] * b + fixed["q_c"] * c
+        + fixed["q_d"] * dd + fixed["q_e"] * e
+        + fixed["q_mul_ab"] * a * b + fixed["q_mul_cd"] * c * dd
+        + fixed["q_const"] + pi
+    ) % R
+    pn = proof.z_eval
+    pd = proof.z_next_eval
+    for w in range(NUM_WIRES):
+        wv = proof.wire_evals[w]
+        pn = pn * ((wv + beta * pk.shifts[w] * zeta + gamma) % R) % R
+        pd = pd * ((wv + beta * sigma[w] + gamma) % R) % R
+    perm = (pn - pd) % R
+    l0 = zh * pow(n * (zeta - 1) % R, -1, R) % R
+    total = (gate + alpha * perm + alpha * alpha % R * l0 * (proof.z_eval - 1)) % R
+
+    t_at_zeta = 0
+    zn = pow(zeta, n, R)
+    acc = 1
+    for te in proof.t_evals:
+        t_at_zeta = (t_at_zeta + te * acc) % R
+        acc = acc * zn % R
+    if total != zh * t_at_zeta % R:
+        return False
+
+    groups = [
+        (zeta,
+         [(cm, ev) for cm, ev in zip(proof.wire_commits, proof.wire_evals)]
+         + [(proof.z_commit, proof.z_eval)]
+         + [(cm, ev) for cm, ev in zip(proof.t_commits, proof.t_evals)]),
+        (zeta * d.omega % R, [(proof.z_commit, proof.z_next_eval)]),
+    ]
+    from .kzg import BatchOpening
+
+    openings = [BatchOpening(zeta, proof.w_x),
+                BatchOpening(zeta * d.omega % R, proof.w_wx)]
+    return verify_batch(params, groups, v_ch, u_ch, openings)

@@ -1,0 +1,53 @@
+"""Poseidon Fiat–Shamir transcript over BN254 Fr.
+
+The reference's proof transcripts are Poseidon sponges with WIDTH=5
+(``eigentrust-zk/src/verifier/transcript/native.rs:23-157``: absorb
+scalars and EC points, squeeze challenges). Same design here:
+
+- scalars absorb directly;
+- curve points absorb as coordinate limbs: each Fq coordinate splits
+  into (lo 128 bits, hi bits) so the embedding into Fr is injective —
+  q > r, so a single mod-r absorb would alias coordinates that differ
+  by r (a Fiat–Shamir soundness hole the split avoids);
+- each challenge squeeze absorbs a round counter first, so consecutive
+  challenges are distinct even with no interleaved data.
+"""
+
+from __future__ import annotations
+
+from ..crypto.poseidon import PoseidonSponge
+from ..utils.fields import Fr
+
+_MASK128 = (1 << 128) - 1
+
+
+class PoseidonTranscript:
+    """Shared prover/verifier transcript; both sides replay the same
+    absorb sequence, so challenges agree."""
+
+    def __init__(self, label: bytes = b"protocol-tpu-plonk"):
+        self.sponge = PoseidonSponge()
+        self.rounds = 0
+        seed = int.from_bytes(label, "little") % Fr.MODULUS
+        self.sponge.update([Fr(seed)])
+
+    def absorb_fr(self, value: int) -> None:
+        self.sponge.update([Fr(int(value))])
+
+    def absorb_point(self, pt) -> None:
+        """G1 point (or None identity) as 4 limbs; a domain tag keeps the
+        identity distinct from the scalar 0."""
+        if pt is None:
+            self.sponge.update([Fr(1), Fr(0), Fr(0), Fr(0), Fr(0)])
+            return
+        x, y = pt
+        self.sponge.update([
+            Fr(2),
+            Fr(x & _MASK128), Fr(x >> 128),
+            Fr(y & _MASK128), Fr(y >> 128),
+        ])
+
+    def challenge(self) -> int:
+        self.rounds += 1
+        self.sponge.update([Fr(self.rounds)])
+        return int(self.sponge.squeeze())

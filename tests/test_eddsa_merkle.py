@@ -138,7 +138,7 @@ def test_merkle_arity2_path():
     tree = MerkleTree(leaves, height=3, arity=2)
     path = MerklePath.find_path(tree, 4)
     assert path.value == Fr(104)
-    assert path.verify(arity=2)
+    assert path.verify()
     assert path.path_arr[tree.height][0] == tree.root
 
 
@@ -146,14 +146,14 @@ def test_merkle_arity3_path():
     leaves = [Fr(i) for i in range(20)]
     tree = MerkleTree(leaves, height=3, arity=3)
     path = MerklePath.find_path(tree, 7)
-    assert path.verify(arity=3)
+    assert path.verify()
     assert path.path_arr[tree.height][0] == tree.root
 
 
 def test_merkle_single_leaf():
     tree = MerkleTree([Fr(42)], height=0, arity=2)
     path = MerklePath.find_path(tree, 0)
-    assert path.verify(arity=2)
+    assert path.verify()
     assert tree.root == Fr(42)
 
 
@@ -162,7 +162,7 @@ def test_merkle_tamper_detected():
     tree = MerkleTree(leaves, height=3, arity=2)
     path = MerklePath.find_path(tree, 2)
     path.path_arr[0][0] = Fr(999)
-    assert not path.verify(arity=2)
+    assert not path.verify()
 
 
 def test_merkle_rescue_hasher():
@@ -171,4 +171,4 @@ def test_merkle_rescue_hasher():
     t_res = MerkleTree(leaves, height=2, arity=2, hasher=RescuePrime)
     assert t_pos.root != t_res.root
     path = MerklePath.find_path(t_res, 1)
-    assert path.verify(arity=2, hasher=RescuePrime)
+    assert path.verify()
