@@ -78,7 +78,8 @@ class MerklePath:
         return cls(value, path_arr, tree.arity, tree.hasher, tree.field)
 
     def verify(self) -> bool:
-        ok = True
+        # the claimed value must actually be the leaf this path opens
+        ok = self.value in self.path_arr[0][: self.arity]
         for i in range(len(self.path_arr) - 1):
             group = self.path_arr[i][: self.arity]
             inputs = group + [self.field.zero()] * (WIDTH - len(group))

@@ -105,6 +105,7 @@ class EvaluationDomain:
     def coset_fft(self, coeffs: list, shift: int) -> list:
         """Evaluations over the coset shift·H: scale coeffs by shiftⁱ."""
         padded = list(coeffs) + [0] * (self.n - len(coeffs))
+        assert len(padded) == self.n, "poly degree exceeds domain"
         s = 1
         scaled = []
         for c in padded:

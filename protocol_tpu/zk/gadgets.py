@@ -1,0 +1,307 @@
+"""Gadget chipsets over the framework's 5-wire main gate.
+
+The reference builds every circuit out of a small gadget vocabulary on its
+``MainChip`` gate (``eigentrust-zk/src/gadgets/main.rs:116-700``):
+Add / Sub / Mul / MulAdd / IsBool / IsEqual / IsZero / Inverse / Select /
+And / Or chipsets, plus ``Bits2NumChip`` (``gadgets/bits2num.rs:13``),
+252-bit comparison ``LessEqualChipset`` (``gadgets/lt_eq.rs:22-114``), set
+membership / position / item-select (``gadgets/set.rs:11,153,284``) and
+range checks (``gadgets/range.rs``).
+
+This module is the same vocabulary over ``plonk.ConstraintSystem``'s gate
+
+    q_a·a + q_b·b + q_c·c + q_d·d + q_e·e
+      + q_mul_ab·a·b + q_mul_cd·c·d + q_const = 0.
+
+Differences from the reference, by design:
+
+- Gadgets are plain methods on a ``Chips`` builder rather than halo2
+  Chip/Chipset structs — there is no region/layouter machinery to thread,
+  because our ConstraintSystem is row-based and single-region.
+- Range checks decompose into boolean rows (1 row/bit) instead of the
+  reference's lookup tables (``gadgets/range.rs`` lookup range checks):
+  the proving stack has no lookup argument, so ranges cost O(bits) rows.
+
+Every gadget returns a ``Cell`` whose witness value is already assigned;
+inputs are wired in with copy constraints, exactly like halo2's
+``copy_advice``.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Sequence
+
+from ..utils.errors import EigenError
+from ..utils.fields import BN254_FR_MODULUS
+from .plonk import ConstraintSystem
+
+R = BN254_FR_MODULUS
+
+
+class Cell(NamedTuple):
+    """A (wire, row) coordinate in the constraint system."""
+
+    wire: int
+    row: int
+
+
+class Chips:
+    """Gadget builder over a ConstraintSystem.
+
+    All methods take/return ``Cell``s; witness values are tracked inside
+    the constraint system's wire tables.
+    """
+
+    def __init__(self, cs: ConstraintSystem | None = None):
+        self.cs = cs if cs is not None else ConstraintSystem()
+
+    # --- plumbing ---------------------------------------------------------
+    def value(self, cell: Cell) -> int:
+        return self.cs.wires[cell.wire][cell.row]
+
+    def witness(self, value: int) -> Cell:
+        """A free (unconstrained) witness cell."""
+        row = self.cs.add_row([int(value) % R])
+        return Cell(0, row)
+
+    def constant(self, value: int) -> Cell:
+        """A cell constrained to equal ``value``: a − value = 0."""
+        value = int(value) % R
+        row = self.cs.add_row([value], q_a=1, q_const=-value)
+        return Cell(0, row)
+
+    def public(self, cell: Cell) -> int:
+        """Expose ``cell`` as the next public input; returns its PI row."""
+        row = self.cs.public_input(self.value(cell))
+        self.cs.copy(cell, (0, row))
+        return row
+
+    def assert_equal(self, a: Cell, b: Cell) -> None:
+        self.cs.copy(tuple(a), tuple(b))
+
+    def assert_zero(self, a: Cell) -> None:
+        row = self.cs.add_row([self.value(a)], q_a=1)
+        self.cs.copy(tuple(a), (0, row))
+
+    def _row(self, values, copies, **selectors) -> int:
+        """add_row + copy-constrain listed input cells into their slots.
+
+        ``copies`` maps slot index → source Cell (or None for fresh
+        witnesses produced by this row).
+        """
+        row = self.cs.add_row(values, **selectors)
+        for slot, src in copies.items():
+            self.cs.copy(tuple(src), (slot, row))
+        return row
+
+    # --- arithmetic (MainChip chipsets, main.rs:116-700) ------------------
+    def add(self, a: Cell, b: Cell) -> Cell:
+        va, vb = self.value(a), self.value(b)
+        row = self._row([va, vb, (va + vb) % R], {0: a, 1: b},
+                        q_a=1, q_b=1, q_c=-1)
+        return Cell(2, row)
+
+    def sub(self, a: Cell, b: Cell) -> Cell:
+        va, vb = self.value(a), self.value(b)
+        row = self._row([va, vb, (va - vb) % R], {0: a, 1: b},
+                        q_a=1, q_b=-1, q_c=-1)
+        return Cell(2, row)
+
+    def add_const(self, a: Cell, k: int) -> Cell:
+        va = self.value(a)
+        row = self._row([va, (va + k) % R], {0: a}, q_a=1, q_const=k, q_b=-1)
+        return Cell(1, row)
+
+    def mul_const(self, a: Cell, k: int) -> Cell:
+        va = self.value(a)
+        row = self._row([va, va * k % R], {0: a}, q_a=k, q_b=-1)
+        return Cell(1, row)
+
+    def mul(self, a: Cell, b: Cell) -> Cell:
+        va, vb = self.value(a), self.value(b)
+        row = self._row([va, vb, va * vb % R], {0: a, 1: b},
+                        q_mul_ab=1, q_c=-1)
+        return Cell(2, row)
+
+    def mul_add(self, a: Cell, b: Cell, c: Cell) -> Cell:
+        """a·b + c (MulAddChipset — the power-iteration workhorse,
+        main.rs + dynamic_sets/mod.rs:641-657)."""
+        va, vb, vc = self.value(a), self.value(b), self.value(c)
+        row = self._row([va, vb, vc, (va * vb + vc) % R],
+                        {0: a, 1: b, 2: c}, q_mul_ab=1, q_c=1, q_d=-1)
+        return Cell(3, row)
+
+    def lincomb(self, terms: Sequence[tuple[int, Cell]], const: int = 0) -> Cell:
+        """Σ kᵢ·cellᵢ + const, packed 4 terms per row (partial sum in the
+        5th wire), partials folded with add rows."""
+        pending = list(terms)
+        if not pending:
+            return self.constant(const)
+        acc: Cell | None = None
+        rem_const = const
+        while pending:
+            chunk, pending = pending[:4], pending[4:]
+            partial_val = (sum(k * self.value(c) for k, c in chunk)
+                           + rem_const) % R
+            vals = [self.value(c) for _, c in chunk]
+            vals += [0] * (4 - len(chunk))
+            vals.append(partial_val)
+            sels = {f"q_{'abcd'[i]}": k for i, (k, _) in enumerate(chunk)}
+            row = self.cs.add_row(vals, q_e=-1, q_const=rem_const, **sels)
+            for i, (_, c) in enumerate(chunk):
+                self.cs.copy(tuple(c), (i, row))
+            partial = Cell(4, row)
+            rem_const = 0
+            acc = partial if acc is None else self.add(acc, partial)
+        return acc
+
+    # --- booleans ---------------------------------------------------------
+    def assert_bool(self, a: Cell) -> None:
+        """a² − a = 0 (IsBoolChipset)."""
+        va = self.value(a)
+        self._row([va, va], {0: a, 1: a}, q_mul_ab=1, q_a=-1)
+
+    def is_zero(self, a: Cell) -> Cell:
+        """1 if a == 0 else 0 (IsZeroChipset): witness inv with
+        a·inv + out − 1 = 0 and a·out = 0."""
+        va = self.value(a)
+        inv = pow(va, -1, R) if va else 0
+        out = 0 if va else 1
+        row = self._row([va, inv, out], {0: a}, q_mul_ab=1, q_c=1, q_const=-1)
+        out_cell = Cell(2, row)
+        self._row([va, out], {0: a, 1: out_cell}, q_mul_ab=1)
+        return out_cell
+
+    def is_equal(self, a: Cell, b: Cell) -> Cell:
+        return self.is_zero(self.sub(a, b))
+
+    def inverse(self, a: Cell) -> Cell:
+        """aˉ¹ with constraint a·inv = 1 (InverseChipset); raises on 0."""
+        va = self.value(a)
+        if va == 0:
+            raise EigenError("circuit_error", "inverse of zero")
+        vinv = pow(va, -1, R)
+        row = self._row([va, vinv], {0: a}, q_mul_ab=1, q_const=-1)
+        return Cell(1, row)
+
+    def select(self, bit: Cell, a: Cell, b: Cell) -> Cell:
+        """bit ? a : b (SelectChipset): bit·a − bit·b + b − out = 0.
+        Caller must ensure ``bit`` is boolean-constrained."""
+        vbit, va, vb = self.value(bit), self.value(a), self.value(b)
+        out = va if vbit else vb
+        row = self._row([vbit, va, vbit, vb, out],
+                        {0: bit, 1: a, 2: bit, 3: b},
+                        q_mul_ab=1, q_mul_cd=-1, q_d=1, q_e=-1)
+        return Cell(4, row)
+
+    def logic_and(self, a: Cell, b: Cell) -> Cell:
+        """Boolean AND (AndChipset): asserts both inputs boolean."""
+        self.assert_bool(a)
+        self.assert_bool(b)
+        return self.mul(a, b)
+
+    def logic_or(self, a: Cell, b: Cell) -> Cell:
+        """Boolean OR (OrChipset): a + b − a·b."""
+        self.assert_bool(a)
+        self.assert_bool(b)
+        va, vb = self.value(a), self.value(b)
+        out = (va + vb - va * vb) % R
+        row = self._row([va, vb, out], {0: a, 1: b},
+                        q_a=1, q_b=1, q_mul_ab=-1, q_c=-1)
+        return Cell(2, row)
+
+    def logic_not(self, a: Cell) -> Cell:
+        self.assert_bool(a)
+        va = self.value(a)
+        row = self._row([va, (1 - va) % R], {0: a}, q_a=-1, q_const=1, q_b=-1)
+        return Cell(1, row)
+
+    # --- bit decomposition (Bits2NumChip, bits2num.rs:13) -----------------
+    def to_bits(self, a: Cell, num_bits: int) -> list:
+        """LSB-first boolean decomposition; constrains recomposition
+        Σ bᵢ·2ⁱ == a. The witness must actually fit in ``num_bits``."""
+        va = self.value(a)
+        if va >> num_bits:
+            raise EigenError("circuit_error",
+                             f"value does not fit in {num_bits} bits")
+        bits = []
+        for i in range(num_bits):
+            b = (va >> i) & 1
+            row = self.cs.add_row([b, b], q_mul_ab=1, q_a=-1)
+            self.cs.copy((0, row), (1, row))
+            bits.append(Cell(0, row))
+        # recomposition, MSB-first accumulator: acc ← 2·acc + bit
+        acc = self.constant(0)
+        for bit in reversed(bits):
+            vacc, vbit = self.value(acc), self.value(bit)
+            row = self._row([vacc, vbit, (2 * vacc + vbit) % R],
+                            {0: acc, 1: bit}, q_a=2, q_b=1, q_c=-1)
+            acc = Cell(2, row)
+        self.assert_equal(acc, a)
+        return bits
+
+    def from_bits(self, bits: Sequence[Cell]) -> Cell:
+        """Recompose LSB-first boolean cells into a value cell."""
+        acc = self.constant(0)
+        for bit in reversed(list(bits)):
+            vacc, vbit = self.value(acc), self.value(bit)
+            row = self._row([vacc, vbit, (2 * vacc + vbit) % R],
+                            {0: acc, 1: bit}, q_a=2, q_b=1, q_c=-1)
+            acc = Cell(2, row)
+        return acc
+
+    def range_check(self, a: Cell, num_bits: int) -> None:
+        """0 ≤ a < 2^num_bits (bit-decomposition range check; the
+        reference uses lookups, gadgets/range.rs)."""
+        self.to_bits(a, num_bits)
+
+    # --- comparison (LessEqualChipset, lt_eq.rs:22-114) -------------------
+    N_SHIFTED_BITS = 253
+
+    def less_than(self, a: Cell, b: Cell, num_bits: int = 252) -> Cell:
+        """Strict a < b for a, b < 2^num_bits (callers must range-check
+        inputs, as the reference does): decompose a + 2^num_bits − b and
+        return NOT of the top bit."""
+        if num_bits >= self.N_SHIFTED_BITS:
+            raise EigenError("circuit_error", "compare width too large")
+        va, vb = self.value(a), self.value(b)
+        shifted = (va + (1 << num_bits) - vb) % R
+        sh = self.lincomb([(1, a), (-1, b)], const=1 << num_bits)
+        assert self.value(sh) == shifted
+        bits = self.to_bits(sh, num_bits + 1)
+        return self.logic_not(bits[num_bits])
+
+    def less_eq(self, a: Cell, b: Cell, num_bits: int = 252) -> Cell:
+        """a ≤ b == NOT(b < a)."""
+        return self.logic_not(self.less_than(b, a, num_bits))
+
+    # --- sets (set.rs:11,153,284) -----------------------------------------
+    def set_membership(self, target: Cell, items: Sequence[Cell]) -> Cell:
+        """1 iff target ∈ items (SetChipset): is_zero(Π (itemᵢ − target))."""
+        prod = self.constant(1)
+        for item in items:
+            prod = self.mul(prod, self.sub(item, target))
+        return self.is_zero(prod)
+
+    def set_position(self, target: Cell, items: Sequence[Cell]) -> Cell:
+        """Index of ``target`` in ``items`` (SetPositionChip). Constrains
+        Σ eqᵢ = 1, so membership is enforced and the items visible to the
+        sum must be distinct at the match (true for address sets)."""
+        eqs = [self.is_equal(item, target) for item in items]
+        total = self.lincomb([(1, e) for e in eqs])
+        one = self.constant(1)
+        self.assert_equal(total, one)
+        return self.lincomb([(i, e) for i, e in enumerate(eqs)])
+
+    def select_item(self, index: Cell, items: Sequence[Cell]) -> Cell:
+        """items[index] (SelectItemChip): Σ is_eq(index, i)·itemᵢ with
+        Σ is_eq = 1."""
+        terms = []
+        eqs = []
+        for i, item in enumerate(items):
+            eq = self.is_equal(index, self.constant(i))
+            eqs.append(eq)
+            terms.append((1, self.mul(eq, item)))
+        total = self.lincomb([(1, e) for e in eqs])
+        self.assert_equal(total, self.constant(1))
+        return self.lincomb(terms)

@@ -70,15 +70,16 @@ class ConstraintSystem:
         return len(self.wires[0])
 
     def add_row(self, values=(), **selectors) -> int:
+        unknown = set(selectors) - set(SELECTORS)
+        if unknown:
+            raise EigenError("circuit_error", f"unknown selectors {unknown}")
         row = self.num_rows
         vals = [int(v) % R for v in values]
         vals += [0] * (NUM_WIRES - len(vals))
         for w in range(NUM_WIRES):
             self.wires[w].append(vals[w])
         for name in SELECTORS:
-            self.selectors[name].append(int(selectors.pop(name, 0)) % R)
-        if selectors:
-            raise EigenError("circuit_error", f"unknown selectors {selectors}")
+            self.selectors[name].append(int(selectors.get(name, 0)) % R)
         return row
 
     def copy(self, cell_a, cell_b) -> None:
@@ -180,11 +181,12 @@ class ProvingKey:
     def to_bytes(self) -> bytes:
         import json
 
+        # sigma_evals is derivable (fft of sigma_coeffs) — never persisted,
+        # so the two copies cannot disagree in a key file
         payload = {
             "k": self.k,
             "fixed": {name: coeffs for name, coeffs in self.fixed_coeffs.items()},
             "sigma": self.sigma_coeffs,
-            "sigma_evals": self.sigma_evals,
             "shifts": self.shifts,
             "public_rows": self.public_rows,
         }
@@ -195,7 +197,9 @@ class ProvingKey:
         import json
 
         p = json.loads(data.decode())
-        return cls(p["k"], p["fixed"], p["sigma"], p["sigma_evals"],
+        d = EvaluationDomain(p["k"])
+        sigma_evals = [d.fft(c) for c in p["sigma"]]
+        return cls(p["k"], p["fixed"], p["sigma"], sigma_evals,
                    p["shifts"], p["public_rows"])
 
 
@@ -348,7 +352,8 @@ def prove(params: KZGParams, pk: ProvingKey, cs: ConstraintSystem,
     z_vals = [1] * n
     for i in range(n - 1):
         z_vals[i + 1] = z_vals[i] * numer[i] % R * denom_inv[i] % R
-    assert z_vals[-1] * numer[-1] % R * denom_inv[-1] % R == 1, "perm wrap"
+    if z_vals[-1] * numer[-1] % R * denom_inv[-1] % R != 1:
+        raise EigenError("proving_error", "permutation grand product does not wrap")
     z_coeffs = _blind(d.ifft(z_vals), n, 3)
     z_commit = params.commit(z_coeffs)
     tr.absorb_point(z_commit)
@@ -399,8 +404,11 @@ def prove(params: KZGParams, pk: ProvingKey, cs: ConstraintSystem,
         t_evals_ext.append(total * zh_inv[i] % R)
 
     t_coeffs = de.coset_ifft(t_evals_ext, shift)
-    for c in t_coeffs[QUOTIENT_CHUNKS * n :]:
-        assert c == 0, "quotient degree overflow"
+    if any(c != 0 for c in t_coeffs[QUOTIENT_CHUNKS * n :]):
+        raise EigenError(
+            "proving_error",
+            "quotient degree overflow — witness does not satisfy the circuit",
+        )
     chunks = [t_coeffs[i * n : (i + 1) * n] for i in range(QUOTIENT_CHUNKS)]
     t_commits = [params.commit(ch) for ch in chunks]
     for cm in t_commits:
