@@ -84,5 +84,5 @@ class MerklePath:
             group = self.path_arr[i][: self.arity]
             inputs = group + [self.field.zero()] * (WIDTH - len(group))
             digest = self.hasher(inputs, WIDTH, self.field).finalize()[0]
-            ok &= digest in self.path_arr[i + 1]
+            ok &= digest in self.path_arr[i + 1][: self.arity]
         return ok

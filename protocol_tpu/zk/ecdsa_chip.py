@@ -1,0 +1,90 @@
+"""ECDSA verification chipset (secp256k1 over a BN254-native circuit).
+
+Circuit twin of the reference's ``EcdsaChipset``/``EcdsaAssigner``
+(``eigentrust-zk/src/ecdsa/mod.rs:317-530``) against the native oracle
+``protocol_tpu.crypto.secp256k1`` (itself mirroring
+``ecdsa/native.rs:382-395``):
+
+    s⁻¹·s ≡ 1 (mod n),  u₁ = z·s⁻¹,  u₂ = r·s⁻¹,
+    R = u₁·G + u₂·PK,   accept iff  R.x mod n == r.
+
+All checks are hard constraints — an invalid signature makes the circuit
+unsatisfiable. The client pipeline therefore nulls invalid attestations
+*before* witness generation (replacing them with dummy-signed empty
+entries), matching the end-to-end score semantics of the reference's
+null-then-redistribute rule (``opinion/native.rs:92-101``) while keeping
+the circuit shape static; see ``eigentrust_circuit.py``.
+
+Message-hash binding: the attestation hash is a native (Fr) cell; it is
+decomposed into limbs whose recomposition is copy-constrained to the
+cell and whose value is proven < r (canonical), so exactly one secp
+scalar can be claimed for a given hash cell.
+"""
+
+from __future__ import annotations
+
+from ..utils.errors import EigenError
+from ..utils.fields import BN254_FR_MODULUS
+from .ecc_chip import AssignedPoint, EccChip, secp256k1_spec
+from .gadgets import Cell, Chips
+from .integer_chip import AssignedInteger, IntegerChip
+
+R = BN254_FR_MODULUS
+
+
+class EcdsaChip:
+    """Shared sub-chips for verifying many signatures in one circuit."""
+
+    def __init__(self, chips: Chips):
+        self.chips = chips
+        self.spec = secp256k1_spec()
+        self.fp = IntegerChip(chips, self.spec.p)
+        self.fn = IntegerChip(chips, self.spec.n)
+        self.fr = IntegerChip(chips, R)  # only for canonical Fr binding
+        self.ecc = EccChip(chips, self.fp, self.spec, tag="secp256k1")
+
+    # --- assignment -------------------------------------------------------
+    def assign_pubkey(self, point: tuple) -> AssignedPoint:
+        return self.ecc.assign_point(point)
+
+    def assign_scalar(self, value: int) -> AssignedInteger:
+        """A canonical mod-n scalar witness (0 ≤ value < n)."""
+        if not 0 <= value < self.spec.n:
+            raise EigenError("circuit_error", "scalar out of range")
+        a = self.fn.assign(value)
+        self.fn.assert_canonical(a)
+        return a
+
+    def bind_native_scalar(self, cell: Cell) -> AssignedInteger:
+        """Decompose a native Fr cell into limbs usable as a secp scalar:
+        recomposition is copied to the cell and the value is proven < r,
+        so the representative is unique (r < n, so it is canonical mod n
+        too)."""
+        c = self.chips
+        value = c.value(cell)
+        limbs = self.fr.assign(value)
+        self.fr.assert_canonical(limbs)
+        c.assert_equal(self.fr.native(limbs), cell)
+        return AssignedInteger(limbs.limbs, limbs.value, limbs.max_limb)
+
+    # --- verification -----------------------------------------------------
+    def verify(self, sig_r: AssignedInteger, sig_s: AssignedInteger,
+               msg_hash: AssignedInteger, pubkey: AssignedPoint) -> None:
+        """Hard-constrain signature validity (EcdsaChipset::synthesize
+        twin, ecdsa/mod.rs:416-530)."""
+        fn, fp, ecc = self.fn, self.fp, self.ecc
+        fn.assert_not_zero(sig_r)
+        fn.assert_not_zero(sig_s)
+        s_inv = fn.div(fn.one(), sig_s)
+        u1 = fn.mul(msg_hash, s_inv)
+        u2 = fn.mul(sig_r, s_inv)
+        p1 = ecc.scalar_mul_fixed(fn.to_window_digits(u1))
+        p2 = ecc.scalar_mul(pubkey, fn.to_window_digits(u2))
+        r_pt = ecc.add(p1, p2)
+        # R.x (canonical mod p) reduced mod n must equal r
+        x_can = fp.reduce(r_pt.x)
+        fp.assert_canonical(x_can)
+        as_n = AssignedInteger(x_can.limbs, x_can.value, x_can.max_limb)
+        x_mod_n = fn.reduce(as_n)
+        fn.assert_canonical(x_mod_n)
+        fn.assert_equal(x_mod_n, sig_r)

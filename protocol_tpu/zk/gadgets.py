@@ -18,9 +18,10 @@ Differences from the reference, by design:
 - Gadgets are plain methods on a ``Chips`` builder rather than halo2
   Chip/Chipset structs — there is no region/layouter machinery to thread,
   because our ConstraintSystem is row-based and single-region.
-- Range checks decompose into boolean rows (1 row/bit) instead of the
-  reference's lookup tables (``gadgets/range.rs`` lookup range checks):
-  the proving stack has no lookup argument, so ranges cost O(bits) rows.
+- Range checks use the proving stack's LogUp lookup column when the
+  constraint system sets ``lookup_bits`` (the reference's range chips are
+  likewise lookup-based, ``gadgets/range.rs``), and fall back to boolean
+  decomposition (1 row/bit) otherwise.
 
 Every gadget returns a ``Cell`` whose witness value is already assigned;
 inputs are wired in with copy constraints, exactly like halo2's
@@ -54,6 +55,7 @@ class Chips:
 
     def __init__(self, cs: ConstraintSystem | None = None):
         self.cs = cs if cs is not None else ConstraintSystem()
+        self._const_cache: dict = {}
 
     # --- plumbing ---------------------------------------------------------
     def value(self, cell: Cell) -> int:
@@ -65,10 +67,17 @@ class Chips:
         return Cell(0, row)
 
     def constant(self, value: int) -> Cell:
-        """A cell constrained to equal ``value``: a − value = 0."""
+        """A cell constrained to equal ``value``: a − value = 0.
+        Memoized — repeated constants share one row (copy constraints
+        reference the same cell)."""
         value = int(value) % R
+        hit = self._const_cache.get(value)
+        if hit is not None:
+            return hit
         row = self.cs.add_row([value], q_a=1, q_const=-value)
-        return Cell(0, row)
+        cell = Cell(0, row)
+        self._const_cache[value] = cell
+        return cell
 
     def public(self, cell: Cell) -> int:
         """Expose ``cell`` as the next public input; returns its PI row."""
@@ -250,10 +259,52 @@ class Chips:
             acc = Cell(2, row)
         return acc
 
+    # --- range checks (lookup-backed when available, range.rs) ------------
+    def lookup(self, value: int) -> Cell:
+        """A fresh cell constrained to the range table
+        [0, 2^lookup_bits)."""
+        return Cell(*self.cs.lookup_row(value))
+
     def range_check(self, a: Cell, num_bits: int) -> None:
-        """0 ≤ a < 2^num_bits (bit-decomposition range check; the
-        reference uses lookups, gadgets/range.rs)."""
-        self.to_bits(a, num_bits)
+        """0 ≤ a < 2^num_bits. Uses lookup chunks when the constraint
+        system has a range table, boolean decomposition otherwise."""
+        lb = self.cs.lookup_bits
+        if not lb:
+            self.to_bits(a, num_bits)
+            return
+        va = self.value(a)
+        if va >> num_bits:
+            raise EigenError("circuit_error",
+                             f"value does not fit in {num_bits} bits")
+        terms = []
+        for i in range(0, num_bits, lb):
+            width = min(lb, num_bits - i)
+            cv = (va >> i) & ((1 << width) - 1)
+            chunk = self.lookup(cv)
+            if width < lb:
+                # partial chunk: also look up cv·2^(lb−width), which is in
+                # the table iff cv < 2^width
+                shifted = self.lookup(cv << (lb - width))
+                self.assert_equal(self.mul_const(chunk, 1 << (lb - width)),
+                                  shifted)
+            terms.append((1 << i, chunk))
+        self.assert_equal(self.lincomb(terms), a)
+
+    def split_high(self, a: Cell, num_bits: int) -> tuple:
+        """For a < 2^(num_bits+1): a = top·2^num_bits + rest with top
+        boolean and rest range-checked; returns (top, rest)."""
+        va = self.value(a)
+        top, rest = va >> num_bits, va & ((1 << num_bits) - 1)
+        if top > 1:
+            raise EigenError("circuit_error",
+                             f"value does not fit in {num_bits}+1 bits")
+        top_c = self.witness(top)
+        self.assert_bool(top_c)
+        rest_c = self.witness(rest)
+        self.range_check(rest_c, num_bits)
+        self.assert_equal(
+            self.lincomb([(1 << num_bits, top_c), (1, rest_c)]), a)
+        return top_c, rest_c
 
     # --- comparison (LessEqualChipset, lt_eq.rs:22-114) -------------------
     N_SHIFTED_BITS = 253
@@ -264,12 +315,9 @@ class Chips:
         return NOT of the top bit."""
         if num_bits >= self.N_SHIFTED_BITS:
             raise EigenError("circuit_error", "compare width too large")
-        va, vb = self.value(a), self.value(b)
-        shifted = (va + (1 << num_bits) - vb) % R
         sh = self.lincomb([(1, a), (-1, b)], const=1 << num_bits)
-        assert self.value(sh) == shifted
-        bits = self.to_bits(sh, num_bits + 1)
-        return self.logic_not(bits[num_bits])
+        top, _ = self.split_high(sh, num_bits)
+        return self.logic_not(top)
 
     def less_eq(self, a: Cell, b: Cell, num_bits: int = 252) -> Cell:
         """a ≤ b == NOT(b < a)."""

@@ -1,4 +1,4 @@
-"""PLONK proving system over the framework's main gate.
+"""PLONK proving system over the framework's main gate, with lookups.
 
 The reference proves with halo2 (PSE fork): a PLONKish arithmetization
 whose core is the ``MainChip`` 5-advice/8-fixed gate
@@ -7,13 +7,22 @@ whose core is the ``MainChip`` 5-advice/8-fixed gate
     q_a·a + q_b·b + q_c·c + q_d·d + q_e·e
       + q_mul_ab·a·b + q_mul_cd·c·d + q_const = 0
 
-plus equality (copy) constraints and instance columns. This module is a
-from-scratch implementation of that proving stack shape on the
-framework's own KZG/BN254 layer (``kzg.py``/``bn254.py``):
+plus equality (copy) constraints, instance columns, and halo2's lookup
+argument (the reference's range chips are lookup-based,
+``gadgets/range.rs``). This module is a from-scratch implementation of
+that proving-stack shape on the framework's own KZG/BN254 layer
+(``kzg.py``/``bn254.py``):
 
 - the same 5-wire main gate (so every MainChip-style gadget ports 1:1),
-- copy constraints via the PLONK permutation argument (5-coset grand
-  product),
+- a 6th wire reserved as the **lookup input column**: every row's wire-5
+  value must appear in a fixed range table [0, 2^lookup_bits). Rows that
+  don't use the lookup leave it 0. The argument is LogUp (log-derivative
+  lookups): Σ 1/(β+aᵢ) = Σ mᵢ/(β+tᵢ) enforced through a running-sum
+  column φ and a multiplicity column m — two extra commitments, same
+  power as halo2's sorted-permutation lookup with simpler bookkeeping.
+- copy constraints via the PLONK permutation argument (6-coset grand
+  product; the lookup wire participates, so range-checked cells can be
+  copy-wired like any other),
 - public inputs as a PI(X) polynomial folded into the gate,
 - GWC-style batched KZG openings at {x, ωx},
 - Poseidon Fiat–Shamir transcript (``transcript.py``),
@@ -46,9 +55,10 @@ from .transcript import PoseidonTranscript
 R = BN254_FR_MODULUS
 
 SELECTORS = ("q_a", "q_b", "q_c", "q_d", "q_e", "q_mul_ab", "q_mul_cd", "q_const")
-NUM_WIRES = 5
-QUOTIENT_CHUNKS = 6
-MIN_K = 3  # quotient degree bound 5n+7 < 6n needs n ≥ 8
+NUM_WIRES = 6  # 5 gate wires + 1 lookup input column
+LOOKUP_WIRE = 5
+QUOTIENT_CHUNKS = 7  # permutation term degree: z · 6 wire factors ≈ 7n
+MIN_K = 4  # t degree ≈ 6n+9 must stay under 7n
 
 
 class ConstraintSystem:
@@ -56,14 +66,17 @@ class ConstraintSystem:
 
     Cells are (wire, row) pairs. ``add_row`` appends a gate row; wires
     default to 0 and selectors to 0, so padding rows trivially satisfy
-    the gate.
+    the gate. Wire 5 is the lookup input column: if ``lookup_bits`` is
+    set, every row's wire-5 value must lie in [0, 2^lookup_bits); when
+    unset the only legal value is 0 (the table is {0}).
     """
 
-    def __init__(self):
+    def __init__(self, lookup_bits: int | None = None):
         self.wires: list = [[] for _ in range(NUM_WIRES)]
         self.selectors: dict = {name: [] for name in SELECTORS}
         self.copies: list = []
         self.public_rows: list = []  # (row, value); value lives in wire 0
+        self.lookup_bits = lookup_bits
 
     @property
     def num_rows(self) -> int:
@@ -81,6 +94,13 @@ class ConstraintSystem:
         for name in SELECTORS:
             self.selectors[name].append(int(selectors.get(name, 0)) % R)
         return row
+
+    def lookup_row(self, value: int) -> tuple:
+        """A fresh row whose wire-5 cell carries ``value`` (so it is
+        constrained to the range table); returns that cell."""
+        value = int(value) % R
+        row = self.add_row([0, 0, 0, 0, 0, value])
+        return (LOOKUP_WIRE, row)
 
     def copy(self, cell_a, cell_b) -> None:
         """Equality-constrain two cells; values must already agree."""
@@ -105,14 +125,15 @@ class ConstraintSystem:
 
     # --- MockProver twin --------------------------------------------------
     def check_satisfied(self, public_inputs=None) -> None:
-        """Raise EigenError on the first unsatisfied row/copy/public."""
+        """Raise EigenError on the first unsatisfied row/copy/public/lookup."""
         pubs = list(public_inputs) if public_inputs is not None else self.public_values()
         if len(pubs) != len(self.public_rows):
             raise EigenError("circuit_error", "public input arity mismatch")
         pi_by_row = dict(zip(self.public_rows, pubs))
         s = self.selectors
+        table_max = 1 << self.lookup_bits if self.lookup_bits else 1
         for i in range(self.num_rows):
-            a, b, c, d, e = (self.wires[w][i] for w in range(NUM_WIRES))
+            a, b, c, d, e, lk = (self.wires[w][i] for w in range(NUM_WIRES))
             acc = (
                 s["q_a"][i] * a + s["q_b"][i] * b + s["q_c"][i] * c
                 + s["q_d"][i] * d + s["q_e"][i] * e
@@ -122,6 +143,12 @@ class ConstraintSystem:
             ) % R
             if acc != 0:
                 raise EigenError("circuit_error", f"gate unsatisfied at row {i}")
+            if lk >= table_max:
+                raise EigenError(
+                    "circuit_error",
+                    f"lookup value at row {i} outside table "
+                    f"[0, {table_max})",
+                )
         for (wa, ra), (wb, rb) in self.copies:
             if self.wires[wa][ra] != self.wires[wb][rb]:
                 raise EigenError(
@@ -169,11 +196,12 @@ class ProvingKey:
     directly instead of checking committed evals)."""
 
     k: int
-    fixed_coeffs: dict  # selector name -> coeffs
+    fixed_coeffs: dict  # selector name -> coeffs (includes "t_lookup")
     sigma_coeffs: list  # per wire
     sigma_evals: list  # per wire, row form (for the prover's z build)
     shifts: list
     public_rows: list
+    lookup_bits: int | None
 
     def domain(self) -> EvaluationDomain:
         return EvaluationDomain(self.k)
@@ -189,6 +217,7 @@ class ProvingKey:
             "sigma": self.sigma_coeffs,
             "shifts": self.shifts,
             "public_rows": self.public_rows,
+            "lookup_bits": self.lookup_bits,
         }
         return json.dumps(payload).encode()
 
@@ -200,7 +229,17 @@ class ProvingKey:
         d = EvaluationDomain(p["k"])
         sigma_evals = [d.fft(c) for c in p["sigma"]]
         return cls(p["k"], p["fixed"], p["sigma"], sigma_evals,
-                   p["shifts"], p["public_rows"])
+                   p["shifts"], p["public_rows"], p.get("lookup_bits"))
+
+
+def _table_values(lookup_bits: int | None, n: int) -> list:
+    size = 1 << lookup_bits if lookup_bits else 1
+    if size > n:
+        raise EigenError(
+            "circuit_error",
+            f"lookup table 2^{lookup_bits} does not fit domain 2^k rows",
+        )
+    return list(range(size)) + [0] * (n - size)
 
 
 def keygen(cs: ConstraintSystem, k: int | None = None) -> ProvingKey:
@@ -209,6 +248,11 @@ def keygen(cs: ConstraintSystem, k: int | None = None) -> ProvingKey:
     rows = cs.num_rows
     if k is None:
         k = max(MIN_K, (max(rows, 1) - 1).bit_length())
+        if cs.lookup_bits:
+            k = max(k, cs.lookup_bits)
+    if k < MIN_K:
+        raise EigenError("circuit_error",
+                         f"k={k} below minimum domain size k={MIN_K}")
     n = 1 << k
     if rows > n:
         raise EigenError("circuit_error", f"{rows} rows exceed 2^{k}")
@@ -218,6 +262,7 @@ def keygen(cs: ConstraintSystem, k: int | None = None) -> ProvingKey:
     for name in SELECTORS:
         col = cs.selectors[name] + [0] * (n - rows)
         fixed_coeffs[name] = d.ifft(col)
+    fixed_coeffs["t_lookup"] = d.ifft(_table_values(cs.lookup_bits, n))
 
     # permutation: merge copy cycles with union-find + pointer swap
     shifts = _find_coset_shifts(n, NUM_WIRES)
@@ -252,28 +297,37 @@ def keygen(cs: ConstraintSystem, k: int | None = None) -> ProvingKey:
         sigma_coeffs.append(d.ifft(col))
 
     return ProvingKey(k, fixed_coeffs, sigma_coeffs, sigma_evals, shifts,
-                      list(cs.public_rows))
+                      list(cs.public_rows), cs.lookup_bits)
 
 
 # --- proof object ---------------------------------------------------------
 
 @dataclass
 class Proof:
-    wire_commits: list  # 5 G1
+    wire_commits: list  # 6 G1
+    m_commit: tuple  # lookup multiplicities
     z_commit: tuple
+    phi_commit: tuple  # lookup running sum
     t_commits: list  # QUOTIENT_CHUNKS G1
-    wire_evals: list  # 5 at x
+    wire_evals: list  # 6 at x
+    m_eval: int
     z_eval: int
     z_next_eval: int
+    phi_eval: int
+    phi_next_eval: int
     t_evals: list  # chunks at x
     w_x: tuple  # batch witness at x
     w_wx: tuple  # batch witness at ωx
 
     def to_bytes(self) -> bytes:
         out = []
-        for pt in self.wire_commits + [self.z_commit] + self.t_commits:
+        for pt in (self.wire_commits + [self.m_commit, self.z_commit,
+                                        self.phi_commit] + self.t_commits):
             out.append(g1_to_bytes(pt))
-        for v in self.wire_evals + [self.z_eval, self.z_next_eval] + self.t_evals:
+        for v in (self.wire_evals
+                  + [self.m_eval, self.z_eval, self.z_next_eval,
+                     self.phi_eval, self.phi_next_eval]
+                  + self.t_evals):
             out.append(int(v).to_bytes(32, "little"))
         out.append(g1_to_bytes(self.w_x))
         out.append(g1_to_bytes(self.w_wx))
@@ -281,10 +335,10 @@ class Proof:
 
     @classmethod
     def from_bytes(cls, data: bytes) -> "Proof":
-        npts = NUM_WIRES + 1 + QUOTIENT_CHUNKS
+        npts = NUM_WIRES + 3 + QUOTIENT_CHUNKS
         pts = [g1_from_bytes(data[i * 64 : (i + 1) * 64]) for i in range(npts)]
         off = npts * 64
-        nevals = NUM_WIRES + 2 + QUOTIENT_CHUNKS
+        nevals = NUM_WIRES + 5 + QUOTIENT_CHUNKS
         evals = [
             int.from_bytes(data[off + i * 32 : off + (i + 1) * 32], "little")
             for i in range(nevals)
@@ -292,10 +346,11 @@ class Proof:
         off += nevals * 32
         w_x = g1_from_bytes(data[off : off + 64])
         w_wx = g1_from_bytes(data[off + 64 : off + 128])
+        w = NUM_WIRES
         return cls(
-            pts[:NUM_WIRES], pts[NUM_WIRES], pts[NUM_WIRES + 1 :],
-            evals[:NUM_WIRES], evals[NUM_WIRES], evals[NUM_WIRES + 1],
-            evals[NUM_WIRES + 2 :], w_x, w_wx,
+            pts[:w], pts[w], pts[w + 1], pts[w + 2], pts[w + 3 :],
+            evals[:w], evals[w], evals[w + 1], evals[w + 2], evals[w + 3],
+            evals[w + 4], evals[w + 5 :], w_x, w_wx,
         )
 
 
@@ -328,16 +383,30 @@ def prove(params: KZGParams, pk: ProvingKey, cs: ConstraintSystem,
     for v in pubs:
         tr.absorb_fr(v)
 
-    # round 1: wire polynomials
+    # round 1: wire polynomials + lookup multiplicities
     wire_vals = [col + [0] * (n - cs.num_rows) for col in cs.wires]
     wire_coeffs = [_blind(d.ifft(col), n, 2) for col in wire_vals]
     wire_commits = [params.commit(c) for c in wire_coeffs]
     for cm in wire_commits:
         tr.absorb_point(cm)
+
+    table = _table_values(pk.lookup_bits, n)
+    table_size = 1 << pk.lookup_bits if pk.lookup_bits else 1
+    m_vals = [0] * n
+    for v in wire_vals[LOOKUP_WIRE]:
+        if v >= table_size:
+            raise EigenError("proving_error",
+                             f"lookup value {v} outside range table")
+        m_vals[v] += 1  # table[i] = i for i < table_size; zeros pool at row 0
+    m_coeffs = _blind(d.ifft(m_vals), n, 2)
+    m_commit = params.commit(m_coeffs)
+    tr.absorb_point(m_commit)
+
     beta = tr.challenge()
     gamma = tr.challenge()
+    beta_lk = tr.challenge()
 
-    # round 2: permutation grand product
+    # round 2a: permutation grand product
     omegas = d.elements()
     numer = [1] * n
     denom = [1] * n
@@ -357,6 +426,21 @@ def prove(params: KZGParams, pk: ProvingKey, cs: ConstraintSystem,
     z_coeffs = _blind(d.ifft(z_vals), n, 3)
     z_commit = params.commit(z_coeffs)
     tr.absorb_point(z_commit)
+
+    # round 2b: LogUp running sum φ: φ₀ = 0,
+    # φ_{i+1} = φ_i + 1/(β_lk + aᵢ) − mᵢ/(β_lk + tᵢ); wraps to 0.
+    a_col = wire_vals[LOOKUP_WIRE]
+    inv_a = _batch_inv([(beta_lk + v) % R for v in a_col])
+    inv_t = _batch_inv([(beta_lk + v) % R for v in table])
+    phi_vals = [0] * n
+    for i in range(n - 1):
+        phi_vals[i + 1] = (phi_vals[i] + inv_a[i] - m_vals[i] * inv_t[i]) % R
+    if (phi_vals[-1] + inv_a[-1] - m_vals[-1] * inv_t[-1]) % R != 0:
+        raise EigenError("proving_error", "lookup running sum does not wrap")
+    phi_coeffs = _blind(d.ifft(phi_vals), n, 3)
+    phi_commit = params.commit(phi_coeffs)
+    tr.absorb_point(phi_commit)
+
     alpha = tr.challenge()
 
     # round 3: quotient on an 8n coset
@@ -370,6 +454,10 @@ def prove(params: KZGParams, pk: ProvingKey, cs: ConstraintSystem,
     z_e = ext(z_coeffs)
     zw_coeffs = [c * pow(d.omega, i, R) % R for i, c in enumerate(z_coeffs)]
     zw_e = ext(zw_coeffs)
+    m_e = ext(m_coeffs)
+    phi_e = ext(phi_coeffs)
+    phiw_coeffs = [c * pow(d.omega, i, R) % R for i, c in enumerate(phi_coeffs)]
+    phiw_e = ext(phiw_coeffs)
     fixed_e = {name: ext(c) for name, c in pk.fixed_coeffs.items()}
     sigma_e = [ext(c) for c in pk.sigma_coeffs]
     pi_e = ext(d.ifft(_pi_evals(pk.public_rows, pubs, n)))
@@ -385,7 +473,7 @@ def prove(params: KZGParams, pk: ProvingKey, cs: ConstraintSystem,
 
     t_evals_ext = []
     for i in range(de.n):
-        a, b, c, dd, e = (wires_e[w][i] for w in range(NUM_WIRES))
+        a, b, c, dd, e = (wires_e[w][i] for w in range(5))
         gate = (
             fixed_e["q_a"][i] * a + fixed_e["q_b"][i] * b + fixed_e["q_c"][i] * c
             + fixed_e["q_d"][i] * dd + fixed_e["q_e"][i] * e
@@ -400,7 +488,17 @@ def prove(params: KZGParams, pk: ProvingKey, cs: ConstraintSystem,
             pd = pd * ((wv + beta * sigma_e[w][i] + gamma) % R) % R
         perm = (pn - pd) % R
         l0 = zh[i] * l0_den[i] % R
-        total = (gate + alpha * perm + alpha * alpha % R * l0 * (z_e[i] - 1)) % R
+        # LogUp: (φω − φ)(β+a)(β+t) − (β+t) + m(β+a) = 0 on H
+        ba = (beta_lk + wires_e[LOOKUP_WIRE][i]) % R
+        bt = (beta_lk + fixed_e["t_lookup"][i]) % R
+        lk = ((phiw_e[i] - phi_e[i]) * ba % R * bt - bt + m_e[i] * ba) % R
+        total = (
+            gate
+            + alpha * perm
+            + alpha * alpha % R * l0 * ((z_e[i] - 1) % R)
+            + pow(alpha, 3, R) * lk
+            + pow(alpha, 4, R) * l0 * phi_e[i]
+        ) % R
         t_evals_ext.append(total * zh_inv[i] % R)
 
     t_coeffs = de.coset_ifft(t_evals_ext, shift)
@@ -417,10 +515,14 @@ def prove(params: KZGParams, pk: ProvingKey, cs: ConstraintSystem,
 
     # round 4: evaluations
     wire_evals = [poly_eval(c, zeta) for c in wire_coeffs]
+    m_eval = poly_eval(m_coeffs, zeta)
     z_eval = poly_eval(z_coeffs, zeta)
     z_next = poly_eval(z_coeffs, zeta * d.omega % R)
+    phi_eval = poly_eval(phi_coeffs, zeta)
+    phi_next = poly_eval(phi_coeffs, zeta * d.omega % R)
     t_evals = [poly_eval(ch, zeta) for ch in chunks]
-    for v in wire_evals + [z_eval, z_next] + t_evals:
+    for v in (wire_evals + [m_eval, z_eval, z_next, phi_eval, phi_next]
+              + t_evals):
         tr.absorb_fr(v)
     v_ch = tr.challenge()
     tr.challenge()  # u: verifier-side cross-point fold; squeezed here only
@@ -428,12 +530,13 @@ def prove(params: KZGParams, pk: ProvingKey, cs: ConstraintSystem,
 
     openings = open_batch(
         params,
-        [(zeta, wire_coeffs + [z_coeffs] + chunks),
-         (zeta * d.omega % R, [z_coeffs])],
+        [(zeta, wire_coeffs + [m_coeffs, z_coeffs, phi_coeffs] + chunks),
+         (zeta * d.omega % R, [z_coeffs, phi_coeffs])],
         v_ch,
     )
-    proof = Proof(wire_commits, z_commit, t_commits, wire_evals, z_eval,
-                  z_next, t_evals, openings[0].witness, openings[1].witness)
+    proof = Proof(wire_commits, m_commit, z_commit, phi_commit, t_commits,
+                  wire_evals, m_eval, z_eval, z_next, phi_eval, phi_next,
+                  t_evals, openings[0].witness, openings[1].witness)
     return proof.to_bytes()
 
 
@@ -453,14 +556,20 @@ def verify(params: KZGParams, pk: ProvingKey, public_inputs, proof_bytes: bytes)
         tr.absorb_fr(v)
     for cm in proof.wire_commits:
         tr.absorb_point(cm)
+    tr.absorb_point(proof.m_commit)
     beta = tr.challenge()
     gamma = tr.challenge()
+    beta_lk = tr.challenge()
     tr.absorb_point(proof.z_commit)
+    tr.absorb_point(proof.phi_commit)
     alpha = tr.challenge()
     for cm in proof.t_commits:
         tr.absorb_point(cm)
     zeta = tr.challenge()
-    for v in proof.wire_evals + [proof.z_eval, proof.z_next_eval] + proof.t_evals:
+    for v in (proof.wire_evals
+              + [proof.m_eval, proof.z_eval, proof.z_next_eval,
+                 proof.phi_eval, proof.phi_next_eval]
+              + proof.t_evals):
         tr.absorb_fr(v)
     v_ch = tr.challenge()
     u_ch = tr.challenge()
@@ -476,7 +585,7 @@ def verify(params: KZGParams, pk: ProvingKey, public_inputs, proof_bytes: bytes)
     for row, value in zip(pk.public_rows, pubs):
         pi = (pi - value * lag[row]) % R
 
-    a, b, c, dd, e = proof.wire_evals
+    a, b, c, dd, e = proof.wire_evals[:5]
     gate = (
         fixed["q_a"] * a + fixed["q_b"] * b + fixed["q_c"] * c
         + fixed["q_d"] * dd + fixed["q_e"] * e
@@ -491,7 +600,17 @@ def verify(params: KZGParams, pk: ProvingKey, public_inputs, proof_bytes: bytes)
         pd = pd * ((wv + beta * sigma[w] + gamma) % R) % R
     perm = (pn - pd) % R
     l0 = zh * pow(n * (zeta - 1) % R, -1, R) % R
-    total = (gate + alpha * perm + alpha * alpha % R * l0 * (proof.z_eval - 1)) % R
+    ba = (beta_lk + proof.wire_evals[LOOKUP_WIRE]) % R
+    bt = (beta_lk + fixed["t_lookup"]) % R
+    lk = ((proof.phi_next_eval - proof.phi_eval) * ba % R * bt
+          - bt + proof.m_eval * ba) % R
+    total = (
+        gate
+        + alpha * perm
+        + alpha * alpha % R * l0 * ((proof.z_eval - 1) % R)
+        + pow(alpha, 3, R) * lk
+        + pow(alpha, 4, R) * l0 * proof.phi_eval
+    ) % R
 
     t_at_zeta = 0
     zn = pow(zeta, n, R)
@@ -505,9 +624,13 @@ def verify(params: KZGParams, pk: ProvingKey, public_inputs, proof_bytes: bytes)
     groups = [
         (zeta,
          [(cm, ev) for cm, ev in zip(proof.wire_commits, proof.wire_evals)]
-         + [(proof.z_commit, proof.z_eval)]
+         + [(proof.m_commit, proof.m_eval),
+            (proof.z_commit, proof.z_eval),
+            (proof.phi_commit, proof.phi_eval)]
          + [(cm, ev) for cm, ev in zip(proof.t_commits, proof.t_evals)]),
-        (zeta * d.omega % R, [(proof.z_commit, proof.z_next_eval)]),
+        (zeta * d.omega % R,
+         [(proof.z_commit, proof.z_next_eval),
+          (proof.phi_commit, proof.phi_next_eval)]),
     ]
     from .kzg import BatchOpening
 

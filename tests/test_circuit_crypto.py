@@ -1,0 +1,166 @@
+"""Wrong-field integer / ECC / ECDSA chipset tests — native-vs-circuit
+equivalence against the host oracles (SURVEY §4 pattern 2), mirroring the
+reference's inline chip tests (integer/native.rs, ecc/generic/mod.rs,
+ecdsa/mod.rs)."""
+
+import pytest
+
+from protocol_tpu.crypto.secp256k1 import AffinePoint, EcdsaKeypair, Signature
+from protocol_tpu.utils.errors import EigenError
+from protocol_tpu.utils.fields import Fr
+from protocol_tpu.zk.ecc_chip import EccChip, secp256k1_spec
+from protocol_tpu.zk.ecdsa_chip import EcdsaChip
+from protocol_tpu.zk.gadgets import Chips
+from protocol_tpu.zk.integer_chip import IntegerChip, from_limbs, to_limbs
+from protocol_tpu.zk.plonk import ConstraintSystem
+
+SPEC = secp256k1_spec()
+
+
+def fresh(lookup_bits=17):
+    return Chips(ConstraintSystem(lookup_bits=lookup_bits))
+
+
+class TestIntegerChip:
+    def test_limb_roundtrip(self):
+        v = 0xDEADBEEF << 180 | 0x12345
+        assert from_limbs(to_limbs(v)) == v
+
+    def test_mul_div_reduce_sub(self):
+        c = fresh()
+        fp = IntegerChip(c, SPEC.p)
+        a_v = 0x123456789ABCDEF_FEDCBA987654321 << 120 | 7
+        b_v = SPEC.p - 12345678901234567890
+        a, b = fp.assign(a_v), fp.assign(b_v)
+        prod = fp.mul(a, b)
+        assert prod.value == a_v * b_v % SPEC.p
+        quot = fp.div(prod, b)
+        assert quot.value % SPEC.p == a_v % SPEC.p
+        diff = fp.reduce(fp.sub(a, b))
+        assert diff.value % SPEC.p == (a_v - b_v) % SPEC.p
+        fp.assert_canonical(diff)
+        fp.assert_not_zero(a)
+        c.cs.check_satisfied()
+
+    def test_add_then_mul_requires_reduce_eventually(self):
+        c = fresh()
+        fp = IntegerChip(c, SPEC.p)
+        x = fp.assign(SPEC.p - 1)
+        for _ in range(3):
+            x = fp.add(x, x)
+        prod = fp.mul(fp.reduce(x), fp.reduce(x))
+        assert prod.value == pow((SPEC.p - 1) * 8, 2, SPEC.p)
+        c.cs.check_satisfied()
+
+    def test_tampered_product_limb_rejected(self):
+        c = fresh()
+        fp = IntegerChip(c, SPEC.p)
+        out = fp.mul(fp.assign(12345), fp.assign(67890))
+        c.cs.wires[out.limbs[0].wire][out.limbs[0].row] += 1
+        with pytest.raises(EigenError):
+            c.cs.check_satisfied()
+
+    def test_non_congruent_witness_rejected_at_build(self):
+        c = fresh()
+        fp = IntegerChip(c, SPEC.p)
+        a, b = fp.assign(3), fp.assign(5)
+        bad_out = fp.assign(16)
+        with pytest.raises(EigenError):
+            fp.constrain_mul(a, b, bad_out)
+
+    def test_window_digits_bind_to_limbs(self):
+        c = fresh()
+        fn = IntegerChip(c, SPEC.n)
+        v = 0xFEDCBA9876543210FEDCBA9876543210
+        digits = fn.to_window_digits(fn.assign(v))
+        got = sum(c.value(d) << (4 * i) for i, d in enumerate(digits))
+        assert got == v
+        c.cs.check_satisfied()
+
+
+class TestEccChip:
+    def test_add_double_match_host(self):
+        c = fresh()
+        fp = IntegerChip(c, SPEC.p)
+        ecc = EccChip(c, fp, SPEC, tag="secp256k1")
+        p1 = SPEC.mul(SPEC.gen, 0x1234567890ABCDEF)
+        p2 = SPEC.mul(SPEC.gen, 0xFEDCBA0987654321)
+        a1, a2 = ecc.assign_point(p1), ecc.assign_point(p2)
+        out = ecc.add(a1, a2)
+        assert (out.x.value % SPEC.p, out.y.value % SPEC.p) == SPEC.add(p1, p2)
+        dbl = ecc.double(a1)
+        host = AffinePoint(*p1).double()
+        assert (dbl.x.value % SPEC.p, dbl.y.value % SPEC.p) == (host.x, host.y)
+        c.cs.check_satisfied()
+
+    def test_off_curve_point_rejected(self):
+        c = fresh()
+        fp = IntegerChip(c, SPEC.p)
+        ecc = EccChip(c, fp, SPEC, tag="secp256k1")
+        with pytest.raises(EigenError):
+            ecc.assign_point((5, 5))
+
+    def test_scalar_mul_variable_and_fixed(self):
+        c = fresh()
+        chip = EcdsaChip(c)
+        k = 0xA1B2C3D4E5F60718293A4B5C6D7E8F90A1B2C3D4E5F60718293A4B5C6D7E8F
+        digits = chip.fn.to_window_digits(chip.fn.assign(k))
+        base = SPEC.mul(SPEC.gen, 0x31415926535897932384626433832795)
+        out = chip.ecc.scalar_mul(chip.ecc.assign_point(base), digits)
+        assert (out.x.value % SPEC.p, out.y.value % SPEC.p) == SPEC.mul(base, k)
+        outf = chip.ecc.scalar_mul_fixed(digits)
+        assert (outf.x.value % SPEC.p,
+                outf.y.value % SPEC.p) == SPEC.mul(SPEC.gen, k)
+        c.cs.check_satisfied()
+
+
+class TestEcdsaChip:
+    KEY = 0xDEADBEEFCAFE1234567890
+    MSG = Fr(987654321012345678901234567890)
+
+    def _verify(self, sig, msg, pk_point):
+        c = fresh()
+        chip = EcdsaChip(c)
+        z = chip.bind_native_scalar(c.witness(int(msg)))
+        chip.verify(chip.assign_scalar(sig.r), chip.assign_scalar(sig.s), z,
+                    chip.assign_pubkey(pk_point))
+        c.cs.check_satisfied()
+        return c
+
+    def test_valid_signature_satisfies(self):
+        kp = EcdsaKeypair(self.KEY)
+        sig = kp.sign(int(self.MSG))
+        c = self._verify(sig, self.MSG, (kp.public_key.point.x,
+                                         kp.public_key.point.y))
+        assert c.cs.num_rows < 300_000  # row-budget regression guard
+
+    def test_forged_signature_rejected(self):
+        kp = EcdsaKeypair(self.KEY)
+        sig = kp.sign(int(self.MSG))
+        bad = Signature(r=sig.r, s=(sig.s + 1) % SPEC.n, rec_id=sig.rec_id)
+        with pytest.raises(EigenError):
+            self._verify(bad, self.MSG, (kp.public_key.point.x,
+                                         kp.public_key.point.y))
+
+    def test_wrong_message_rejected(self):
+        kp = EcdsaKeypair(self.KEY)
+        sig = kp.sign(int(self.MSG))
+        with pytest.raises(EigenError):
+            self._verify(sig, Fr(int(self.MSG) + 1),
+                         (kp.public_key.point.x, kp.public_key.point.y))
+
+    def test_wrong_pubkey_rejected(self):
+        kp = EcdsaKeypair(self.KEY)
+        other = EcdsaKeypair(self.KEY + 1)
+        sig = kp.sign(int(self.MSG))
+        with pytest.raises(EigenError):
+            self._verify(sig, self.MSG, (other.public_key.point.x,
+                                         other.public_key.point.y))
+
+    def test_hash_binding_is_canonical(self):
+        c = fresh()
+        chip = EcdsaChip(c)
+        cell = c.witness(int(self.MSG))
+        bound = chip.bind_native_scalar(cell)
+        assert bound.value == int(self.MSG)
+        c.cs.check_satisfied()
