@@ -83,16 +83,40 @@ class ConstraintSystem:
         return len(self.wires[0])
 
     def add_row(self, values=(), **selectors) -> int:
-        unknown = set(selectors) - set(SELECTORS)
-        if unknown:
-            raise EigenError("circuit_error", f"unknown selectors {unknown}")
-        row = self.num_rows
-        vals = [int(v) % R for v in values]
-        vals += [0] * (NUM_WIRES - len(vals))
-        for w in range(NUM_WIRES):
-            self.wires[w].append(vals[w])
-        for name in SELECTORS:
-            self.selectors[name].append(int(selectors.get(name, 0)) % R)
+        # hot path: circuits run to millions of rows, so only the
+        # selectors actually passed are touched; all validation happens
+        # before any column is mutated
+        wires = self.wires
+        sel = self.selectors
+        if len(values) > NUM_WIRES:
+            raise EigenError("circuit_error",
+                             f"row takes at most {NUM_WIRES} values")
+        if selectors:
+            for name in selectors:
+                if name not in sel:
+                    raise EigenError("circuit_error",
+                                     f"unknown selector {name}")
+        row = len(wires[0])
+        i = 0
+        for v in values:
+            if type(v) is not int:
+                v = int(v)
+            if not 0 <= v < R:
+                v %= R
+            wires[i].append(v)
+            i += 1
+        while i < NUM_WIRES:
+            wires[i].append(0)
+            i += 1
+        for col in sel.values():
+            col.append(0)
+        if selectors:
+            for name, v in selectors.items():
+                if type(v) is not int:
+                    v = int(v)
+                if not 0 <= v < R:
+                    v %= R
+                sel[name][row] = v
         return row
 
     def lookup_row(self, value: int) -> tuple:

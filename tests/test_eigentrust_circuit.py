@@ -1,0 +1,168 @@
+"""EigenTrustSet circuit vs native twin — the reference's canonical
+equivalence test (``test_closed_graph_circuit``,
+``dynamic_sets/mod.rs:744-868``): run the native converge to produce
+public inputs, then require the circuit to be satisfied on them."""
+
+import pytest
+
+from protocol_tpu.crypto.poseidon import PoseidonSponge
+from protocol_tpu.crypto.secp256k1 import EcdsaKeypair, Signature
+from protocol_tpu.models.eigentrust import (
+    Attestation,
+    EigenTrustSet,
+    HASHER_WIDTH,
+    SignedAttestation,
+)
+from protocol_tpu.utils.errors import EigenError
+from protocol_tpu.utils.fields import Fr
+from protocol_tpu.zk.eigentrust_circuit import EigenTrustSetCircuit, ETWitness
+
+DOMAIN = Fr(42)
+
+
+def make_peers(count):
+    kps = [EcdsaKeypair(1000 + i) for i in range(count)]
+    addrs = [kp.public_key.to_address() for kp in kps]
+    return kps, addrs
+
+
+def attest(kp, about, value):
+    att = Attestation(about=about, domain=DOMAIN, value=Fr(value),
+                      message=Fr.zero())
+    return SignedAttestation(att, kp.sign(int(att.hash())))
+
+
+def build_fixture(n, scores_by_peer, kps, addrs):
+    """Native set + circuit witness from per-peer score rows."""
+    native = EigenTrustSet(n, 20, 1000, DOMAIN)
+    for a in addrs:
+        native.add_member(a)
+    witness_matrix = [[None] * n for _ in range(n)]
+    op_hashes = {}
+    for i, row in scores_by_peer.items():
+        signed_row = []
+        for j in range(n):
+            if j == len(addrs) or row[j] is None:
+                signed_row.append(None)
+                continue
+            sa = attest(kps[i], addrs[j], row[j])
+            signed_row.append(sa)
+            witness_matrix[i][j] = sa
+        op_hashes[i] = native.update_op(kps[i].public_key, signed_row)
+    pubkeys = [kps[i].public_key if i < len(kps) else None for i in range(n)]
+    witness = ETWitness(addresses=list(addrs), pubkeys=pubkeys,
+                        att_matrix=witness_matrix, domain=DOMAIN)
+    return native, witness, op_hashes
+
+
+def expected_opinions_hash(n, op_hashes):
+    """Global sponge: per-row op hash, absent rows = sponge over zeros."""
+    glob = PoseidonSponge(HASHER_WIDTH)
+    rows = []
+    for i in range(n):
+        if i in op_hashes:
+            rows.append(op_hashes[i])
+        else:
+            empty = PoseidonSponge(HASHER_WIDTH)
+            empty.update([Fr.zero()] * n)
+            rows.append(empty.squeeze())
+    glob.update(rows)
+    return glob.squeeze()
+
+
+class TestEigenTrustCircuit:
+    def test_closed_graph_circuit_n2(self):
+        """2 peers, full opinions — native scores satisfy the circuit."""
+        n = 2
+        kps, addrs = make_peers(n)
+        native, witness, op_hashes = build_fixture(
+            n, {0: [0, 700], 1: [400, 0]}, kps, addrs)
+        native_scores = native.converge()
+
+        circuit = EigenTrustSetCircuit(num_neighbours=n)
+        chips, pubs = circuit.build(witness)
+        chips.cs.check_satisfied()
+
+        assert pubs[:n] == [int(a) for a in addrs]
+        assert pubs[n : 2 * n] == [int(s) for s in native_scores]
+        assert pubs[2 * n] == int(DOMAIN)
+        assert pubs[2 * n + 1] == int(expected_opinions_hash(n, op_hashes))
+
+    def test_missing_opinion_redistributes(self):
+        """Peer 1 posts nothing: native redistribution must match."""
+        n = 3
+        kps, addrs = make_peers(n)
+        native, witness, op_hashes = build_fixture(
+            n, {0: [0, 500, 500], 2: [300, 700, 0]}, kps, addrs)
+        native_scores = native.converge()
+
+        chips, pubs = EigenTrustSetCircuit(num_neighbours=n).build(witness)
+        chips.cs.check_satisfied()
+        assert pubs[n : 2 * n] == [int(s) for s in native_scores]
+
+    def test_empty_slot(self):
+        """3-capacity set with only 2 members (slot 2 empty)."""
+        n = 3
+        kps, addrs = make_peers(2)
+        full_addrs = addrs + [Fr.zero()]
+        native = EigenTrustSet(n, 20, 1000, DOMAIN)
+        for a in addrs:
+            native.add_member(a)
+        witness_matrix = [[None] * n for _ in range(n)]
+        op_hashes = {}
+        for i, row in {0: [0, 900], 1: [800, 0]}.items():
+            signed = []
+            for j in range(n):
+                if j < 2 and row[j]:
+                    sa = attest(kps[i], full_addrs[j], row[j])
+                    signed.append(sa)
+                    witness_matrix[i][j] = sa
+                else:
+                    signed.append(None)
+            op_hashes[i] = native.update_op(kps[i].public_key, signed)
+        native_scores = native.converge()
+
+        witness = ETWitness(
+            addresses=full_addrs,
+            pubkeys=[kps[0].public_key, kps[1].public_key, None],
+            att_matrix=witness_matrix, domain=DOMAIN)
+        chips, pubs = EigenTrustSetCircuit(num_neighbours=n).build(witness)
+        chips.cs.check_satisfied()
+        assert pubs[n : 2 * n] == [int(s) for s in native_scores]
+        assert pubs[2 * n + 1] == int(expected_opinions_hash(n, op_hashes))
+
+    def test_forged_signature_nulled_like_native(self):
+        """A forged attestation is nulled at witness time; scores match a
+        native set whose validator nulls the same entry."""
+        n = 2
+        kps, addrs = make_peers(n)
+        native = EigenTrustSet(n, 20, 1000, DOMAIN)
+        for a in addrs:
+            native.add_member(a)
+        good = attest(kps[0], addrs[1], 600)
+        bad_att = Attestation(about=addrs[0], domain=DOMAIN, value=Fr(999),
+                              message=Fr.zero())
+        forged = SignedAttestation(
+            bad_att, Signature(r=good.signature.r, s=good.signature.s,
+                               rec_id=good.signature.rec_id))
+        native.update_op(kps[0].public_key, [None, good])
+        native.update_op(kps[1].public_key, [forged, None])
+        native_scores = native.converge()
+
+        witness = ETWitness(
+            addresses=list(addrs),
+            pubkeys=[kp.public_key for kp in kps],
+            att_matrix=[[None, good], [forged, None]], domain=DOMAIN)
+        chips, pubs = EigenTrustSetCircuit(num_neighbours=n).build(witness)
+        chips.cs.check_satisfied()
+        assert pubs[n : 2 * n] == [int(s) for s in native_scores]
+
+    def test_tampered_score_public_input_rejected(self):
+        n = 2
+        kps, addrs = make_peers(n)
+        _, witness, _ = build_fixture(n, {0: [0, 1], 1: [1, 0]}, kps, addrs)
+        chips, pubs = EigenTrustSetCircuit(num_neighbours=n).build(witness)
+        bad = list(pubs)
+        bad[n] = (bad[n] + 1) % Fr.MODULUS
+        with pytest.raises(EigenError):
+            chips.cs.check_satisfied(bad)
