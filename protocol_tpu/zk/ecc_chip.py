@@ -228,12 +228,15 @@ class EccChip:
         )
         return self.add(acc, self.constant_point(self.spec.neg(mass)))
 
-    def scalar_mul_fixed(self, digits: list) -> AssignedPoint:
-        """Fixed-base windowed mul of the generator: constant per-window
-        tables T_w[d] = (d·16^w)·G + C; 68 adds, zero in-circuit doubles."""
+    def scalar_mul_fixed(self, digits: list,
+                         base: tuple | None = None) -> AssignedPoint:
+        """Fixed-base windowed mul of a constant point (default: the
+        generator): constant per-window tables T_w[d] = (d·16^w)·base + C;
+        68 adds, zero in-circuit doubles."""
         if len(digits) != NUM_WINDOWS:
             raise EigenError("circuit_error", "expected 68 window digits")
-        tables = self._fixed_g_tables()
+        tables = self._fixed_tables_for(base if base is not None
+                                        else self.spec.gen)
         acc = self.constant_point(self.aux_init)
         for w, digit in enumerate(digits):
             acc = self.add(acc, self.select_point_const(digit, tables[w]))
@@ -243,16 +246,16 @@ class EccChip:
         )
         return self.add(acc, self.constant_point(self.spec.neg(mass)))
 
-    def _fixed_g_tables(self) -> list:
-        key = "G"
+    def _fixed_tables_for(self, base: tuple) -> list:
+        key = base
         if key not in self._fixed_tables:
             tables = []
             for w in range(NUM_WINDOWS):
-                base = self.spec.mul(
-                    self.spec.gen, pow(TABLE_SIZE, w, self.spec.n))
+                window_base = self.spec.mul(
+                    base, pow(TABLE_SIZE, w, self.spec.n))
                 row = [self.aux_c]
                 for d in range(1, TABLE_SIZE):
-                    row.append(self.spec.add(row[-1], base))
+                    row.append(self.spec.add(row[-1], window_base))
                 tables.append(row)
             self._fixed_tables[key] = tables
         return self._fixed_tables[key]

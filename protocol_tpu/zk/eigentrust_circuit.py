@@ -57,7 +57,6 @@ from ..utils.keccak import keccak256
 from .ecc_chip import AssignedPoint
 from .ecdsa_chip import EcdsaChip
 from .gadgets import Chips
-from .integer_chip import AssignedInteger, NUM_LIMBS
 from .plonk import ConstraintSystem
 from .poseidon_chip import PoseidonChip, PoseidonSpongeChip
 
@@ -100,12 +99,13 @@ class EigenTrustSetCircuit:
         self.lookup_bits = lookup_bits
 
     # --- witness preparation ---------------------------------------------
-    def _prepare_entry(self, signed, about: Fr, domain: Fr, pk: PublicKey):
+    def _prepare_entry(self, signed, about: Fr, domain: Fr, pk: PublicKey,
+                       dummy: EcdsaKeypair, dummy_sigs: dict):
         """Returns (value, message, sig, use_dummy) with invalid/missing
         entries replaced by the dummy-signed empty attestation — the
         native null rule (opinion/native.rs:92-101) applied at witness
-        time."""
-        dummy = dummy_keypair()
+        time. ``dummy_sigs`` caches the per-slot empty-attestation
+        signature (identical for every row)."""
         if signed is not None:
             att = signed.attestation
             if att.about != about or att.domain != domain:
@@ -116,8 +116,11 @@ class EigenTrustSetCircuit:
                                    pk).verify()
                 if ok:
                     return att.value, att.message, signed.signature, 0
-        empty = SignedAttestation.empty(domain, about=about).attestation
-        sig = dummy.sign(int(empty.hash()))
+        key = int(about)
+        if key not in dummy_sigs:
+            empty = SignedAttestation.empty(domain, about=about).attestation
+            dummy_sigs[key] = (empty, dummy.sign(int(empty.hash())))
+        empty, sig = dummy_sigs[key]
         return empty.value, empty.message, sig, 1
 
     # --- circuit construction --------------------------------------------
@@ -134,6 +137,7 @@ class EigenTrustSetCircuit:
         poseidon = PoseidonChip(chips, HASHER_WIDTH)
         ecdsa = EcdsaChip(chips)
         dummy = dummy_keypair()
+        dummy_sigs: dict = {}
         dummy_pk_pt = (dummy.public_key.point.x, dummy.public_key.point.y)
 
         # public-bound cells
@@ -163,7 +167,8 @@ class EigenTrustSetCircuit:
                     if witness.pubkeys[i] is not None else PublicKey())
             for j in range(n):
                 value, message, sig, use_dummy = self._prepare_entry(
-                    row[j], witness.addresses[j], witness.domain, pk_i)
+                    row[j], witness.addresses[j], witness.domain, pk_i,
+                    dummy, dummy_sigs)
                 value_cell = c.witness(int(value))
                 message_cell = c.witness(int(message))
                 att_hash = poseidon.hash(
@@ -171,7 +176,7 @@ class EigenTrustSetCircuit:
                      zero])
                 dummy_bit = c.witness(use_dummy)
                 c.assert_bool(dummy_bit)
-                pk_sel = _select_point(chips, dummy_bit, dummy_pk,
+                pk_sel = _select_point(ecdsa, dummy_bit, dummy_pk,
                                        pk_points[i])
                 ecdsa.verify(
                     ecdsa.assign_scalar(sig.r),
@@ -192,8 +197,12 @@ class EigenTrustSetCircuit:
                 zero if j == i else score_v[i][j]
                 for j in range(n)
             ]
-            row_sum = c.lincomb([(1, x) for x in fi])
-            empty = c.is_zero(row_sum)
+            # the native rule redistributes when EVERY entry is zero
+            # (native.rs:263-278 / models filter_peers_ops), not when the
+            # row merely sums to 0 mod r — entry-wise zero bits ANDed
+            zero_bits = [c.is_zero(x) for x in fi]
+            empty = c.is_equal(c.lincomb([(1, b) for b in zero_bits]),
+                               c.constant(n))
             for j in range(n):
                 redist = zero if j == i else valid[j]
                 chosen = c.select(empty, redist, fi[j])
@@ -245,16 +254,8 @@ class EigenTrustSetCircuit:
         return chips, chips.cs.public_values()
 
 
-def _select_point(chips: Chips, bit, a: AssignedPoint,
+def _select_point(ecdsa: EcdsaChip, bit, a: AssignedPoint,
                   b: AssignedPoint) -> AssignedPoint:
-    """bit ? a : b, coordinate-limb-wise (8 select rows)."""
-    coords = []
-    for coord in ("x", "y"):
-        ia = getattr(a, coord)
-        ib = getattr(b, coord)
-        limbs = [chips.select(bit, ia.limbs[i], ib.limbs[i])
-                 for i in range(NUM_LIMBS)]
-        value = ia.value if chips.value(bit) else ib.value
-        mx = [max(ia.max_limb[i], ib.max_limb[i]) for i in range(NUM_LIMBS)]
-        coords.append(AssignedInteger(limbs, value, mx))
-    return AssignedPoint(*coords)
+    """bit ? a : b via the integer chip's limb-wise select."""
+    return AssignedPoint(ecdsa.fp.select(bit, a.x, b.x),
+                         ecdsa.fp.select(bit, a.y, b.y))

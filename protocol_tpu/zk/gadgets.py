@@ -141,27 +141,49 @@ class Chips:
         return Cell(3, row)
 
     def lincomb(self, terms: Sequence[tuple[int, Cell]], const: int = 0) -> Cell:
-        """Σ kᵢ·cellᵢ + const, packed 4 terms per row (partial sum in the
-        5th wire), partials folded with add rows."""
+        """Σ kᵢ·cellᵢ + const, packed 3 terms per row with a running
+        accumulator chained through the 5th wire (no separate fold
+        rows)."""
         pending = list(terms)
         if not pending:
             return self.constant(const)
+        cs = self.cs
+        wires = cs.wires
+        copies = cs.copies
         acc: Cell | None = None
-        rem_const = const
+        acc_val = const
+        sel_names = ("q_a", "q_b", "q_c", "q_d")
         while pending:
-            chunk, pending = pending[:4], pending[4:]
-            partial_val = (sum(k * self.value(c) for k, c in chunk)
-                           + rem_const) % R
-            vals = [self.value(c) for _, c in chunk]
-            vals += [0] * (4 - len(chunk))
-            vals.append(partial_val)
-            sels = {f"q_{'abcd'[i]}": k for i, (k, _) in enumerate(chunk)}
-            row = self.cs.add_row(vals, q_e=-1, q_const=rem_const, **sels)
-            for i, (_, c) in enumerate(chunk):
-                self.cs.copy(tuple(c), (i, row))
-            partial = Cell(4, row)
-            rem_const = 0
-            acc = partial if acc is None else self.add(acc, partial)
+            # slot 0 carries the accumulator (when one exists)
+            take = 4 if acc is None else 3
+            chunk, pending = pending[:take], pending[take:]
+            vals = []
+            sels = {"q_e": -1}
+            slot = 0
+            if acc is not None:
+                vals.append(acc_val)
+                sels["q_a"] = 1
+                slot = 1
+            else:
+                sels["q_const"] = const
+            for k, cell in chunk:
+                v = wires[cell[0]][cell[1]]
+                vals.append(v)
+                sels[sel_names[slot]] = k
+                acc_val += k * v
+                slot += 1
+            acc_val %= R
+            while len(vals) < 4:
+                vals.append(0)
+            vals.append(acc_val)
+            row = cs.add_row(vals, **sels)
+            base = 0
+            if acc is not None:
+                copies.append((tuple(acc), (0, row)))
+                base = 1
+            for i, (_, cell) in enumerate(chunk):
+                copies.append((tuple(cell), (base + i, row)))
+            acc = Cell(4, row)
         return acc
 
     # --- booleans ---------------------------------------------------------
@@ -265,6 +287,48 @@ class Chips:
         [0, 2^lookup_bits)."""
         return Cell(*self.cs.lookup_row(value))
 
+    def assign_range(self, value: int, num_bits: int) -> Cell:
+        """Witness ``value`` already constrained to [0, 2^num_bits), in
+        the fused row form: each row holds one lookup chunk in wire 5
+        (copied to a gate wire) and chains the recomposition accumulator
+        — ceil(bits/lookup_bits) rows total, the workhorse behind every
+        limb assignment."""
+        lb = self.cs.lookup_bits
+        if not lb:
+            cell = self.witness(value)
+            self.to_bits(cell, num_bits)
+            return cell
+        value = int(value)
+        if value < 0 or value >> num_bits:
+            raise EigenError("circuit_error",
+                             f"value does not fit in {num_bits} bits")
+        cs = self.cs
+        copies = cs.copies
+        acc_cell = None
+        acc_val = 0
+        for i in range(0, num_bits, lb):
+            width = min(lb, num_bits - i)
+            cv = (value >> i) & ((1 << width) - 1)
+            acc_new = acc_val + (cv << i)
+            if acc_cell is None:
+                row = cs.add_row([0, cv, acc_new, 0, 0, cv],
+                                 q_b=1 << i, q_c=-1)
+            else:
+                row = cs.add_row([acc_val, cv, acc_new, 0, 0, cv],
+                                 q_a=1, q_b=1 << i, q_c=-1)
+                copies.append((tuple(acc_cell), (0, row)))
+            copies.append(((1, row), (5, row)))
+            if width < lb:
+                # partial chunk: cv·2^(lb−width) must also be in the table
+                sh = cv << (lb - width)
+                row2 = cs.add_row([cv, sh, 0, 0, 0, sh],
+                                  q_a=1 << (lb - width), q_b=-1)
+                copies.append(((1, row), (0, row2)))
+                copies.append(((1, row2), (5, row2)))
+            acc_cell = Cell(2, row)
+            acc_val = acc_new
+        return acc_cell
+
     def range_check(self, a: Cell, num_bits: int) -> None:
         """0 ≤ a < 2^num_bits. Uses lookup chunks when the constraint
         system has a range table, boolean decomposition otherwise."""
@@ -272,23 +336,7 @@ class Chips:
         if not lb:
             self.to_bits(a, num_bits)
             return
-        va = self.value(a)
-        if va >> num_bits:
-            raise EigenError("circuit_error",
-                             f"value does not fit in {num_bits} bits")
-        terms = []
-        for i in range(0, num_bits, lb):
-            width = min(lb, num_bits - i)
-            cv = (va >> i) & ((1 << width) - 1)
-            chunk = self.lookup(cv)
-            if width < lb:
-                # partial chunk: also look up cv·2^(lb−width), which is in
-                # the table iff cv < 2^width
-                shifted = self.lookup(cv << (lb - width))
-                self.assert_equal(self.mul_const(chunk, 1 << (lb - width)),
-                                  shifted)
-            terms.append((1 << i, chunk))
-        self.assert_equal(self.lincomb(terms), a)
+        self.assert_equal(self.assign_range(self.value(a), num_bits), a)
 
     def split_high(self, a: Cell, num_bits: int) -> tuple:
         """For a < 2^(num_bits+1): a = top·2^num_bits + rest with top
@@ -300,8 +348,7 @@ class Chips:
                              f"value does not fit in {num_bits}+1 bits")
         top_c = self.witness(top)
         self.assert_bool(top_c)
-        rest_c = self.witness(rest)
-        self.range_check(rest_c, num_bits)
+        rest_c = self.assign_range(rest, num_bits)
         self.assert_equal(
             self.lincomb([(1 << num_bits, top_c), (1, rest_c)]), a)
         return top_c, rest_c

@@ -102,11 +102,8 @@ class IntegerChip:
         if value < 0 or value >= 1 << (3 * LIMB_BITS + self.top_bits):
             raise EigenError("circuit_error", "integer witness out of range")
         c = self.chips
-        limbs = []
-        for lv, bits in zip(to_limbs(value), limb_bits):
-            cell = c.witness(lv)
-            c.range_check(cell, bits)
-            limbs.append(cell)
+        limbs = [c.assign_range(lv, bits)
+                 for lv, bits in zip(to_limbs(value), limb_bits)]
         return AssignedInteger(limbs, value, [(1 << b) - 1 for b in limb_bits])
 
     def constant(self, value: int) -> AssignedInteger:
@@ -258,8 +255,7 @@ class IntegerChip:
             # max(pos_max, neg_max) + 2^(vb+136) < r (checked)
             if max(pos_max, neg_max) + (1 << (vb + CARRY_SHIFT)) >= R:
                 raise EigenError("circuit_error", "carry bound exceeds field")
-            v_shifted = c.witness(v_val + (1 << vb))
-            c.range_check(v_shifted, vb + 1)
+            v_shifted = c.assign_range(v_val + (1 << vb), vb + 1)
             c.assert_equal(
                 c.lincomb([(1 << CARRY_SHIFT, v_shifted)],
                           const=-(1 << (vb + CARRY_SHIFT))),
@@ -289,9 +285,7 @@ class IntegerChip:
         top_bits = max(1, q_max.bit_length() - 3 * LIMB_BITS)
         for i, lv in enumerate(to_limbs(q_val)):
             bits = LIMB_BITS if i < NUM_LIMBS - 1 else top_bits
-            cell = c.witness(lv)
-            c.range_check(cell, bits)
-            limbs.append(cell)
+            limbs.append(c.assign_range(lv, bits))
             mx.append((1 << bits) - 1)
         return AssignedInteger(limbs, q_val, mx)
 

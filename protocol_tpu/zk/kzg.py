@@ -178,10 +178,13 @@ def open_batch(params: KZGParams, groups, gamma: int) -> list:
     return out
 
 
-def verify_batch(params: KZGParams, groups, gamma: int, u: int,
-                 openings: list) -> bool:
+def fold_batch(groups, gamma: int, u: int, openings: list) -> tuple:
     """groups: [(z, [(commitment, claimed_eval), ...])]; γ folds within a
-    point, u folds across points; one pairing check total."""
+    point, u folds across points. Returns the KZG **accumulator**
+    (acc_l, acc_r): the pair satisfying the deferred pairing equation
+    e(acc_l, G2)·e(−acc_r, τG2) == 1 iff every opening is valid — the
+    GWC19 accumulation the reference's aggregator carries across proofs
+    (``verifier/aggregator/native.rs:140-187``)."""
     acc_l = None  # Σ uⁱ (zᵢ·Wᵢ + Fᵢ − yᵢ·G1)
     acc_r = None  # Σ uⁱ Wᵢ
     ui = 1
@@ -200,4 +203,15 @@ def verify_batch(params: KZGParams, groups, gamma: int, u: int,
         acc_l = g1_add(acc_l, g1_mul(term, ui))
         acc_r = g1_add(acc_r, g1_mul(opening.witness, ui))
         ui = ui * u % R
+    return acc_l, acc_r
+
+
+def decide(params: KZGParams, acc_l, acc_r) -> bool:
+    """The deferred pairing check on an accumulator."""
     return pairing_check([(acc_l, G2_GEN), (g1_neg(acc_r), params.s_g2)])
+
+
+def verify_batch(params: KZGParams, groups, gamma: int, u: int,
+                 openings: list) -> bool:
+    acc_l, acc_r = fold_batch(groups, gamma, u, openings)
+    return decide(params, acc_l, acc_r)

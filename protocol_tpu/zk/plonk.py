@@ -45,16 +45,18 @@ from ..utils.fields import BN254_FR_MODULUS
 from .domain import EvaluationDomain, poly_eval
 from .kzg import (
     KZGParams,
+    decide,
+    fold_batch,
     g1_from_bytes,
     g1_to_bytes,
     open_batch,
-    verify_batch,
 )
 from .transcript import PoseidonTranscript
 
 R = BN254_FR_MODULUS
 
 SELECTORS = ("q_a", "q_b", "q_c", "q_d", "q_e", "q_mul_ab", "q_mul_cd", "q_const")
+FIXED_NAMES = SELECTORS + ("t_lookup",)
 NUM_WIRES = 6  # 5 gate wires + 1 lookup input column
 LOOKUP_WIRE = 5
 QUOTIENT_CHUNKS = 7  # permutation term degree: z · 6 wire factors ≈ 7n
@@ -73,7 +75,9 @@ class ConstraintSystem:
 
     def __init__(self, lookup_bits: int | None = None):
         self.wires: list = [[] for _ in range(NUM_WIRES)]
-        self.selectors: dict = {name: [] for name in SELECTORS}
+        # sparse: selector name -> {row: value}; unset rows are 0 (the
+        # overwhelmingly common case at multi-million-row scale)
+        self.selectors: dict = {name: {} for name in SELECTORS}
         self.copies: list = []
         self.public_rows: list = []  # (row, value); value lives in wire 0
         self.lookup_bits = lookup_bits
@@ -108,15 +112,14 @@ class ConstraintSystem:
         while i < NUM_WIRES:
             wires[i].append(0)
             i += 1
-        for col in sel.values():
-            col.append(0)
         if selectors:
             for name, v in selectors.items():
                 if type(v) is not int:
                     v = int(v)
                 if not 0 <= v < R:
                     v %= R
-                sel[name][row] = v
+                if v:
+                    sel[name][row] = v
         return row
 
     def lookup_row(self, value: int) -> tuple:
@@ -153,28 +156,45 @@ class ConstraintSystem:
         pubs = list(public_inputs) if public_inputs is not None else self.public_values()
         if len(pubs) != len(self.public_rows):
             raise EigenError("circuit_error", "public input arity mismatch")
-        pi_by_row = dict(zip(self.public_rows, pubs))
         s = self.selectors
+        w0, w1, w2, w3, w4, w5 = self.wires
         table_max = 1 << self.lookup_bits if self.lookup_bits else 1
-        for i in range(self.num_rows):
-            a, b, c, d, e, lk = (self.wires[w][i] for w in range(NUM_WIRES))
-            acc = (
-                s["q_a"][i] * a + s["q_b"][i] * b + s["q_c"][i] * c
-                + s["q_d"][i] * d + s["q_e"][i] * e
-                + s["q_mul_ab"][i] * a * b + s["q_mul_cd"][i] * c * d
-                + s["q_const"][i]
-                - pi_by_row.get(i, 0)
-            ) % R
-            if acc != 0:
-                raise EigenError("circuit_error", f"gate unsatisfied at row {i}")
+        # rows with no selector entry satisfy the gate trivially: only
+        # touched rows accumulate (sparse walk, one pass per selector)
+        sums: dict = {}
+        get = sums.get
+        for i, v in s["q_a"].items():
+            sums[i] = get(i, 0) + v * w0[i]
+        for i, v in s["q_b"].items():
+            sums[i] = get(i, 0) + v * w1[i]
+        for i, v in s["q_c"].items():
+            sums[i] = get(i, 0) + v * w2[i]
+        for i, v in s["q_d"].items():
+            sums[i] = get(i, 0) + v * w3[i]
+        for i, v in s["q_e"].items():
+            sums[i] = get(i, 0) + v * w4[i]
+        for i, v in s["q_mul_ab"].items():
+            sums[i] = get(i, 0) + v * w0[i] * w1[i]
+        for i, v in s["q_mul_cd"].items():
+            sums[i] = get(i, 0) + v * w2[i] * w3[i]
+        for i, v in s["q_const"].items():
+            sums[i] = get(i, 0) + v
+        for row, value in zip(self.public_rows, pubs):
+            sums[row] = sums.get(row, 0) - int(value)
+        for i, acc in sums.items():
+            if acc % R:
+                raise EigenError("circuit_error",
+                                 f"gate unsatisfied at row {i}")
+        for i, lk in enumerate(w5):
             if lk >= table_max:
                 raise EigenError(
                     "circuit_error",
                     f"lookup value at row {i} outside table "
                     f"[0, {table_max})",
                 )
+        wires = self.wires
         for (wa, ra), (wb, rb) in self.copies:
-            if self.wires[wa][ra] != self.wires[wb][rb]:
+            if wires[wa][ra] != wires[wb][rb]:
                 raise EigenError(
                     "circuit_error", f"copy violated: ({wa},{ra}) vs ({wb},{rb})"
                 )
@@ -215,9 +235,12 @@ def _find_coset_shifts(n: int, count: int) -> list:
 
 @dataclass
 class ProvingKey:
-    """Keygen output; doubles as the verifying key (fixed and σ
-    polynomials are public circuit data — the verifier evaluates them
-    directly instead of checking committed evals)."""
+    """Keygen output; doubles as the verifying key. Fixed and σ
+    polynomials are committed at keygen (``vk_commits``) and their ζ
+    evaluations ride the proof's batched KZG opening — halo2's actual
+    protocol shape, and the property that makes succinct in-circuit
+    verification possible (the aggregator never evaluates a 2^k-degree
+    polynomial)."""
 
     k: int
     fixed_coeffs: dict  # selector name -> coeffs (includes "t_lookup")
@@ -226,9 +249,15 @@ class ProvingKey:
     shifts: list
     public_rows: list
     lookup_bits: int | None
+    vk_commits: dict  # FIXED_NAMES + "sigma_{w}" -> G1
 
     def domain(self) -> EvaluationDomain:
         return EvaluationDomain(self.k)
+
+    def commit_list(self) -> list:
+        """vk commitments in transcript/opening order."""
+        return ([self.vk_commits[name] for name in FIXED_NAMES]
+                + [self.vk_commits[f"sigma_{w}"] for w in range(NUM_WIRES)])
 
     def to_bytes(self) -> bytes:
         import json
@@ -242,6 +271,8 @@ class ProvingKey:
             "shifts": self.shifts,
             "public_rows": self.public_rows,
             "lookup_bits": self.lookup_bits,
+            "vk_commits": {name: g1_to_bytes(pt).hex()
+                           for name, pt in self.vk_commits.items()},
         }
         return json.dumps(payload).encode()
 
@@ -252,8 +283,11 @@ class ProvingKey:
         p = json.loads(data.decode())
         d = EvaluationDomain(p["k"])
         sigma_evals = [d.fft(c) for c in p["sigma"]]
+        commits = {name: g1_from_bytes(bytes.fromhex(h))
+                   for name, h in p["vk_commits"].items()}
         return cls(p["k"], p["fixed"], p["sigma"], sigma_evals,
-                   p["shifts"], p["public_rows"], p.get("lookup_bits"))
+                   p["shifts"], p["public_rows"], p.get("lookup_bits"),
+                   commits)
 
 
 def _table_values(lookup_bits: int | None, n: int) -> list:
@@ -266,9 +300,11 @@ def _table_values(lookup_bits: int | None, n: int) -> list:
     return list(range(size)) + [0] * (n - size)
 
 
-def keygen(cs: ConstraintSystem, k: int | None = None) -> ProvingKey:
-    """Fixed/σ polynomial construction (halo2 ``keygen_pk`` equivalent,
-    reference ``utils.rs:174-186``)."""
+def keygen(params: KZGParams, cs: ConstraintSystem,
+           k: int | None = None) -> ProvingKey:
+    """Fixed/σ polynomial construction + vk commitments (halo2
+    ``keygen_pk`` equivalent, reference ``utils.rs:174-186``; same
+    params-first argument order)."""
     rows = cs.num_rows
     if k is None:
         k = max(MIN_K, (max(rows, 1) - 1).bit_length())
@@ -284,7 +320,9 @@ def keygen(cs: ConstraintSystem, k: int | None = None) -> ProvingKey:
 
     fixed_coeffs = {}
     for name in SELECTORS:
-        col = cs.selectors[name] + [0] * (n - rows)
+        col = [0] * n
+        for i, v in cs.selectors[name].items():
+            col[i] = v
         fixed_coeffs[name] = d.ifft(col)
     fixed_coeffs["t_lookup"] = d.ifft(_table_values(cs.lookup_bits, n))
 
@@ -320,8 +358,13 @@ def keygen(cs: ConstraintSystem, k: int | None = None) -> ProvingKey:
         sigma_evals.append(col)
         sigma_coeffs.append(d.ifft(col))
 
+    vk_commits = {name: params.commit(fixed_coeffs[name])
+                  for name in FIXED_NAMES}
+    for w in range(NUM_WIRES):
+        vk_commits[f"sigma_{w}"] = params.commit(sigma_coeffs[w])
+
     return ProvingKey(k, fixed_coeffs, sigma_coeffs, sigma_evals, shifts,
-                      list(cs.public_rows), cs.lookup_bits)
+                      list(cs.public_rows), cs.lookup_bits, vk_commits)
 
 
 # --- proof object ---------------------------------------------------------
@@ -340,6 +383,8 @@ class Proof:
     phi_eval: int
     phi_next_eval: int
     t_evals: list  # chunks at x
+    fixed_evals: list  # FIXED_NAMES at x (9)
+    sigma_zeta: list  # σ_w at x (6)
     w_x: tuple  # batch witness at x
     w_wx: tuple  # batch witness at ωx
 
@@ -351,7 +396,7 @@ class Proof:
         for v in (self.wire_evals
                   + [self.m_eval, self.z_eval, self.z_next_eval,
                      self.phi_eval, self.phi_next_eval]
-                  + self.t_evals):
+                  + self.t_evals + self.fixed_evals + self.sigma_zeta):
             out.append(int(v).to_bytes(32, "little"))
         out.append(g1_to_bytes(self.w_x))
         out.append(g1_to_bytes(self.w_wx))
@@ -362,7 +407,8 @@ class Proof:
         npts = NUM_WIRES + 3 + QUOTIENT_CHUNKS
         pts = [g1_from_bytes(data[i * 64 : (i + 1) * 64]) for i in range(npts)]
         off = npts * 64
-        nevals = NUM_WIRES + 5 + QUOTIENT_CHUNKS
+        nf = len(FIXED_NAMES)
+        nevals = NUM_WIRES + 5 + QUOTIENT_CHUNKS + nf + NUM_WIRES
         evals = [
             int.from_bytes(data[off + i * 32 : off + (i + 1) * 32], "little")
             for i in range(nevals)
@@ -371,10 +417,12 @@ class Proof:
         w_x = g1_from_bytes(data[off : off + 64])
         w_wx = g1_from_bytes(data[off + 64 : off + 128])
         w = NUM_WIRES
+        qe = w + 5 + QUOTIENT_CHUNKS
         return cls(
             pts[:w], pts[w], pts[w + 1], pts[w + 2], pts[w + 3 :],
             evals[:w], evals[w], evals[w + 1], evals[w + 2], evals[w + 3],
-            evals[w + 4], evals[w + 5 :], w_x, w_wx,
+            evals[w + 4], evals[w + 5 : qe], evals[qe : qe + nf],
+            evals[qe + nf :], w_x, w_wx,
         )
 
 
@@ -537,7 +585,9 @@ def prove(params: KZGParams, pk: ProvingKey, cs: ConstraintSystem,
         tr.absorb_point(cm)
     zeta = tr.challenge()
 
-    # round 4: evaluations
+    # round 4: evaluations (witness polys + the vk's fixed/σ polys — the
+    # verifier checks the latter against the keygen commitments instead
+    # of evaluating degree-2^k polynomials itself)
     wire_evals = [poly_eval(c, zeta) for c in wire_coeffs]
     m_eval = poly_eval(m_coeffs, zeta)
     z_eval = poly_eval(z_coeffs, zeta)
@@ -545,8 +595,11 @@ def prove(params: KZGParams, pk: ProvingKey, cs: ConstraintSystem,
     phi_eval = poly_eval(phi_coeffs, zeta)
     phi_next = poly_eval(phi_coeffs, zeta * d.omega % R)
     t_evals = [poly_eval(ch, zeta) for ch in chunks]
+    fixed_evals = [poly_eval(pk.fixed_coeffs[name], zeta)
+                   for name in FIXED_NAMES]
+    sigma_zeta = [poly_eval(c, zeta) for c in pk.sigma_coeffs]
     for v in (wire_evals + [m_eval, z_eval, z_next, phi_eval, phi_next]
-              + t_evals):
+              + t_evals + fixed_evals + sigma_zeta):
         tr.absorb_fr(v)
     v_ch = tr.challenge()
     tr.challenge()  # u: verifier-side cross-point fold; squeezed here only
@@ -554,26 +607,35 @@ def prove(params: KZGParams, pk: ProvingKey, cs: ConstraintSystem,
 
     openings = open_batch(
         params,
-        [(zeta, wire_coeffs + [m_coeffs, z_coeffs, phi_coeffs] + chunks),
+        [(zeta, wire_coeffs + [m_coeffs, z_coeffs, phi_coeffs] + chunks
+          + [pk.fixed_coeffs[name] for name in FIXED_NAMES]
+          + list(pk.sigma_coeffs)),
          (zeta * d.omega % R, [z_coeffs, phi_coeffs])],
         v_ch,
     )
     proof = Proof(wire_commits, m_commit, z_commit, phi_commit, t_commits,
                   wire_evals, m_eval, z_eval, z_next, phi_eval, phi_next,
-                  t_evals, openings[0].witness, openings[1].witness)
+                  t_evals, fixed_evals, sigma_zeta,
+                  openings[0].witness, openings[1].witness)
     return proof.to_bytes()
 
 
-def verify(params: KZGParams, pk: ProvingKey, public_inputs, proof_bytes: bytes) -> bool:
+def succinct_verify(pk: ProvingKey, public_inputs, proof_bytes: bytes):
+    """The full verifier computation except the final pairing: returns
+    the KZG accumulator (acc_l, acc_r), or None when any algebraic check
+    fails. Needs no SRS — only the pairing decider does. This is the
+    seam the aggregator (native and in-circuit) re-runs
+    (snark-verifier's ``succinctly_verify`` shape,
+    ``verifier/aggregator/native.rs:140-187``)."""
     try:
         proof = Proof.from_bytes(proof_bytes)
     except (ValueError, IndexError):
-        return False
+        return None
     d = pk.domain()
     n = d.n
     pubs = [int(v) % R for v in public_inputs]
     if len(pubs) != len(pk.public_rows):
-        return False
+        return None
 
     tr = PoseidonTranscript()
     for v in pubs:
@@ -593,17 +655,18 @@ def verify(params: KZGParams, pk: ProvingKey, public_inputs, proof_bytes: bytes)
     for v in (proof.wire_evals
               + [proof.m_eval, proof.z_eval, proof.z_next_eval,
                  proof.phi_eval, proof.phi_next_eval]
-              + proof.t_evals):
+              + proof.t_evals + proof.fixed_evals + proof.sigma_zeta):
         tr.absorb_fr(v)
     v_ch = tr.challenge()
     u_ch = tr.challenge()
 
-    # fixed/σ/PI evaluations from public key material
-    fixed = {name: poly_eval(c, zeta) for name, c in pk.fixed_coeffs.items()}
-    sigma = [poly_eval(c, zeta) for c in pk.sigma_coeffs]
+    # fixed/σ evaluations come from the proof, bound to the vk
+    # commitments through the batched opening below
+    fixed = dict(zip(FIXED_NAMES, proof.fixed_evals))
+    sigma = list(proof.sigma_zeta)
     zh = (pow(zeta, n, R) - 1) % R
     if zh == 0:
-        return False
+        return None
     pi = 0
     lag = d.lagrange_evals(zeta, pk.public_rows)
     for row, value in zip(pk.public_rows, pubs):
@@ -643,7 +706,7 @@ def verify(params: KZGParams, pk: ProvingKey, public_inputs, proof_bytes: bytes)
         t_at_zeta = (t_at_zeta + te * acc) % R
         acc = acc * zn % R
     if total != zh * t_at_zeta % R:
-        return False
+        return None
 
     groups = [
         (zeta,
@@ -651,7 +714,9 @@ def verify(params: KZGParams, pk: ProvingKey, public_inputs, proof_bytes: bytes)
          + [(proof.m_commit, proof.m_eval),
             (proof.z_commit, proof.z_eval),
             (proof.phi_commit, proof.phi_eval)]
-         + [(cm, ev) for cm, ev in zip(proof.t_commits, proof.t_evals)]),
+         + [(cm, ev) for cm, ev in zip(proof.t_commits, proof.t_evals)]
+         + list(zip(pk.commit_list(),
+                    proof.fixed_evals + proof.sigma_zeta))),
         (zeta * d.omega % R,
          [(proof.z_commit, proof.z_next_eval),
           (proof.phi_commit, proof.phi_next_eval)]),
@@ -660,4 +725,12 @@ def verify(params: KZGParams, pk: ProvingKey, public_inputs, proof_bytes: bytes)
 
     openings = [BatchOpening(zeta, proof.w_x),
                 BatchOpening(zeta * d.omega % R, proof.w_wx)]
-    return verify_batch(params, groups, v_ch, u_ch, openings)
+    return fold_batch(groups, v_ch, u_ch, openings)
+
+
+def verify(params: KZGParams, pk: ProvingKey, public_inputs,
+           proof_bytes: bytes) -> bool:
+    acc = succinct_verify(pk, public_inputs, proof_bytes)
+    if acc is None:
+        return False
+    return decide(params, *acc)

@@ -1,0 +1,136 @@
+"""In-circuit PLONK verification (loader/transcript chipsets) and the
+fully aggregated Threshold circuit.
+
+The heavy end-to-end cases are ``slow``-marked — the reference
+`#[ignore]`s its aggregator/threshold real-prover tests for the same
+cost reason (aggregator/mod.rs:663,690; threshold/mod.rs:850,951). Run
+with ``pytest -m slow``.
+"""
+
+from fractions import Fraction
+
+import pytest
+
+from protocol_tpu.crypto.secp256k1 import EcdsaKeypair
+from protocol_tpu.models.eigentrust import (
+    Attestation,
+    EigenTrustSet,
+    SignedAttestation,
+)
+from protocol_tpu.utils.fields import Fr
+from protocol_tpu.zk.aggregator import NativeAggregator, Snark
+from protocol_tpu.zk.gadgets import Chips
+from protocol_tpu.zk.kzg import KZGParams, decide
+from protocol_tpu.zk.plonk import ConstraintSystem, keygen, prove, succinct_verify
+from protocol_tpu.zk.loader_chip import AggregatorChipset, TranscriptChip, \
+    PlonkVerifierChip
+from protocol_tpu.zk.threshold_circuit import ThresholdCircuit
+from protocol_tpu.zk.transcript import PoseidonTranscript
+
+DOMAIN = Fr(42)
+
+
+def et_shaped_snark(seed=b"eta"):
+    """A small real snark whose publics mimic the ET layout
+    (participants ‖ scores ‖ domain ‖ opinions_hash) for n=2, built from
+    an actual native EigenTrustSet run."""
+    kps = [EcdsaKeypair(7000 + i) for i in range(2)]
+    addrs = [kp.public_key.to_address() for kp in kps]
+    native = EigenTrustSet(2, 20, 1000, DOMAIN)
+    for a in addrs:
+        native.add_member(a)
+    for i, row in {0: [None, 400], 1: [600, None]}.items():
+        signed = []
+        for j in range(2):
+            if row[j]:
+                att = Attestation(about=addrs[j], domain=DOMAIN,
+                                  value=Fr(row[j]), message=Fr.zero())
+                signed.append(SignedAttestation(
+                    att, kps[i].sign(int(att.hash()))))
+            else:
+                signed.append(None)
+        native.update_op(kps[i].public_key, signed)
+    scores = native.converge()
+    ratios = native.converge_rational()
+
+    c = Chips(ConstraintSystem(lookup_bits=4))
+    # exercise every selector so no vk commitment is the identity
+    x, y = c.witness(3), c.witness(4)
+    s = c.add(x, y)
+    c.lincomb([(2, x), (3, y), (1, s), (1, c.mul(x, y))], const=1)
+    c.mul_add(x, y, s)
+    c.range_check(c.witness(9), 4)
+    row = c.cs.add_row([0, 0, 2, 3, 0, 0], q_mul_cd=1, q_const=-6)
+    pubs_native = ([int(a) for a in addrs] + [int(v) for v in scores]
+                   + [int(DOMAIN), 12345])
+    for v in pubs_native:
+        c.cs.public_input(v)
+    c.cs.check_satisfied()
+    params = KZGParams.setup(8, seed=seed)
+    pk = keygen(params, c.cs)
+    proof = prove(params, pk, c.cs)
+    return params, pk, c.cs.public_values(), proof, addrs, scores, ratios
+
+
+class TestTranscriptChip:
+    def test_challenges_match_native(self):
+        native = PoseidonTranscript()
+        pt = (123456789, 987654321 << 130 | 7)
+        native.absorb_fr(42)
+        native.absorb_point(pt)
+        ch1 = native.challenge()
+        ch2 = native.challenge()
+
+        chips = Chips(ConstraintSystem(lookup_bits=17))
+        verifier = PlonkVerifierChip(chips)
+        tr = TranscriptChip(chips, verifier.fq)
+        tr.absorb_fr(chips.witness(42))
+        tr.absorb_point(verifier.ecc.assign_point(
+            _on_curve_point()))
+        # re-run native with the on-curve point for a fair comparison
+        native2 = PoseidonTranscript()
+        native2.absorb_fr(42)
+        native2.absorb_point(_on_curve_point())
+        assert chips.value(tr.challenge()) == native2.challenge()
+        assert chips.value(tr.challenge()) == native2.challenge()
+        chips.cs.check_satisfied()
+
+
+def _on_curve_point():
+    from protocol_tpu.zk import bn254
+
+    return bn254.g1_mul(bn254.G1_GEN, 0xDEADBEEF)
+
+
+@pytest.mark.slow
+class TestInCircuitVerification:
+    def test_accumulator_matches_native(self):
+        params, pk, pubs, proof, *_ = et_shaped_snark()
+        native_acc = succinct_verify(pk, pubs, proof)
+        assert native_acc is not None and decide(params, *native_acc)
+        agg_native = NativeAggregator([Snark(pk, pubs, proof)])
+
+        chips = Chips(ConstraintSystem(lookup_bits=17))
+        cells = [chips.witness(v) for v in pubs]
+        chipset = AggregatorChipset(chips)
+        limb_cells, _ = chipset.aggregate([(pk, cells, proof)])
+        chips.cs.check_satisfied()
+        assert [chips.value(c) for c in limb_cells] == agg_native.instances
+
+    def test_threshold_with_aggregation(self):
+        """The complete Threshold shape: in-circuit ET verification +
+        threshold logic, accumulator decided by the host pairing."""
+        params, pk, pubs, proof, addrs, scores, ratios = et_shaped_snark()
+        circuit = ThresholdCircuit(num_neighbours=2)
+        chips, th_pubs = circuit.build_aggregated(
+            pk, pubs, proof, addrs[1], Fr(500), Fraction(ratios[1]))
+        chips.cs.check_satisfied()
+
+        assert th_pubs[0] == int(addrs[1])
+        assert th_pubs[1] == 500
+        assert th_pubs[2] in (0, 1)
+        # the circuit's accumulator equals the native aggregator's, and
+        # the deferred pairing accepts it
+        agg_native = NativeAggregator([Snark(pk, pubs, proof)])
+        assert th_pubs[3:19] == agg_native.instances
+        assert agg_native.decide(params)

@@ -207,7 +207,7 @@ class TestRealProver:
         c.cs.check_satisfied()
 
         params = KZGParams.setup(7, seed=b"gadget-test")
-        pk = keygen(c.cs, k=7)
+        pk = keygen(params, c.cs, k=7)
         proof = prove(params, pk, c.cs)
         assert verify(params, pk, [42], proof)
         assert not verify(params, pk, [43], proof)

@@ -1,0 +1,124 @@
+"""Threshold circuit + native aggregator tests (reference pattern:
+threshold/mod.rs inline tests + aggregator/native.rs:322)."""
+
+from fractions import Fraction
+
+import pytest
+
+from protocol_tpu.crypto.secp256k1 import EcdsaKeypair
+from protocol_tpu.models.eigentrust import (
+    Attestation,
+    EigenTrustSet,
+    SignedAttestation,
+)
+from protocol_tpu.utils.errors import EigenError
+from protocol_tpu.utils.fields import Fr
+from protocol_tpu.zk.aggregator import NativeAggregator, Snark, accumulator_limbs
+from protocol_tpu.zk.gadgets import Chips
+from protocol_tpu.zk.kzg import KZGParams
+from protocol_tpu.zk.plonk import ConstraintSystem, keygen, prove
+from protocol_tpu.zk.threshold_circuit import ThresholdCircuit
+
+DOMAIN = Fr(42)
+
+
+def small_snark(x, y, seed):
+    """A tiny real snark to aggregate."""
+    c = Chips(ConstraintSystem())
+    out = c.mul_add(c.witness(x), c.witness(y), c.constant(5))
+    c.public(out)
+    params = KZGParams.setup(8, seed=seed)
+    pk = keygen(params, c.cs)
+    proof = prove(params, pk, c.cs)
+    return params, Snark(pk, c.cs.public_values(), proof)
+
+
+def native_fixture(n=2):
+    kps = [EcdsaKeypair(9000 + i) for i in range(n)]
+    addrs = [kp.public_key.to_address() for kp in kps]
+    native = EigenTrustSet(n, 20, 1000, DOMAIN)
+    for a in addrs:
+        native.add_member(a)
+    rows = {0: [None, 300], 1: [700, None]}
+    for i, row in rows.items():
+        signed = []
+        for j in range(n):
+            if row[j]:
+                att = Attestation(about=addrs[j], domain=DOMAIN,
+                                  value=Fr(row[j]), message=Fr.zero())
+                signed.append(SignedAttestation(att, kps[i].sign(int(att.hash()))))
+            else:
+                signed.append(None)
+        native.update_op(kps[i].public_key, signed)
+    scores = native.converge()
+    ratios = native.converge_rational()
+    et_instances = ([int(a) for a in addrs] + [int(s) for s in scores]
+                    + [int(DOMAIN), 0])
+    return addrs, scores, ratios, et_instances
+
+
+class TestNativeAggregator:
+    def test_aggregate_two_snarks_and_decide(self):
+        params, s1 = small_snark(3, 4, b"agg-a")
+        _, s2 = small_snark(7, 9, b"agg-a")  # same SRS
+        agg = NativeAggregator([s1, s2])
+        assert len(agg.instances) == 16
+        assert agg.decide(params)
+
+    def test_tampered_proof_rejected(self):
+        params, s1 = small_snark(3, 4, b"agg-b")
+        bad = bytearray(s1.proof)
+        bad[-1] ^= 1
+        with pytest.raises(EigenError):
+            NativeAggregator([Snark(s1.pk, s1.instances, bytes(bad))])
+
+    def test_tampered_instance_breaks_decider(self):
+        params, s1 = small_snark(3, 4, b"agg-c")
+        agg = NativeAggregator([s1])
+        # forging the accumulator (e.g. swapping lhs/rhs) must fail decide
+        lhs, rhs = agg.accumulator
+        assert not NativeAggregator.decide(
+            type("A", (), {"accumulator": (rhs, lhs)})(), params)
+
+    def test_limbs_roundtrip(self):
+        params, s1 = small_snark(2, 2, b"agg-d")
+        agg = NativeAggregator([s1])
+        limbs = accumulator_limbs(agg.accumulator)
+        (lx, ly), (rx, ry) = agg.accumulator
+        from protocol_tpu.zk.integer_chip import from_limbs
+
+        assert from_limbs(limbs[0:4]) == lx
+        assert from_limbs(limbs[4:8]) == ly
+        assert from_limbs(limbs[8:12]) == rx
+        assert from_limbs(limbs[12:16]) == ry
+
+
+class TestThresholdCircuit:
+    def test_above_and_below_threshold(self):
+        addrs, scores, ratios, et_instances = native_fixture()
+        fake_acc = list(range(1, 17))
+        for idx, th, expect in ((1, 500, True), (1, 1700, False),
+                                (0, 500, True)):
+            circuit = ThresholdCircuit(num_neighbours=2)
+            chips, pubs = circuit.build(
+                et_instances, addrs[idx], Fr(th),
+                Fraction(ratios[idx]), fake_acc)
+            chips.cs.check_satisfied()
+            assert pubs[0] == int(addrs[idx])
+            assert pubs[1] == th
+            assert pubs[2] == (1 if expect else 0)
+            assert pubs[3:19] == fake_acc
+
+    def test_unknown_target_rejected(self):
+        addrs, scores, ratios, et_instances = native_fixture()
+        with pytest.raises(EigenError):
+            ThresholdCircuit(num_neighbours=2).build(
+                et_instances, Fr(123456), Fr(10), Fraction(ratios[0]),
+                list(range(16)))
+
+    def test_inconsistent_ratio_rejected(self):
+        addrs, scores, ratios, et_instances = native_fixture()
+        with pytest.raises((AssertionError, EigenError)):
+            ThresholdCircuit(num_neighbours=2).build(
+                et_instances, addrs[0], Fr(10),
+                Fraction(ratios[0]) + 1, list(range(16)))

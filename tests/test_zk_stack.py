@@ -177,8 +177,9 @@ def test_copy_of_unequal_cells_rejected():
 @pytest.fixture(scope="module")
 def plonk_setup():
     cs = _mul_add_circuit(31337, 271828)
-    pk = keygen(cs)
-    params = KZGParams.setup(pk.k, seed=b"plonk-fixture")
+    params = KZGParams.setup(8, seed=b"plonk-fixture")
+    pk = keygen(params, cs)
+    assert pk.k <= 8
     return cs, pk, params
 
 
