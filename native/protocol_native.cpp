@@ -1,0 +1,772 @@
+// Native prover core: 256-bit Montgomery field arithmetic, radix-2 NTT,
+// Pippenger G1 MSM, grand products and the quotient kernel for the
+// framework's PLONK protocol.
+//
+// The reference's entire proving stack is native (Rust halo2,
+// eigentrust-zk/Cargo.toml); this library is the framework's equivalent
+// performance layer. Python keeps witness generation and protocol
+// orchestration (zk/prover_fast.py); every O(n)/O(n log n) polynomial or
+// curve operation crosses this boundary as flat little-endian 4x64-bit
+// limb arrays in standard (non-Montgomery) form.
+//
+// Build: g++ -O3 -shared -fPIC -o libprotocol_native.so protocol_native.cpp
+// (driven by protocol_tpu/native/__init__.py, which caches the .so).
+
+#include <cstdint>
+#include <cstring>
+#include <vector>
+
+typedef unsigned __int128 u128;
+typedef uint64_t u64;
+
+struct Fp {
+    u64 v[4];
+};
+
+// Field context: modulus, -modulus^-1 mod 2^64, R^2 mod p (Montgomery).
+struct FieldCtx {
+    Fp mod;
+    u64 inv;   // -p^{-1} mod 2^64
+    Fp r2;     // (2^256)^2 mod p
+    Fp one;    // 2^256 mod p (Montgomery 1)
+};
+
+static inline bool geq(const Fp &a, const Fp &b) {
+    for (int i = 3; i >= 0; --i) {
+        if (a.v[i] != b.v[i]) return a.v[i] > b.v[i];
+    }
+    return true;
+}
+
+static inline void sub_nored(Fp &out, const Fp &a, const Fp &b) {
+    u128 borrow = 0;
+    for (int i = 0; i < 4; ++i) {
+        u128 d = (u128)a.v[i] - b.v[i] - (u64)borrow;
+        out.v[i] = (u64)d;
+        borrow = (d >> 64) ? 1 : 0;
+    }
+}
+
+static inline void add_mod(Fp &out, const Fp &a, const Fp &b, const FieldCtx &f) {
+    u128 carry = 0;
+    for (int i = 0; i < 4; ++i) {
+        u128 s = (u128)a.v[i] + b.v[i] + (u64)carry;
+        out.v[i] = (u64)s;
+        carry = s >> 64;
+    }
+    if (carry || geq(out, f.mod)) {
+        Fp t;
+        sub_nored(t, out, f.mod);
+        out = t;
+    }
+}
+
+static inline void sub_mod(Fp &out, const Fp &a, const Fp &b, const FieldCtx &f) {
+    u128 borrow = 0;
+    Fp t;
+    for (int i = 0; i < 4; ++i) {
+        u128 d = (u128)a.v[i] - b.v[i] - (u64)borrow;
+        t.v[i] = (u64)d;
+        borrow = (d >> 64) ? 1 : 0;
+    }
+    if (borrow) {
+        u128 carry = 0;
+        for (int i = 0; i < 4; ++i) {
+            u128 s = (u128)t.v[i] + f.mod.v[i] + (u64)carry;
+            t.v[i] = (u64)s;
+            carry = s >> 64;
+        }
+    }
+    out = t;
+}
+
+// CIOS Montgomery multiplication.
+static inline void mont_mul(Fp &out, const Fp &a, const Fp &b, const FieldCtx &f) {
+    u64 t[6] = {0, 0, 0, 0, 0, 0};
+    for (int i = 0; i < 4; ++i) {
+        u128 c = 0;
+        for (int j = 0; j < 4; ++j) {
+            u128 s = (u128)t[j] + (u128)a.v[i] * b.v[j] + (u64)c;
+            t[j] = (u64)s;
+            c = s >> 64;
+        }
+        u128 s = (u128)t[4] + (u64)c;
+        t[4] = (u64)s;
+        t[5] = (u64)(s >> 64);
+
+        u64 m = t[0] * f.inv;
+        c = ((u128)t[0] + (u128)m * f.mod.v[0]) >> 64;
+        for (int j = 1; j < 4; ++j) {
+            u128 s2 = (u128)t[j] + (u128)m * f.mod.v[j] + (u64)c;
+            t[j - 1] = (u64)s2;
+            c = s2 >> 64;
+        }
+        u128 s2 = (u128)t[4] + (u64)c;
+        t[3] = (u64)s2;
+        t[4] = t[5] + (u64)(s2 >> 64);
+        t[5] = 0;
+    }
+    Fp r = {{t[0], t[1], t[2], t[3]}};
+    if (t[4] || geq(r, f.mod)) {
+        Fp q;
+        sub_nored(q, r, f.mod);
+        // note: if t[4] set, the true value is r + 2^256 which is < 2p,
+        // so one subtraction (mod 2^256 arithmetic) lands in range
+        out = q;
+    } else {
+        out = r;
+    }
+}
+
+static inline void to_mont(Fp &out, const Fp &a, const FieldCtx &f) {
+    mont_mul(out, a, f.r2, f);
+}
+
+static inline void from_mont(Fp &out, const Fp &a, const FieldCtx &f) {
+    Fp one = {{1, 0, 0, 0}};
+    mont_mul(out, a, one, f);
+}
+
+static inline void mont_sqr(Fp &out, const Fp &a, const FieldCtx &f) {
+    mont_mul(out, a, a, f);
+}
+
+static void mont_pow(Fp &out, const Fp &base, const u64 *exp, int exp_words,
+                     const FieldCtx &f) {
+    Fp acc = f.one;
+    Fp b = base;
+    for (int w = 0; w < exp_words; ++w) {
+        u64 e = exp[w];
+        for (int bit = 0; bit < 64; ++bit) {
+            if (e & 1) mont_mul(acc, acc, b, f);
+            mont_sqr(b, b, f);
+            e >>= 1;
+        }
+    }
+    out = acc;
+}
+
+static void mont_inv(Fp &out, const Fp &a, const FieldCtx &f) {
+    // a^(p-2)
+    u64 e[4];
+    Fp pm2 = f.mod;
+    u128 borrow = 2;
+    for (int i = 0; i < 4; ++i) {
+        u128 d = (u128)pm2.v[i] - (u64)borrow;
+        e[i] = (u64)d;
+        borrow = (d >> 64) ? 1 : 0;
+    }
+    mont_pow(out, a, e, 4, f);
+}
+
+// --------------------------------------------------------------------------
+// context setup
+
+static FieldCtx make_ctx(const u64 *mod_limbs) {
+    FieldCtx f;
+    std::memcpy(f.mod.v, mod_limbs, 32);
+    // inv = -p^{-1} mod 2^64 (Newton iteration)
+    u64 p0 = f.mod.v[0];
+    u64 inv = 1;
+    for (int i = 0; i < 63; ++i) inv *= 2 - p0 * inv;
+    f.inv = ~inv + 1;
+    // one = 2^256 mod p: compute by repeated doubling of 1 (256 times)
+    Fp one = {{1, 0, 0, 0}};
+    Fp acc = one;
+    for (int i = 0; i < 256; ++i) add_mod(acc, acc, acc, f);
+    f.one = acc;
+    // r2 = (2^256)^2 mod p: double 'one' 256 more times
+    Fp r2 = acc;
+    for (int i = 0; i < 256; ++i) add_mod(r2, r2, r2, f);
+    f.r2 = r2;
+    return f;
+}
+
+extern "C" {
+
+// --- field vector ops (standard-form in/out) ------------------------------
+
+void fr_vec_op(const u64 *mod_limbs, int op, u64 *out, const u64 *a,
+               const u64 *b, long n) {
+    FieldCtx f = make_ctx(mod_limbs);
+    for (long i = 0; i < n; ++i) {
+        Fp x, y, r;
+        std::memcpy(x.v, a + 4 * i, 32);
+        if (b) std::memcpy(y.v, b + 4 * i, 32);
+        switch (op) {
+        case 0: add_mod(r, x, y, f); break;
+        case 1: sub_mod(r, x, y, f); break;
+        case 2: {  // mul
+            Fp xm, ym;
+            to_mont(xm, x, f);
+            to_mont(ym, y, f);
+            mont_mul(r, xm, ym, f);
+            from_mont(r, r, f);
+            break;
+        }
+        default: r = x;
+        }
+        std::memcpy(out + 4 * i, r.v, 32);
+    }
+}
+
+// --- NTT ------------------------------------------------------------------
+
+// in-place radix-2 DIT NTT over the subgroup generated by omega (standard
+// form in/out). dir=0 forward, dir=1 inverse (uses omega^-1 and scales by
+// n^-1).
+void ntt(const u64 *mod_limbs, u64 *data, long n, const u64 *omega_limbs,
+         int dir) {
+    FieldCtx f = make_ctx(mod_limbs);
+    Fp omega_s;
+    std::memcpy(omega_s.v, omega_limbs, 32);
+    Fp omega;
+    to_mont(omega, omega_s, f);
+    if (dir) mont_inv(omega, omega, f);
+
+    std::vector<Fp> a(n);
+    for (long i = 0; i < n; ++i) {
+        Fp x;
+        std::memcpy(x.v, data + 4 * i, 32);
+        to_mont(a[i], x, f);
+    }
+    // bit reversal
+    for (long i = 1, j = 0; i < n; ++i) {
+        long bit = n >> 1;
+        for (; j & bit; bit >>= 1) j ^= bit;
+        j ^= bit;
+        if (i < j) std::swap(a[i], a[j]);
+    }
+    // twiddle table: tw[j] = omega^j for j < n/2; level `len` uses
+    // stride n/len — one multiply per butterfly instead of two
+    std::vector<Fp> tw(n / 2 > 0 ? n / 2 : 1);
+    tw[0] = f.one;
+    for (long j = 1; j < n / 2; ++j) mont_mul(tw[j], tw[j - 1], omega, f);
+    for (long len = 2; len <= n; len <<= 1) {
+        long stride = n / len;
+        for (long i = 0; i < n; i += len) {
+            for (long j = 0; j < len / 2; ++j) {
+                Fp u = a[i + j];
+                Fp v;
+                mont_mul(v, a[i + j + len / 2], tw[j * stride], f);
+                add_mod(a[i + j], u, v, f);
+                sub_mod(a[i + j + len / 2], u, v, f);
+            }
+        }
+    }
+    if (dir) {
+        // scale by n^{-1}
+        Fp n_fp = {{(u64)n, 0, 0, 0}};
+        Fp n_mont, n_inv;
+        to_mont(n_mont, n_fp, f);
+        mont_inv(n_inv, n_mont, f);
+        for (long i = 0; i < n; ++i) mont_mul(a[i], a[i], n_inv, f);
+    }
+    for (long i = 0; i < n; ++i) {
+        Fp x;
+        from_mont(x, a[i], f);
+        std::memcpy(data + 4 * i, x.v, 32);
+    }
+}
+
+// multiply data[i] by shift^i (coset scaling), standard form
+void coset_scale(const u64 *mod_limbs, u64 *data, long n,
+                 const u64 *shift_limbs, int invert) {
+    FieldCtx f = make_ctx(mod_limbs);
+    Fp s;
+    std::memcpy(s.v, shift_limbs, 32);
+    to_mont(s, s, f);
+    if (invert) mont_inv(s, s, f);
+    Fp acc = f.one;
+    for (long i = 0; i < n; ++i) {
+        Fp x;
+        std::memcpy(x.v, data + 4 * i, 32);
+        to_mont(x, x, f);
+        mont_mul(x, x, acc, f);
+        from_mont(x, x, f);
+        std::memcpy(data + 4 * i, x.v, 32);
+        mont_mul(acc, acc, s, f);
+    }
+}
+
+// Horner evaluation of many polynomials (coeff-major: polys[p][i]) at x.
+void poly_eval_many(const u64 *mod_limbs, const u64 *coeffs, long n_polys,
+                    long n, const u64 *x_limbs, u64 *out) {
+    FieldCtx f = make_ctx(mod_limbs);
+    Fp x;
+    std::memcpy(x.v, x_limbs, 32);
+    to_mont(x, x, f);
+    for (long p = 0; p < n_polys; ++p) {
+        Fp acc = {{0, 0, 0, 0}};
+        const u64 *c = coeffs + p * 4 * n;
+        for (long i = n - 1; i >= 0; --i) {
+            Fp ci;
+            std::memcpy(ci.v, c + 4 * i, 32);
+            to_mont(ci, ci, f);
+            mont_mul(acc, acc, x, f);
+            add_mod(acc, acc, ci, f);
+        }
+        from_mont(acc, acc, f);
+        std::memcpy(out + 4 * p, acc.v, 32);
+    }
+}
+
+// batch inversion, standard form; zeros stay zero
+void batch_inverse(const u64 *mod_limbs, u64 *data, long n) {
+    FieldCtx f = make_ctx(mod_limbs);
+    std::vector<Fp> vals(n), prefix(n);
+    Fp acc = f.one;
+    for (long i = 0; i < n; ++i) {
+        Fp x;
+        std::memcpy(x.v, data + 4 * i, 32);
+        to_mont(vals[i], x, f);
+        prefix[i] = acc;
+        bool zero = !(x.v[0] | x.v[1] | x.v[2] | x.v[3]);
+        if (!zero) mont_mul(acc, acc, vals[i], f);
+    }
+    Fp inv;
+    mont_inv(inv, acc, f);
+    for (long i = n - 1; i >= 0; --i) {
+        Fp x = vals[i];
+        bool zero = true;
+        for (int k = 0; k < 4; ++k) zero = zero && !x.v[k];
+        if (zero) continue;
+        Fp r;
+        mont_mul(r, inv, prefix[i], f);
+        mont_mul(inv, inv, x, f);
+        from_mont(r, r, f);
+        std::memcpy(data + 4 * i, r.v, 32);
+    }
+}
+
+// --- G1 (short Weierstrass y^2 = x^3 + b, a=0) ----------------------------
+
+struct JacPoint {
+    Fp x, y, z;  // Montgomery form; z=0 => identity
+};
+
+static inline bool is_zero_fp(const Fp &a) {
+    return !(a.v[0] | a.v[1] | a.v[2] | a.v[3]);
+}
+
+static void jac_double(JacPoint &r, const JacPoint &p_in, const FieldCtx &f) {
+    JacPoint p = p_in;  // r may alias p_in
+    if (is_zero_fp(p.z)) { r = p; return; }
+    Fp a, bb, c, d, e, g, t;
+    mont_sqr(a, p.x, f);                 // A = X^2
+    mont_sqr(bb, p.y, f);                // B = Y^2
+    mont_sqr(c, bb, f);                  // C = B^2
+    add_mod(d, p.x, bb, f);              // (X+B)
+    mont_sqr(d, d, f);                   // (X+B)^2
+    sub_mod(d, d, a, f);
+    sub_mod(d, d, c, f);
+    add_mod(d, d, d, f);                 // D = 2((X+B)^2 - A - C)
+    add_mod(e, a, a, f);
+    add_mod(e, e, a, f);                 // E = 3A
+    mont_sqr(g, e, f);                   // G = E^2
+    sub_mod(r.x, g, d, f);
+    sub_mod(r.x, r.x, d, f);             // X' = G - 2D
+    sub_mod(t, d, r.x, f);
+    mont_mul(t, t, e, f);
+    Fp c8;
+    add_mod(c8, c, c, f);
+    add_mod(c8, c8, c8, f);
+    add_mod(c8, c8, c8, f);              // 8C
+    sub_mod(r.y, t, c8, f);              // Y' = E(D - X') - 8C
+    mont_mul(r.z, p.y, p.z, f);
+    add_mod(r.z, r.z, r.z, f);           // Z' = 2YZ
+}
+
+static void jac_add(JacPoint &r, const JacPoint &p_in, const JacPoint &q_in,
+                    const FieldCtx &f) {
+    JacPoint p = p_in, q = q_in;  // r may alias either input
+    if (is_zero_fp(p.z)) { r = q; return; }
+    if (is_zero_fp(q.z)) { r = p; return; }
+    Fp z1z1, z2z2, u1, u2, s1, s2, h, i, j, rr, v, t;
+    mont_sqr(z1z1, p.z, f);
+    mont_sqr(z2z2, q.z, f);
+    mont_mul(u1, p.x, z2z2, f);
+    mont_mul(u2, q.x, z1z1, f);
+    mont_mul(s1, p.y, q.z, f);
+    mont_mul(s1, s1, z2z2, f);
+    mont_mul(s2, q.y, p.z, f);
+    mont_mul(s2, s2, z1z1, f);
+    sub_mod(h, u2, u1, f);
+    sub_mod(rr, s2, s1, f);
+    if (is_zero_fp(h)) {
+        if (is_zero_fp(rr)) { jac_double(r, p, f); return; }
+        r.x = f.one; r.y = f.one;
+        r.z = Fp{{0, 0, 0, 0}};
+        return;
+    }
+    add_mod(rr, rr, rr, f);              // r = 2(S2-S1)
+    add_mod(i, h, h, f);
+    mont_sqr(i, i, f);                   // I = (2H)^2
+    mont_mul(j, h, i, f);                // J = H*I
+    mont_mul(v, u1, i, f);               // V = U1*I
+    mont_sqr(r.x, rr, f);
+    sub_mod(r.x, r.x, j, f);
+    sub_mod(r.x, r.x, v, f);
+    sub_mod(r.x, r.x, v, f);             // X3 = r^2 - J - 2V
+    sub_mod(t, v, r.x, f);
+    mont_mul(t, t, rr, f);
+    Fp s1j;
+    mont_mul(s1j, s1, j, f);
+    add_mod(s1j, s1j, s1j, f);
+    sub_mod(r.y, t, s1j, f);             // Y3 = r(V - X3) - 2 S1 J
+    add_mod(t, p.z, q.z, f);
+    mont_sqr(t, t, f);
+    sub_mod(t, t, z1z1, f);
+    sub_mod(t, t, z2z2, f);
+    mont_mul(r.z, t, h, f);              // Z3 = ((Z1+Z2)^2 - Z1Z1 - Z2Z2) H
+}
+
+// Pippenger MSM: bases affine standard-form (x,y) pairs (8 limbs each,
+// zero-zero = identity), scalars standard-form 4-limb. Result affine
+// standard form written to out (8 limbs; zeros for identity).
+void g1_msm(const u64 *mod_limbs, const u64 *bases, const u64 *scalars,
+            long n, u64 *out) {
+    FieldCtx f = make_ctx(mod_limbs);
+    int c = 4;
+    if (n > 32) c = 8;
+    if (n > 1024) c = 12;
+    if (n > 262144) c = 16;
+    int windows = (256 + c - 1) / c;
+
+    std::vector<JacPoint> pts(n);
+    std::vector<bool> infinite(n);
+    for (long i = 0; i < n; ++i) {
+        Fp x, y;
+        std::memcpy(x.v, bases + 8 * i, 32);
+        std::memcpy(y.v, bases + 8 * i + 4, 32);
+        bool inf = is_zero_fp(x) && is_zero_fp(y);
+        infinite[i] = inf;
+        if (!inf) {
+            to_mont(pts[i].x, x, f);
+            to_mont(pts[i].y, y, f);
+            pts[i].z = f.one;
+        }
+    }
+
+    JacPoint total;
+    total.z = Fp{{0, 0, 0, 0}};
+    std::vector<JacPoint> buckets((size_t)1 << c);
+    for (int w = windows - 1; w >= 0; --w) {
+        for (int d = 0; d < c; ++d) jac_double(total, total, f);
+        for (auto &b : buckets) b.z = Fp{{0, 0, 0, 0}};
+        long bit0 = (long)w * c;
+        for (long i = 0; i < n; ++i) {
+            if (infinite[i]) continue;
+            // extract c bits starting at bit0 from scalar i
+            u64 idx = 0;
+            for (int bit = c - 1; bit >= 0; --bit) {
+                long pos = bit0 + bit;
+                if (pos >= 256) { idx <<= 1; continue; }
+                u64 word = scalars[4 * i + pos / 64];
+                idx = (idx << 1) | ((word >> (pos % 64)) & 1);
+            }
+            if (idx) jac_add(buckets[idx], buckets[idx], pts[i], f);
+        }
+        JacPoint running, sum;
+        running.z = Fp{{0, 0, 0, 0}};
+        sum.z = Fp{{0, 0, 0, 0}};
+        for (long b = ((long)1 << c) - 1; b >= 1; --b) {
+            jac_add(running, running, buckets[b], f);
+            jac_add(sum, sum, running, f);
+        }
+        jac_add(total, total, sum, f);
+    }
+
+    // to affine
+    if (is_zero_fp(total.z)) {
+        std::memset(out, 0, 64);
+        return;
+    }
+    Fp zinv, zinv2, zinv3, ax, ay;
+    mont_inv(zinv, total.z, f);
+    mont_sqr(zinv2, zinv, f);
+    mont_mul(zinv3, zinv2, zinv, f);
+    mont_mul(ax, total.x, zinv2, f);
+    mont_mul(ay, total.y, zinv3, f);
+    from_mont(ax, ax, f);
+    from_mont(ay, ay, f);
+    std::memcpy(out, ax.v, 32);
+    std::memcpy(out + 4, ay.v, 32);
+}
+
+// test shim: affine double + add through the Jacobian path
+void g1_test_ops(const u64 *mod_limbs, const u64 *p_aff, const u64 *q_aff,
+                 u64 *dbl_out, u64 *add_out) {
+    FieldCtx f = make_ctx(mod_limbs);
+    JacPoint p, q;
+    std::memcpy(p.x.v, p_aff, 32);
+    std::memcpy(p.y.v, p_aff + 4, 32);
+    to_mont(p.x, p.x, f);
+    to_mont(p.y, p.y, f);
+    p.z = f.one;
+    std::memcpy(q.x.v, q_aff, 32);
+    std::memcpy(q.y.v, q_aff + 4, 32);
+    to_mont(q.x, q.x, f);
+    to_mont(q.y, q.y, f);
+    q.z = f.one;
+    JacPoint d, s;
+    jac_double(d, p, f);
+    jac_add(s, p, q, f);
+    JacPoint pts[2] = {d, s};
+    u64 *outs[2] = {dbl_out, add_out};
+    for (int i = 0; i < 2; ++i) {
+        Fp zinv, zinv2, zinv3, ax, ay;
+        mont_inv(zinv, pts[i].z, f);
+        mont_sqr(zinv2, zinv, f);
+        mont_mul(zinv3, zinv2, zinv, f);
+        mont_mul(ax, pts[i].x, zinv2, f);
+        mont_mul(ay, pts[i].y, zinv3, f);
+        from_mont(ax, ax, f);
+        from_mont(ay, ay, f);
+        std::memcpy(outs[i], ax.v, 32);
+        std::memcpy(outs[i] + 4, ay.v, 32);
+    }
+}
+
+// --- PLONK grand products -------------------------------------------------
+
+// permutation grand product z for NUM_WIRES wires.
+// wires: [w][i] standard form; sigma_evals likewise; shifts: per-wire
+// scalars; omegas: domain elements. Writes z (n values, standard form).
+// Returns 0 on success, 1 if the product fails to wrap to 1.
+int perm_grand_product(const u64 *mod_limbs, const u64 *wires, int num_wires,
+                       const u64 *sigma, const u64 *shifts, const u64 *omegas,
+                       const u64 *beta_l, const u64 *gamma_l, long n,
+                       u64 *z_out) {
+    FieldCtx f = make_ctx(mod_limbs);
+    Fp beta, gamma;
+    std::memcpy(beta.v, beta_l, 32);
+    std::memcpy(gamma.v, gamma_l, 32);
+    to_mont(beta, beta, f);
+    to_mont(gamma, gamma, f);
+
+    std::vector<Fp> numer(n), denom(n);
+    for (long i = 0; i < n; ++i) { numer[i] = f.one; denom[i] = f.one; }
+    for (int w = 0; w < num_wires; ++w) {
+        Fp kw;
+        std::memcpy(kw.v, shifts + 4 * w, 32);
+        to_mont(kw, kw, f);
+        Fp beta_kw;
+        mont_mul(beta_kw, beta, kw, f);
+        const u64 *col = wires + (size_t)w * 4 * n;
+        const u64 *sg = sigma + (size_t)w * 4 * n;
+        for (long i = 0; i < n; ++i) {
+            Fp wv, om, sv, t1, t2;
+            std::memcpy(wv.v, col + 4 * i, 32);
+            to_mont(wv, wv, f);
+            std::memcpy(om.v, omegas + 4 * i, 32);
+            to_mont(om, om, f);
+            std::memcpy(sv.v, sg + 4 * i, 32);
+            to_mont(sv, sv, f);
+            mont_mul(t1, beta_kw, om, f);
+            add_mod(t1, t1, wv, f);
+            add_mod(t1, t1, gamma, f);
+            mont_mul(numer[i], numer[i], t1, f);
+            mont_mul(t2, beta, sv, f);
+            add_mod(t2, t2, wv, f);
+            add_mod(t2, t2, gamma, f);
+            mont_mul(denom[i], denom[i], t2, f);
+        }
+    }
+    // batch invert denom (all nonzero w.h.p.)
+    std::vector<Fp> prefix(n);
+    Fp acc = f.one;
+    for (long i = 0; i < n; ++i) {
+        prefix[i] = acc;
+        mont_mul(acc, acc, denom[i], f);
+    }
+    Fp inv;
+    mont_inv(inv, acc, f);
+    std::vector<Fp> dinv(n);
+    for (long i = n - 1; i >= 0; --i) {
+        mont_mul(dinv[i], inv, prefix[i], f);
+        mont_mul(inv, inv, denom[i], f);
+    }
+    Fp z = f.one;
+    for (long i = 0; i < n; ++i) {
+        Fp out;
+        from_mont(out, z, f);
+        std::memcpy(z_out + 4 * i, out.v, 32);
+        Fp step;
+        mont_mul(step, numer[i], dinv[i], f);
+        mont_mul(z, z, step, f);
+    }
+    // wrap check: z after last row must be 1
+    Fp z_std;
+    from_mont(z_std, z, f);
+    Fp one_std = {{1, 0, 0, 0}};
+    for (int k = 0; k < 4; ++k)
+        if (z_std.v[k] != one_std.v[k]) return 1;
+    return 0;
+}
+
+// LogUp running sum phi. a_col, table, m: standard form length n.
+// Returns 0 ok / 1 if the sum fails to wrap to 0.
+int logup_running_sum(const u64 *mod_limbs, const u64 *a_col,
+                      const u64 *table, const u64 *m_col,
+                      const u64 *beta_l, long n, u64 *phi_out) {
+    FieldCtx f = make_ctx(mod_limbs);
+    Fp beta;
+    std::memcpy(beta.v, beta_l, 32);
+    to_mont(beta, beta, f);
+    std::vector<Fp> inv_a(n), inv_t(n);
+    for (long i = 0; i < n; ++i) {
+        Fp a, t;
+        std::memcpy(a.v, a_col + 4 * i, 32);
+        to_mont(a, a, f);
+        add_mod(inv_a[i], a, beta, f);
+        std::memcpy(t.v, table + 4 * i, 32);
+        to_mont(t, t, f);
+        add_mod(inv_t[i], t, beta, f);
+    }
+    // joint batch inversion
+    std::vector<Fp> all(2 * n), prefix(2 * n);
+    for (long i = 0; i < n; ++i) { all[i] = inv_a[i]; all[n + i] = inv_t[i]; }
+    Fp acc = f.one;
+    for (long i = 0; i < 2 * n; ++i) { prefix[i] = acc; mont_mul(acc, acc, all[i], f); }
+    Fp inv;
+    mont_inv(inv, acc, f);
+    for (long i = 2 * n - 1; i >= 0; --i) {
+        Fp r;
+        mont_mul(r, inv, prefix[i], f);
+        mont_mul(inv, inv, all[i], f);
+        all[i] = r;
+    }
+    Fp phi = {{0, 0, 0, 0}};
+    for (long i = 0; i < n; ++i) {
+        Fp out;
+        from_mont(out, phi, f);
+        std::memcpy(phi_out + 4 * i, out.v, 32);
+        Fp mi, term;
+        std::memcpy(mi.v, m_col + 4 * i, 32);
+        to_mont(mi, mi, f);
+        mont_mul(term, mi, all[n + i], f);
+        Fp step;
+        sub_mod(step, all[i], term, f);
+        add_mod(phi, phi, step, f);
+    }
+    return is_zero_fp(phi) ? 0 : 1;
+}
+
+// --- quotient kernel ------------------------------------------------------
+
+// Evaluate the full PLONK constraint combination over the extended coset
+// and divide by Z_H. All arrays are standard-form, length ext_n:
+//   wires_e[6], z_e, zw_e, m_e, phi_e, phiw_e, fixed_e[9 in FIXED order],
+//   sigma_e[6], pi_e, xs (coset points), zh_inv, l0 (zh*l0_den)
+// scalars: beta, gamma, beta_lk, alpha, shifts[6]
+// fixed order: q_a q_b q_c q_d q_e q_mul_ab q_mul_cd q_const t_lookup
+void quotient_eval(const u64 *mod_limbs, const u64 *wires_e, const u64 *z_e,
+                   const u64 *zw_e, const u64 *m_e, const u64 *phi_e,
+                   const u64 *phiw_e, const u64 *fixed_e, const u64 *sigma_e,
+                   const u64 *pi_e, const u64 *xs, const u64 *zh_inv_a,
+                   const u64 *l0_a, const u64 *beta_l, const u64 *gamma_l,
+                   const u64 *beta_lk_l, const u64 *alpha_l,
+                   const u64 *shifts_l, long ext_n, long n_unused,
+                   u64 *t_out) {
+    (void)n_unused;
+    FieldCtx f = make_ctx(mod_limbs);
+    Fp beta, gamma, beta_lk, alpha, shifts[6];
+    std::memcpy(beta.v, beta_l, 32); to_mont(beta, beta, f);
+    std::memcpy(gamma.v, gamma_l, 32); to_mont(gamma, gamma, f);
+    std::memcpy(beta_lk.v, beta_lk_l, 32); to_mont(beta_lk, beta_lk, f);
+    std::memcpy(alpha.v, alpha_l, 32); to_mont(alpha, alpha, f);
+    for (int w = 0; w < 6; ++w) {
+        std::memcpy(shifts[w].v, shifts_l + 4 * w, 32);
+        to_mont(shifts[w], shifts[w], f);
+    }
+    Fp a2, a3, a4;
+    mont_mul(a2, alpha, alpha, f);
+    mont_mul(a3, a2, alpha, f);
+    mont_mul(a4, a3, alpha, f);
+
+    auto load = [&](const u64 *arr, long i, Fp &out_fp) {
+        std::memcpy(out_fp.v, arr + 4 * i, 32);
+        to_mont(out_fp, out_fp, f);
+    };
+
+    for (long i = 0; i < ext_n; ++i) {
+        Fp w[6];
+        for (int k = 0; k < 6; ++k) load(wires_e + (size_t)k * 4 * ext_n, i, w[k]);
+        Fp fx[9];
+        for (int k = 0; k < 9; ++k) load(fixed_e + (size_t)k * 4 * ext_n, i, fx[k]);
+        Fp sg[6];
+        for (int k = 0; k < 6; ++k) load(sigma_e + (size_t)k * 4 * ext_n, i, sg[k]);
+        Fp zi, zwi, mi, phii, phiwi, pii, xi, zhi, l0i;
+        load(z_e, i, zi); load(zw_e, i, zwi); load(m_e, i, mi);
+        load(phi_e, i, phii); load(phiw_e, i, phiwi); load(pi_e, i, pii);
+        load(xs, i, xi); load(zh_inv_a, i, zhi); load(l0_a, i, l0i);
+
+        // gate
+        Fp gate = {{0, 0, 0, 0}}, t;
+        for (int k = 0; k < 5; ++k) {
+            mont_mul(t, fx[k], w[k], f);
+            add_mod(gate, gate, t, f);
+        }
+        Fp ab, cd;
+        mont_mul(ab, w[0], w[1], f);
+        mont_mul(cd, w[2], w[3], f);
+        mont_mul(t, fx[5], ab, f);
+        add_mod(gate, gate, t, f);
+        mont_mul(t, fx[6], cd, f);
+        add_mod(gate, gate, t, f);
+        add_mod(gate, gate, fx[7], f);
+        add_mod(gate, gate, pii, f);
+
+        // permutation
+        Fp pn = zi, pd = zwi;
+        for (int k = 0; k < 6; ++k) {
+            Fp f1, f2;
+            mont_mul(f1, beta, shifts[k], f);
+            mont_mul(f1, f1, xi, f);
+            add_mod(f1, f1, w[k], f);
+            add_mod(f1, f1, gamma, f);
+            mont_mul(pn, pn, f1, f);
+            mont_mul(f2, beta, sg[k], f);
+            add_mod(f2, f2, w[k], f);
+            add_mod(f2, f2, gamma, f);
+            mont_mul(pd, pd, f2, f);
+        }
+        Fp perm;
+        sub_mod(perm, pn, pd, f);
+
+        // lookup (LogUp)
+        Fp ba, bt, dphi, lk;
+        add_mod(ba, beta_lk, w[5], f);
+        add_mod(bt, beta_lk, fx[8], f);
+        sub_mod(dphi, phiwi, phii, f);
+        mont_mul(lk, dphi, ba, f);
+        mont_mul(lk, lk, bt, f);
+        sub_mod(lk, lk, bt, f);
+        Fp mba;
+        mont_mul(mba, mi, ba, f);
+        add_mod(lk, lk, mba, f);
+
+        // total = gate + alpha*perm + a2*l0*(z-1) + a3*lk + a4*l0*phi
+        Fp total = gate;
+        mont_mul(t, alpha, perm, f);
+        add_mod(total, total, t, f);
+        Fp zm1;
+        sub_mod(zm1, zi, f.one, f);
+        mont_mul(t, a2, l0i, f);
+        mont_mul(t, t, zm1, f);
+        add_mod(total, total, t, f);
+        mont_mul(t, a3, lk, f);
+        add_mod(total, total, t, f);
+        mont_mul(t, a4, l0i, f);
+        mont_mul(t, t, phii, f);
+        add_mod(total, total, t, f);
+
+        mont_mul(total, total, zhi, f);
+        Fp out_std;
+        from_mont(out_std, total, f);
+        std::memcpy(t_out + 4 * i, out_std.v, 32);
+    }
+}
+
+}  // extern "C"

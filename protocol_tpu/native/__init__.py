@@ -1,0 +1,230 @@
+"""ctypes bindings for the C++ prover core (``native/protocol_native.cpp``).
+
+The reference's proving stack is native end-to-end (Rust halo2); this
+package is the framework's equivalent: Montgomery field kernels, NTT,
+Pippenger MSM, PLONK grand products and the quotient kernel, compiled
+on demand with g++ and cached next to the source. Everything degrades
+gracefully: ``available()`` is False when no toolchain exists and the
+pure-Python paths keep working.
+
+Data layout at the boundary: little-endian 4×uint64 limb arrays
+(numpy, shape (n, 4), standard — not Montgomery — form).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from pathlib import Path
+
+import numpy as np
+
+_SRC = Path(__file__).resolve().parents[2] / "native" / "protocol_native.cpp"
+_BUILD_DIR = Path(__file__).resolve().parent / "build"
+_LIB_PATH = _BUILD_DIR / "libprotocol_native.so"
+
+_lock = threading.Lock()
+_lib = None
+_build_failed = False
+
+
+def _build() -> bool:
+    _BUILD_DIR.mkdir(exist_ok=True)
+    cmd = ["g++", "-O3", "-shared", "-fPIC", "-std=c++17",
+           "-o", str(_LIB_PATH), str(_SRC)]
+    try:
+        subprocess.run(cmd, check=True, capture_output=True, timeout=300)
+        return True
+    except (subprocess.CalledProcessError, FileNotFoundError,
+            subprocess.TimeoutExpired):
+        return False
+
+
+def _load():
+    global _lib, _build_failed
+    with _lock:
+        if _lib is not None or _build_failed:
+            return _lib
+        if not _SRC.exists():
+            _build_failed = True
+            return None
+        stale = (not _LIB_PATH.exists()
+                 or _LIB_PATH.stat().st_mtime < _SRC.stat().st_mtime)
+        if stale and not _build():
+            _build_failed = True
+            return None
+        try:
+            lib = ctypes.CDLL(str(_LIB_PATH))
+        except OSError:
+            _build_failed = True
+            return None
+        u64p = ctypes.POINTER(ctypes.c_uint64)
+        lib.fr_vec_op.argtypes = [u64p, ctypes.c_int, u64p, u64p, u64p,
+                                  ctypes.c_long]
+        lib.ntt.argtypes = [u64p, u64p, ctypes.c_long, u64p, ctypes.c_int]
+        lib.coset_scale.argtypes = [u64p, u64p, ctypes.c_long, u64p,
+                                    ctypes.c_int]
+        lib.poly_eval_many.argtypes = [u64p, u64p, ctypes.c_long,
+                                       ctypes.c_long, u64p, u64p]
+        lib.batch_inverse.argtypes = [u64p, u64p, ctypes.c_long]
+        lib.g1_msm.argtypes = [u64p, u64p, u64p, ctypes.c_long, u64p]
+        lib.perm_grand_product.argtypes = [u64p, u64p, ctypes.c_int, u64p,
+                                           u64p, u64p, u64p, u64p,
+                                           ctypes.c_long, u64p]
+        lib.perm_grand_product.restype = ctypes.c_int
+        lib.logup_running_sum.argtypes = [u64p, u64p, u64p, u64p, u64p,
+                                          ctypes.c_long, u64p]
+        lib.logup_running_sum.restype = ctypes.c_int
+        lib.quotient_eval.argtypes = [u64p] + [u64p] * 12 + [u64p] * 5 \
+            + [ctypes.c_long, ctypes.c_long, u64p]
+        _lib = lib
+        return _lib
+
+
+def available() -> bool:
+    return _load() is not None
+
+
+def _ptr(arr: np.ndarray):
+    return arr.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64))
+
+
+# --- conversions -----------------------------------------------------------
+
+def ints_to_limbs(values) -> np.ndarray:
+    """Python ints (each < 2^256) → (n, 4) uint64 array."""
+    blob = b"".join(int(v).to_bytes(32, "little") for v in values)
+    return np.frombuffer(blob, dtype="<u8").reshape(-1, 4).copy()
+
+
+def limbs_to_ints(arr: np.ndarray) -> list:
+    data = np.ascontiguousarray(arr, dtype="<u8").tobytes()
+    return [int.from_bytes(data[i * 32 : (i + 1) * 32], "little")
+            for i in range(len(data) // 32)]
+
+
+def _scalar(v: int) -> np.ndarray:
+    return ints_to_limbs([v])
+
+
+def g1_msm(base_modulus: int, bases: np.ndarray, scalars: np.ndarray):
+    """Pippenger MSM. Point arithmetic runs over the curve's BASE field
+    (``base_modulus`` — Fq for BN254 G1); scalars are plain 256-bit
+    integers. bases: (n, 8) affine standard form (zeros = identity);
+    scalars: (n, 4). Returns an affine (x, y) tuple or None."""
+    lib = _load()
+    if lib is None:
+        raise RuntimeError("native library unavailable")
+    bases = np.ascontiguousarray(bases)
+    scalars = np.ascontiguousarray(scalars)
+    out = np.empty(8, dtype="<u8")
+    lib.g1_msm(_ptr(_scalar(base_modulus)), _ptr(bases), _ptr(scalars),
+               len(bases), _ptr(out))
+    vals = limbs_to_ints(out.reshape(2, 4))
+    if vals[0] == 0 and vals[1] == 0:
+        return None
+    return (vals[0], vals[1])
+
+
+def points_to_limbs(points) -> np.ndarray:
+    """Affine (x, y) tuples (None = identity) → (n, 8) uint64 array."""
+    flat = []
+    for pt in points:
+        if pt is None:
+            flat.extend((0, 0))
+        else:
+            flat.extend((pt[0], pt[1]))
+    return ints_to_limbs(flat).reshape(-1, 8)
+
+
+# --- array-level API -------------------------------------------------------
+
+class FieldKernel:
+    """Kernels over one prime modulus; all arrays are (n, 4) uint64."""
+
+    def __init__(self, modulus: int):
+        self.lib = _load()
+        if self.lib is None:
+            raise RuntimeError("native library unavailable")
+        self.modulus = modulus
+        self.mod_arr = _scalar(modulus)
+
+    def vec_mul(self, a: np.ndarray, b: np.ndarray) -> np.ndarray:
+        out = np.empty_like(a)
+        self.lib.fr_vec_op(_ptr(self.mod_arr), 2, _ptr(out), _ptr(a),
+                           _ptr(b), len(a))
+        return out
+
+    def ntt(self, data: np.ndarray, omega: int, inverse: bool = False
+            ) -> np.ndarray:
+        data = np.ascontiguousarray(data)
+        self.lib.ntt(_ptr(self.mod_arr), _ptr(data), len(data),
+                     _ptr(_scalar(omega)), 1 if inverse else 0)
+        return data
+
+    def coset_scale(self, data: np.ndarray, shift: int,
+                    invert: bool = False) -> np.ndarray:
+        data = np.ascontiguousarray(data)
+        self.lib.coset_scale(_ptr(self.mod_arr), _ptr(data), len(data),
+                             _ptr(_scalar(shift)), 1 if invert else 0)
+        return data
+
+    def poly_eval_many(self, polys: np.ndarray, x: int) -> list:
+        """polys: (n_polys, n, 4) contiguous; returns ints."""
+        polys = np.ascontiguousarray(polys)
+        n_polys, n = polys.shape[0], polys.shape[1]
+        out = np.empty((n_polys, 4), dtype="<u8")
+        self.lib.poly_eval_many(_ptr(self.mod_arr), _ptr(polys), n_polys, n,
+                                _ptr(_scalar(x)), _ptr(out))
+        return limbs_to_ints(out)
+
+    def batch_inverse(self, data: np.ndarray) -> np.ndarray:
+        data = np.ascontiguousarray(data)
+        self.lib.batch_inverse(_ptr(self.mod_arr), _ptr(data), len(data))
+        return data
+
+    def perm_grand_product(self, wires: np.ndarray, sigma: np.ndarray,
+                           shifts: list, omegas: np.ndarray, beta: int,
+                           gamma: int) -> np.ndarray:
+        """wires/sigma: (num_wires, n, 4); returns z (n, 4)."""
+        wires = np.ascontiguousarray(wires)
+        sigma = np.ascontiguousarray(sigma)
+        n = wires.shape[1]
+        z = np.empty((n, 4), dtype="<u8")
+        rc = self.lib.perm_grand_product(
+            _ptr(self.mod_arr), _ptr(wires), wires.shape[0], _ptr(sigma),
+            _ptr(ints_to_limbs(shifts)), _ptr(np.ascontiguousarray(omegas)),
+            _ptr(_scalar(beta)), _ptr(_scalar(gamma)), n, _ptr(z))
+        if rc != 0:
+            raise ValueError("permutation grand product does not wrap")
+        return z
+
+    def logup_running_sum(self, a_col: np.ndarray, table: np.ndarray,
+                          m_col: np.ndarray, beta: int) -> np.ndarray:
+        n = len(a_col)
+        phi = np.empty((n, 4), dtype="<u8")
+        rc = self.lib.logup_running_sum(
+            _ptr(self.mod_arr), _ptr(np.ascontiguousarray(a_col)),
+            _ptr(np.ascontiguousarray(table)),
+            _ptr(np.ascontiguousarray(m_col)), _ptr(_scalar(beta)), n,
+            _ptr(phi))
+        if rc != 0:
+            raise ValueError("lookup running sum does not wrap")
+        return phi
+
+    def quotient_eval(self, wires_e, z_e, zw_e, m_e, phi_e, phiw_e,
+                      fixed_e, sigma_e, pi_e, xs, zh_inv, l0, beta, gamma,
+                      beta_lk, alpha, shifts) -> np.ndarray:
+        ext_n = len(z_e)
+        out = np.empty((ext_n, 4), dtype="<u8")
+        args = [np.ascontiguousarray(a) for a in
+                (wires_e, z_e, zw_e, m_e, phi_e, phiw_e, fixed_e, sigma_e,
+                 pi_e, xs, zh_inv, l0)]
+        self.lib.quotient_eval(
+            _ptr(self.mod_arr), *[_ptr(a) for a in args],
+            _ptr(_scalar(beta)), _ptr(_scalar(gamma)),
+            _ptr(_scalar(beta_lk)), _ptr(_scalar(alpha)),
+            _ptr(ints_to_limbs(shifts)), ext_n, 0, _ptr(out))
+        return out
