@@ -210,6 +210,54 @@ void fr_vec_op(const u64 *mod_limbs, int op, u64 *out, const u64 *a,
     }
 }
 
+// scalar-broadcast variants: b points at ONE field element.
+// op 0 add, 1 sub (a - s), 2 mul.
+void fr_vec_scalar_op(const u64 *mod_limbs, int op, u64 *out, const u64 *a,
+                      const u64 *scalar, long n) {
+    FieldCtx f = make_ctx(mod_limbs);
+    Fp s, sm;
+    std::memcpy(s.v, scalar, 32);
+    to_mont(sm, s, f);
+    for (long i = 0; i < n; ++i) {
+        Fp x, r;
+        std::memcpy(x.v, a + 4 * i, 32);
+        switch (op) {
+        case 0: add_mod(r, x, s, f); break;
+        case 1: sub_mod(r, x, s, f); break;
+        case 2: {
+            Fp xm;
+            to_mont(xm, x, f);
+            mont_mul(r, xm, sm, f);
+            from_mont(r, r, f);
+            break;
+        }
+        default: r = x;
+        }
+        std::memcpy(out + 4 * i, r.v, 32);
+    }
+}
+
+// out[i] = acc after synthetic division: (f(X) - f(z)) / (X - z).
+// coeffs: n low-first; out: n-1 coefficients.
+void fr_poly_divide_linear(const u64 *mod_limbs, const u64 *coeffs, long n,
+                           const u64 *z_limbs, u64 *out) {
+    FieldCtx f = make_ctx(mod_limbs);
+    if (n <= 1) return;
+    Fp z;
+    std::memcpy(z.v, z_limbs, 32);
+    to_mont(z, z, f);
+    Fp acc = {{0, 0, 0, 0}};
+    for (long i = n - 1; i >= 1; --i) {
+        Fp c, t;
+        std::memcpy(c.v, coeffs + 4 * i, 32);
+        to_mont(c, c, f);
+        mont_mul(t, acc, z, f);
+        add_mod(acc, t, c, f);
+        from_mont(t, acc, f);
+        std::memcpy(out + 4 * (i - 1), t.v, 32);
+    }
+}
+
 // --- NTT ------------------------------------------------------------------
 
 // in-place radix-2 DIT NTT over the subgroup generated by omega (standard
@@ -494,6 +542,76 @@ void g1_msm(const u64 *mod_limbs, const u64 *bases, const u64 *scalars,
     std::memcpy(out + 4, ay.v, 32);
 }
 
+// Many scalar multiples of ONE fixed affine base: out[i] = scalars[i]·B.
+// 8-bit window table (32 windows x 256 entries), then one batched
+// Jacobian->affine normalization. Powers the SRS ("powers of tau") setup,
+// where n independent muls of G1 would otherwise dominate.
+void g1_fixed_base_muls(const u64 *mod_limbs, const u64 *base_aff,
+                        const u64 *scalars, long n, u64 *out) {
+    FieldCtx f = make_ctx(mod_limbs);
+    JacPoint base;
+    std::memcpy(base.x.v, base_aff, 32);
+    std::memcpy(base.y.v, base_aff + 4, 32);
+    to_mont(base.x, base.x, f);
+    to_mont(base.y, base.y, f);
+    base.z = f.one;
+
+    const int C = 8, WINDOWS = 32, TABLE = 1 << C;
+    // table[w][j] = j * 2^{8w} * B
+    std::vector<JacPoint> table((size_t)WINDOWS * TABLE);
+    JacPoint win_base = base;
+    for (int w = 0; w < WINDOWS; ++w) {
+        JacPoint *row = &table[(size_t)w * TABLE];
+        row[0].z = Fp{{0, 0, 0, 0}};
+        row[1] = win_base;
+        for (int j = 2; j < TABLE; ++j) jac_add(row[j], row[j - 1], win_base, f);
+        if (w + 1 < WINDOWS) {
+            jac_add(win_base, row[TABLE - 1], win_base, f);  // 2^{8(w+1)} B
+        }
+    }
+
+    std::vector<JacPoint> res(n);
+    for (long i = 0; i < n; ++i) {
+        JacPoint acc;
+        acc.z = Fp{{0, 0, 0, 0}};
+        for (int w = 0; w < WINDOWS; ++w) {
+            u64 word = scalars[4 * i + w / 8];
+            u64 idx = (word >> ((w % 8) * 8)) & 0xff;
+            if (idx) jac_add(acc, acc, table[(size_t)w * TABLE + idx], f);
+        }
+        res[i] = acc;
+    }
+
+    // batched normalization: invert all z^1 at once
+    std::vector<Fp> zs(n), prefix(n);
+    Fp acc = f.one;
+    for (long i = 0; i < n; ++i) {
+        zs[i] = is_zero_fp(res[i].z) ? f.one : res[i].z;
+        prefix[i] = acc;
+        mont_mul(acc, acc, zs[i], f);
+    }
+    Fp inv;
+    mont_inv(inv, acc, f);
+    for (long i = n - 1; i >= 0; --i) {
+        Fp zi;
+        mont_mul(zi, inv, prefix[i], f);
+        mont_mul(inv, inv, zs[i], f);
+        if (is_zero_fp(res[i].z)) {
+            std::memset(out + 8 * i, 0, 64);
+            continue;
+        }
+        Fp z2, z3, ax, ay;
+        mont_sqr(z2, zi, f);
+        mont_mul(z3, z2, zi, f);
+        mont_mul(ax, res[i].x, z2, f);
+        mont_mul(ay, res[i].y, z3, f);
+        from_mont(ax, ax, f);
+        from_mont(ay, ay, f);
+        std::memcpy(out + 8 * i, ax.v, 32);
+        std::memcpy(out + 8 * i + 4, ay.v, 32);
+    }
+}
+
 // test shim: affine double + add through the Jacobian path
 void g1_test_ops(const u64 *mod_limbs, const u64 *p_aff, const u64 *q_aff,
                  u64 *dbl_out, u64 *add_out) {
@@ -545,8 +663,13 @@ int perm_grand_product(const u64 *mod_limbs, const u64 *wires, int num_wires,
     to_mont(beta, beta, f);
     to_mont(gamma, gamma, f);
 
-    std::vector<Fp> numer(n), denom(n);
-    for (long i = 0; i < n; ++i) { numer[i] = f.one; denom[i] = f.one; }
+    std::vector<Fp> numer(n), denom(n), om_m(n);
+    for (long i = 0; i < n; ++i) {
+        numer[i] = f.one;
+        denom[i] = f.one;
+        std::memcpy(om_m[i].v, omegas + 4 * i, 32);
+        to_mont(om_m[i], om_m[i], f);
+    }
     for (int w = 0; w < num_wires; ++w) {
         Fp kw;
         std::memcpy(kw.v, shifts + 4 * w, 32);
@@ -556,11 +679,10 @@ int perm_grand_product(const u64 *mod_limbs, const u64 *wires, int num_wires,
         const u64 *col = wires + (size_t)w * 4 * n;
         const u64 *sg = sigma + (size_t)w * 4 * n;
         for (long i = 0; i < n; ++i) {
-            Fp wv, om, sv, t1, t2;
+            Fp wv, sv, t1, t2;
             std::memcpy(wv.v, col + 4 * i, 32);
             to_mont(wv, wv, f);
-            std::memcpy(om.v, omegas + 4 * i, 32);
-            to_mont(om, om, f);
+            const Fp &om = om_m[i];
             std::memcpy(sv.v, sg + 4 * i, 32);
             to_mont(sv, sv, f);
             mont_mul(t1, beta_kw, om, f);
@@ -667,9 +789,8 @@ void quotient_eval(const u64 *mod_limbs, const u64 *wires_e, const u64 *z_e,
                    const u64 *pi_e, const u64 *xs, const u64 *zh_inv_a,
                    const u64 *l0_a, const u64 *beta_l, const u64 *gamma_l,
                    const u64 *beta_lk_l, const u64 *alpha_l,
-                   const u64 *shifts_l, long ext_n, long n_unused,
+                   const u64 *shifts_l, long ext_n,
                    u64 *t_out) {
-    (void)n_unused;
     FieldCtx f = make_ctx(mod_limbs);
     Fp beta, gamma, beta_lk, alpha, shifts[6];
     std::memcpy(beta.v, beta_l, 32); to_mont(beta, beta, f);

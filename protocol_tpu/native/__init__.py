@@ -47,12 +47,14 @@ def _load():
     with _lock:
         if _lib is not None or _build_failed:
             return _lib
-        if not _SRC.exists():
-            _build_failed = True
-            return None
-        stale = (not _LIB_PATH.exists()
-                 or _LIB_PATH.stat().st_mtime < _SRC.stat().st_mtime)
-        if stale and not _build():
+        if _SRC.exists():
+            stale = (not _LIB_PATH.exists()
+                     or _LIB_PATH.stat().st_mtime < _SRC.stat().st_mtime)
+            if stale and not _build() and not _LIB_PATH.exists():
+                # no toolchain AND no previously-built library
+                _build_failed = True
+                return None
+        elif not _LIB_PATH.exists():
             _build_failed = True
             return None
         try:
@@ -78,7 +80,13 @@ def _load():
                                           ctypes.c_long, u64p]
         lib.logup_running_sum.restype = ctypes.c_int
         lib.quotient_eval.argtypes = [u64p] + [u64p] * 12 + [u64p] * 5 \
-            + [ctypes.c_long, ctypes.c_long, u64p]
+            + [ctypes.c_long, u64p]
+        lib.fr_vec_scalar_op.argtypes = [u64p, ctypes.c_int, u64p, u64p,
+                                         u64p, ctypes.c_long]
+        lib.fr_poly_divide_linear.argtypes = [u64p, u64p, ctypes.c_long,
+                                              u64p, u64p]
+        lib.g1_fixed_base_muls.argtypes = [u64p, u64p, u64p, ctypes.c_long,
+                                           u64p]
         _lib = lib
         return _lib
 
@@ -89,6 +97,16 @@ def available() -> bool:
 
 def _ptr(arr: np.ndarray):
     return arr.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64))
+
+
+def _require_inplace(arr: np.ndarray) -> np.ndarray:
+    """Kernels that mutate their argument must see the caller's real
+    buffer — a silent ``ascontiguousarray`` copy would leave the
+    caller's array untransformed."""
+    if not arr.flags["C_CONTIGUOUS"] or arr.dtype != np.uint64:
+        raise ValueError(
+            "in-place kernel requires a C-contiguous uint64 array")
+    return arr
 
 
 # --- conversions -----------------------------------------------------------
@@ -128,6 +146,21 @@ def g1_msm(base_modulus: int, bases: np.ndarray, scalars: np.ndarray):
     return (vals[0], vals[1])
 
 
+def g1_fixed_base_muls(base_modulus: int, base_pt, scalars: np.ndarray
+                       ) -> np.ndarray:
+    """out[i] = scalars[i]·base (affine standard form, (n, 8)); identity
+    rows are zeros. Windowed fixed-base — the SRS powers-of-τ kernel."""
+    lib = _load()
+    if lib is None:
+        raise RuntimeError("native library unavailable")
+    base = ints_to_limbs([base_pt[0], base_pt[1]]).reshape(8)
+    scalars = np.ascontiguousarray(scalars)
+    out = np.empty((len(scalars), 8), dtype="<u8")
+    lib.g1_fixed_base_muls(_ptr(_scalar(base_modulus)), _ptr(base),
+                           _ptr(scalars), len(scalars), _ptr(out))
+    return out
+
+
 def points_to_limbs(points) -> np.ndarray:
     """Affine (x, y) tuples (None = identity) → (n, 8) uint64 array."""
     flat = []
@@ -152,21 +185,71 @@ class FieldKernel:
         self.mod_arr = _scalar(modulus)
 
     def vec_mul(self, a: np.ndarray, b: np.ndarray) -> np.ndarray:
+        a = np.ascontiguousarray(a)
+        b = np.ascontiguousarray(b)
         out = np.empty_like(a)
         self.lib.fr_vec_op(_ptr(self.mod_arr), 2, _ptr(out), _ptr(a),
                            _ptr(b), len(a))
         return out
 
+    def vec_add(self, a: np.ndarray, b: np.ndarray) -> np.ndarray:
+        a = np.ascontiguousarray(a)
+        b = np.ascontiguousarray(b)
+        out = np.empty_like(a)
+        self.lib.fr_vec_op(_ptr(self.mod_arr), 0, _ptr(out), _ptr(a),
+                           _ptr(b), len(a))
+        return out
+
+    def vec_sub(self, a: np.ndarray, b: np.ndarray) -> np.ndarray:
+        a = np.ascontiguousarray(a)
+        b = np.ascontiguousarray(b)
+        out = np.empty_like(a)
+        self.lib.fr_vec_op(_ptr(self.mod_arr), 1, _ptr(out), _ptr(a),
+                           _ptr(b), len(a))
+        return out
+
+    def scalar_add(self, a: np.ndarray, s: int) -> np.ndarray:
+        a = np.ascontiguousarray(a)
+        out = np.empty_like(a)
+        self.lib.fr_vec_scalar_op(_ptr(self.mod_arr), 0, _ptr(out), _ptr(a),
+                                  _ptr(_scalar(s)), len(a))
+        return out
+
+    def scalar_sub(self, a: np.ndarray, s: int) -> np.ndarray:
+        a = np.ascontiguousarray(a)
+        out = np.empty_like(a)
+        self.lib.fr_vec_scalar_op(_ptr(self.mod_arr), 1, _ptr(out), _ptr(a),
+                                  _ptr(_scalar(s)), len(a))
+        return out
+
+    def scalar_mul(self, a: np.ndarray, s: int) -> np.ndarray:
+        a = np.ascontiguousarray(a)
+        out = np.empty_like(a)
+        self.lib.fr_vec_scalar_op(_ptr(self.mod_arr), 2, _ptr(out), _ptr(a),
+                                  _ptr(_scalar(s)), len(a))
+        return out
+
+    def poly_divide_linear(self, coeffs: np.ndarray, z: int) -> np.ndarray:
+        """(f(X) − f(z)) / (X − z); coeffs (n, 4) → (n−1, 4)."""
+        coeffs = np.ascontiguousarray(coeffs)
+        n = len(coeffs)
+        if n <= 1:
+            return np.zeros((0, 4), dtype="<u8")
+        out = np.empty((n - 1, 4), dtype="<u8")
+        self.lib.fr_poly_divide_linear(_ptr(self.mod_arr), _ptr(coeffs), n,
+                                       _ptr(_scalar(z)), _ptr(out))
+        return out
+
     def ntt(self, data: np.ndarray, omega: int, inverse: bool = False
             ) -> np.ndarray:
-        data = np.ascontiguousarray(data)
+        data = _require_inplace(data)
         self.lib.ntt(_ptr(self.mod_arr), _ptr(data), len(data),
                      _ptr(_scalar(omega)), 1 if inverse else 0)
         return data
 
     def coset_scale(self, data: np.ndarray, shift: int,
                     invert: bool = False) -> np.ndarray:
-        data = np.ascontiguousarray(data)
+        data = _require_inplace(data)
         self.lib.coset_scale(_ptr(self.mod_arr), _ptr(data), len(data),
                              _ptr(_scalar(shift)), 1 if invert else 0)
         return data
@@ -181,7 +264,7 @@ class FieldKernel:
         return limbs_to_ints(out)
 
     def batch_inverse(self, data: np.ndarray) -> np.ndarray:
-        data = np.ascontiguousarray(data)
+        data = _require_inplace(data)
         self.lib.batch_inverse(_ptr(self.mod_arr), _ptr(data), len(data))
         return data
 
@@ -226,5 +309,5 @@ class FieldKernel:
             _ptr(self.mod_arr), *[_ptr(a) for a in args],
             _ptr(_scalar(beta)), _ptr(_scalar(gamma)),
             _ptr(_scalar(beta_lk)), _ptr(_scalar(alpha)),
-            _ptr(ints_to_limbs(shifts)), ext_n, 0, _ptr(out))
+            _ptr(ints_to_limbs(shifts)), ext_n, _ptr(out))
         return out

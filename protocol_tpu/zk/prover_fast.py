@@ -1,0 +1,479 @@
+"""Native-accelerated PLONK keygen/prover on the C++ kernel layer.
+
+The reference's proving stack is native end-to-end (Rust halo2 — MSMs,
+FFTs and the quotient loop all run compiled; ``eigentrust-zk`` merely
+drives it). This module is the framework's equivalent: a mirror of
+``plonk.keygen``/``plonk.prove`` whose polynomial and curve arithmetic
+lives in ``native/protocol_native.cpp`` (Montgomery NTT, Pippenger MSM,
+grand-product / LogUp / quotient kernels), with Python keeping only the
+Fiat–Shamir transcript and protocol orchestration.
+
+Proofs are byte-identical in format and transcript-compatible with
+``plonk.prove``: anything produced here verifies under
+``plonk.verify``/``succinct_verify`` (and therefore under the in-circuit
+aggregator) with no changes — ``FastProvingKey`` duck-types the vk
+fields those consumers read (k, shifts, public_rows, lookup_bits,
+vk_commits, commit_list, domain).
+
+Data layout: (n, 4) little-endian uint64 limb arrays in standard
+(non-Montgomery) form throughout; conversions happen once at the wire
+boundary (witness columns in, transcript scalars out).
+"""
+
+from __future__ import annotations
+
+import json
+import secrets
+from dataclasses import dataclass
+
+import numpy as np
+
+from .. import native
+from ..utils.errors import EigenError
+from ..utils.fields import BN254_FR_MODULUS
+from .bn254 import BN254_FQ_MODULUS, G1_GEN
+from .domain import EvaluationDomain
+from .kzg import KZGParams, g1_from_bytes, g1_to_bytes
+from .plonk import (
+    FIXED_NAMES,
+    LOOKUP_WIRE,
+    MIN_K,
+    NUM_WIRES,
+    QUOTIENT_CHUNKS,
+    SELECTORS,
+    ConstraintSystem,
+    Proof,
+    _find_coset_shifts,
+    _table_values,
+)
+from .transcript import PoseidonTranscript
+
+R = BN254_FR_MODULUS
+Q = BN254_FQ_MODULUS
+
+
+def available() -> bool:
+    return native.available()
+
+
+def _kernel() -> native.FieldKernel:
+    return native.FieldKernel(R)
+
+
+def _get_int(arr: np.ndarray, i: int) -> int:
+    return int.from_bytes(arr[i].tobytes(), "little")
+
+
+def _set_int(arr: np.ndarray, i: int, v: int) -> None:
+    arr[i] = np.frombuffer(int(v % R).to_bytes(32, "little"), dtype="<u8")
+
+
+def _col_to_limbs(col: list, n: int) -> np.ndarray:
+    out = np.zeros((n, 4), dtype="<u8")
+    if col:
+        out[: len(col)] = native.ints_to_limbs(col)
+    return out
+
+
+# --- SRS limb cache --------------------------------------------------------
+
+def srs_limbs(params: KZGParams) -> np.ndarray:
+    """(n, 8) limb view of the G1 powers, cached on the params object."""
+    cached = getattr(params, "_srs_limbs", None)
+    if cached is None or len(cached) != len(params.g1_powers):
+        cached = native.points_to_limbs(params.g1_powers)
+        params._srs_limbs = cached
+    return cached
+
+
+def commit_limbs(params: KZGParams, coeffs: np.ndarray):
+    """MSM commit of a (n, 4) coefficient array → affine point or None."""
+    if len(coeffs) > len(params.g1_powers):
+        raise EigenError("proving_error", "poly exceeds SRS")
+    return native.g1_msm(Q, srs_limbs(params)[: len(coeffs)], coeffs)
+
+
+def setup_params_fast(k: int, extra: int = 8, seed: bytes | None = None
+                      ) -> KZGParams:
+    """``KZGParams.setup`` with the powers-of-τ G1 chain on the native
+    fixed-base kernel (identical output for identical seed)."""
+    n = (1 << k) + extra
+    if seed is None:
+        tau = secrets.randbelow(R - 1) + 1
+    else:
+        tau = int.from_bytes(seed + b"kzg-tau", "little") % (R - 1) + 1
+    powers = [1] * n
+    for i in range(1, n):
+        powers[i] = powers[i - 1] * tau % R
+    from .bn254 import g2_mul, G2_GEN
+
+    pts = native.g1_fixed_base_muls(Q, G1_GEN, native.ints_to_limbs(powers))
+    vals = native.limbs_to_ints(pts.reshape(-1, 4))
+    g1_powers = []
+    for i in range(n):
+        x, y = vals[2 * i], vals[2 * i + 1]
+        g1_powers.append(None if x == 0 and y == 0 else (x, y))
+    return KZGParams(k, g1_powers, g2_mul(G2_GEN, tau))
+
+
+# --- proving key -----------------------------------------------------------
+
+@dataclass
+class FastProvingKey:
+    """Keygen output in limb-array form. Duck-types the ``ProvingKey``
+    surface that ``succinct_verify``/``verify``/the aggregator touch."""
+
+    k: int
+    fixed_limbs: np.ndarray  # (9, n, 4) coeff form, FIXED_NAMES order
+    sigma_limbs: np.ndarray  # (6, n, 4) coeff form
+    sigma_eval_limbs: np.ndarray  # (6, n, 4) row form
+    shifts: list
+    public_rows: list
+    lookup_bits: int | None
+    vk_commits: dict
+
+    def domain(self) -> EvaluationDomain:
+        return EvaluationDomain(self.k)
+
+    def commit_list(self) -> list:
+        return ([self.vk_commits[name] for name in FIXED_NAMES]
+                + [self.vk_commits[f"sigma_{w}"] for w in range(NUM_WIRES)])
+
+    def to_bytes(self) -> bytes:
+        header = json.dumps({
+            "k": self.k,
+            "shifts": self.shifts,
+            "public_rows": self.public_rows,
+            "lookup_bits": self.lookup_bits,
+            "vk_commits": {name: g1_to_bytes(pt).hex()
+                           for name, pt in self.vk_commits.items()},
+        }).encode()
+        return (b"FPK1" + len(header).to_bytes(8, "little") + header
+                + np.ascontiguousarray(self.fixed_limbs).tobytes()
+                + np.ascontiguousarray(self.sigma_limbs).tobytes())
+
+    @classmethod
+    def from_bytes(cls, data: bytes) -> "FastProvingKey":
+        if data[:4] != b"FPK1":
+            raise EigenError("proving_error", "bad proving key magic")
+        hlen = int.from_bytes(data[4:12], "little")
+        p = json.loads(data[12 : 12 + hlen].decode())
+        n = 1 << p["k"]
+        off = 12 + hlen
+        fixed = np.frombuffer(data, dtype="<u8", count=9 * n * 4,
+                              offset=off).reshape(9, n, 4).copy()
+        off += 9 * n * 4 * 8
+        sigma = np.frombuffer(data, dtype="<u8", count=6 * n * 4,
+                              offset=off).reshape(6, n, 4).copy()
+        # sigma row form is derivable — recompute so the two copies can
+        # never disagree in a key file (same rule as ProvingKey.to_bytes)
+        fk = _kernel()
+        omega = EvaluationDomain(p["k"]).omega
+        sigma_evals = np.empty_like(sigma)
+        for w in range(NUM_WIRES):
+            sigma_evals[w] = fk.ntt(sigma[w].copy(), omega)
+        commits = {name: g1_from_bytes(bytes.fromhex(h))
+                   for name, h in p["vk_commits"].items()}
+        return cls(p["k"], fixed, sigma, sigma_evals, p["shifts"],
+                   p["public_rows"], p.get("lookup_bits"), commits)
+
+
+def keygen_fast(params: KZGParams, cs: ConstraintSystem,
+                k: int | None = None) -> FastProvingKey:
+    """``plonk.keygen`` on native kernels; same key material."""
+    rows = cs.num_rows
+    if k is None:
+        k = max(MIN_K, (max(rows, 1) - 1).bit_length())
+        if cs.lookup_bits:
+            k = max(k, cs.lookup_bits)
+    if k < MIN_K:
+        raise EigenError("circuit_error",
+                         f"k={k} below minimum domain size k={MIN_K}")
+    n = 1 << k
+    if rows > n:
+        raise EigenError("circuit_error", f"{rows} rows exceed 2^{k}")
+    fk = _kernel()
+    d = EvaluationDomain(k)
+    _table_values(cs.lookup_bits, n)  # validates table fits the domain
+
+    # fixed columns: scatter the sparse selector maps, then iNTT in place
+    fixed = np.zeros((len(FIXED_NAMES), n, 4), dtype="<u8")
+    for idx, name in enumerate(SELECTORS):
+        sel = cs.selectors[name]
+        if sel:
+            rows_idx = np.fromiter(sel.keys(), dtype=np.int64)
+            fixed[idx, rows_idx] = native.ints_to_limbs(list(sel.values()))
+    table_size = 1 << cs.lookup_bits if cs.lookup_bits else 1
+    fixed[len(SELECTORS), :table_size, 0] = np.arange(table_size,
+                                                      dtype=np.uint64)
+    for idx in range(len(FIXED_NAMES)):
+        fk.ntt(fixed[idx], d.omega, inverse=True)
+
+    # permutation σ: baseline shifts[w]·ωʳ, then swap along copy cycles.
+    # Union-find only over cells that appear in copies — every other cell
+    # keeps its identity image (the full 6n-cell map of the slow path is
+    # never materialized).
+    shifts = _find_coset_shifts(n, NUM_WIRES)
+    omegas = np.zeros((n, 4), dtype="<u8")
+    omegas[:, 0] = 1
+    fk.coset_scale(omegas, d.omega)  # omegas[i] = ωⁱ
+
+    sigma_evals = np.empty((NUM_WIRES, n, 4), dtype="<u8")
+    for w in range(NUM_WIRES):
+        sigma_evals[w] = fk.scalar_mul(omegas, shifts[w])
+
+    parent: dict = {}
+    nxt: dict = {}
+
+    def find(x):
+        while parent.get(x, x) != x:
+            parent[x] = parent.get(parent[x], parent[x])
+            x = parent[x]
+        return x
+
+    for a, b in cs.copies:
+        if a not in nxt:
+            nxt[a] = a
+        if b not in nxt:
+            nxt[b] = b
+        ra, rb = find(a), find(b)
+        if ra == rb:
+            continue
+        parent[ra] = rb
+        nxt[a], nxt[b] = nxt[b], nxt[a]
+    for (w, r), (tw, tr) in nxt.items():
+        _set_int(sigma_evals[w], r,
+                 shifts[tw] * _get_int(omegas, tr) % R)
+
+    sigma = sigma_evals.copy()
+    for w in range(NUM_WIRES):
+        fk.ntt(sigma[w], d.omega, inverse=True)
+
+    vk_commits = {}
+    for idx, name in enumerate(FIXED_NAMES):
+        vk_commits[name] = commit_limbs(params, fixed[idx])
+    for w in range(NUM_WIRES):
+        vk_commits[f"sigma_{w}"] = commit_limbs(params, sigma[w])
+
+    return FastProvingKey(k, fixed, sigma, sigma_evals, shifts,
+                          list(cs.public_rows), cs.lookup_bits, vk_commits)
+
+
+# --- prover ----------------------------------------------------------------
+
+def _blind_arr(coeffs: np.ndarray, n: int, count: int, randint) -> np.ndarray:
+    """(b₀+b₁X+…)·Z_H blinding on a coefficient array; returns an array
+    of length n+count."""
+    out = np.zeros((n + count, 4), dtype="<u8")
+    out[: len(coeffs)] = coeffs[: n + count]
+    for i in range(count):
+        b = randint()
+        _set_int(out, i, (_get_int(out, i) - b) % R)
+        _set_int(out, n + i, (_get_int(out, n + i) + b) % R)
+    return out
+
+
+def prove_fast(params: KZGParams, pk: FastProvingKey, cs: ConstraintSystem,
+               public_inputs=None, randint=None) -> bytes:
+    """``plonk.prove`` on native kernels; transcript-identical, so the
+    output verifies under ``plonk.verify``/``succinct_verify`` and
+    aggregates under the aggregator chipset. ``randint`` overrides the
+    blinding sampler (deterministic fixtures)."""
+    if randint is None:
+        randint = lambda: secrets.randbelow(R)  # noqa: E731
+    fk = _kernel()
+    d = pk.domain()
+    n = d.n
+    if cs.num_rows > n:
+        raise EigenError("proving_error", "circuit larger than key domain")
+    pubs = (list(public_inputs) if public_inputs is not None
+            else cs.public_values())
+    tr = PoseidonTranscript()
+    for v in pubs:
+        tr.absorb_fr(v)
+
+    # round 1: wires + lookup multiplicities
+    wire_vals = np.zeros((NUM_WIRES, n, 4), dtype="<u8")
+    for w in range(NUM_WIRES):
+        col = cs.wires[w]
+        if col:
+            wire_vals[w, : len(col)] = native.ints_to_limbs(col)
+    wire_coeffs = []
+    for w in range(NUM_WIRES):
+        c = wire_vals[w].copy()
+        fk.ntt(c, d.omega, inverse=True)
+        wire_coeffs.append(_blind_arr(c, n, 2, randint))
+    wire_commits = [commit_limbs(params, c) for c in wire_coeffs]
+    for cm in wire_commits:
+        tr.absorb_point(cm)
+
+    table_size = 1 << pk.lookup_bits if pk.lookup_bits else 1
+    for v in cs.wires[LOOKUP_WIRE]:
+        if v >= table_size:
+            raise EigenError("proving_error",
+                             f"lookup value {v} outside range table")
+    # in range ⇒ values are table indices, safe as int64
+    lk_small = np.fromiter(cs.wires[LOOKUP_WIRE], dtype=np.int64,
+                           count=cs.num_rows)
+    m_small = np.bincount(lk_small, minlength=table_size).astype(np.uint64)
+    m_small[0] += n - cs.num_rows  # padding rows pool at table entry 0
+    m_vals = np.zeros((n, 4), dtype="<u8")
+    m_vals[:table_size, 0] = m_small
+    m_coeffs_base = m_vals.copy()
+    fk.ntt(m_coeffs_base, d.omega, inverse=True)
+    m_coeffs = _blind_arr(m_coeffs_base, n, 2, randint)
+    m_commit = commit_limbs(params, m_coeffs)
+    tr.absorb_point(m_commit)
+
+    beta = tr.challenge()
+    gamma = tr.challenge()
+    beta_lk = tr.challenge()
+
+    # round 2a: permutation grand product (native kernel)
+    omegas = np.zeros((n, 4), dtype="<u8")
+    omegas[:, 0] = 1
+    fk.coset_scale(omegas, d.omega)
+    z_vals = fk.perm_grand_product(wire_vals, pk.sigma_eval_limbs,
+                                   pk.shifts, omegas, beta, gamma)
+    z_base = z_vals.copy()
+    fk.ntt(z_base, d.omega, inverse=True)
+    z_coeffs = _blind_arr(z_base, n, 3, randint)
+    z_commit = commit_limbs(params, z_coeffs)
+    tr.absorb_point(z_commit)
+
+    # round 2b: LogUp running sum (native kernel)
+    table_limbs = np.zeros((n, 4), dtype="<u8")
+    table_limbs[:table_size, 0] = np.arange(table_size, dtype=np.uint64)
+    phi_vals = fk.logup_running_sum(wire_vals[LOOKUP_WIRE], table_limbs,
+                                    m_vals, beta_lk)
+    phi_base = phi_vals.copy()
+    fk.ntt(phi_base, d.omega, inverse=True)
+    phi_coeffs = _blind_arr(phi_base, n, 3, randint)
+    phi_commit = commit_limbs(params, phi_coeffs)
+    tr.absorb_point(phi_commit)
+
+    alpha = tr.challenge()
+
+    # round 3: quotient over the 8n extension coset
+    de = EvaluationDomain(pk.k + 3)
+    ext_n = de.n
+    shift = _find_coset_shifts(ext_n, 2)[1]
+
+    def ext(coeffs: np.ndarray) -> np.ndarray:
+        out = np.zeros((ext_n, 4), dtype="<u8")
+        out[: len(coeffs)] = coeffs
+        fk.coset_scale(out, shift)
+        fk.ntt(out, de.omega)
+        return out
+
+    wires_e = np.empty((NUM_WIRES, ext_n, 4), dtype="<u8")
+    for w in range(NUM_WIRES):
+        wires_e[w] = ext(wire_coeffs[w])
+    z_e = ext(z_coeffs)
+    zw_coeffs = z_coeffs.copy()
+    fk.coset_scale(zw_coeffs, d.omega)  # z(ωX): cᵢ ← cᵢ·ωⁱ
+    zw_e = ext(zw_coeffs)
+    m_e = ext(m_coeffs)
+    phi_e = ext(phi_coeffs)
+    phiw_coeffs = phi_coeffs.copy()
+    fk.coset_scale(phiw_coeffs, d.omega)
+    phiw_e = ext(phiw_coeffs)
+    fixed_e = np.empty((len(FIXED_NAMES), ext_n, 4), dtype="<u8")
+    for idx in range(len(FIXED_NAMES)):
+        fixed_e[idx] = ext(pk.fixed_limbs[idx])
+    sigma_e = np.empty((NUM_WIRES, ext_n, 4), dtype="<u8")
+    for w in range(NUM_WIRES):
+        sigma_e[w] = ext(pk.sigma_limbs[w])
+    pi_vals = np.zeros((n, 4), dtype="<u8")
+    for row, value in zip(pk.public_rows, pubs):
+        _set_int(pi_vals, row, (-int(value)) % R)
+    fk.ntt(pi_vals, d.omega, inverse=True)
+    pi_e = ext(pi_vals)
+
+    # xs = shift·ω_e^i; Z_H(xs) has period 8 on the extension coset:
+    # xs^n = shift^n·(ω_e^n)^i and ω_e has order 8n
+    xs = np.zeros((ext_n, 4), dtype="<u8")
+    _shift_limb = np.frombuffer(int(shift).to_bytes(32, "little"),
+                                dtype="<u8")
+    xs[:] = _shift_limb
+    fk.coset_scale(xs, de.omega)
+    w8 = pow(de.omega, n, R)
+    shift_n = pow(shift, n, R)
+    zh8 = [(shift_n * pow(w8, i, R) - 1) % R for i in range(8)]
+    zh8_inv = [pow(v, -1, R) for v in zh8]
+    reps = ext_n // 8
+    zh_inv = np.tile(native.ints_to_limbs(zh8_inv), (reps, 1))
+    zh_tiled = np.tile(native.ints_to_limbs(zh8), (reps, 1))
+    # l0 = Z_H(x) / (n·(x−1))
+    l0_den = fk.scalar_mul(fk.scalar_sub(xs, 1), n % R)
+    fk.batch_inverse(l0_den)
+    l0 = fk.vec_mul(zh_tiled, l0_den)
+
+    t_ext = fk.quotient_eval(wires_e, z_e, zw_e, m_e, phi_e, phiw_e,
+                             fixed_e, sigma_e, pi_e, xs, zh_inv, l0,
+                             beta, gamma, beta_lk, alpha, pk.shifts)
+    del wires_e, zw_e, m_e, phiw_e, fixed_e, sigma_e, pi_e, xs, zh_inv
+    del zh_tiled, l0_den, l0, z_e, phi_e
+
+    fk.ntt(t_ext, de.omega, inverse=True)
+    fk.coset_scale(t_ext, shift, invert=True)
+    if t_ext[QUOTIENT_CHUNKS * n :].any():
+        raise EigenError(
+            "proving_error",
+            "quotient degree overflow — witness does not satisfy the circuit",
+        )
+    chunks = [np.ascontiguousarray(t_ext[i * n : (i + 1) * n])
+              for i in range(QUOTIENT_CHUNKS)]
+    t_commits = [commit_limbs(params, ch) for ch in chunks]
+    for cm in t_commits:
+        tr.absorb_point(cm)
+    zeta = tr.challenge()
+
+    # round 4: evaluations via one stacked Horner pass per point
+    all_polys = (wire_coeffs + [m_coeffs, z_coeffs, phi_coeffs] + chunks
+                 + [pk.fixed_limbs[i] for i in range(len(FIXED_NAMES))]
+                 + [pk.sigma_limbs[w] for w in range(NUM_WIRES)])
+    max_len = max(len(p) for p in all_polys)
+    stacked = np.zeros((len(all_polys), max_len, 4), dtype="<u8")
+    for i, p in enumerate(all_polys):
+        stacked[i, : len(p)] = p
+    evals = fk.poly_eval_many(stacked, zeta)
+    nw = NUM_WIRES
+    wire_evals = evals[:nw]
+    m_eval = evals[nw]
+    z_eval = evals[nw + 1]
+    phi_eval = evals[nw + 2]
+    t_evals = evals[nw + 3 : nw + 3 + QUOTIENT_CHUNKS]
+    fixed_evals = evals[nw + 3 + QUOTIENT_CHUNKS :
+                        nw + 3 + QUOTIENT_CHUNKS + len(FIXED_NAMES)]
+    sigma_zeta = evals[nw + 3 + QUOTIENT_CHUNKS + len(FIXED_NAMES) :]
+    zeta_w = zeta * d.omega % R
+    shifted_pair = np.zeros((2, n + 3, 4), dtype="<u8")
+    shifted_pair[0, : len(z_coeffs)] = z_coeffs
+    shifted_pair[1, : len(phi_coeffs)] = phi_coeffs
+    z_next, phi_next = fk.poly_eval_many(shifted_pair, zeta_w)
+    for v in (wire_evals + [m_eval, z_eval, z_next, phi_eval, phi_next]
+              + t_evals + fixed_evals + sigma_zeta):
+        tr.absorb_fr(v)
+    v_ch = tr.challenge()
+    tr.challenge()  # u — verifier-side fold; keep transcripts in lockstep
+
+    # batched openings at ζ and ωζ: fold with γ powers, divide, commit
+    def open_group(polys: list, at: int):
+        width = max(len(p) for p in polys)
+        folded = np.zeros((width, 4), dtype="<u8")
+        g = 1
+        for p in polys:
+            term = fk.scalar_mul(p, g)
+            folded[: len(term)] = fk.vec_add(folded[: len(term)], term)
+            g = g * v_ch % R
+        quotient = fk.poly_divide_linear(folded, at)
+        return commit_limbs(params, quotient)
+
+    w_x = open_group(all_polys, zeta)
+    w_wx = open_group([z_coeffs, phi_coeffs], zeta_w)
+
+    proof = Proof(wire_commits, m_commit, z_commit, phi_commit, t_commits,
+                  wire_evals, m_eval, z_eval, z_next, phi_eval, phi_next,
+                  t_evals, fixed_evals, sigma_zeta, w_x, w_wx)
+    return proof.to_bytes()

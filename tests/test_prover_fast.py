@@ -1,0 +1,135 @@
+"""Native-accelerated prover tests: cross-compatibility with the pure
+Python prover (same SRS, same vk commitments, proofs verify under either
+key object), key round-trips, and failure modes.
+
+Mirrors the reference's proving-layer test pattern (SURVEY.md §4.1/§4.4):
+the slow path is the oracle, the native path must be indistinguishable
+to the verifier.
+"""
+
+import random
+
+import pytest
+
+from protocol_tpu import native
+from protocol_tpu.utils.errors import EigenError
+from protocol_tpu.utils.fields import BN254_FR_MODULUS as R
+from protocol_tpu.zk.kzg import KZGParams, decide
+from protocol_tpu.zk.plonk import (
+    ConstraintSystem,
+    keygen,
+    prove,
+    succinct_verify,
+    verify,
+)
+
+pytestmark = pytest.mark.skipif(not native.available(),
+                                reason="native toolchain unavailable")
+
+
+def _circuit(seed=7, gates=20, lookup_bits=6):
+    rng = random.Random(seed)
+    cs = ConstraintSystem(lookup_bits=lookup_bits)
+    for _ in range(gates):
+        a, b = rng.randrange(50), rng.randrange(50)
+        cs.add_row([a, b, (a * b + a) % R], q_a=1, q_mul_ab=1, q_c=R - 1)
+    lk = cs.lookup_row(37)
+    row = cs.add_row([37], q_a=1, q_const=R - 37)
+    cs.copy(lk, (0, row))
+    cs.public_input(12345)
+    cs.check_satisfied()
+    return cs
+
+
+@pytest.fixture(scope="module")
+def setup():
+    from protocol_tpu.zk import prover_fast as pf
+
+    cs = _circuit()
+    params = pf.setup_params_fast(7, seed=b"pf")
+    pk_fast = pf.keygen_fast(params, cs)
+    pk_slow = keygen(params, cs)
+    return pf, cs, params, pk_fast, pk_slow
+
+
+def test_srs_matches_slow_setup(setup):
+    pf, _, params, _, _ = setup
+    slow = KZGParams.setup(7, seed=b"pf")
+    assert params.g1_powers == slow.g1_powers
+    assert params.s_g2 == slow.s_g2
+
+
+def test_vk_commitments_match(setup):
+    _, _, _, pk_fast, pk_slow = setup
+    assert pk_fast.k == pk_slow.k
+    assert pk_fast.shifts == pk_slow.shifts
+    assert pk_fast.public_rows == pk_slow.public_rows
+    for name, cm in pk_slow.vk_commits.items():
+        assert pk_fast.vk_commits[name] == cm, name
+
+
+def test_cross_prove_verify(setup):
+    pf, cs, params, pk_fast, pk_slow = setup
+    pubs = cs.public_values()
+    proof_fast = pf.prove_fast(params, pk_fast, cs)
+    assert verify(params, pk_fast, pubs, proof_fast)
+    assert verify(params, pk_slow, pubs, proof_fast)
+    proof_slow = prove(params, pk_slow, cs)
+    assert verify(params, pk_fast, pubs, proof_slow)
+
+
+def test_succinct_verify_accumulator(setup):
+    pf, cs, params, pk_fast, _ = setup
+    proof = pf.prove_fast(params, pk_fast, cs)
+    acc = succinct_verify(pk_fast, cs.public_values(), proof)
+    assert acc is not None
+    assert decide(params, *acc)
+
+
+def test_proving_key_roundtrip(setup):
+    pf, cs, params, pk_fast, pk_slow = setup
+    pk2 = pf.FastProvingKey.from_bytes(pk_fast.to_bytes())
+    assert pk2.vk_commits == pk_fast.vk_commits
+    proof = pf.prove_fast(params, pk2, cs)
+    assert verify(params, pk_slow, cs.public_values(), proof)
+
+
+def test_tampered_public_input_rejected(setup):
+    pf, cs, params, pk_fast, _ = setup
+    proof = pf.prove_fast(params, pk_fast, cs)
+    bad = list(cs.public_values())
+    bad[0] = (bad[0] + 1) % R
+    assert not verify(params, pk_fast, bad, proof)
+
+
+def test_fresh_witness_same_key(setup):
+    pf, _, params, pk_fast, pk_slow = setup
+    cs2 = _circuit(seed=99)
+    proof = pf.prove_fast(params, pk_fast, cs2)
+    assert verify(params, pk_slow, cs2.public_values(), proof)
+
+
+def test_unsatisfied_witness_rejected(setup):
+    pf, _, params, pk_fast, _ = setup
+    cs = _circuit()
+    cs.wires[0][0] = (cs.wires[0][0] + 1) % R  # break a gate
+    with pytest.raises(EigenError):
+        pf.prove_fast(params, pk_fast, cs)
+
+
+def test_lookup_out_of_range_rejected(setup):
+    pf, _, params, pk_fast, _ = setup
+    cs = _circuit()
+    cs.wires[5][0] = 1 << 10  # outside the 2^6 table
+    with pytest.raises(EigenError):
+        pf.prove_fast(params, pk_fast, cs)
+
+
+def test_deterministic_blinding_hook(setup):
+    pf, cs, params, pk_fast, _ = setup
+    rng1, rng2 = random.Random(5), random.Random(5)
+    p1 = pf.prove_fast(params, pk_fast, cs,
+                       randint=lambda: rng1.randrange(R))
+    p2 = pf.prove_fast(params, pk_fast, cs,
+                       randint=lambda: rng2.randrange(R))
+    assert p1 == p2
