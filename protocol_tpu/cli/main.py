@@ -30,8 +30,11 @@ from ..client import (
 from ..utils.errors import EigenError
 from .fs import EigenFile, assets_dir, load_mnemonic
 
-ET_PARAMS_K = 14  # circuit degree for the EigenTrust circuit (see zk layer)
-TH_PARAMS_K = 15
+# Circuit degrees for the EigenTrust4 shape (the reference pins k=20/21,
+# circuits/mod.rs:57-59; this stack's ET circuit is 2.49M rows → k=22,
+# and the Threshold circuit aggregates the ET snark in-circuit on top).
+ET_PARAMS_K = 22
+TH_PARAMS_K = 23
 
 
 def build_parser() -> argparse.ArgumentParser:

@@ -114,8 +114,13 @@ class ThPublicInputs:
 
 @dataclass
 class ThSetup:
-    """Threshold circuit setup bundle."""
+    """Threshold circuit setup bundle. ``et_setup``/``ratio`` carry the
+    EigenTrust context the prover needs to re-prove and aggregate the
+    inner snark (the reference's th_circuit_setup holds the same data
+    live while it builds the Snark, lib.rs:469-534)."""
 
     pub_inputs: ThPublicInputs
     num_decomposed: list  # [Fr] decimal limbs
     den_decomposed: list  # [Fr]
+    et_setup: "ETSetup" = None
+    ratio: Fraction = None

@@ -301,7 +301,8 @@ class Client:
             threshold=Fr(threshold),
             threshold_check=check,
         )
-        return ThSetup(pub_inputs, th.num_decomposed, th.den_decomposed)
+        return ThSetup(pub_inputs, th.num_decomposed, th.den_decomposed,
+                       et_setup=setup, ratio=ratio)
 
     def verify_threshold(
         self, attestations, participant: bytes, threshold: int

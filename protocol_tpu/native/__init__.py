@@ -50,8 +50,10 @@ def _load():
         if _SRC.exists():
             stale = (not _LIB_PATH.exists()
                      or _LIB_PATH.stat().st_mtime < _SRC.stat().st_mtime)
-            if stale and not _build() and not _LIB_PATH.exists():
-                # no toolchain AND no previously-built library
+            if stale and not _build():
+                # a stale library may have a mismatched ABI for the
+                # current source — loading it risks memory corruption
+                # mid-prove, so degrade to the pure-Python path instead
                 _build_failed = True
                 return None
         elif not _LIB_PATH.exists():
@@ -63,32 +65,41 @@ def _load():
             _build_failed = True
             return None
         u64p = ctypes.POINTER(ctypes.c_uint64)
-        lib.fr_vec_op.argtypes = [u64p, ctypes.c_int, u64p, u64p, u64p,
-                                  ctypes.c_long]
-        lib.ntt.argtypes = [u64p, u64p, ctypes.c_long, u64p, ctypes.c_int]
-        lib.coset_scale.argtypes = [u64p, u64p, ctypes.c_long, u64p,
-                                    ctypes.c_int]
-        lib.poly_eval_many.argtypes = [u64p, u64p, ctypes.c_long,
-                                       ctypes.c_long, u64p, u64p]
-        lib.batch_inverse.argtypes = [u64p, u64p, ctypes.c_long]
-        lib.g1_msm.argtypes = [u64p, u64p, u64p, ctypes.c_long, u64p]
-        lib.perm_grand_product.argtypes = [u64p, u64p, ctypes.c_int, u64p,
-                                           u64p, u64p, u64p, u64p,
-                                           ctypes.c_long, u64p]
-        lib.perm_grand_product.restype = ctypes.c_int
-        lib.logup_running_sum.argtypes = [u64p, u64p, u64p, u64p, u64p,
-                                          ctypes.c_long, u64p]
-        lib.logup_running_sum.restype = ctypes.c_int
-        lib.quotient_eval.argtypes = [u64p] + [u64p] * 12 + [u64p] * 5 \
-            + [ctypes.c_long, u64p]
-        lib.fr_vec_scalar_op.argtypes = [u64p, ctypes.c_int, u64p, u64p,
-                                         u64p, ctypes.c_long]
-        lib.fr_poly_divide_linear.argtypes = [u64p, u64p, ctypes.c_long,
-                                              u64p, u64p]
-        lib.g1_fixed_base_muls.argtypes = [u64p, u64p, u64p, ctypes.c_long,
-                                           u64p]
+        try:
+            _bind(lib, u64p)
+        except AttributeError:
+            # symbol set does not match this source revision
+            _build_failed = True
+            return None
         _lib = lib
         return _lib
+
+
+def _bind(lib, u64p) -> None:
+    lib.fr_vec_op.argtypes = [u64p, ctypes.c_int, u64p, u64p, u64p,
+                              ctypes.c_long]
+    lib.ntt.argtypes = [u64p, u64p, ctypes.c_long, u64p, ctypes.c_int]
+    lib.coset_scale.argtypes = [u64p, u64p, ctypes.c_long, u64p,
+                                ctypes.c_int]
+    lib.poly_eval_many.argtypes = [u64p, u64p, ctypes.c_long,
+                                   ctypes.c_long, u64p, u64p]
+    lib.batch_inverse.argtypes = [u64p, u64p, ctypes.c_long]
+    lib.g1_msm.argtypes = [u64p, u64p, u64p, ctypes.c_long, u64p]
+    lib.perm_grand_product.argtypes = [u64p, u64p, ctypes.c_int, u64p,
+                                       u64p, u64p, u64p, u64p,
+                                       ctypes.c_long, u64p]
+    lib.perm_grand_product.restype = ctypes.c_int
+    lib.logup_running_sum.argtypes = [u64p, u64p, u64p, u64p, u64p,
+                                      ctypes.c_long, u64p]
+    lib.logup_running_sum.restype = ctypes.c_int
+    lib.quotient_eval.argtypes = [u64p] + [u64p] * 12 + [u64p] * 5 \
+        + [ctypes.c_long, u64p]
+    lib.fr_vec_scalar_op.argtypes = [u64p, ctypes.c_int, u64p, u64p,
+                                     u64p, ctypes.c_long]
+    lib.fr_poly_divide_linear.argtypes = [u64p, u64p, ctypes.c_long,
+                                          u64p, u64p]
+    lib.g1_fixed_base_muls.argtypes = [u64p, u64p, u64p, ctypes.c_long,
+                                       u64p]
 
 
 def available() -> bool:

@@ -8,6 +8,7 @@ from .converge import (
     sharded_converge_fixed,
     sharded_converge_adaptive,
 )
+from .checkpointed import run_with_retries, sharded_converge_checkpointed
 
 __all__ = [
     "make_mesh",
@@ -17,4 +18,6 @@ __all__ = [
     "place_sharded",
     "sharded_converge_fixed",
     "sharded_converge_adaptive",
+    "sharded_converge_checkpointed",
+    "run_with_retries",
 ]

@@ -1,0 +1,192 @@
+"""Structured tracing + metrics.
+
+The reference has no observability beyond ad-hoc ``Instant`` timers
+printed to the log (eigentrust/src/lib.rs:549-555, utils.rs:264-267,
+dynamic_sets/native.rs:1121-1127) — SURVEY.md §5 marks real tracing as
+net-new for this framework. This module provides:
+
+- ``span(name, **fields)``: nested wall-clock spans (context manager),
+- ``event(name, **fields)``: point events with arbitrary fields,
+- counters/gauges via ``metric(name, value)``,
+- a process-global ``Tracer`` with JSONL export and a summary table,
+- ``device_trace(log_dir)``: optional passthrough to the JAX profiler
+  (xprof) for device-side timelines.
+
+Tracing is off unless enabled — ``enable()`` in code or the
+``PROTOCOL_TPU_TRACE`` env var (set to a path to also stream JSONL
+there; set to ``1`` for in-memory only). Overhead when disabled is one
+attribute check per call site.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import os
+import threading
+import time
+from dataclasses import dataclass, field
+
+
+@dataclass
+class SpanRecord:
+    name: str
+    start: float
+    duration: float
+    depth: int
+    fields: dict
+
+
+class Tracer:
+    """Process-global collector. Thread-safe; spans nest per-thread."""
+
+    def __init__(self):
+        self.enabled = False
+        self._lock = threading.Lock()
+        self._local = threading.local()
+        self._stream = None
+        self.spans: list = []
+        self.events: list = []
+        self.metrics: dict = {}
+
+    # --- lifecycle --------------------------------------------------------
+    def enable(self, stream_path: str | None = None) -> None:
+        self.enabled = True
+        if stream_path:
+            self._stream = open(stream_path, "a", buffering=1)
+
+    def disable(self) -> None:
+        self.enabled = False
+        if self._stream:
+            self._stream.close()
+            self._stream = None
+
+    def reset(self) -> None:
+        with self._lock:
+            self.spans.clear()
+            self.events.clear()
+            self.metrics.clear()
+
+    # --- recording --------------------------------------------------------
+    def _depth(self) -> int:
+        return getattr(self._local, "depth", 0)
+
+    @contextlib.contextmanager
+    def span(self, name: str, **fields):
+        if not self.enabled:
+            yield
+            return
+        depth = self._depth()
+        self._local.depth = depth + 1
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            dt = time.perf_counter() - t0
+            self._local.depth = depth
+            rec = SpanRecord(name, t0, dt, depth, fields)
+            with self._lock:
+                self.spans.append(rec)
+            self._emit({"type": "span", "name": name, "duration_s": dt,
+                        "depth": depth, **fields})
+
+    def event(self, name: str, **fields) -> None:
+        if not self.enabled:
+            return
+        with self._lock:
+            self.events.append((time.time(), name, fields))
+        self._emit({"type": "event", "name": name, **fields})
+
+    def metric(self, name: str, value) -> None:
+        """Record a gauge/counter sample (last-write-wins + history)."""
+        if not self.enabled:
+            return
+        with self._lock:
+            self.metrics.setdefault(name, []).append(float(value))
+        self._emit({"type": "metric", "name": name, "value": float(value)})
+
+    def _emit(self, obj: dict) -> None:
+        if self._stream is not None:
+            self._stream.write(json.dumps(obj) + "\n")
+
+    # --- reporting --------------------------------------------------------
+    def summary(self) -> dict:
+        """Aggregate span stats: {name: {count, total_s, max_s}}."""
+        out: dict = {}
+        with self._lock:
+            for rec in self.spans:
+                agg = out.setdefault(
+                    rec.name, {"count": 0, "total_s": 0.0, "max_s": 0.0})
+                agg["count"] += 1
+                agg["total_s"] += rec.duration
+                agg["max_s"] = max(agg["max_s"], rec.duration)
+        return out
+
+    def dump_jsonl(self, path: str) -> None:
+        with open(path, "w") as f:
+            for rec in self.spans:
+                f.write(json.dumps({
+                    "type": "span", "name": rec.name, "start": rec.start,
+                    "duration_s": rec.duration, "depth": rec.depth,
+                    **rec.fields}) + "\n")
+            for ts, name, fields in self.events:
+                f.write(json.dumps(
+                    {"type": "event", "ts": ts, "name": name, **fields}) + "\n")
+            for name, values in self.metrics.items():
+                f.write(json.dumps(
+                    {"type": "metric", "name": name, "values": values}) + "\n")
+
+
+TRACER = Tracer()
+
+_env = os.environ.get("PROTOCOL_TPU_TRACE")
+if _env:
+    try:
+        TRACER.enable(None if _env == "1" else _env)
+    except OSError:  # unwritable stream path must not break imports
+        TRACER.disable()
+        TRACER.enabled = True  # keep in-memory tracing on
+
+
+def enable(stream_path: str | None = None) -> None:
+    TRACER.enable(stream_path)
+
+
+def disable() -> None:
+    TRACER.disable()
+
+
+def span(name: str, **fields):
+    return TRACER.span(name, **fields)
+
+
+def event(name: str, **fields) -> None:
+    TRACER.event(name, **fields)
+
+
+def metric(name: str, value) -> None:
+    TRACER.metric(name, value)
+
+
+def summary() -> dict:
+    return TRACER.summary()
+
+
+@contextlib.contextmanager
+def device_trace(log_dir: str):
+    """JAX profiler (xprof) passthrough for device-side timelines; pair
+    with ``tensorboard --logdir`` offline. No-op context on failure so
+    production paths never die on profiler availability."""
+    import jax
+
+    try:
+        jax.profiler.start_trace(log_dir)
+        started = True
+    except Exception:  # pragma: no cover - profiler unavailable
+        started = False
+    try:
+        yield
+    finally:
+        if started:
+            with contextlib.suppress(Exception):
+                jax.profiler.stop_trace()

@@ -1,6 +1,20 @@
 """ZK layer: constraint system, gadgets, circuits, and the KZG/PLONK
 proving stack (reference: the ``eigentrust-zk`` crate's circuit side).
 
-Round-1 status: the proving stack lands incrementally — see ``api`` for
-the stable facade the CLI and Client call.
+Modules
+-------
+- ``api``: stable byte-artifact facade for the CLI/Client (params,
+  proving keys, ET/Threshold proofs, verification).
+- ``plonk`` / ``prover_fast``: the proving system (pure Python twin +
+  native-kernel prover producing identical transcripts).
+- ``kzg`` / ``bn254`` / ``domain``: commitment scheme and field/curve
+  backends.
+- ``gadgets`` / ``poseidon_chip`` / ``integer_chip`` / ``ecc_chip`` /
+  ``ecdsa_chip``: the chip layer.
+- ``eigentrust_circuit`` / ``threshold_circuit``: the two product
+  circuits.
+- ``transcript`` / ``aggregator`` / ``loader_chip``: Fiat–Shamir and
+  recursive aggregation (native + in-circuit).
+- ``evm`` / ``yul``: generated Yul on-chain verifier + the in-repo
+  executor for it.
 """

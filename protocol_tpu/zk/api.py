@@ -3,44 +3,308 @@
 Byte-level artifacts in, byte-level artifacts out — the CLI persists them
 via the EigenFile layout exactly like the reference persists halo2's
 serialized params/keys/proofs (eigentrust-cli/src/fs.rs:50-84).
+
+Twin of the reference Client's proving surface (eigentrust/src/lib.rs):
+``generate_kzg_params`` :588-604, ``generate_et_pk`` :537-558 (dummy
+circuit for key shape), ``generate_et_proof`` :239-269, ``verify``
+:304-336, ``generate_th_pk`` :561-585 (which, like the reference, must
+prove a full EigenTrust snark first to derive the Threshold key),
+``generate_th_proof`` :272-301 (re-proves the ET circuit with the
+Poseidon transcript and aggregates it in-circuit — the reference's
+``Snark::new`` + ``NativeAggregator`` path, aggregator/native.rs:75-187).
+
+One deliberate divergence: the reference ships two independent SRS files
+(k=20 and k=21).  KZG accumulation is only sound when the aggregated
+snark and the decider share one τ, and this stack generates params
+freshly (no shared ceremony), so the Threshold flow proves the inner
+EigenTrust snark under the *Threshold* SRS.
 """
 
 from __future__ import annotations
 
+from dataclasses import dataclass
+from fractions import Fraction
+
 from ..utils.errors import EigenError
+from ..utils.fields import Fr
 
 
-def _not_ready(what: str):
-    raise EigenError(
-        "proving_error",
-        f"{what}: the PLONK/KZG proving stack is still landing; "
-        "track protocol_tpu.zk",
+@dataclass(frozen=True)
+class CircuitShape:
+    """The EigenTrust4 instantiation (circuits/mod.rs:38-59) as runtime
+    config — const generics in the reference, jit-shape params here."""
+
+    num_neighbours: int = 4
+    num_iterations: int = 20
+    initial_score: int = 1000
+    lookup_bits: int = 17
+    num_limbs: int = 2
+    power_of_ten: int = 72
+
+
+DEFAULT_SHAPE = CircuitShape()
+
+_DUMMY_SEED = 0xD00D
+
+
+def generate_kzg_params(k: int, seed: bytes | None = None) -> bytes:
+    """Universal SRS for circuits up to 2^k rows (lib.rs:588-604)."""
+    from .prover_fast import available, setup_params_fast
+
+    if available():
+        return setup_params_fast(k, seed=seed).to_bytes()
+    from .kzg import KZGParams
+
+    return KZGParams.setup(k, seed=seed).to_bytes()
+
+
+def _keygen(params, cs):
+    from .prover_fast import available, keygen_fast
+
+    if available():
+        return keygen_fast(params, cs)
+    from .plonk import keygen
+
+    return keygen(params, cs)
+
+
+def _prove(params, pk, cs):
+    from .prover_fast import FastProvingKey, prove_fast
+
+    if isinstance(pk, FastProvingKey):
+        return prove_fast(params, pk, cs)
+    from .plonk import prove
+
+    return prove(params, pk, cs)
+
+
+def _load_params(params: bytes):
+    from .kzg import KZGParams
+
+    return KZGParams.from_bytes(params)
+
+
+def _load_pk(pk: bytes):
+    """Format-sniffing load: FPK1 limb-array keys (native kernels) or
+    the pure-Python ProvingKey JSON — each proves via its own path in
+    ``_prove``."""
+    from .prover_fast import FastProvingKey
+
+    if pk[:4] == b"FPK1":
+        return FastProvingKey.from_bytes(pk)
+    from .plonk import ProvingKey
+
+    return ProvingKey.from_bytes(pk)
+
+
+def _load_vk(pk: bytes):
+    from .prover_fast import VerifyingKey
+
+    return VerifyingKey.from_key_bytes(pk)
+
+
+def _dummy_et_fixture(shape: CircuitShape):
+    """Deterministic full-opinion fixture giving the canonical circuit
+    shape — the reference's dummy-circuit trick for keygen
+    (lib.rs:537-558; with its NUM_ITERATIONS/NUM_NEIGHBOURS dim quirk
+    deliberately not replicated, SURVEY.md §7.3)."""
+    from ..crypto.secp256k1 import EcdsaKeypair
+    from ..models.eigentrust import Attestation, EigenTrustSet, SignedAttestation
+    from .eigentrust_circuit import ETWitness
+
+    n = shape.num_neighbours
+    kps = [EcdsaKeypair(_DUMMY_SEED + i) for i in range(n)]
+    addrs = [kp.public_key.to_address() for kp in kps]
+    domain = Fr(1)
+    native = EigenTrustSet(n, shape.num_iterations, shape.initial_score, domain)
+    for a in addrs:
+        native.add_member(a)
+    matrix = [[None] * n for _ in range(n)]
+    for i in range(n):
+        signed = []
+        for j in range(n):
+            if i == j:
+                signed.append(None)
+                continue
+            att = Attestation(about=addrs[j], domain=domain,
+                              value=Fr(100), message=Fr.zero())
+            sa = SignedAttestation(att, kps[i].sign(int(att.hash())))
+            signed.append(sa)
+            matrix[i][j] = sa
+        native.update_op(kps[i].public_key, signed)
+    scores = native.converge()
+    ratios = native.converge_rational()
+    witness = ETWitness(addresses=list(addrs),
+                        pubkeys=[kp.public_key for kp in kps],
+                        att_matrix=matrix, domain=domain)
+    return witness, addrs, scores, ratios
+
+
+def _build_et_circuit(witness, shape: CircuitShape):
+    from .eigentrust_circuit import EigenTrustSetCircuit
+
+    circuit = EigenTrustSetCircuit(
+        num_neighbours=shape.num_neighbours,
+        num_iterations=shape.num_iterations,
+        initial_score=shape.initial_score,
+        lookup_bits=shape.lookup_bits,
     )
+    return circuit.build(witness)
 
 
-def generate_kzg_params(k: int) -> bytes:
-    _not_ready("kzg-params")
+def generate_et_pk(params: bytes, shape: CircuitShape = DEFAULT_SHAPE) -> bytes:
+    """Proving key over the dummy-witness circuit (lib.rs:537-558); the
+    circuit structure is witness-independent, so the key proves any
+    same-shape witness."""
+    p = _load_params(params)
+    witness, *_ = _dummy_et_fixture(shape)
+    chips, _ = _build_et_circuit(witness, shape)
+    return _keygen(p, chips.cs).to_bytes()
 
 
-def generate_et_pk(params: bytes) -> bytes:
-    _not_ready("et-proving-key")
+def _et_setup_circuit(setup, shape: CircuitShape):
+    """Rebuild the satisfied circuit from an ETSetup and cross-check its
+    public inputs against the setup's (lib.rs:239-269 builds EigenTrust4
+    from the same matrix it converged natively)."""
+    from .eigentrust_circuit import ETWitness
+
+    witness = ETWitness(
+        addresses=list(setup.pub_inputs.participants),
+        pubkeys=list(setup.pub_keys),
+        att_matrix=setup.attestation_matrix,
+        domain=setup.pub_inputs.domain,
+    )
+    chips, pubs = _build_et_circuit(witness, shape)
+    expected = [int(x) for x in setup.pub_inputs.to_flat()]
+    if pubs != expected:
+        raise EigenError(
+            "proving_error",
+            "circuit public inputs diverge from the native setup",
+        )
+    return chips, pubs
 
 
-def generate_et_proof(params: bytes, pk: bytes, setup) -> bytes:
-    _not_ready("et-proof")
+def generate_et_proof(params: bytes, pk: bytes, setup,
+                      shape: CircuitShape = DEFAULT_SHAPE) -> bytes:
+    p = _load_params(params)
+    chips, _ = _et_setup_circuit(setup, shape)
+    return _prove(p, _load_pk(pk), chips.cs)
 
 
-def verify_et(params: bytes, pk: bytes, pub_inputs: bytes, proof: bytes) -> bool:
-    _not_ready("et-verify")
+def verify_et(params: bytes, pk: bytes, pub_inputs: bytes, proof: bytes,
+              shape: CircuitShape = DEFAULT_SHAPE) -> bool:
+    from ..client.circuit_io import ETPublicInputs
+    from .plonk import verify
+
+    p = _load_params(params)
+    pubs = ETPublicInputs.from_bytes(pub_inputs, shape.num_neighbours)
+    flat = [int(x) for x in pubs.to_flat()]
+    return verify(p, _load_vk(pk), flat, proof)
 
 
-def generate_th_pk(params: bytes) -> bytes:
-    _not_ready("th-proving-key")
+def _aggregate_th_circuit(p, et_chips, et_pubs, target_address: Fr,
+                          threshold: Fr, ratio: Fraction,
+                          shape: CircuitShape):
+    """ET snark (keygen + prove under the shared SRS) aggregated inside
+    the Threshold circuit — the reference's th_circuit_setup hot path
+    (lib.rs:469-534: Snark::new re-keygens and re-proves the whole ET
+    circuit, aggregator/native.rs:78-96)."""
+    from .threshold_circuit import ThresholdCircuit
+
+    et_pk = _keygen(p, et_chips.cs)
+    et_proof = _prove(p, et_pk, et_chips.cs)
+
+    circuit = ThresholdCircuit(
+        num_neighbours=shape.num_neighbours,
+        num_limbs=shape.num_limbs,
+        power_of_ten=shape.power_of_ten,
+        initial_score=shape.initial_score,
+        lookup_bits=shape.lookup_bits,
+    )
+    return circuit.build_aggregated(et_pk, et_pubs, et_proof,
+                                    target_address, threshold, ratio)
 
 
-def generate_th_proof(params: bytes, pk: bytes, setup) -> bytes:
-    _not_ready("th-proof")
+def generate_th_pk(params: bytes, shape: CircuitShape = DEFAULT_SHAPE) -> bytes:
+    """Threshold proving key. Like the reference (lib.rs:561-585) this
+    must build the full aggregated circuit — i.e. actually prove a dummy
+    EigenTrust snark first — to derive the key."""
+    p = _load_params(params)
+    witness, addrs, _, ratios = _dummy_et_fixture(shape)
+    et_chips, et_pubs = _build_et_circuit(witness, shape)
+    chips, _ = _aggregate_th_circuit(p, et_chips, et_pubs, addrs[0], Fr(1),
+                                     ratios[0], shape)
+    return _keygen(p, chips.cs).to_bytes()
 
 
-def verify_th(params: bytes, pk: bytes, pub_inputs: bytes, proof: bytes) -> bool:
-    _not_ready("th-verify")
+def generate_th_proof(params: bytes, pk: bytes, setup,
+                      shape: CircuitShape = DEFAULT_SHAPE) -> bytes:
+    """Prove the Threshold circuit for a ThSetup. Fills in
+    ``setup.pub_inputs.agg_instances`` with the accumulator limbs of the
+    freshly-proven inner EigenTrust snark (the caller persists the
+    public inputs *after* this returns, exactly like handle_th_proof
+    writes them post-proof, cli.rs:542-583)."""
+    if setup.et_setup is None or setup.ratio is None:
+        raise EigenError(
+            "proving_error",
+            "ThSetup lacks the EigenTrust context; build it via "
+            "Client.th_circuit_setup",
+        )
+    p = _load_params(params)
+    et_chips, et_pubs = _et_setup_circuit(setup.et_setup, shape)
+    chips, pubs = _aggregate_th_circuit(
+        p, et_chips, et_pubs, setup.pub_inputs.address,
+        setup.pub_inputs.threshold, setup.ratio, shape,
+    )
+    expected_head = [
+        int(setup.pub_inputs.address),
+        int(setup.pub_inputs.threshold),
+        1 if setup.pub_inputs.threshold_check else 0,
+    ]
+    if pubs[:3] != expected_head:
+        raise EigenError(
+            "proving_error",
+            "threshold circuit public inputs diverge from the setup",
+        )
+    setup.pub_inputs.agg_instances = [Fr(v) for v in pubs[3:]]
+    return _prove(p, _load_pk(pk), chips.cs)
+
+
+def _accumulator_from_limbs(limbs: list):
+    """16 Fr limb instances → (lhs, rhs) G1 pair (inverse of
+    ``aggregator.accumulator_limbs``)."""
+    from .bn254 import g1_is_on_curve
+    from .integer_chip import NUM_LIMBS, from_limbs
+
+    if len(limbs) != 4 * NUM_LIMBS:
+        raise EigenError("verification_error",
+                         f"expected {4 * NUM_LIMBS} accumulator limbs, "
+                         f"got {len(limbs)}")
+    coords = [from_limbs(limbs[i * NUM_LIMBS:(i + 1) * NUM_LIMBS])
+              for i in range(4)]
+    lhs = (coords[0], coords[1])
+    rhs = (coords[2], coords[3])
+    for pt in (lhs, rhs):
+        if not g1_is_on_curve(pt):
+            raise EigenError("verification_error",
+                             "accumulator limbs do not encode G1 points")
+    return lhs, rhs
+
+
+def verify_th(params: bytes, pk: bytes, pub_inputs: bytes, proof: bytes,
+              shape: CircuitShape = DEFAULT_SHAPE) -> bool:
+    """PLONK-verify the Threshold proof, then run the deferred KZG
+    decider over the accumulator limbs it exposes (the one pairing that
+    attests to the aggregated EigenTrust snark, lib.rs:665-673 +
+    aggregator decide)."""
+    from ..client.circuit_io import ThPublicInputs
+    from .kzg import decide
+    from .plonk import verify
+
+    p = _load_params(params)
+    pubs = ThPublicInputs.from_bytes(pub_inputs)
+    flat = [int(x) for x in pubs.to_flat()]
+    if not verify(p, _load_vk(pk), flat, proof):
+        return False
+    lhs, rhs = _accumulator_from_limbs(pubs.agg_instances)
+    return decide(p, lhs, rhs)

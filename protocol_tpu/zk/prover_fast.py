@@ -68,11 +68,23 @@ def _set_int(arr: np.ndarray, i: int, v: int) -> None:
     arr[i] = np.frombuffer(int(v % R).to_bytes(32, "little"), dtype="<u8")
 
 
-def _col_to_limbs(col: list, n: int) -> np.ndarray:
-    out = np.zeros((n, 4), dtype="<u8")
-    if col:
-        out[: len(col)] = native.ints_to_limbs(col)
-    return out
+def _parse_key_header(data: bytes) -> tuple:
+    """(header_dict, payload_offset) for either serialized key format:
+    FPK1 (limb arrays after a JSON header) or the pure-Python
+    ProvingKey's bare JSON (payload_offset = None)."""
+    if data[:4] == b"FPK1":
+        hlen = int.from_bytes(data[4:12], "little")
+        return json.loads(data[12 : 12 + hlen].decode()), 12 + hlen
+    try:
+        return json.loads(data.decode()), None
+    except (UnicodeDecodeError, ValueError) as e:
+        raise EigenError("proving_error",
+                         "unrecognized proving key format") from e
+
+
+def _decode_vk_commits(header: dict) -> dict:
+    return {name: g1_from_bytes(bytes.fromhex(h))
+            for name, h in header["vk_commits"].items()}
 
 
 # --- SRS limb cache --------------------------------------------------------
@@ -156,10 +168,8 @@ class FastProvingKey:
     def from_bytes(cls, data: bytes) -> "FastProvingKey":
         if data[:4] != b"FPK1":
             raise EigenError("proving_error", "bad proving key magic")
-        hlen = int.from_bytes(data[4:12], "little")
-        p = json.loads(data[12 : 12 + hlen].decode())
+        p, off = _parse_key_header(data)
         n = 1 << p["k"]
-        off = 12 + hlen
         fixed = np.frombuffer(data, dtype="<u8", count=9 * n * 4,
                               offset=off).reshape(9, n, 4).copy()
         off += 9 * n * 4 * 8
@@ -172,10 +182,38 @@ class FastProvingKey:
         sigma_evals = np.empty_like(sigma)
         for w in range(NUM_WIRES):
             sigma_evals[w] = fk.ntt(sigma[w].copy(), omega)
-        commits = {name: g1_from_bytes(bytes.fromhex(h))
-                   for name, h in p["vk_commits"].items()}
         return cls(p["k"], fixed, sigma, sigma_evals, p["shifts"],
-                   p["public_rows"], p.get("lookup_bits"), commits)
+                   p["public_rows"], p.get("lookup_bits"),
+                   _decode_vk_commits(p))
+
+
+@dataclass
+class VerifyingKey:
+    """vk-only view of a serialized proving key: everything
+    ``succinct_verify``/``verify`` touch (domain, shifts, public rows,
+    vk commitments) without the coefficient columns — verification
+    never needs them, and at k=22 they are ~0.5 GB of limb data."""
+
+    k: int
+    shifts: list
+    public_rows: list
+    lookup_bits: int | None
+    vk_commits: dict
+
+    def domain(self) -> EvaluationDomain:
+        return EvaluationDomain(self.k)
+
+    def commit_list(self) -> list:
+        return ([self.vk_commits[name] for name in FIXED_NAMES]
+                + [self.vk_commits[f"sigma_{w}"] for w in range(NUM_WIRES)])
+
+    @classmethod
+    def from_key_bytes(cls, data: bytes) -> "VerifyingKey":
+        """Parse either key format (FPK1 limb-array or the slow path's
+        JSON), reading only the header fields."""
+        p, _ = _parse_key_header(data)
+        return cls(p["k"], p["shifts"], p["public_rows"],
+                   p.get("lookup_bits"), _decode_vk_commits(p))
 
 
 def keygen_fast(params: KZGParams, cs: ConstraintSystem,

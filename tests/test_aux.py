@@ -1,0 +1,248 @@
+"""Aux subsystems: tracing/metrics + checkpoint/resume (SURVEY.md §5 —
+net-new relative to the reference, which has only ad-hoc Instant timers
+and final-artifact persistence)."""
+
+import json
+
+import numpy as np
+import pytest
+
+from protocol_tpu.utils import trace
+from protocol_tpu.utils.checkpoint import CheckpointManager
+from protocol_tpu.utils.errors import EigenError
+
+
+@pytest.fixture
+def tracer():
+    t = trace.Tracer()
+    t.enable()
+    return t
+
+
+class TestTracer:
+    def test_disabled_is_noop(self):
+        t = trace.Tracer()
+        with t.span("x"):
+            t.event("e")
+            t.metric("m", 1)
+        assert not t.spans and not t.events and not t.metrics
+
+    def test_nested_spans_and_summary(self, tracer):
+        with tracer.span("outer"):
+            with tracer.span("inner", shard=3):
+                pass
+            with tracer.span("inner"):
+                pass
+        s = tracer.summary()
+        assert s["inner"]["count"] == 2
+        assert s["outer"]["count"] == 1
+        assert s["outer"]["total_s"] >= s["inner"]["total_s"]
+        depths = {r.name: r.depth for r in tracer.spans}
+        assert depths == {"inner": 1, "outer": 0}
+
+    def test_metrics_history(self, tracer):
+        tracer.metric("delta", 0.5)
+        tracer.metric("delta", 0.1)
+        assert tracer.metrics["delta"] == [0.5, 0.1]
+
+    def test_jsonl_dump(self, tracer, tmp_path):
+        with tracer.span("s", k=1):
+            tracer.event("e", detail="x")
+        tracer.metric("m", 2.0)
+        path = tmp_path / "trace.jsonl"
+        tracer.dump_jsonl(str(path))
+        lines = [json.loads(l) for l in path.read_text().splitlines()]
+        types = {l["type"] for l in lines}
+        assert types == {"span", "event", "metric"}
+
+    def test_stream_path(self, tmp_path):
+        t = trace.Tracer()
+        t.enable(str(tmp_path / "live.jsonl"))
+        t.event("boot", ok=True)
+        t.disable()
+        line = json.loads((tmp_path / "live.jsonl").read_text())
+        assert line["name"] == "boot" and line["ok"] is True
+
+
+class TestCheckpointManager:
+    def test_save_restore_roundtrip(self, tmp_path):
+        cm = CheckpointManager(str(tmp_path))
+        scores = np.arange(10.0)
+        cm.save(5, {"scores": scores}, meta={"delta": 0.25})
+        step, arrays, meta = cm.restore()
+        assert step == 5
+        np.testing.assert_array_equal(arrays["scores"], scores)
+        assert meta["delta"] == 0.25 and meta["step"] == 5
+
+    def test_keep_bound_gc(self, tmp_path):
+        cm = CheckpointManager(str(tmp_path), keep=2)
+        for step in (1, 2, 3, 4):
+            cm.save(step, {"scores": np.zeros(4)})
+        assert cm.steps() == [3, 4]
+
+    def test_restore_empty_raises(self, tmp_path):
+        cm = CheckpointManager(str(tmp_path))
+        with pytest.raises(EigenError):
+            cm.restore()
+
+    def test_partial_write_ignored(self, tmp_path):
+        """A payload without its sidecar (crash between renames) must
+        not be offered for resume."""
+        cm = CheckpointManager(str(tmp_path))
+        cm.save(1, {"scores": np.zeros(3)})
+        (tmp_path / "step-000000000002.npz").write_bytes(b"garbage")
+        assert cm.steps() == [1]
+        assert cm.latest() == 1
+
+
+class TestCheckpointedConverge:
+    @pytest.fixture(scope="class")
+    def problem(self):
+        from protocol_tpu.graph import barabasi_albert_edges
+        from protocol_tpu.parallel import build_sharded_operator, make_mesh
+
+        n = 256
+        src, dst, val = barabasi_albert_edges(n, 3, seed=11)
+        mesh = make_mesh(4)
+        sop = build_sharded_operator(n, src, dst, val, num_shards=4)
+        return mesh, sop
+
+    def test_matches_unchunked(self, problem, tmp_path):
+        import jax.numpy as jnp
+
+        from protocol_tpu.parallel import (
+            sharded_converge_adaptive,
+            sharded_converge_checkpointed,
+        )
+
+        mesh, sop = problem
+        s0 = sop.initial_scores(1000.0, dtype=jnp.float64)
+        ref, ref_iters, ref_delta = sharded_converge_adaptive(
+            sop, s0, mesh, tol=1e-8, max_iterations=50)
+
+        cm = CheckpointManager(str(tmp_path / "ck"))
+        out, iters, delta = sharded_converge_checkpointed(
+            sop, s0, mesh, cm, tol=1e-8, max_iterations=50,
+            checkpoint_every=7)
+        assert iters == int(ref_iters)
+        np.testing.assert_allclose(
+            np.asarray(out), np.asarray(ref), rtol=1e-12, atol=1e-9)
+        assert delta == pytest.approx(float(ref_delta))
+        assert cm.latest() == iters
+
+    def test_resume_after_crash(self, problem, tmp_path):
+        """Kill the run mid-way; the resumed run must land on the same
+        scores as an uninterrupted one."""
+        import jax.numpy as jnp
+
+        from protocol_tpu.parallel import (
+            sharded_converge_adaptive,
+            sharded_converge_checkpointed,
+        )
+
+        mesh, sop = problem
+        s0 = sop.initial_scores(1000.0, dtype=jnp.float64)
+        cm = CheckpointManager(str(tmp_path / "ck"))
+
+        # phase 1: only allow 10 iterations ("crash" after that)
+        sharded_converge_checkpointed(
+            sop, s0, mesh, cm, tol=1e-8, max_iterations=10,
+            checkpoint_every=5, alpha=0.2)
+        assert cm.latest() == 10
+
+        # phase 2: resume to convergence
+        out, iters, delta = sharded_converge_checkpointed(
+            sop, s0, mesh, cm, tol=1e-8, max_iterations=150,
+            checkpoint_every=5, alpha=0.2)
+        assert iters > 10 and delta <= 1e-8
+
+        ref, *_ = sharded_converge_adaptive(
+            sop, s0, mesh, tol=1e-8, max_iterations=150, alpha=0.2)
+        np.testing.assert_allclose(
+            np.asarray(out), np.asarray(ref), rtol=1e-10, atol=1e-8)
+
+    def test_shape_mismatch_rejected(self, problem, tmp_path):
+        import jax.numpy as jnp
+
+        from protocol_tpu.parallel import sharded_converge_checkpointed
+
+        mesh, sop = problem
+        cm = CheckpointManager(str(tmp_path / "ck"))
+        cm.save(3, {"scores": np.zeros(sop.n_pad + 4)})
+        with pytest.raises(ValueError):
+            sharded_converge_checkpointed(
+                sop, sop.initial_scores(1000.0, dtype=jnp.float64), mesh,
+                cm, max_iterations=5)
+
+    def test_run_with_retries(self, problem, tmp_path):
+        import jax.numpy as jnp
+
+        from protocol_tpu.parallel import (
+            run_with_retries,
+            sharded_converge_checkpointed,
+        )
+
+        mesh, sop = problem
+        s0 = sop.initial_scores(1000.0, dtype=jnp.float64)
+        cm = CheckpointManager(str(tmp_path / "ck"))
+        attempts = {"n": 0}
+
+        def job():
+            attempts["n"] += 1
+            if attempts["n"] == 1:
+                # simulate a device failure after some progress
+                sharded_converge_checkpointed(
+                    sop, s0, mesh, cm, tol=1e-8, max_iterations=10,
+                    checkpoint_every=5, alpha=0.2)
+                raise RuntimeError("device lost")
+            return sharded_converge_checkpointed(
+                sop, s0, mesh, cm, tol=1e-8, max_iterations=150,
+                checkpoint_every=5, alpha=0.2)
+
+        out, iters, delta = run_with_retries(job)
+        assert attempts["n"] == 2 and delta <= 1e-8
+
+
+class TestReviewRegressions:
+    def test_stale_tmp_sidecar_ignored(self, tmp_path):
+        """A leftover step-*.tmp.json (crash between renames) must not
+        break steps()/resume — and gets swept."""
+        cm = CheckpointManager(str(tmp_path))
+        cm.save(4, {"scores": np.zeros(3)})
+        stale = tmp_path / "step-000000000009.tmp.json"
+        stale.write_text("{}")
+        assert cm.steps() == [4]
+        assert not stale.exists()
+
+    def test_resume_with_no_budget_reports_checkpoint_delta(self, tmp_path):
+        """Resuming at step == max_iterations must report the recorded
+        delta, not inf."""
+        from protocol_tpu.graph import barabasi_albert_edges
+        from protocol_tpu.parallel import (
+            build_sharded_operator,
+            make_mesh,
+            sharded_converge_checkpointed,
+        )
+        import jax.numpy as jnp
+
+        n = 64
+        src, dst, val = barabasi_albert_edges(n, 3, seed=2)
+        mesh = make_mesh(4)
+        sop = build_sharded_operator(n, src, dst, val, num_shards=4)
+        s0 = sop.initial_scores(1000.0, dtype=jnp.float64)
+        cm = CheckpointManager(str(tmp_path / "ck"))
+        _, iters1, delta1 = sharded_converge_checkpointed(
+            sop, s0, mesh, cm, tol=1e-12, max_iterations=6,
+            checkpoint_every=3, alpha=0.2)
+        assert iters1 == 6 and np.isfinite(delta1)
+        _, iters2, delta2 = sharded_converge_checkpointed(
+            sop, s0, mesh, cm, tol=1e-12, max_iterations=6,
+            checkpoint_every=3, alpha=0.2)
+        assert iters2 == 6
+        assert delta2 == pytest.approx(delta1)
+
+    def test_vk_parse_garbage_rejected(self):
+        from protocol_tpu.zk.prover_fast import VerifyingKey
+
+        with pytest.raises(EigenError):
+            VerifyingKey.from_key_bytes(b"\xff\xfe not a key")

@@ -1,0 +1,174 @@
+"""EVM verifier generation (zk/evm.py) + Yul interpreter (zk/yul.py) —
+twin of the reference's generated-Yul verifier tests
+(``eigentrust-zk/src/verifier/mod.rs:292-332``: generate, encode
+calldata, run in an in-memory EVM, check accept/reject)."""
+
+import pytest
+
+from protocol_tpu.utils.errors import EigenError
+from protocol_tpu.utils.fields import BN254_FR_MODULUS as R
+from protocol_tpu.zk import evm
+from protocol_tpu.zk.gadgets import Chips
+from protocol_tpu.zk.kzg import KZGParams
+from protocol_tpu.zk.plonk import ConstraintSystem, keygen, prove
+from protocol_tpu.zk.yul import VMRevert, YulVM
+
+
+@pytest.fixture(scope="module")
+def snark():
+    """Small real proof exercising every selector + the lookup table."""
+    c = Chips(ConstraintSystem(lookup_bits=4))
+    x, y = c.witness(3), c.witness(4)
+    s = c.add(x, y)
+    c.lincomb([(2, x), (3, y), (1, s), (1, c.mul(x, y))], const=1)
+    c.mul_add(x, y, s)
+    c.range_check(c.witness(9), 4)
+    out = c.mul(x, s)
+    c.public(out)
+    c.public(x)
+    c.cs.check_satisfied()
+    params = KZGParams.setup(8, seed=b"evm-test")
+    pk = keygen(params, c.cs)
+    proof = prove(params, pk, c.cs)
+    return params, pk, c.cs.public_values(), proof
+
+
+@pytest.fixture(scope="module")
+def verifier(snark):
+    params, pk, pubs, proof = snark
+    return evm.gen_evm_verifier_code(params, pk)
+
+
+class TestYulInterpreter:
+    def run(self, body, calldata=b""):
+        return YulVM("{ " + body + " }").run(calldata)
+
+    def test_arithmetic_and_return(self):
+        out, gas = self.run(
+            "mstore(0, addmod(mul(3, 5), 2, 7)) return(0, 32)")
+        assert int.from_bytes(out, "big") == 3  # (15+2) mod 7
+        assert gas > 0
+
+    def test_for_loop_break(self):
+        out, _ = self.run("""
+            let acc := 0
+            for { let i := 0 } lt(i, 100) { i := add(i, 1) } {
+                if eq(i, 5) { break }
+                acc := add(acc, i)
+            }
+            mstore(0, acc) return(0, 32)""")
+        assert int.from_bytes(out, "big") == 10
+
+    def test_switch_and_functions(self):
+        out, _ = self.run("""
+            function both(a, b) -> lo, hi {
+                lo := a
+                hi := b
+                if gt(a, b) { lo := b hi := a }
+            }
+            let lo, hi := both(9, 4)
+            switch hi
+            case 9 { mstore(0, lo) }
+            default { mstore(0, 999) }
+            return(0, 32)""")
+        assert int.from_bytes(out, "big") == 4
+
+    def test_calldata_and_revert(self):
+        body = "if lt(calldataload(0), 10) { revert(0, 0) } " \
+               "mstore(0, 1) return(0, 32)"
+        with pytest.raises(VMRevert):
+            self.run(body, (5).to_bytes(32, "big"))
+        out, _ = self.run(body, (11).to_bytes(32, "big"))
+        assert int.from_bytes(out, "big") == 1
+
+    def test_modexp_precompile(self):
+        out, _ = self.run(f"""
+            mstore(0, 32) mstore(32, 32) mstore(64, 32)
+            mstore(96, 5) mstore(128, 3) mstore(160, 97)
+            pop(staticcall(gas(), 5, 0, 192, 0, 32))
+            return(0, 32)""")
+        assert int.from_bytes(out, "big") == pow(5, 3, 97)
+
+    def test_ec_precompiles(self):
+        from protocol_tpu.zk.bn254 import G1_GEN, g1_add, g1_mul
+
+        out, gas = self.run("""
+            mstore(0, 1) mstore(32, 2) mstore(64, 5)
+            pop(staticcall(gas(), 7, 0, 96, 0, 64))
+            mstore(64, 1) mstore(96, 2)
+            pop(staticcall(gas(), 6, 0, 128, 0, 64))
+            return(0, 64)""")
+        expect = g1_add(g1_mul(G1_GEN, 5), G1_GEN)
+        assert int.from_bytes(out[:32], "big") == expect[0]
+        assert int.from_bytes(out[32:], "big") == expect[1]
+        assert gas > 6000  # ecMul price charged
+
+
+class TestEvmVerifier:
+    def test_accepts_valid_proof(self, snark, verifier):
+        _, _, pubs, proof = snark
+        ok, gas = evm.evm_verify(verifier, evm.encode_calldata(pubs, proof))
+        assert ok
+        # pairing + ~35 sponge permutations dominate
+        assert 100_000 < gas < 10_000_000
+
+    def test_rejects_wrong_calldata_size(self, verifier):
+        ok, _ = evm.evm_verify(verifier, b"\x00" * 31)
+        assert not ok
+
+    @pytest.mark.parametrize("section", ["instance", "point", "eval", "w"])
+    def test_rejects_tampering(self, snark, verifier, section):
+        _, _, pubs, proof = snark
+        calldata = bytearray(evm.encode_calldata(pubs, proof))
+        n_pub = len(pubs)
+        offsets = {
+            "instance": 31,
+            "point": 32 * n_pub + 16,
+            "eval": 32 * (n_pub + 32) + 31,
+            "w": len(calldata) - 100,
+        }
+        calldata[offsets[section]] ^= 1
+        ok, _ = evm.evm_verify(verifier, bytes(calldata))
+        assert not ok
+
+    def test_rejects_swapped_instances(self, snark, verifier):
+        _, _, pubs, proof = snark
+        assert pubs[0] != pubs[1]
+        ok, _ = evm.evm_verify(
+            verifier, evm.encode_calldata(list(reversed(pubs)), proof))
+        assert not ok
+
+    def test_non_field_instance_rejected(self, snark, verifier):
+        _, _, pubs, proof = snark
+        bad = [pubs[0] + R] + [int(v) for v in pubs[1:]]
+        ok, _ = evm.evm_verify(verifier, evm.encode_calldata(bad, proof))
+        assert not ok
+
+    def test_codegen_deterministic(self, snark):
+        params, pk, *_ = snark
+        assert (evm.gen_evm_verifier_code(params, pk)
+                == evm.gen_evm_verifier_code(params, pk))
+
+    def test_calldata_length_check(self, snark):
+        _, _, pubs, proof = snark
+        with pytest.raises(EigenError):
+            evm.encode_calldata(pubs, proof[:-1])
+
+    def test_matches_native_verifier_verdict(self, snark, verifier):
+        """Generated verifier and plonk.verify agree on the same bytes."""
+        from protocol_tpu.zk.plonk import verify
+
+        params, pk, pubs, proof = snark
+        assert verify(params, pk, pubs, proof)
+        ok, _ = evm.evm_verify(verifier, evm.encode_calldata(pubs, proof))
+        assert ok
+
+    def test_vk_only_generation(self, snark):
+        """Codegen works from a serialized key reloaded as vk-only."""
+        from protocol_tpu.zk.prover_fast import VerifyingKey
+
+        params, pk, pubs, proof = snark
+        vk = VerifyingKey.from_key_bytes(pk.to_bytes())
+        code = evm.gen_evm_verifier_code(params, vk)
+        ok, _ = evm.evm_verify(code, evm.encode_calldata(pubs, proof))
+        assert ok
