@@ -1,5 +1,5 @@
-"""TPU compute kernels: converge (dense + bucketed-ELL SpMV), and batched
-crypto/field primitives."""
+"""TPU compute kernels: converge (dense + bucketed-ELL SpMV), batched
+big-prime field arithmetic, and batched Poseidon hashing."""
 
 from .converge import (
     converge_dense_fixed,
@@ -9,6 +9,21 @@ from .converge import (
     operator_arrays,
     spmv,
 )
+from .fieldops import (
+    FieldCtx,
+    add_mod,
+    field_converge,
+    from_limbs,
+    from_mont,
+    inv_mod,
+    mont_matvec,
+    mont_mul,
+    mont_pow,
+    sub_mod,
+    to_limbs,
+    to_mont,
+)
+from .poseidon_batch import PoseidonBatch
 
 __all__ = [
     "converge_dense_fixed",
@@ -17,4 +32,17 @@ __all__ = [
     "converge_sparse_adaptive",
     "operator_arrays",
     "spmv",
+    "FieldCtx",
+    "add_mod",
+    "field_converge",
+    "from_limbs",
+    "from_mont",
+    "inv_mod",
+    "mont_matvec",
+    "mont_mul",
+    "mont_pow",
+    "sub_mod",
+    "to_limbs",
+    "to_mont",
+    "PoseidonBatch",
 ]

@@ -1,0 +1,316 @@
+"""Batched big-prime field arithmetic on TPU (jnp, int32 limbs).
+
+BASELINE.json config 5: "eigentrust-zk witness gen, batched BN254 field
+ops on TPU, bit-exact field scores". The reference does all field math
+in scalar Rust (ff 4×u64 Montgomery, e.g. the converge hot loop
+``dynamic_sets/native.rs:319-329`` and per-cell witness inverses
+``dynamic_sets/mod.rs:126-181``); here the same arithmetic runs
+data-parallel over a batch dimension so large witness pipelines (hashes,
+score products, inverse chains) are one TPU dispatch, not N scalar ops.
+
+Representation: a field element is a row of ``L`` little-endian limbs of
+``B`` bits in int32. B=12, L=22 (264 bits ≥ 254-bit moduli) keeps every
+intermediate of the Montgomery CIOS inner loop below 2^31:
+
+- per-step products are < 2^24,
+- limbs accumulate lazily across the 22 CIOS steps (bounded by
+  22·2^25 < 2^30) — no per-step carry propagation,
+- the shifted-out limb's low bits are exact despite deferred carries,
+  because t ≡ t[0] (mod 2^B) (all other limbs carry factors of 2^B).
+
+Everything is modulus-generic (BN254 Fr/Fq, secp256k1 field and order —
+any prime up to 256 bits): precompute a ``FieldCtx`` per modulus. All ops are
+shape-static, jit-compatible, int32-only (TPU-native); bit-exactness
+against Python ints is the test contract (``tests/test_fieldops.py``).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+LIMB_BITS = 12
+NUM_LIMBS = 22
+BASE = 1 << LIMB_BITS
+MASK = BASE - 1
+
+
+class FieldCtx:
+    """Per-modulus constants, host-side. Hashable/static for jit."""
+
+    def __init__(self, modulus: int):
+        # CIOS is exact for any modulus < R = 2^264: with input x < R the
+        # output is < p·(x/R + 1) < 2p, which one conditional subtract
+        # fixes. 256 bits leaves ≥ 2^8 of lazy-sum headroom (see
+        # ``max_lazy_terms``) — enough for BN254 Fr/Fq AND the secp256k1
+        # field/order the batched-ECDSA path needs.
+        if modulus.bit_length() > 256:
+            raise ValueError("modulus too large for the limb layout")
+        self.modulus = modulus
+        # how many < p terms may be lazily summed before exceeding R
+        self.max_lazy_terms = 1 << (LIMB_BITS * NUM_LIMBS
+                                    - modulus.bit_length())
+        self.p_limbs = tuple(
+            (modulus >> (LIMB_BITS * i)) & MASK for i in range(NUM_LIMBS)
+        )
+        # -p^{-1} mod 2^B (CIOS quotient constant)
+        self.p_inv_neg = (-pow(modulus, -1, BASE)) % BASE
+        self.r = pow(2, LIMB_BITS * NUM_LIMBS, modulus)  # R mod p
+        self.r2 = self.r * self.r % modulus  # R² mod p (to-Montgomery factor)
+
+    def __hash__(self):
+        return hash(self.modulus)
+
+    def __eq__(self, other):
+        return isinstance(other, FieldCtx) and other.modulus == self.modulus
+
+
+# --- host <-> limb conversion ----------------------------------------------
+
+def to_limbs(values) -> np.ndarray:
+    """Python ints → (n, L) int32 limb rows (plain, not Montgomery)."""
+    out = np.zeros((len(values), NUM_LIMBS), dtype=np.int32)
+    for i, v in enumerate(values):
+        v = int(v)
+        for j in range(NUM_LIMBS):
+            out[i, j] = (v >> (LIMB_BITS * j)) & MASK
+    return out
+
+
+def from_limbs(arr) -> list:
+    arr = np.asarray(arr)
+    return [
+        sum(int(arr[i, j]) << (LIMB_BITS * j) for j in range(NUM_LIMBS))
+        for i in range(arr.shape[0])
+    ]
+
+
+# --- carry handling ---------------------------------------------------------
+
+def _ripple(t: jnp.ndarray) -> jnp.ndarray:
+    """Normalize limbs to [0, 2^B): full-length carry/borrow ripple.
+
+    A single carry can cascade across every limb (…FFF + 1), so the pass
+    count is L. Works for negative limbs too: int32 ``>>`` is arithmetic
+    and ``& MASK`` of a negative limb yields its low bits, which is
+    exactly the borrow decomposition d = (d >> B)·2^B + (d & MASK)."""
+    width = t.shape[1]
+    for _ in range(width):
+        carry = t >> LIMB_BITS
+        t = (t & MASK) + jnp.pad(carry[:, :-1], ((0, 0), (1, 0)))
+    return t
+
+
+def _geq(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    """Rowwise a >= b on normalized limb rows (top-down lexicographic);
+    b may be a (L,) constant row or an (n, L) batch."""
+    b = jnp.broadcast_to(b, a.shape)
+    n = a.shape[0]
+    gt = jnp.zeros((n,), dtype=jnp.bool_)
+    eq = jnp.ones((n,), dtype=jnp.bool_)
+    for j in range(NUM_LIMBS - 1, -1, -1):
+        gt = gt | (eq & (a[:, j] > b[:, j]))
+        eq = eq & (a[:, j] == b[:, j])
+    return gt | eq
+
+
+def _p_row(ctx: FieldCtx) -> jnp.ndarray:
+    return jnp.asarray(ctx.p_limbs, dtype=jnp.int32)
+
+
+def _cond_sub_p(t: jnp.ndarray, ctx: FieldCtx) -> jnp.ndarray:
+    """One conditional subtract of p (inputs normalized, in [0, 2p))."""
+    p_row = _p_row(ctx)
+    sub = _geq(t, p_row)
+    return _ripple(t - jnp.where(sub[:, None], p_row, 0))
+
+
+# --- core Montgomery multiply ----------------------------------------------
+
+@partial(jax.jit, static_argnames=("ctx",))
+def mont_mul(ctx: FieldCtx, x: jnp.ndarray, y: jnp.ndarray) -> jnp.ndarray:
+    """Batched Montgomery product: x·y·R⁻¹ mod p, normalized rows.
+
+    x may hold lazily-summed values up to R = 2^264 (see
+    ``mont_matvec``): the CIOS output is < p·(x/R + 1) < 2p for any
+    x < R, so the single conditional subtract suffices."""
+    n = x.shape[0]
+    p_row = _p_row(ctx)
+    t = jnp.zeros((n, NUM_LIMBS + 2), dtype=jnp.int32)
+
+    def step(i, t):
+        xi = lax.dynamic_slice_in_dim(x, i, 1, axis=1)  # (n, 1)
+        t = t.at[:, :NUM_LIMBS].add(xi * y)
+        u = ((t[:, 0] & MASK) * ctx.p_inv_neg) & MASK  # (n,)
+        t = t.at[:, :NUM_LIMBS].add(u[:, None] * p_row)
+        # t ≡ 0 mod 2^B now; shift one limb down, keeping the carry exact
+        carry0 = t[:, 0] >> LIMB_BITS
+        t = jnp.pad(t[:, 1:], ((0, 0), (0, 1)))
+        t = t.at[:, 0].add(carry0)
+        return t
+
+    t = lax.fori_loop(0, NUM_LIMBS, step, t)
+    t = _ripple(t)[:, :NUM_LIMBS]
+    return _cond_sub_p(t, ctx)
+
+
+@partial(jax.jit, static_argnames=("ctx",))
+def add_mod(ctx: FieldCtx, x: jnp.ndarray, y: jnp.ndarray) -> jnp.ndarray:
+    """(x + y) mod p on normalized rows (works in either domain)."""
+    return _cond_sub_p(_ripple(x + y), ctx)
+
+
+@partial(jax.jit, static_argnames=("ctx",))
+def sub_mod(ctx: FieldCtx, x: jnp.ndarray, y: jnp.ndarray) -> jnp.ndarray:
+    """(x - y) mod p on normalized rows (works in either domain)."""
+    need_p = ~_geq(x, y)
+    return _ripple(x - y + jnp.where(need_p[:, None], _p_row(ctx), 0))
+
+
+def to_mont(ctx: FieldCtx, limbs: jnp.ndarray) -> jnp.ndarray:
+    """Plain rows → Montgomery domain (multiply by R² with reduction)."""
+    r2 = jnp.broadcast_to(
+        jnp.asarray(to_limbs([ctx.r2])[0], dtype=jnp.int32), limbs.shape
+    )
+    return mont_mul(ctx, limbs, r2)
+
+
+def from_mont(ctx: FieldCtx, limbs: jnp.ndarray) -> jnp.ndarray:
+    """Montgomery rows → plain rows (multiply by 1 with reduction)."""
+    one = jnp.zeros_like(limbs).at[:, 0].set(1)
+    return mont_mul(ctx, limbs, one)
+
+
+def mont_one(ctx: FieldCtx, n: int) -> jnp.ndarray:
+    """1 in Montgomery form, broadcast to (n, L)."""
+    return jnp.broadcast_to(
+        jnp.asarray(to_limbs([ctx.r])[0], dtype=jnp.int32), (n, NUM_LIMBS)
+    )
+
+
+@partial(jax.jit, static_argnames=("ctx", "exp"))
+def mont_pow(ctx: FieldCtx, x: jnp.ndarray, exp: int) -> jnp.ndarray:
+    """x^exp (static exponent) in the Montgomery domain.
+
+    Small exponents (the Poseidon S-box x^5) unroll to a minimal
+    multiply chain; large ones (Fermat inversion, ~254 bits) run a
+    rolled square-and-multiply under ``fori_loop`` — the unrolled chain
+    would be ~380 multiplies of ~22 ops each, minutes of XLA compile for
+    zero runtime benefit."""
+    e = int(exp)
+    if e.bit_length() <= 8:
+        acc = mont_one(ctx, x.shape[0])
+        base = x
+        while e:
+            if e & 1:
+                acc = mont_mul(ctx, acc, base)
+            e >>= 1
+            if e:
+                base = mont_mul(ctx, base, base)
+        return acc
+
+    nbits = e.bit_length()
+    bits = jnp.asarray([(e >> i) & 1 for i in range(nbits)], dtype=jnp.int32)
+
+    def step(i, state):
+        acc, base = state
+        hit = mont_mul(ctx, acc, base)
+        acc = jnp.where(bits[i] == 1, hit, acc)
+        base = mont_mul(ctx, base, base)
+        return acc, base
+
+    acc, _ = lax.fori_loop(0, nbits, step, (mont_one(ctx, x.shape[0]), x))
+    return acc
+
+
+def inv_mod(ctx: FieldCtx, x: jnp.ndarray) -> jnp.ndarray:
+    """Batched modular inverse via Fermat (x^(p-2)); 0 → 0 like the
+    reference's witness convention for absent inverses."""
+    return mont_pow(ctx, x, ctx.modulus - 2)
+
+
+# --- batched dot products (the field-converge building block) --------------
+
+@partial(jax.jit, static_argnames=("ctx",))
+def mont_matvec(ctx: FieldCtx, m: jnp.ndarray, v: jnp.ndarray) -> jnp.ndarray:
+    """new[i] = Σ_j m[j, i] · v[j]  (mod p), Montgomery domain.
+
+    m: (N, N, L) trust matrix, v: (N, L) — index convention matches the
+    reference converge loop (``dynamic_sets/native.rs:322-326``: score
+    flows j → i through m[j][i]). The N² products run as one batched
+    Montgomery multiply; the lazy limb sum over j is exact for N ≤ 512
+    (sum < 512·p keeps CIOS intermediates in int32 and its output < 2p).
+    """
+    n = m.shape[0]
+    limit = min(512, ctx.max_lazy_terms)
+    if n > limit:
+        raise ValueError(
+            f"mont_matvec supports set sizes up to {limit} for this modulus")
+    prod = mont_mul(
+        ctx,
+        m.transpose(1, 0, 2).reshape(n * n, NUM_LIMBS),  # [i, j] rows
+        jnp.tile(v, (n, 1)),
+    ).reshape(n, n, NUM_LIMBS)
+    acc = _ripple(jnp.sum(prod, axis=1, dtype=jnp.int32))
+    # acc < N·p: one Montgomery multiply by R (plain) maps it to
+    # acc·R·R⁻¹ = acc mod p while staying in the Montgomery domain
+    r_row = jnp.broadcast_to(
+        jnp.asarray(to_limbs([ctx.r])[0], dtype=jnp.int32), acc.shape
+    )
+    return mont_mul(ctx, acc, r_row)
+
+
+# --- bit-exact EigenTrust field convergence --------------------------------
+
+def _lazy_rowsum_mod(ctx: FieldCtx, rows: jnp.ndarray) -> jnp.ndarray:
+    """Exact mod-p reduction of a lazy limb-sum (< 512·p): one
+    Montgomery multiply by plain R maps acc → acc·R·R⁻¹ = acc mod p."""
+    r_row = jnp.broadcast_to(
+        jnp.asarray(to_limbs([ctx.r])[0], dtype=jnp.int32), rows.shape
+    )
+    return mont_mul(ctx, rows, r_row)
+
+
+@partial(jax.jit, static_argnames=("ctx", "num_iterations"))
+def _field_converge_mont(ctx: FieldCtx, m: jnp.ndarray, s0: jnp.ndarray,
+                         num_iterations: int):
+    n = m.shape[0]
+    # row sums + Fermat inverse-or-zero (native.rs:305-314 semantics)
+    row_sum = _lazy_rowsum_mod(ctx, _ripple(jnp.sum(m, axis=1,
+                                                    dtype=jnp.int32)))
+    inv = inv_mod(ctx, row_sum)  # (N, L); zero rows stay zero
+    m_norm = mont_mul(
+        ctx,
+        m.reshape(n * n, NUM_LIMBS),
+        jnp.repeat(inv, n, axis=0),
+    ).reshape(n, n, NUM_LIMBS)
+
+    def body(_, s):
+        return mont_matvec(ctx, m_norm, s)
+
+    return lax.fori_loop(0, num_iterations, body, s0)
+
+
+def field_converge(ctx: FieldCtx, matrix, initial, num_iterations: int) -> list:
+    """Bit-exact TPU twin of ``EigenTrustSet.converge``'s post-filter
+    phase (``models/eigentrust.py`` / reference
+    ``dynamic_sets/native.rs:305-329``): field row-normalization by
+    modular inverse-or-zero, then the fixed power iteration — producing
+    the exact same Fr scores as the scalar loop, but as batched int32
+    limb arithmetic on device (the zk witness path of BASELINE.json
+    config 5).
+
+    ``matrix``: N×N ints (filtered opinion values), ``initial``: N ints.
+    Returns N ints.
+    """
+    n = len(matrix)
+    flat = [int(v) % ctx.modulus for row in matrix for v in row]
+    m = to_mont(ctx, jnp.asarray(to_limbs(flat))).reshape(n, n, NUM_LIMBS)
+    s0 = to_mont(ctx, jnp.asarray(to_limbs([int(v) for v in initial])))
+    s = _field_converge_mont(ctx, m, s0, num_iterations)
+    return from_limbs(np.asarray(from_mont(ctx, s)))

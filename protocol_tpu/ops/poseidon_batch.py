@@ -1,0 +1,138 @@
+"""Batched Poseidon hashing on TPU — the ingest-scale validation layer.
+
+The reference hashes every attestation and opinion row with a scalar
+width-5 Hades permutation (``poseidon/native/mod.rs:34-96``); at the
+north-star scale (millions of signed attestations, SURVEY.md §7.2 step
+5) hashing must be batched or ingestion becomes the bottleneck. This
+module runs N permutations as one device dispatch on the int32
+limb engine (``ops.fieldops``), bit-exact against the host
+``crypto.poseidon`` implementation (same Grain-generated constants).
+
+State layout: (n, WIDTH, L) Montgomery-domain limb rows. Round
+constants and the MDS matrix are pre-converted to Montgomery form once
+per (modulus, width) instance and closed over as jit constants.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..crypto.poseidon import DEFAULT_WIDTH, poseidon_params
+from ..utils.fields import Fr
+from .fieldops import (
+    NUM_LIMBS,
+    FieldCtx,
+    _lazy_rowsum_mod,
+    _ripple,
+    add_mod,
+    from_limbs,
+    from_mont,
+    mont_mul,
+    to_limbs,
+    to_mont,
+)
+
+
+class PoseidonBatch:
+    """One Poseidon instance (modulus, width) with device constants."""
+
+    def __init__(self, modulus: int = Fr.MODULUS, width: int = DEFAULT_WIDTH):
+        self.ctx = FieldCtx(modulus)
+        self.width = width
+        rc, mds, full_rounds, partial_rounds = poseidon_params(width, modulus)
+        self.full_rounds = full_rounds
+        self.partial_rounds = partial_rounds
+        to_m = lambda vals: (  # noqa: E731 - plain ints -> Montgomery rows
+            np.asarray(to_mont(self.ctx, jnp.asarray(to_limbs(vals))))
+        )
+        total_rounds = full_rounds + partial_rounds
+        self.rc_m = jnp.asarray(
+            to_m(rc).reshape(total_rounds, width, NUM_LIMBS)
+        )
+        self.mds_m = jnp.asarray(
+            to_m([mds[i][j] for i in range(width) for j in range(width)])
+            .reshape(width, width, NUM_LIMBS)
+        )
+
+    # --- device core ------------------------------------------------------
+    def _sbox(self, x: jnp.ndarray) -> jnp.ndarray:
+        """x^5 rowwise: 3 Montgomery multiplies."""
+        x2 = mont_mul(self.ctx, x, x)
+        x4 = mont_mul(self.ctx, x2, x2)
+        return mont_mul(self.ctx, x4, x)
+
+    def _mds_apply(self, state: jnp.ndarray) -> jnp.ndarray:
+        """out[b, i] = Σ_j mds[i, j] · state[b, j]."""
+        n, w, L = state.shape
+        a = jnp.broadcast_to(self.mds_m, (n, w, w, L)).reshape(-1, L)
+        b = jnp.broadcast_to(state[:, None, :, :], (n, w, w, L)).reshape(-1, L)
+        prod = mont_mul(self.ctx, a, b).reshape(n, w, w, L)
+        acc = _ripple(
+            jnp.sum(prod, axis=2, dtype=jnp.int32).reshape(n * w, L)
+        )
+        return _lazy_rowsum_mod(self.ctx, acc).reshape(n, w, L)
+
+    def _round(self, state: jnp.ndarray, r, partial: bool) -> jnp.ndarray:
+        n, w, L = state.shape
+        rc = lax.dynamic_index_in_dim(self.rc_m, r, keepdims=False)  # (w, L)
+        state = add_mod(
+            self.ctx,
+            state.reshape(n * w, L),
+            jnp.tile(rc, (n, 1)),
+        ).reshape(n, w, L)
+        if partial:
+            lane0 = self._sbox(state[:, 0, :])
+            state = state.at[:, 0, :].set(lane0)
+        else:
+            state = self._sbox(state.reshape(n * w, L)).reshape(n, w, L)
+        return self._mds_apply(state)
+
+    @partial(jax.jit, static_argnames=("self",))
+    def permute_mont(self, state: jnp.ndarray) -> jnp.ndarray:
+        """Full Hades permutation on (n, width, L) Montgomery state."""
+        half = self.full_rounds // 2
+
+        def full_body(r, s):
+            return self._round(s, r, partial=False)
+
+        def partial_body(r, s):
+            return self._round(s, r, partial=True)
+
+        state = lax.fori_loop(0, half, full_body, state)
+        state = lax.fori_loop(half, half + self.partial_rounds,
+                              partial_body, state)
+        state = lax.fori_loop(half + self.partial_rounds,
+                              self.full_rounds + self.partial_rounds,
+                              full_body, state)
+        return state
+
+    # --- host conveniences ------------------------------------------------
+    def permute(self, states) -> list:
+        """(n, width) plain ints → (n, width) plain ints, one permutation
+        each; bit-exact twin of ``crypto.poseidon.Poseidon.permute``."""
+        states = [[int(v) for v in row] for row in states]
+        n = len(states)
+        w = self.width
+        flat = [v for row in states for v in row]
+        st = to_mont(self.ctx, jnp.asarray(to_limbs(flat))).reshape(
+            n, w, NUM_LIMBS)
+        out = self.permute_mont(st)
+        vals = from_limbs(
+            np.asarray(from_mont(self.ctx, out.reshape(n * w, NUM_LIMBS))))
+        return [vals[i * w:(i + 1) * w] for i in range(n)]
+
+    def hash_batch(self, inputs) -> list:
+        """Batch of ≤width-length input tuples → lane-0 digests; twin of
+        ``Poseidon.hash`` (zero-padded single permutation). This is the
+        ingest path: one call hashes every attestation in the batch."""
+        w = self.width
+        padded = [list(row) + [0] * (w - len(row)) for row in inputs]
+        return [row[0] for row in self.permute(padded)]
+
+

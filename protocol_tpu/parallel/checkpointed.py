@@ -58,6 +58,18 @@ def sharded_converge_checkpointed(
                 f"checkpoint score length {arrays['scores'].shape[0]} does "
                 f"not match operator n_pad {meta.n_pad}"
             )
+        # a resume under a different configuration would silently blend
+        # two trajectories; n/n_valid fingerprint the graph, alpha the
+        # iteration semantics (tol may legitimately change — it only
+        # affects the stopping predicate of a memoryless iteration)
+        for key, current in (("n", meta.n), ("n_valid", meta.n_valid),
+                             ("alpha", float(alpha))):
+            recorded = ck_meta.get(key)
+            if recorded is not None and recorded != current:
+                raise ValueError(
+                    f"checkpoint was written with {key}={recorded}, "
+                    f"resume requested {key}={current}"
+                )
         s0 = jnp.asarray(arrays["scores"], dtype=s0.dtype)
         done = step
         # carry the recorded delta so a resume that has no iterations
@@ -81,8 +93,9 @@ def sharded_converge_checkpointed(
             checkpoints.save(
                 done,
                 {"scores": np.asarray(scores)},
-                meta={"delta": delta, "tol": tol, "alpha": alpha,
+                meta={"delta": delta, "tol": tol, "alpha": float(alpha),
                       "n": meta.n, "n_pad": meta.n_pad,
+                      "n_valid": meta.n_valid,
                       "converged": delta <= tol},
             )
             if iters < chunk:

@@ -75,6 +75,16 @@ class CheckpointManager:
                     os.remove(base + suffix)
                 except FileNotFoundError:
                     pass
+        # orphan payloads (crash between the payload and sidecar renames)
+        # never appear in steps() and would otherwise accumulate forever.
+        # Safe here because _gc runs in the writer process after its own
+        # sidecar rename completed (single-writer assumption).
+        live = set(steps)
+        for name in os.listdir(self.directory):
+            m = re.fullmatch(r"step-(\d{12})\.npz", name)
+            if m and int(m.group(1)) not in live:
+                with contextlib.suppress(OSError):
+                    os.remove(os.path.join(self.directory, name))
 
     # --- read -------------------------------------------------------------
     def steps(self) -> list:

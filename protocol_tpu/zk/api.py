@@ -103,6 +103,17 @@ def _load_vk(pk: bytes):
     return VerifyingKey.from_key_bytes(pk)
 
 
+def _load_params_verifier(params: bytes):
+    """Header + τG2 only — verification never touches the G1 powers,
+    and at k=22 the full SRS is ~270 MB."""
+    from .kzg import KZGParams
+
+    try:
+        return KZGParams.verifier_from_bytes(params)
+    except ValueError as e:
+        raise EigenError("parsing_error", str(e)) from e
+
+
 def _dummy_et_fixture(shape: CircuitShape):
     """Deterministic full-opinion fixture giving the canonical circuit
     shape — the reference's dummy-circuit trick for keygen
@@ -196,7 +207,7 @@ def verify_et(params: bytes, pk: bytes, pub_inputs: bytes, proof: bytes,
     from ..client.circuit_io import ETPublicInputs
     from .plonk import verify
 
-    p = _load_params(params)
+    p = _load_params_verifier(params)
     pubs = ETPublicInputs.from_bytes(pub_inputs, shape.num_neighbours)
     flat = [int(x) for x in pubs.to_flat()]
     return verify(p, _load_vk(pk), flat, proof)
@@ -301,7 +312,7 @@ def verify_th(params: bytes, pk: bytes, pub_inputs: bytes, proof: bytes,
     from .kzg import decide
     from .plonk import verify
 
-    p = _load_params(params)
+    p = _load_params_verifier(params)
     pubs = ThPublicInputs.from_bytes(pub_inputs)
     flat = [int(x) for x in pubs.to_flat()]
     if not verify(p, _load_vk(pk), flat, proof):

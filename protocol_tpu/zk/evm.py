@@ -133,7 +133,7 @@ def gen_evm_verifier_code(params: KZGParams, vk) -> str:
     preamble = "\n      ".join(lines)
 
     # --- poseidon permutation rounds (loops over the constant table) -----
-    def full_round_block(first: int, count: int) -> str:
+    def full_round_block(count: int) -> str:
         return f"""
         for {{ let r := 0 }} lt(r, {count}) {{ r := add(r, 1) }} {{
           s0 := pow5(addmod(s0, mload(idx), RMOD))
@@ -207,7 +207,7 @@ object "PlonkVerifier" {{
         let s3 := mload({_hx(_STATE + 96)})
         let s4 := mload({_hx(_STATE + 128)})
         let idx := {_hx(_RC)}
-        {full_round_block(0, half)}
+        {full_round_block(half)}
         for {{ let r := 0 }} lt(r, {partial_rounds}) {{ r := add(r, 1) }} {{
           s0 := pow5(addmod(s0, mload(idx), RMOD))
           s1 := addmod(s1, mload(add(idx, 32)), RMOD)
@@ -217,7 +217,7 @@ object "PlonkVerifier" {{
           idx := add(idx, 160)
           s0, s1, s2, s3, s4 := mds(s0, s1, s2, s3, s4)
         }}
-        {full_round_block(0, half)}
+        {full_round_block(half)}
         mstore({_hx(_STATE)}, s0)
         mstore({_hx(_STATE + 32)}, s1)
         mstore({_hx(_STATE + 64)}, s2)

@@ -95,6 +95,19 @@ class KZGParams:
         s_g2 = g2_from_bytes(data[off : off + 128])
         return cls(k, powers, s_g2)
 
+    @classmethod
+    def verifier_from_bytes(cls, data: bytes) -> "KZGParams":
+        """Verifier-side load: header + the τG2 tail only, skipping the
+        G1 powers (hundreds of MB at k=22). ``succinct_verify`` needs no
+        SRS and the pairing decider reads only ``s_g2``; the returned
+        params must not be used for committing."""
+        k = int.from_bytes(data[0:4], "little")
+        count = int.from_bytes(data[4:8], "little")
+        expected = 8 + 64 * count + 128
+        if len(data) != expected:
+            raise ValueError(f"bad params length {len(data)} != {expected}")
+        return cls(k, [], g2_from_bytes(data[-128:]))
+
 
 # --- point codecs ---------------------------------------------------------
 

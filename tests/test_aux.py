@@ -246,3 +246,35 @@ class TestReviewRegressions:
 
         with pytest.raises(EigenError):
             VerifyingKey.from_key_bytes(b"\xff\xfe not a key")
+
+    def test_resume_config_mismatch_rejected(self, tmp_path):
+        from protocol_tpu.graph import barabasi_albert_edges
+        from protocol_tpu.parallel import (
+            build_sharded_operator,
+            make_mesh,
+            sharded_converge_checkpointed,
+        )
+        import jax.numpy as jnp
+
+        n = 64
+        src, dst, val = barabasi_albert_edges(n, 3, seed=5)
+        mesh = make_mesh(4)
+        sop = build_sharded_operator(n, src, dst, val, num_shards=4)
+        s0 = sop.initial_scores(1000.0, dtype=jnp.float64)
+        cm = CheckpointManager(str(tmp_path / "ck"))
+        sharded_converge_checkpointed(
+            sop, s0, mesh, cm, max_iterations=4, checkpoint_every=2,
+            alpha=0.2)
+        with pytest.raises(ValueError, match="alpha"):
+            sharded_converge_checkpointed(
+                sop, s0, mesh, cm, max_iterations=8, checkpoint_every=2,
+                alpha=0.0)
+
+    def test_orphan_payload_swept(self, tmp_path):
+        cm = CheckpointManager(str(tmp_path))
+        cm.save(1, {"scores": np.zeros(3)})
+        orphan = tmp_path / "step-000000000007.npz"
+        orphan.write_bytes(b"leftover")
+        cm.save(2, {"scores": np.zeros(3)})
+        assert not orphan.exists()
+        assert cm.steps() == [1, 2]

@@ -1,0 +1,215 @@
+"""Batched TPU field arithmetic (``ops.fieldops``) and batched Poseidon
+(``ops.poseidon_batch``) — bit-exactness against Python ints and the
+host crypto layer is the whole contract (BASELINE.json config 5:
+"batched BN254 field ops on TPU, bit-exact field scores")."""
+
+import random
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from protocol_tpu.crypto.poseidon import Poseidon
+from protocol_tpu.crypto.secp256k1 import N as SECP_N
+from protocol_tpu.ops import fieldops as fo
+from protocol_tpu.utils.fields import BN254_FR_MODULUS as P
+from protocol_tpu.utils.fields import Fr
+
+rng = random.Random(0xF1E1D)
+
+
+@pytest.fixture(scope="module")
+def ctx():
+    return fo.FieldCtx(P)
+
+
+def rand_batch(n):
+    return [rng.randrange(P) for _ in range(n)]
+
+
+def roundtrip(ctx, values):
+    return fo.from_limbs(np.asarray(
+        fo.from_mont(ctx, fo.to_mont(ctx, jnp.asarray(fo.to_limbs(values))))))
+
+
+class TestFieldOps:
+    def test_limb_roundtrip(self):
+        vals = [0, 1, P - 1, 2**253, *rand_batch(5)]
+        assert fo.from_limbs(fo.to_limbs(vals)) == vals
+
+    def test_montgomery_roundtrip(self, ctx):
+        vals = [0, 1, P - 1, *rand_batch(13)]
+        assert roundtrip(ctx, vals) == vals
+
+    def test_mul_bit_exact(self, ctx):
+        xs, ys = rand_batch(32), rand_batch(32)
+        xm = fo.to_mont(ctx, jnp.asarray(fo.to_limbs(xs)))
+        ym = fo.to_mont(ctx, jnp.asarray(fo.to_limbs(ys)))
+        got = fo.from_limbs(np.asarray(
+            fo.from_mont(ctx, fo.mont_mul(ctx, xm, ym))))
+        assert got == [x * y % P for x, y in zip(xs, ys)]
+
+    def test_add_sub_bit_exact(self, ctx):
+        xs, ys = rand_batch(16), rand_batch(16)
+        xm = fo.to_mont(ctx, jnp.asarray(fo.to_limbs(xs)))
+        ym = fo.to_mont(ctx, jnp.asarray(fo.to_limbs(ys)))
+        s = fo.from_limbs(np.asarray(
+            fo.from_mont(ctx, fo.add_mod(ctx, xm, ym))))
+        d = fo.from_limbs(np.asarray(
+            fo.from_mont(ctx, fo.sub_mod(ctx, xm, ym))))
+        assert s == [(x + y) % P for x, y in zip(xs, ys)]
+        assert d == [(x - y) % P for x, y in zip(xs, ys)]
+
+    def test_pow_and_inverse(self, ctx):
+        xs = [0, 1, P - 1, *rand_batch(5)]
+        xm = fo.to_mont(ctx, jnp.asarray(fo.to_limbs(xs)))
+        p5 = fo.from_limbs(np.asarray(
+            fo.from_mont(ctx, fo.mont_pow(ctx, xm, 5))))
+        assert p5 == [pow(x, 5, P) for x in xs]
+        inv = fo.from_limbs(np.asarray(
+            fo.from_mont(ctx, fo.inv_mod(ctx, xm))))
+        # 0 -> 0 (the reference's invert-or-zero witness convention)
+        assert inv == [pow(x, P - 2, P) if x else 0 for x in xs]
+
+    def test_matvec_bit_exact(self, ctx):
+        n = 6
+        m = [[rng.randrange(P) for _ in range(n)] for _ in range(n)]
+        v = rand_batch(n)
+        mm = fo.to_mont(ctx, jnp.asarray(
+            fo.to_limbs([c for row in m for c in row]))).reshape(
+                n, n, fo.NUM_LIMBS)
+        vm = fo.to_mont(ctx, jnp.asarray(fo.to_limbs(v)))
+        got = fo.from_limbs(np.asarray(
+            fo.from_mont(ctx, fo.mont_matvec(ctx, mm, vm))))
+        assert got == [
+            sum(m[j][i] * v[j] for j in range(n)) % P for i in range(n)
+        ]
+
+    def test_other_modulus(self):
+        """Modulus-generic: same engine over the secp256k1 group order
+        (the wrong-field modulus ECDSA batching needs)."""
+        ctx = fo.FieldCtx(SECP_N)
+        xs = [rng.randrange(SECP_N) for _ in range(8)]
+        ys = [rng.randrange(SECP_N) for _ in range(8)]
+        xm = fo.to_mont(ctx, jnp.asarray(fo.to_limbs(xs)))
+        ym = fo.to_mont(ctx, jnp.asarray(fo.to_limbs(ys)))
+        got = fo.from_limbs(np.asarray(
+            fo.from_mont(ctx, fo.mont_mul(ctx, xm, ym))))
+        assert got == [x * y % SECP_N for x, y in zip(xs, ys)]
+
+
+class TestFieldConverge:
+    def test_bit_exact_vs_native_model(self):
+        """The flagship parity target: TPU limb arithmetic reproduces
+        ``EigenTrustSet.converge``'s Fr scores bit-for-bit
+        (dynamic_sets/native.rs:305-329 semantics)."""
+        from protocol_tpu.crypto.secp256k1 import EcdsaKeypair
+        from protocol_tpu.models.eigentrust import (
+            Attestation,
+            EigenTrustSet,
+            SignedAttestation,
+        )
+
+        domain = Fr(42)
+        n = 4
+        kps = [EcdsaKeypair(1000 + i) for i in range(n)]
+        addrs = [kp.public_key.to_address() for kp in kps]
+        native = EigenTrustSet(n, 20, 1000, domain)
+        for a in addrs:
+            native.add_member(a)
+        rows = {0: [0, 300, 300, 400], 1: [500, 0, 250, 250],
+                2: [100, 200, 0, 700], 3: [300, 300, 400, 0]}
+        for i, row in rows.items():
+            signed = []
+            for j in range(n):
+                if row[j]:
+                    att = Attestation(about=addrs[j], domain=domain,
+                                      value=Fr(row[j]), message=Fr.zero())
+                    signed.append(
+                        SignedAttestation(att, kps[i].sign(int(att.hash()))))
+                else:
+                    signed.append(None)
+            native.update_op(kps[i].public_key, signed)
+        expect = [int(s) for s in native.converge()]
+        matrix, _ = native.opinion_matrix()
+        ctx = fo.FieldCtx(Fr.MODULUS)
+        got = fo.field_converge(ctx, matrix, [1000] * n, 20)
+        assert got == expect
+
+    def test_zero_row_normalization(self):
+        """A zero opinion row (inverse-or-zero) must not poison scores."""
+        ctx = fo.FieldCtx(P)
+        matrix = [[0, 5, 0], [3, 0, 7], [0, 0, 0]]
+        got = fo.field_converge(ctx, matrix, [10, 10, 10], 3)
+        # host twin of the same semantics
+        s = [10, 10, 10]
+        norm = []
+        for row in matrix:
+            inv = pow(sum(row), P - 2, P) if sum(row) else 0
+            norm.append([v * inv % P for v in row])
+        for _ in range(3):
+            s = [sum(norm[j][i] * s[j] for j in range(3)) % P
+                 for i in range(3)]
+        assert got == s
+
+
+class TestPoseidonBatch:
+    @pytest.fixture(scope="class")
+    def pb(self):
+        from protocol_tpu.ops.poseidon_batch import PoseidonBatch
+
+        return PoseidonBatch()
+
+    def test_permute_bit_exact(self, pb):
+        states = [[rng.randrange(P) for _ in range(5)] for _ in range(4)]
+        out = pb.permute(states)
+        for row_in, row_out in zip(states, out):
+            expect = [int(v) for v in Poseidon([Fr(v) for v in row_in]).permute()]
+            assert row_out == expect
+
+    def test_hash_batch_matches_attestation_hash(self, pb):
+        """The ingest path: batched digests equal per-attestation host
+        hashes (models.eigentrust.Attestation.hash inputs)."""
+        msgs = [[rng.randrange(P) for _ in range(3)] for _ in range(6)]
+        digs = pb.hash_batch(msgs)
+        for m, d in zip(msgs, digs):
+            assert d == int(Poseidon.hash([Fr(v) for v in m]))
+
+    def test_edge_values(self, pb):
+        states = [[0, 0, 0, 0, 0], [P - 1] * 5, [1, 0, P - 1, 2, 3]]
+        out = pb.permute(states)
+        for row_in, row_out in zip(states, out):
+            expect = [int(v) for v in Poseidon([Fr(v) for v in row_in]).permute()]
+            assert row_out == expect
+
+
+class TestPallasMontMul:
+    """The fused Pallas TPU kernel must agree with the jnp engine (and
+    hence with Python ints) — run in interpret mode on the CPU mesh; on
+    real TPU the same kernel compiles natively."""
+
+    def test_matches_jnp_engine(self):
+        from protocol_tpu.ops.pallas_kernels import pallas_mont_mul
+
+        ctx = fo.FieldCtx(P)
+        for n in (1, 5, 130):
+            xs = [rng.randrange(P) for _ in range(n)]
+            ys = [rng.randrange(P) for _ in range(n)]
+            xm = fo.to_mont(ctx, jnp.asarray(fo.to_limbs(xs)))
+            ym = fo.to_mont(ctx, jnp.asarray(fo.to_limbs(ys)))
+            ref = np.asarray(fo.mont_mul(ctx, xm, ym))
+            got = np.asarray(pallas_mont_mul(ctx, xm, ym, interpret=True))
+            assert (ref == got).all()
+
+    def test_bit_exact_vs_python(self):
+        from protocol_tpu.ops.pallas_kernels import pallas_mont_mul
+
+        ctx = fo.FieldCtx(P)
+        xs = [0, 1, P - 1, *[rng.randrange(P) for _ in range(4)]]
+        ys = [P - 1, 1, P - 1, *[rng.randrange(P) for _ in range(4)]]
+        xm = fo.to_mont(ctx, jnp.asarray(fo.to_limbs(xs)))
+        ym = fo.to_mont(ctx, jnp.asarray(fo.to_limbs(ys)))
+        got = fo.from_limbs(np.asarray(
+            fo.from_mont(ctx, pallas_mont_mul(ctx, xm, ym, interpret=True))))
+        assert got == [x * y % P for x, y in zip(xs, ys)]
