@@ -12,7 +12,7 @@ Native host oracle mirroring the reference's wrong-field ECDSA semantics
 - ``to_address``— keccak256(X_be ‖ Y_be)[12:] as a BN254 Fr element
                   (:90-110).
 
-The TPU-batched twin lives in ``protocol_tpu.ops.ecdsa``.
+The TPU-batched twin lives in ``protocol_tpu.ops.secp_batch``.
 """
 
 from __future__ import annotations

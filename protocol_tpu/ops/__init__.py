@@ -24,6 +24,7 @@ from .fieldops import (
     to_mont,
 )
 from .poseidon_batch import PoseidonBatch
+from .secp_batch import recover_batch, verify_batch
 
 __all__ = [
     "converge_dense_fixed",
@@ -45,4 +46,6 @@ __all__ = [
     "to_limbs",
     "to_mont",
     "PoseidonBatch",
+    "recover_batch",
+    "verify_batch",
 ]

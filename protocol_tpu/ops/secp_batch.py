@@ -1,0 +1,277 @@
+"""Batched secp256k1 ECDSA on TPU — ingest-scale signature validation.
+
+The reference validates every attestation with a scalar EC multiply
+(``ecdsa/native.rs:382-395`` verify, ``:298-331`` recover — SURVEY.md
+§3.1 marks pubkey recovery as the ingest hot spot: one EC scalar-mul
+per attestation). This module runs N verifications/recoveries as one
+device dispatch on the modulus-generic limb engine (``ops.fieldops``):
+Jacobian point arithmetic over the secp256k1 base field and scalar
+logic over the group order, batched along the lane axis.
+
+Structure per signature: two fixed-base/variable-base scalar muls fused
+in one 256-step Strauss ladder (per bit: one Jacobian double + one
+table add from {∞, G, Q, G+Q}), with branchless infinity/equal-point
+handling via lane selects. Bit-exact against ``crypto.secp256k1``.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..crypto.secp256k1 import GX, GY, N as SECP_N, P as SECP_P
+from .fieldops import (
+    NUM_LIMBS,
+    FieldCtx,
+    _cond_sub_p,
+    add_mod,
+    from_limbs,
+    from_mont,
+    inv_mod,
+    mont_mul,
+    sub_mod,
+    to_limbs,
+    to_mont,
+)
+
+CTX_P = FieldCtx(SECP_P)  # base field (curve coordinates)
+CTX_N = FieldCtx(SECP_N)  # scalar field (signature algebra)
+
+SCALAR_BITS = 256
+
+
+def _const_mont(ctx: FieldCtx, value: int, n: int) -> jnp.ndarray:
+    """Montgomery form of a host constant (value·R mod p), trace-safe."""
+    row = to_limbs([value * ctx.r % ctx.modulus])[0]
+    return jnp.broadcast_to(jnp.asarray(row, dtype=jnp.int32),
+                            (n, NUM_LIMBS))
+
+
+def _zeros(n: int) -> jnp.ndarray:
+    return jnp.zeros((n, NUM_LIMBS), dtype=jnp.int32)
+
+
+def _is_zero_row(x: jnp.ndarray) -> jnp.ndarray:
+    return jnp.all(x == 0, axis=1)
+
+
+def _select(cond: jnp.ndarray, a, b):
+    """Per-point select: cond (n,) picks coords from a else b."""
+    c = cond[:, None]
+    return tuple(jnp.where(c, ai, bi) for ai, bi in zip(a, b))
+
+
+# --- Jacobian arithmetic (a = 0 curve) -------------------------------------
+
+def _dbl(ctx, pt):
+    """2P in Jacobian coordinates (valid for Z=0 → stays at infinity)."""
+    x, y, z = pt
+    a = mont_mul(ctx, x, x)
+    b = mont_mul(ctx, y, y)
+    c = mont_mul(ctx, b, b)
+    xb = add_mod(ctx, x, b)
+    d = sub_mod(ctx, sub_mod(ctx, mont_mul(ctx, xb, xb), a), c)
+    d = add_mod(ctx, d, d)
+    e = add_mod(ctx, add_mod(ctx, a, a), a)
+    f = mont_mul(ctx, e, e)
+    x3 = sub_mod(ctx, f, add_mod(ctx, d, d))
+    c8 = add_mod(ctx, c, c)
+    c8 = add_mod(ctx, c8, c8)
+    c8 = add_mod(ctx, c8, c8)
+    y3 = sub_mod(ctx, mont_mul(ctx, e, sub_mod(ctx, d, x3)), c8)
+    yz = mont_mul(ctx, y, z)
+    z3 = add_mod(ctx, yz, yz)
+    return x3, y3, z3
+
+
+def _add(ctx, p, q):
+    """P + Q, branchless: handles ∞ operands, P == Q (falls back to the
+    doubling formula) and P == −Q (→ ∞) via selects."""
+    x1, y1, z1 = p
+    x2, y2, z2 = q
+    z1z1 = mont_mul(ctx, z1, z1)
+    z2z2 = mont_mul(ctx, z2, z2)
+    u1 = mont_mul(ctx, x1, z2z2)
+    u2 = mont_mul(ctx, x2, z1z1)
+    s1 = mont_mul(ctx, y1, mont_mul(ctx, z2, z2z2))
+    s2 = mont_mul(ctx, y2, mont_mul(ctx, z1, z1z1))
+    h = sub_mod(ctx, u2, u1)
+    rr = sub_mod(ctx, s2, s1)
+
+    hh = mont_mul(ctx, h, h)
+    hhh = mont_mul(ctx, h, hh)
+    v = mont_mul(ctx, u1, hh)
+    rr2 = mont_mul(ctx, rr, rr)
+    x3 = sub_mod(ctx, sub_mod(ctx, rr2, hhh), add_mod(ctx, v, v))
+    y3 = sub_mod(ctx, mont_mul(ctx, rr, sub_mod(ctx, v, x3)),
+                 mont_mul(ctx, s1, hhh))
+    z3 = mont_mul(ctx, mont_mul(ctx, z1, z2), h)
+    general = (x3, y3, z3)
+
+    p_inf = _is_zero_row(z1)
+    q_inf = _is_zero_row(z2)
+    h_zero = _is_zero_row(h)
+    r_zero = _is_zero_row(rr)
+
+    doubled = _dbl(ctx, p)
+    inf = (_zeros(x1.shape[0]),) * 3
+
+    out = _select(h_zero & r_zero, doubled, general)  # P == Q
+    out = _select(h_zero & ~r_zero & ~p_inf & ~q_inf, inf, out)  # P == −Q
+    out = _select(q_inf, p, out)
+    out = _select(p_inf, q, out)
+    return out
+
+
+def _to_affine(ctx, pt):
+    """Jacobian → affine Montgomery coords; ∞ → (0, 0)."""
+    x, y, z = pt
+    zi = inv_mod(ctx, z)  # Montgomery-domain inverse; 0 → 0
+    zi2 = mont_mul(ctx, zi, zi)
+    return mont_mul(ctx, x, zi2), mont_mul(ctx, y, mont_mul(ctx, zi, zi2))
+
+
+def _bit(scalars: jnp.ndarray, j) -> jnp.ndarray:
+    """Bit j of plain limb rows (traced j)."""
+    from .fieldops import LIMB_BITS
+
+    limb = lax.dynamic_slice_in_dim(scalars, j // LIMB_BITS, 1, axis=1)[:, 0]
+    return (limb >> (j % LIMB_BITS)) & 1
+
+
+@partial(jax.jit, static_argnames=())
+def _strauss(u1_plain: jnp.ndarray, u2_plain: jnp.ndarray, q):
+    """u1·G + u2·Q as one interleaved ladder. Scalars are plain limb
+    rows; Q is an affine Montgomery pair. Returns a Jacobian point."""
+    ctx = CTX_P
+    n = u1_plain.shape[0]
+    gx = _const_mont(ctx, GX, n)
+    gy = _const_mont(ctx, GY, n)
+    one = _const_mont(ctx, 1, n)
+    g = (gx, gy, one)
+    qx, qy = q
+    qj = (qx, qy, one)
+    gq = _add(ctx, g, qj)
+
+    # table[i] for i = b1 + 2·b2: ∞, G, Q, G+Q — stacked (n, 4, L)
+    inf = (_zeros(n),) * 3
+    table = [jnp.stack([c0, c1, c2, c3], axis=1)
+             for c0, c1, c2, c3 in zip(inf, g, qj, gq)]
+
+    def body(i, acc):
+        j = SCALAR_BITS - 1 - i
+        acc = _dbl(ctx, acc)
+        idx = _bit(u1_plain, j) + 2 * _bit(u2_plain, j)  # (n,)
+        entry = tuple(
+            jnp.take_along_axis(
+                t, idx[:, None, None].astype(jnp.int32), axis=1
+            )[:, 0, :]
+            for t in table
+        )
+        return _add(ctx, acc, entry)
+
+    return lax.fori_loop(0, SCALAR_BITS, body, inf)
+
+
+def _mod_n_plain(x_plain: jnp.ndarray) -> jnp.ndarray:
+    """Reduce a base-field value (< p) into the scalar field: at most
+    one subtract of n since p < 2n for secp256k1 (one conditional
+    subtract — fieldops._cond_sub_p — is exact here)."""
+    return _cond_sub_p(x_plain, CTX_N)
+
+
+# --- public batch ops -------------------------------------------------------
+
+def verify_batch(rs, ss, msgs, pub_points) -> np.ndarray:
+    """Batched ECDSA verification, one ladder for the whole batch.
+
+    Twin of ``crypto.secp256k1.EcdsaVerifier.verify`` (itself mirroring
+    ``ecdsa/native.rs:382-395``): R' = (m·s⁻¹)·G + (r·s⁻¹)·Q, accept iff
+    R' ≠ ∞ and R'.x mod n == r. Zero r/s and default (0, 0) pubkeys are
+    rejected exactly like the scalar path.
+
+    rs, ss, msgs: int lists; pub_points: [(x, y)] affine ints.
+    Returns a bool numpy array.
+    """
+    n = len(rs)
+    # r stays UNreduced for the final comparison: the scalar path
+    # compares R'.x mod n against the raw signature r, so r >= n can
+    # never verify (no malleability via r + n); only the u2 scalar uses
+    # r mod n, exactly like the host's  u2 = sig.r * s_inv % N.
+    r_raw = jnp.asarray(to_limbs(rs))
+    s_pl = jnp.asarray(to_limbs([v % SECP_N for v in ss]))
+    s_m = to_mont(CTX_N, s_pl)
+    m_m = to_mont(CTX_N, jnp.asarray(to_limbs([v % SECP_N for v in msgs])))
+    r_m = to_mont(CTX_N, jnp.asarray(to_limbs([v % SECP_N for v in rs])))
+
+    s_inv = inv_mod(CTX_N, s_m)
+    u1 = np.asarray(from_mont(CTX_N, mont_mul(CTX_N, m_m, s_inv)))
+    u2 = np.asarray(from_mont(CTX_N, mont_mul(CTX_N, r_m, s_inv)))
+
+    qx = to_mont(CTX_P, jnp.asarray(to_limbs([p[0] for p in pub_points])))
+    qy = to_mont(CTX_P, jnp.asarray(to_limbs([p[1] for p in pub_points])))
+
+    rpt = _strauss(jnp.asarray(u1), jnp.asarray(u2), (qx, qy))
+    not_inf = ~_is_zero_row(rpt[2])
+    ax, _ = _to_affine(CTX_P, rpt)
+    x_plain = from_mont(CTX_P, ax)
+    x_mod_n = _mod_n_plain(x_plain)
+    x_matches = jnp.all(x_mod_n == r_raw, axis=1)
+
+    nonzero = ~(_is_zero_row(r_raw) | _is_zero_row(s_pl))
+    pk_ok = jnp.asarray(
+        [not (p[0] == 0 and p[1] == 0) for p in pub_points])
+    return np.asarray(not_inf & x_matches & nonzero & pk_ok)
+
+
+def recover_batch(rs, ss, rec_ids, msgs):
+    """Batched pubkey recovery: pk = r⁻¹·(s·R − m·G) with R lifted from
+    (r, rec_id) — the ingest hot path (``ecdsa/native.rs:298-331``,
+    driven per-attestation by ``Client.et_circuit_setup``).
+
+    Returns (xs, ys, valid): affine coordinate int lists and a bool
+    array (False where r does not lift to a curve point or the result
+    is ∞)."""
+    k = len(rs)
+    r_pl = jnp.asarray(to_limbs([v % SECP_P for v in rs]))
+    r_m = to_mont(CTX_P, r_pl)
+
+    # lift_x: y = (x³ + 7)^((p+1)/4); valid iff y² == x³ + 7
+    x3 = mont_mul(CTX_P, r_m, mont_mul(CTX_P, r_m, r_m))
+    rhs = add_mod(CTX_P, x3, _const_mont(CTX_P, 7, k))
+    from .fieldops import mont_pow
+
+    y = mont_pow(CTX_P, rhs, (SECP_P + 1) // 4)
+    lift_ok = jnp.all(mont_mul(CTX_P, y, y) == rhs, axis=1)
+
+    # parity select: plain lsb vs rec_id
+    y_plain = from_mont(CTX_P, y)
+    # host recover_public_key lifts with bool(rec_id): ANY nonzero
+    # rec_id selects the odd-y point (rec_id is a full wire byte)
+    want_odd = jnp.asarray([int(bool(v)) for v in rec_ids], dtype=jnp.int32)
+    y_odd = y_plain[:, 0] & 1
+    y_neg = sub_mod(CTX_P, _zeros(k), y)
+    y_sel = jnp.where((y_odd == want_odd)[:, None], y, y_neg)
+
+    # scalars: u1 = −m·r⁻¹, u2 = s·r⁻¹ (mod n)
+    rn_m = to_mont(CTX_N, jnp.asarray(to_limbs([v % SECP_N for v in rs])))
+    r_inv = inv_mod(CTX_N, rn_m)
+    m_m = to_mont(CTX_N, jnp.asarray(to_limbs([v % SECP_N for v in msgs])))
+    s_m = to_mont(CTX_N, jnp.asarray(to_limbs([v % SECP_N for v in ss])))
+    u1 = sub_mod(CTX_N, jnp.zeros_like(m_m),
+                 mont_mul(CTX_N, m_m, r_inv))
+    u2 = mont_mul(CTX_N, s_m, r_inv)
+    u1_pl = jnp.asarray(np.asarray(from_mont(CTX_N, u1)))
+    u2_pl = jnp.asarray(np.asarray(from_mont(CTX_N, u2)))
+
+    pk = _strauss(u1_pl, u2_pl, (r_m, y_sel))
+    not_inf = ~_is_zero_row(pk[2])
+    ax, ay = _to_affine(CTX_P, pk)
+    xs = from_limbs(np.asarray(from_mont(CTX_P, ax)))
+    ys = from_limbs(np.asarray(from_mont(CTX_P, ay)))
+    return xs, ys, np.asarray(lift_ok & not_inf)

@@ -1,0 +1,137 @@
+"""Batched ECDSA on the TPU limb engine (``ops.secp_batch``) vs the
+host scalar implementation — the ingest validation layer at scale
+(SURVEY.md §7.2 step 5; reference hot spots ``ecdsa/native.rs:298-331``
+recover and ``:382-395`` verify).
+
+One module-scoped fixture drives everything so the 256-step Strauss
+ladder compiles once per batch shape."""
+
+import random
+
+import pytest
+
+from protocol_tpu.crypto.secp256k1 import (
+    EcdsaKeypair,
+    EcdsaVerifier,
+    recover_public_key,
+)
+from protocol_tpu.ops import secp_batch as sb
+
+rng = random.Random(0x5EC9)
+BATCH = 6
+
+
+@pytest.fixture(scope="module")
+def signed():
+    kps = [EcdsaKeypair(20_000 + i) for i in range(BATCH)]
+    msgs = [rng.randrange(1, sb.SECP_N) for _ in range(BATCH)]
+    sigs = [kp.sign(m) for kp, m in zip(kps, msgs)]
+    pubs = [(kp.public_key.point.x, kp.public_key.point.y) for kp in kps]
+    return kps, msgs, sigs, pubs
+
+
+class TestVerifyBatch:
+    def test_valid_signatures_accepted(self, signed):
+        kps, msgs, sigs, pubs = signed
+        ok = sb.verify_batch([s.r for s in sigs], [s.s for s in sigs],
+                             msgs, pubs)
+        assert ok.all()
+        # sanity: the host verifier agrees
+        for kp, m, s in zip(kps, msgs, sigs):
+            assert EcdsaVerifier(s, m, kp.public_key).verify()
+
+    def test_wrong_message_rejected_per_lane(self, signed):
+        _, msgs, sigs, pubs = signed
+        bad = list(msgs)
+        bad[0] += 1
+        ok = sb.verify_batch([s.r for s in sigs], [s.s for s in sigs],
+                             bad, pubs)
+        assert not ok[0] and ok[1:].all()
+
+    def test_swapped_pubkeys_rejected(self, signed):
+        _, msgs, sigs, pubs = signed
+        rotated = pubs[1:] + pubs[:1]
+        ok = sb.verify_batch([s.r for s in sigs], [s.s for s in sigs],
+                             msgs, rotated)
+        assert not ok.any()
+
+    def test_degenerate_inputs_rejected(self, signed):
+        _, msgs, sigs, pubs = signed
+        rs = [sigs[0].r, sigs[1].r, 0] + [s.r for s in sigs[3:]]
+        ss = [0, sigs[1].s, sigs[2].s] + [s.s for s in sigs[3:]]
+        pps = list(pubs)
+        pps[1] = (0, 0)  # default pubkey
+        ok = sb.verify_batch(rs, ss, msgs, pps)
+        assert not ok[0] and not ok[1] and not ok[2]
+        assert ok[3:].all()
+
+
+class TestRecoverBatch:
+    def test_bit_exact_vs_host(self, signed):
+        _, msgs, sigs, _ = signed
+        xs, ys, valid = sb.recover_batch(
+            [s.r for s in sigs], [s.s for s in sigs],
+            [s.rec_id for s in sigs], msgs)
+        assert valid.all()
+        for i, (s, m) in enumerate(zip(sigs, msgs)):
+            host = recover_public_key(s, m)
+            assert (xs[i], ys[i]) == (host.point.x, host.point.y)
+
+    def test_flipped_parity_recovers_different_key(self, signed):
+        kps, msgs, sigs, _ = signed
+        xs, ys, valid = sb.recover_batch(
+            [s.r for s in sigs], [s.s for s in sigs],
+            [1 - s.rec_id for s in sigs], msgs)
+        assert valid.all()
+        for i, kp in enumerate(kps):
+            assert (xs[i], ys[i]) != (kp.public_key.point.x,
+                                      kp.public_key.point.y)
+
+    def test_unliftable_r_flagged(self, signed):
+        """An r whose x³+7 is a quadratic non-residue must come back
+        invalid, not crash."""
+        _, msgs, sigs, _ = signed
+        rs = [s.r for s in sigs]
+        # find a non-liftable x
+        x = 5
+        while pow(x**3 + 7, (sb.SECP_P - 1) // 2, sb.SECP_P) == 1:
+            x += 1
+        rs[0] = x
+        _, _, valid = sb.recover_batch(
+            rs, [s.s for s in sigs], [s.rec_id for s in sigs], msgs)
+        assert not valid[0]
+        assert valid[1:].all()
+
+
+class TestHostParityEdges:
+    """Divergences caught in review: the batch path must match the host
+    verifier on r >= n and full-byte rec_id inputs."""
+
+    def test_r_geq_n_rejected(self, signed):
+        """An r at or above the group order must never verify (the host
+        compares against raw r, so x mod n < n <= r can't match). For
+        secp256k1 r+n rarely fits 256 bits, so craft r >= n directly."""
+        _, msgs, sigs, pubs = signed
+        from protocol_tpu.crypto.secp256k1 import EcdsaVerifier, Signature
+
+        rs = [s.r for s in sigs]
+        rs[0] = sb.SECP_N + 5
+        ok = sb.verify_batch(rs, [s.s for s in sigs], msgs, pubs)
+        assert not ok[0] and ok[1:].all()
+        host_sig = Signature(r=rs[0], s=sigs[0].s, rec_id=sigs[0].rec_id)
+        from protocol_tpu.crypto.secp256k1 import PublicKey, AffinePoint
+        host = EcdsaVerifier(host_sig, msgs[0],
+                             PublicKey(AffinePoint(*pubs[0]))).verify()
+        assert host == bool(ok[0])
+
+    def test_full_byte_rec_id_matches_host(self, signed):
+        _, msgs, sigs, _ = signed
+        rec_ids = [2 if s.rec_id else s.rec_id for s in sigs]
+        xs, ys, valid = sb.recover_batch(
+            [s.r for s in sigs], [s.s for s in sigs], rec_ids, msgs)
+        assert valid.all()
+        from protocol_tpu.crypto.secp256k1 import Signature
+        for i, (s, m) in enumerate(zip(sigs, msgs)):
+            host = recover_public_key(
+                Signature(r=s.r, s=s.s, rec_id=rec_ids[i]), m)
+            assert (xs[i], ys[i]) == (host.point.x, host.point.y)
