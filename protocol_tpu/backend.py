@@ -10,7 +10,7 @@ already materialized by ``EigenTrustSet.filter_peers_ops`` — or, at scale,
 the raw edge list which ``graph.filter_edges`` filters with identical
 semantics) and return real-valued scores. The field-exact path stays on
 ``EigenTrustSet.converge`` itself — field scores are not float-approximable
-(SURVEY.md §7.3) and are computed host-side or via ``ops.limb`` batched
+(SURVEY.md §7.3) and are computed host-side or via ``ops.fieldops`` batched
 field kernels for witnesses.
 """
 

@@ -72,6 +72,7 @@ class Client:
         num_neighbours: int = DEFAULT_NUM_NEIGHBOURS,
         num_iterations: int = DEFAULT_NUM_ITERATIONS,
         initial_score: int = DEFAULT_INITIAL_SCORE,
+        batched_ingest: bool = False,
     ):
         self.config = config
         self.mnemonic = mnemonic
@@ -79,6 +80,10 @@ class Client:
         self.num_neighbours = num_neighbours
         self.num_iterations = num_iterations
         self.initial_score = initial_score
+        # True routes signer recovery through the TPU batch path
+        # (client.ingest) — worth it for large ingest batches; the host
+        # scalar loop stays default for small sets
+        self.batched_ingest = batched_ingest
         if chain is not None:
             self.chain = chain
         elif config.node_url == "memory":
@@ -166,9 +171,22 @@ class Client:
         pub_key_map: dict = {}
         origins: list = []
         participants: set = set()
-        for signed in attestations:
-            pk = signed.recover_public_key()
-            origin = address_from_public_key(pk)
+        if self.batched_ingest and attestations:
+            from .ingest import recover_signers_batch
+
+            pks, addr_list, valid = recover_signers_batch(attestations)
+            if not valid.all():
+                bad = int((~valid).argmax())
+                raise EigenError("validation_error",
+                                 f"attestation {bad} failed batched recovery")
+            recovered = list(zip(pks, addr_list))
+        else:
+            recovered = [
+                (pk := signed.recover_public_key(),
+                 address_from_public_key(pk))
+                for signed in attestations
+            ]
+        for signed, (pk, origin) in zip(attestations, recovered):
             origins.append(origin)
             pub_key_map[origin] = pk
             participants.add(origin)

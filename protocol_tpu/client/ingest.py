@@ -1,0 +1,96 @@
+"""Batched attestation ingest: TPU-validated signatures at scale.
+
+The scalar ingest path (``Client.et_circuit_setup`` →
+``SignedAttestationData.recover_public_key``) performs one Poseidon hash
+and one EC scalar multiply per attestation on the host — the reference's
+ingest hot spot (SURVEY.md §3.1; ``ecdsa/native.rs:298-331``). This
+module replaces that per-attestation loop with two device dispatches:
+
+1. all attestation hashes in one batched Poseidon permutation
+   (``ops.poseidon_batch``),
+2. all pubkey recoveries in one batched Strauss ladder
+   (``ops.secp_batch``), with an optional batched verification pass
+   replicating the scalar path's recover-then-verify sanity check
+   (``crypto.secp256k1.recover_public_key`` asserts the same).
+
+Batches pad to the next power of two so repeated ingests reuse the
+ladder's jit cache instead of retracing per batch size. ``Client``
+opts in via ``batched_ingest=True`` (host scalar recovery stays the
+default: for a handful of attestations the device compile outweighs
+the win). Outputs are host objects (PublicKey, 20-byte addresses)
+identical to the scalar path — property-tested in
+``tests/test_ingest.py``.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import numpy as np
+
+from ..crypto.secp256k1 import AffinePoint, PublicKey
+from ..models.eigentrust import HASHER_WIDTH
+
+
+def attestation_hashes_batch(attestations: Sequence) -> list:
+    """Poseidon attestation hashes for a batch of
+    SignedAttestationData, one device dispatch
+    (``Attestation.hash``: Poseidon_5(about, domain, value, message, 0))."""
+    from ..ops.poseidon_batch import get_poseidon_batch
+
+    pb = get_poseidon_batch(width=HASHER_WIDTH)
+    rows = []
+    for signed in attestations:
+        att = signed.attestation.to_scalar()
+        rows.append([int(att.about), int(att.domain), int(att.value),
+                     int(att.message)])
+    return pb.hash_batch(rows)
+
+
+def recover_signers_batch(attestations: Sequence, check: bool = True):
+    """Batched twin of per-attestation ``recover_public_key``.
+
+    Returns (pub_keys, addresses, valid): recovered ``PublicKey``s,
+    their 20-byte addresses, and a bool mask. ``check=True`` adds the
+    batched verification pass the scalar path asserts (recovered key
+    must verify the signature); lanes failing any stage come back
+    invalid instead of raising — batch ingest must not let one
+    malformed attestation poison the rest.
+    """
+    from ..ops.secp_batch import recover_batch, verify_batch
+
+    if not attestations:
+        return [], [], np.zeros(0, dtype=bool)
+
+    k = len(attestations)
+    # pad to a power of two (min 4): the Strauss ladder jit-caches per
+    # batch shape, so bucketing sizes avoids a fresh multi-minute trace
+    # for every distinct attestation count
+    size = 4
+    while size < k:
+        size *= 2
+    pad = size - k
+
+    msgs = [int(h) for h in attestation_hashes_batch(attestations)]
+    sigs = [s.signature.to_signature() for s in attestations]
+    rs = [s.r for s in sigs] + [1] * pad
+    ss = [s.s for s in sigs] + [1] * pad
+    rec = [s.rec_id for s in sigs] + [0] * pad
+    msgs_p = msgs + [1] * pad
+    xs, ys, valid = recover_batch(rs, ss, rec, msgs_p)
+    if check:
+        ok = verify_batch(rs, ss, msgs_p, list(zip(xs, ys)))
+        valid = valid & ok
+    xs, ys, valid = xs[:k], ys[:k], valid[:k]
+
+    pub_keys = []
+    addresses = []
+    for x, y, v in zip(xs, ys, valid):
+        if v:
+            pk = PublicKey(AffinePoint(int(x), int(y)))
+            pub_keys.append(pk)
+            addresses.append(pk.to_address_bytes())
+        else:
+            pub_keys.append(None)
+            addresses.append(None)
+    return pub_keys, addresses, np.asarray(valid)

@@ -15,7 +15,7 @@ per (modulus, width) instance and closed over as jit constants.
 
 from __future__ import annotations
 
-from functools import partial
+from functools import lru_cache, partial
 
 import numpy as np
 
@@ -39,8 +39,18 @@ from .fieldops import (
 )
 
 
+@lru_cache(maxsize=8)
+def get_poseidon_batch(modulus: int = Fr.MODULUS,
+                       width: int = DEFAULT_WIDTH) -> "PoseidonBatch":
+    """Cached instance per (modulus, width): construction burns ~7 s of
+    Montgomery constant conversion and ``permute_mont`` jit-caches on the
+    instance, so callers must share one."""
+    return PoseidonBatch(modulus, width)
+
+
 class PoseidonBatch:
-    """One Poseidon instance (modulus, width) with device constants."""
+    """One Poseidon instance (modulus, width) with device constants.
+    Prefer :func:`get_poseidon_batch` — a fresh instance recompiles."""
 
     def __init__(self, modulus: int = Fr.MODULUS, width: int = DEFAULT_WIDTH):
         self.ctx = FieldCtx(modulus)

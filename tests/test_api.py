@@ -155,3 +155,47 @@ class TestApiProveCycle:
                 api.generate_et_proof(params, pk, setup, shape=TINY)
         finally:
             setup.pub_inputs.scores = list(reversed(setup.pub_inputs.scores))
+
+
+@pytest.mark.slow
+class TestApiThresholdCycle:
+    """Full Threshold byte-artifact cycle at the tiny shape: th pk over
+    a dummy aggregated circuit (which proves a dummy inner ET snark),
+    real th proof over a different witness, verify incl. the deferred
+    KZG decider on the accumulator limbs. The reference #[ignore]s the
+    same flow (threshold/mod.rs:850,951)."""
+
+    def test_th_cycle(self):
+        params = api.generate_kzg_params(22, seed=b"api-th-cycle")
+        th_pk = api.generate_th_pk(params, shape=TINY)
+
+        setup_et = tiny_et_setup()
+        # build the ThSetup by hand from the ET context (no chain)
+        from protocol_tpu.client.circuit_io import ThPublicInputs, ThSetup
+        from protocol_tpu.models.threshold import Threshold
+
+        index = 1
+        threshold = 500
+        ratio = setup_et.rational_scores[index]
+        th = Threshold(setup_et.pub_inputs.scores[index], ratio,
+                       Fr(threshold), num_limbs=TINY.num_limbs,
+                       power_of_ten=TINY.power_of_ten,
+                       num_neighbours=TINY.num_neighbours,
+                       initial_score=TINY.initial_score)
+        setup = ThSetup(
+            ThPublicInputs(
+                address=setup_et.pub_inputs.participants[index],
+                threshold=Fr(threshold),
+                threshold_check=th.check_threshold(),
+            ),
+            th.num_decomposed, th.den_decomposed,
+            et_setup=setup_et, ratio=ratio,
+        )
+        proof = api.generate_th_proof(params, th_pk, setup, shape=TINY)
+        assert len(setup.pub_inputs.agg_instances) == 16
+        pub_bytes = setup.pub_inputs.to_bytes()
+        assert api.verify_th(params, th_pk, pub_bytes, proof, shape=TINY)
+        bad = bytearray(proof)
+        bad[len(bad) // 2] ^= 1
+        assert not api.verify_th(params, th_pk, pub_bytes, bytes(bad),
+                                 shape=TINY)

@@ -1,0 +1,124 @@
+"""Batched ingest (``client.ingest``) vs the scalar per-attestation
+path — identical hashes, recovered keys, and addresses."""
+
+import numpy as np
+import pytest
+
+from protocol_tpu.client.attestation import (
+    AttestationData,
+    SignatureData,
+    SignedAttestationData,
+)
+from protocol_tpu.client.ingest import (
+    attestation_hashes_batch,
+    recover_signers_batch,
+)
+from protocol_tpu.crypto.secp256k1 import EcdsaKeypair
+
+DOMAIN = b"\x42" + b"\x00" * 19
+
+
+def make_signed(kp: EcdsaKeypair, about: bytes, value: int,
+                message: bytes = b"\x00" * 32) -> SignedAttestationData:
+    att = AttestationData(about=about, domain=DOMAIN, value=value,
+                          message=message)
+    msg_hash = int(att.to_scalar().hash())
+    sig = kp.sign(msg_hash)
+    return SignedAttestationData(
+        att,
+        SignatureData(sig.r.to_bytes(32, "big"), sig.s.to_bytes(32, "big"),
+                      sig.rec_id),
+    )
+
+
+@pytest.fixture(scope="module")
+def batch():
+    kps = [EcdsaKeypair(31_000 + i) for i in range(5)]
+    signed = [
+        make_signed(kp, bytes([i + 1]) * 20, 10 * i + 1,
+                    message=bytes([i]) * 32)
+        for i, kp in enumerate(kps)
+    ]
+    return kps, signed
+
+
+class TestBatchedIngest:
+    def test_hashes_match_scalar_path(self, batch):
+        _, signed = batch
+        digs = attestation_hashes_batch(signed)
+        for s, d in zip(signed, digs):
+            assert d == int(s.attestation.to_scalar().hash())
+
+    def test_recovery_matches_scalar_path(self, batch):
+        kps, signed = batch
+        pub_keys, addresses, valid = recover_signers_batch(signed)
+        assert valid.all()
+        for kp, s, pk, addr in zip(kps, signed, pub_keys, addresses):
+            scalar_pk = s.recover_public_key()
+            assert pk.point.x == scalar_pk.point.x
+            assert pk.point.y == scalar_pk.point.y
+            assert addr == kp.public_key.to_address_bytes()
+
+    def test_forged_signature_flagged_not_fatal(self, batch):
+        kps, signed = batch
+        forged = list(signed)
+        # signature from key 0 pasted onto a different attestation
+        forged[2] = SignedAttestationData(forged[2].attestation,
+                                          signed[0].signature)
+        pub_keys, addresses, valid = recover_signers_batch(forged)
+        # a pasted signature recovers to SOME key, just not the claimed
+        # signer's (the opinion layer nulls it by address mismatch); the
+        # batch must not crash and the other lanes stay valid
+        others = [i for i in range(len(forged)) if i != 2]
+        assert all(valid[i] for i in others)
+        if valid[2]:
+            assert addresses[2] != kps[2].public_key.to_address_bytes()
+
+    def test_empty_batch(self):
+        pub_keys, addresses, valid = recover_signers_batch([])
+        assert pub_keys == [] and addresses == [] and valid.shape == (0,)
+
+    def test_check_pass_consistent(self, batch):
+        """check=True (verify pass) must not reject honest lanes."""
+        _, signed = batch
+        _, _, v1 = recover_signers_batch(signed, check=True)
+        _, _, v2 = recover_signers_batch(signed, check=False)
+        assert v1.all() and v2.all()
+
+
+class TestClientBatchedIngest:
+    def test_et_setup_identical_between_paths(self):
+        """Client(batched_ingest=True) must produce the same ETSetup as
+        the scalar path for the same attestations."""
+        from protocol_tpu.client.client import Client, ClientConfig
+
+        mnemonic = ("test test test test test test test test test test "
+                    "test junk")
+        cfg = ClientConfig(domain="0x" + "00" * 20)
+        scalar = Client(cfg, mnemonic)
+        batched = Client(cfg, mnemonic, chain=scalar.chain,
+                         batched_ingest=True)
+
+        from protocol_tpu.client.eth import ecdsa_keypairs_from_mnemonic
+
+        kps = ecdsa_keypairs_from_mnemonic(mnemonic, 3)
+        addrs = [kp.public_key.to_address_bytes() for kp in kps]
+        clients = [
+            Client(cfg, mnemonic, chain=scalar.chain)
+            for _ in range(3)
+        ]
+        for i, c in enumerate(clients):
+            c.keypairs = [kps[i]]
+            c.attest(addrs[(i + 1) % 3], 5 + i)
+            c.attest(addrs[(i + 2) % 3], 9 - i)
+
+        atts = scalar.get_attestations()
+        s1 = scalar.et_circuit_setup(atts)
+        s2 = batched.et_circuit_setup(atts)
+        assert s1.address_set == s2.address_set
+        assert s1.pub_inputs.to_bytes() == s2.pub_inputs.to_bytes()
+        assert s1.rational_scores == s2.rational_scores
+        for a, b in zip(s1.pub_keys, s2.pub_keys):
+            assert (a is None) == (b is None)
+            if a is not None:
+                assert (a.point.x, a.point.y) == (b.point.x, b.point.y)
