@@ -43,6 +43,10 @@ def build_parser() -> argparse.ArgumentParser:
         description="TPU-native EigenTrust: attestations, scores, proofs",
     )
     parser.add_argument("--assets", help="assets directory (default ./assets)")
+    parser.add_argument(
+        "--trace", metavar="PATH",
+        help="enable structured tracing; '-' prints a span summary to "
+             "stderr, a path additionally streams JSONL there")
     sub = parser.add_subparsers(dest="command", required=True)
 
     p = sub.add_parser("attest", help="sign and publish an attestation")
@@ -68,9 +72,13 @@ def build_parser() -> argparse.ArgumentParser:
 
     p = sub.add_parser("local-scores", help="score attestations.csv offline")
     p.add_argument("--backend", choices=["native", "jax", "jax-sparse"], default="native")
+    p.add_argument("--batched-ingest", action="store_true",
+                   help="recover attestation signers on the device in one batch")
 
     p = sub.add_parser("scores", help="fetch attestations and compute scores")
     p.add_argument("--backend", choices=["native", "jax", "jax-sparse"], default="native")
+    p.add_argument("--batched-ingest", action="store_true",
+                   help="recover attestation signers on the device in one batch")
 
     sub.add_parser("show", help="print the current config")
 
@@ -101,7 +109,8 @@ def _save_config(files: EigenFile, config: ClientConfig) -> None:
     JSONFileStorage(files.config_json()).save(config.to_dict())
 
 
-def _make_client(files: EigenFile, config: ClientConfig) -> Client:
+def _make_client(files: EigenFile, config: ClientConfig,
+                 batched_ingest: bool = False) -> Client:
     chain = None
     if config.node_url == "memory":
         path = files.chain_json()
@@ -109,7 +118,8 @@ def _make_client(files: EigenFile, config: ClientConfig) -> Client:
             chain = LocalChain.from_json(JSONFileStorage(path).load())
         else:
             chain = LocalChain()
-    return Client(config, load_mnemonic(), chain=chain)
+    return Client(config, load_mnemonic(), chain=chain,
+                  batched_ingest=batched_ingest)
 
 
 def _save_chain(files: EigenFile, client: Client) -> None:
@@ -162,11 +172,14 @@ def _compute_scores(client: Client, atts: list, backend_name: str) -> list:
 
         from ..backend import JaxDenseBackend, JaxSparseBackend
 
+        from ..utils import trace
+
         backend = JaxDenseBackend() if backend_name == "jax" else JaxSparseBackend()
         matrix, _ = setup.opinion
-        float_scores = backend.converge(
-            matrix, client.initial_score, client.num_iterations
-        )
+        with trace.span("converge.backend", backend=backend_name):
+            float_scores = backend.converge(
+                matrix, client.initial_score, client.num_iterations
+            )
         for i, score in enumerate(scores):
             ratio = float(score.ratio)
             dev = float(float_scores[i])
@@ -200,7 +213,8 @@ def handle_attestations(args, files, config):
 
 
 def handle_scores(args, files, config, local: bool):
-    client = _make_client(files, config)
+    client = _make_client(files, config,
+                          batched_ingest=getattr(args, "batched_ingest", False))
     atts = _load_attestations(files) if local else _fetch_attestations(files, client)
     scores = _compute_scores(client, atts, args.backend)
     _write_scores(files, scores)
@@ -372,6 +386,14 @@ def main(argv=None) -> int:
     args = build_parser().parse_args(argv)
     files = EigenFile(assets_dir(args.assets))
     config = _load_config(files)
+    if args.trace:
+        from ..utils import trace
+
+        try:
+            trace.enable(None if args.trace == "-" else args.trace)
+        except OSError as e:
+            print(f"error: cannot open trace path: {e}", file=sys.stderr)
+            return 1
     try:
         if args.command == "scores":
             return handle_scores(args, files, config, local=False) or 0
@@ -381,3 +403,15 @@ def main(argv=None) -> int:
     except EigenError as e:
         print(f"error: {e}", file=sys.stderr)
         return 1
+    finally:
+        if args.trace:
+            from ..utils import trace
+
+            for name, agg in sorted(trace.summary().items()):
+                print(f"trace: {name}  n={agg['count']}  "
+                      f"total={agg['total_s']:.3f}s  max={agg['max_s']:.3f}s",
+                      file=sys.stderr)
+            # the tracer is process-global: close the stream and clear
+            # state so in-process callers don't leak spans across runs
+            trace.disable()
+            trace.TRACER.reset()

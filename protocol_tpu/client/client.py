@@ -20,6 +20,7 @@ from dataclasses import dataclass
 from typing import Optional, Sequence
 
 from ..models.eigentrust import EigenTrustSet
+from ..utils import trace
 from ..models.threshold import Threshold
 from ..crypto.poseidon import PoseidonSponge
 from ..utils.errors import EigenError
@@ -181,11 +182,12 @@ class Client:
                                  f"attestation {bad} failed batched recovery")
             recovered = list(zip(pks, addr_list))
         else:
-            recovered = [
-                (pk := signed.recover_public_key(),
-                 address_from_public_key(pk))
-                for signed in attestations
-            ]
+            with trace.span("ingest.recover_scalar", n=len(attestations)):
+                recovered = [
+                    (pk := signed.recover_public_key(),
+                     address_from_public_key(pk))
+                    for signed in attestations
+                ]
         for signed, (pk, origin) in zip(attestations, recovered):
             origins.append(origin)
             pub_key_map[origin] = pk
@@ -233,8 +235,10 @@ class Client:
                 op_hashes.append(et.update_op(pk, matrix[i]))
 
         opinion = et.opinion_matrix()
-        rational_scores = et.converge_rational()
-        field_scores = et.converge()
+        with trace.span("converge.rational", n=len(address_set)):
+            rational_scores = et.converge_rational()
+        with trace.span("converge.field", n=len(address_set)):
+            field_scores = et.converge()
 
         sponge = PoseidonSponge()
         sponge.update(op_hashes)

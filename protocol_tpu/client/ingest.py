@@ -32,10 +32,21 @@ from ..crypto.secp256k1 import AffinePoint, PublicKey
 from ..models.eigentrust import HASHER_WIDTH
 
 
+def _pow2_bucket(k: int) -> int:
+    """Shape bucket (min 4): jitted kernels specialize on batch size, so
+    padding to a power of two reuses compiles across nearby sizes."""
+    size = 4
+    while size < k:
+        size *= 2
+    return size
+
+
 def attestation_hashes_batch(attestations: Sequence) -> list:
     """Poseidon attestation hashes for a batch of
     SignedAttestationData, one device dispatch
-    (``Attestation.hash``: Poseidon_5(about, domain, value, message, 0))."""
+    (``Attestation.hash``: Poseidon_5(about, domain, value, message, 0)).
+    Padded to the same power-of-two bucket as the recovery ladder so the
+    permutation compile is shared across nearby batch sizes."""
     from ..ops.poseidon_batch import get_poseidon_batch
 
     pb = get_poseidon_batch(width=HASHER_WIDTH)
@@ -44,7 +55,9 @@ def attestation_hashes_batch(attestations: Sequence) -> list:
         att = signed.attestation.to_scalar()
         rows.append([int(att.about), int(att.domain), int(att.value),
                      int(att.message)])
-    return pb.hash_batch(rows)
+    k = len(rows)
+    rows += [[0, 0, 0, 0]] * (_pow2_bucket(k) - k)
+    return pb.hash_batch(rows)[:k]
 
 
 def recover_signers_batch(attestations: Sequence, check: bool = True):
@@ -63,23 +76,24 @@ def recover_signers_batch(attestations: Sequence, check: bool = True):
         return [], [], np.zeros(0, dtype=bool)
 
     k = len(attestations)
-    # pad to a power of two (min 4): the Strauss ladder jit-caches per
-    # batch shape, so bucketing sizes avoids a fresh multi-minute trace
-    # for every distinct attestation count
-    size = 4
-    while size < k:
-        size *= 2
-    pad = size - k
+    # the Strauss ladder jit-caches per batch shape; bucketing sizes
+    # avoids a fresh multi-minute trace per distinct attestation count
+    pad = _pow2_bucket(k) - k
 
-    msgs = [int(h) for h in attestation_hashes_batch(attestations)]
+    from ..utils import trace
+
+    with trace.span("ingest.hash_batch", n=k):
+        msgs = [int(h) for h in attestation_hashes_batch(attestations)]
     sigs = [s.signature.to_signature() for s in attestations]
     rs = [s.r for s in sigs] + [1] * pad
     ss = [s.s for s in sigs] + [1] * pad
     rec = [s.rec_id for s in sigs] + [0] * pad
     msgs_p = msgs + [1] * pad
-    xs, ys, valid = recover_batch(rs, ss, rec, msgs_p)
+    with trace.span("ingest.recover_batch", n=k):
+        xs, ys, valid = recover_batch(rs, ss, rec, msgs_p)
     if check:
-        ok = verify_batch(rs, ss, msgs_p, list(zip(xs, ys)))
+        with trace.span("ingest.verify_batch", n=k):
+            ok = verify_batch(rs, ss, msgs_p, list(zip(xs, ys)))
         valid = valid & ok
     xs, ys, valid = xs[:k], ys[:k], valid[:k]
 

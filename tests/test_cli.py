@@ -120,8 +120,27 @@ def test_bandada_threshold_gate(tmp_path, capsys, monkeypatch):
     assert "below band threshold" in capsys.readouterr().err
 
 
-def test_kzg_params_requires_zk_layer_or_writes(tmp_path):
-    """Once the zk layer lands this writes params; until then it must fail
-    cleanly (not crash)."""
-    code = run(tmp_path, "kzg-params", "--k", "8")
-    assert code in (0, 1)
+def test_kzg_params_writes_artifact(tmp_path):
+    assert run(tmp_path, "kzg-params", "--k", "8") == 0
+    data = (tmp_path / "kzg-params-8.bin").read_bytes()
+    from protocol_tpu.zk.kzg import KZGParams
+
+    assert KZGParams.verifier_from_bytes(data).k == 8
+
+
+def test_trace_flag_prints_summary(tmp_path, capsys):
+    """--trace - prints a span summary after the verb; the kzg verb is
+    the cheapest real one."""
+    code = run(tmp_path, "--trace", "-", "kzg-params", "--k", "6")
+    assert code == 0
+    # tracing was enabled for the process; spans only appear where the
+    # library emits them, so just assert the flag parsed and ran clean
+    from protocol_tpu.utils import trace
+
+    trace.disable()
+
+
+def test_batched_ingest_flag_parses(tmp_path):
+    """--batched-ingest on local-scores parses; with no attestations the
+    verb still fails cleanly like the plain path."""
+    assert run(tmp_path, "local-scores", "--batched-ingest") == 1
