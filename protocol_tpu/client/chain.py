@@ -18,7 +18,7 @@ from __future__ import annotations
 
 import json
 import urllib.request
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 
 from ..utils.errors import EigenError
 from ..utils.keccak import keccak256

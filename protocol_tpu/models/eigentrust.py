@@ -27,7 +27,6 @@ exact-semantics anchor, and its ``converge`` accepts a pluggable backend
 from __future__ import annotations
 
 from dataclasses import dataclass
-from fractions import Fraction
 from typing import Optional, Sequence
 
 from ..utils.fields import Fr

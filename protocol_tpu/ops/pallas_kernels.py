@@ -24,7 +24,7 @@ import jax.numpy as jnp
 from jax import lax
 from jax.experimental import pallas as pl
 
-from .fieldops import BASE, LIMB_BITS, MASK, NUM_LIMBS, FieldCtx
+from .fieldops import LIMB_BITS, MASK, NUM_LIMBS, FieldCtx
 
 LANES = 128
 

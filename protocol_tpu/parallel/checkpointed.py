@@ -25,7 +25,7 @@ from jax.sharding import Mesh
 
 from ..utils import trace
 from ..utils.checkpoint import CheckpointManager
-from .converge import _resolve_sharded, _shard_inputs, sharded_converge_adaptive
+from .converge import _resolve_sharded, sharded_converge_adaptive
 
 
 def sharded_converge_checkpointed(

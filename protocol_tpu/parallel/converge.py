@@ -19,7 +19,7 @@ leading-axis-sharded arrays for ``shard_map``.
 from __future__ import annotations
 
 from dataclasses import dataclass
-from functools import lru_cache, partial
+from functools import lru_cache
 
 import numpy as np
 

@@ -49,7 +49,7 @@ from __future__ import annotations
 
 from dataclasses import dataclass
 
-from ..crypto.secp256k1 import EcdsaKeypair, EcdsaVerifier, PublicKey, Signature
+from ..crypto.secp256k1 import EcdsaKeypair, EcdsaVerifier, PublicKey
 from ..models.eigentrust import HASHER_WIDTH, SignedAttestation
 from ..utils.errors import EigenError
 from ..utils.fields import BN254_FR_MODULUS, Fr

@@ -32,9 +32,7 @@ from .bn254 import (
     g1_msm,
     g1_mul,
     g1_neg,
-    g2_add,
     g2_mul,
-    g2_neg,
     pairing_check,
 )
 from .domain import poly_divide_linear, poly_eval
