@@ -24,11 +24,7 @@ def make_signed(kp: EcdsaKeypair, about: bytes, value: int,
                           message=message)
     msg_hash = int(att.to_scalar().hash())
     sig = kp.sign(msg_hash)
-    return SignedAttestationData(
-        att,
-        SignatureData(sig.r.to_bytes(32, "big"), sig.s.to_bytes(32, "big"),
-                      sig.rec_id),
-    )
+    return SignedAttestationData(att, SignatureData.from_signature(sig))
 
 
 @pytest.fixture(scope="module")

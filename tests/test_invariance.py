@@ -1,0 +1,106 @@
+"""Ordering/permutation invariance of the scoring pipeline — SURVEY.md
+§7.3: participant order is a sorted address set and score↔address
+alignment bugs are silent, so invariance is property-tested here.
+
+Three properties:
+- attestation submission order never changes any peer's score,
+- edge order never changes the sparse converge result,
+- relabeling peer ids permutes scores consistently.
+"""
+
+import random
+
+import numpy as np
+import pytest
+
+from protocol_tpu.client.attestation import (
+    AttestationData,
+    SignatureData,
+    SignedAttestationData,
+)
+from protocol_tpu.client.client import Client, ClientConfig
+from protocol_tpu.crypto.secp256k1 import EcdsaKeypair
+
+rng = random.Random(0xA11CE)
+
+DOMAIN_HEX = "0x" + "00" * 20
+DOMAIN = b"\x00" * 20
+
+
+def sign_att(kp, about, value):
+    att = AttestationData(about=about, domain=DOMAIN, value=value)
+    sig = kp.sign(int(att.to_scalar().hash()))
+    return SignedAttestationData(att, SignatureData.from_signature(sig))
+
+
+@pytest.fixture(scope="module")
+def fixture():
+    kps = [EcdsaKeypair(42_000 + i) for i in range(4)]
+    addrs = [kp.public_key.to_address_bytes() for kp in kps]
+    atts = []
+    for i, kp in enumerate(kps):
+        for j in range(4):
+            if i != j and (i + j) % 2 == 0:
+                atts.append(sign_att(kp, addrs[j], 50 + 10 * i + j))
+    client = Client(ClientConfig(domain=DOMAIN_HEX),
+                    "test test test test test test test test test test "
+                    "test junk")
+    return client, atts
+
+
+class TestOrderingInvariance:
+    def test_attestation_order_never_changes_scores(self, fixture):
+        client, atts = fixture
+        base = {s.address: s.ratio
+                for s in client.calculate_scores(atts)}
+        for trial in range(3):
+            shuffled = list(atts)
+            rng.shuffle(shuffled)
+            got = {s.address: s.ratio
+                   for s in client.calculate_scores(shuffled)}
+            assert got == base
+
+    def test_field_scores_order_invariant(self, fixture):
+        client, atts = fixture
+        setup = client.et_circuit_setup(atts)
+        base = dict(zip([int(a) for a in setup.pub_inputs.participants],
+                        [int(s) for s in setup.pub_inputs.scores]))
+        shuffled = list(atts)
+        rng.shuffle(shuffled)
+        setup2 = client.et_circuit_setup(shuffled)
+        got = dict(zip([int(a) for a in setup2.pub_inputs.participants],
+                       [int(s) for s in setup2.pub_inputs.scores]))
+        assert got == base
+
+
+class TestSparsePathInvariance:
+    @pytest.fixture(scope="class")
+    def graph(self):
+        from protocol_tpu.graph import barabasi_albert_edges
+
+        n = 500
+        src, dst, val = barabasi_albert_edges(n, 4, seed=17)
+        return n, np.asarray(src), np.asarray(dst), np.asarray(val)
+
+    def converge(self, n, src, dst, val):
+        from protocol_tpu.backend import JaxSparseBackend
+        import jax.numpy as jnp
+
+        backend = JaxSparseBackend(dtype=jnp.float64)
+        valid = np.ones(n, dtype=bool)
+        return np.asarray(
+            backend.converge_edges(n, src, dst, val, valid, 1000.0, 40))
+
+    def test_edge_order_invariant(self, graph):
+        n, src, dst, val = graph
+        base = self.converge(n, src, dst, val)
+        perm = np.array(rng.sample(range(len(src)), len(src)))
+        got = self.converge(n, src[perm], dst[perm], val[perm])
+        np.testing.assert_allclose(got, base, rtol=1e-12, atol=1e-9)
+
+    def test_node_relabeling_permutes_scores(self, graph):
+        n, src, dst, val = graph
+        base = self.converge(n, src, dst, val)
+        relabel = np.array(rng.sample(range(n), n))
+        got = self.converge(n, relabel[src], relabel[dst], val)
+        np.testing.assert_allclose(got[relabel], base, rtol=1e-10, atol=1e-7)

@@ -1,0 +1,155 @@
+"""RpcChain (JSON-RPC AttestationStation client) against a stubbed
+transport — the contract-call encodings the reference gets from
+ethers-rs Abigen bindings (``eigentrust/src/att_station.rs``):
+``attest(AttestationData[])`` calldata, the ``attestations`` view, and
+``AttestationCreated`` log decoding with its three indexed topics."""
+
+import json
+
+import pytest
+
+from protocol_tpu.client.chain import (
+    EVENT_TOPIC,
+    LocalChain,
+    RpcChain,
+    abi_decode_bytes,
+    abi_encode_attest,
+)
+from protocol_tpu.crypto.secp256k1 import EcdsaKeypair
+from protocol_tpu.utils.errors import EigenError
+from protocol_tpu.utils.keccak import keccak256
+
+CONTRACT = bytes.fromhex("11" * 20)
+
+
+class StubRpc(RpcChain):
+    """Records requests; serves canned responses per method."""
+
+    def __init__(self, responses):
+        super().__init__("http://stub:8545", CONTRACT, chain_id=31337)
+        self.responses = dict(responses)
+        self.calls = []
+
+    def rpc(self, method, params):
+        self.calls.append((method, params))
+        if method not in self.responses:
+            raise EigenError("network_error", f"unexpected method {method}")
+        value = self.responses[method]
+        return value(params) if callable(value) else value
+
+
+class TestAttestSigned:
+    def test_builds_and_submits_a_signed_legacy_tx(self):
+        kp = EcdsaKeypair(1234)
+        sent = {}
+
+        def record_send(params):
+            sent["raw"] = params[0]
+            return "0x" + "ab" * 32
+
+        chain = StubRpc({
+            "eth_getTransactionCount": "0x5",
+            "eth_gasPrice": "0x3b9aca00",
+            "eth_sendRawTransaction": record_send,
+        })
+        entries = [(b"\x22" * 20, b"\x33" * 32, b"payload")]
+        tx_hash = chain.attest_signed(kp, entries)
+        assert tx_hash == "0x" + "ab" * 32
+        methods = [m for m, _ in chain.calls]
+        assert methods == ["eth_getTransactionCount", "eth_gasPrice",
+                           "eth_sendRawTransaction"]
+        raw = bytes.fromhex(sent["raw"].removeprefix("0x"))
+        # the calldata must ride inside the RLP payload
+        assert abi_encode_attest(entries) in raw
+
+    def test_unsigned_attest_rejected(self):
+        chain = StubRpc({})
+        with pytest.raises(EigenError):
+            chain.attest(b"\x00" * 20, [])
+
+
+class TestViewAndLogs:
+    def test_get_attestation_encodes_the_view_call(self):
+        expected_selector = keccak256(
+            b"attestations(address,address,bytes32)")[:4]
+        seen = {}
+
+        def handle_call(params):
+            seen["to"] = params[0]["to"]
+            seen["data"] = bytes.fromhex(params[0]["data"].removeprefix("0x"))
+            # abi: offset(32) ‖ len(32) ‖ padded payload
+            payload = b"\x07\x08"
+            return "0x" + (
+                (32).to_bytes(32, "big")
+                + len(payload).to_bytes(32, "big")
+                + payload.ljust(32, b"\x00")
+            ).hex()
+
+        chain = StubRpc({"eth_call": handle_call})
+        out = chain.get_attestation(b"\xaa" * 20, b"\xbb" * 20, b"\xcc" * 32)
+        assert out == b"\x07\x08"
+        assert seen["to"] == "0x" + CONTRACT.hex()
+        data = seen["data"]
+        assert data[:4] == expected_selector
+        assert data[4:36] == b"\x00" * 12 + b"\xaa" * 20
+        assert data[36:68] == b"\x00" * 12 + b"\xbb" * 20
+        assert data[68:100] == b"\xcc" * 32
+
+    def test_get_logs_decodes_indexed_topics(self):
+        payload = b"\x01\x02\x03"
+        log = {
+            "topics": [
+                EVENT_TOPIC,
+                "0x" + (b"\x00" * 12 + b"\xaa" * 20).hex(),
+                "0x" + (b"\x00" * 12 + b"\xbb" * 20).hex(),
+                "0x" + (b"\xcc" * 32).hex(),
+            ],
+            "data": "0x" + (
+                (32).to_bytes(32, "big")
+                + len(payload).to_bytes(32, "big")
+                + payload.ljust(32, b"\x00")
+            ).hex(),
+            "blockNumber": "0x10",
+        }
+
+        def handle(params):
+            flt = params[0]
+            assert flt["address"] == "0x" + CONTRACT.hex()
+            assert flt["topics"] == [EVENT_TOPIC]
+            assert flt["fromBlock"] == "0x0"
+            return [log]
+
+        chain = StubRpc({"eth_getLogs": handle})
+        logs = chain.get_logs()
+        assert len(logs) == 1
+        assert logs[0].creator == b"\xaa" * 20
+        assert logs[0].about == b"\xbb" * 20
+        assert logs[0].key == b"\xcc" * 32
+        assert logs[0].val == payload
+        assert logs[0].block_number == 16
+
+    def test_rpc_error_surfaces_as_eigen_error(self):
+        chain = RpcChain("http://127.0.0.1:1", CONTRACT)  # nothing listens
+        with pytest.raises(EigenError):
+            chain.rpc("eth_blockNumber", [])
+
+
+class TestLocalParity:
+    def test_abi_roundtrip_matches_local_chain_semantics(self):
+        """The wire codecs and the in-memory chain agree: an entry
+        attested through LocalChain comes back byte-identical to what
+        the ABI layer would put on the wire."""
+        local = LocalChain()
+        creator = b"\xaa" * 20
+        entries = [(b"\xbb" * 20, b"\xcc" * 32, b"\x01\x02\x03")]
+        local.attest(creator, entries)
+        log = local.get_logs()[0]
+        assert log.val == entries[0][2]
+        encoded = abi_encode_attest(entries)
+        # decode the dynamic bytes payload back out of the calldata tail
+        assert entries[0][2] in encoded
+        assert abi_decode_bytes(
+            (32).to_bytes(32, "big")
+            + len(entries[0][2]).to_bytes(32, "big")
+            + entries[0][2].ljust(32, b"\x00")
+        ) == entries[0][2]
