@@ -22,3 +22,19 @@ import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_enable_x64", True)
+
+
+def make_signed_attestation(kp, about: bytes, domain: bytes, value: int,
+                            message: bytes = b"\x00" * 32):
+    """Shared fixture recipe: sign an attestation the way the Client
+    does (Poseidon hash of the scalar form, wire-codec signature)."""
+    from protocol_tpu.client.attestation import (
+        AttestationData,
+        SignatureData,
+        SignedAttestationData,
+    )
+
+    att = AttestationData(about=about, domain=domain, value=value,
+                          message=message)
+    sig = kp.sign(int(att.to_scalar().hash()))
+    return SignedAttestationData(att, SignatureData.from_signature(sig))

@@ -139,22 +139,33 @@ class TestApiProveCycle:
         assert not api.verify_et(params, pk, setup.pub_inputs.to_bytes(),
                                  bytes(bad), shape=TINY)
 
-    def test_et_wrong_scores_rejected(self, artifacts):
+    def test_et_wrong_publics_rejected(self, artifacts):
+        """Any genuinely different public input must fail verification.
+        NB the n=2 cycle converges to EQUAL scores, so reversing the
+        score list is a no-op — mutate a score value and the participant
+        order instead (each is a distinct public-input vector)."""
         params, pk, setup, proof = artifacts
         pubs = ETPublicInputs.from_bytes(setup.pub_inputs.to_bytes(),
                                          TINY.num_neighbours)
-        pubs.scores = list(reversed(pubs.scores))
+        assert int(pubs.scores[0]) == int(pubs.scores[1])  # the trap
+        pubs.scores = [pubs.scores[0] + Fr(1), pubs.scores[1]]
         assert not api.verify_et(params, pk, pubs.to_bytes(), proof,
+                                 shape=TINY)
+        pubs2 = ETPublicInputs.from_bytes(setup.pub_inputs.to_bytes(),
+                                          TINY.num_neighbours)
+        pubs2.participants = list(reversed(pubs2.participants))
+        assert not api.verify_et(params, pk, pubs2.to_bytes(), proof,
                                  shape=TINY)
 
     def test_proof_pubs_divergence_rejected(self, artifacts):
         params, pk, setup, _ = artifacts
-        setup.pub_inputs.scores = list(reversed(setup.pub_inputs.scores))
+        original = setup.pub_inputs.scores
+        setup.pub_inputs.scores = [original[0] + Fr(1), *original[1:]]
         try:
             with pytest.raises(EigenError):
                 api.generate_et_proof(params, pk, setup, shape=TINY)
         finally:
-            setup.pub_inputs.scores = list(reversed(setup.pub_inputs.scores))
+            setup.pub_inputs.scores = original
 
 
 @pytest.mark.slow

@@ -4,11 +4,7 @@ path — identical hashes, recovered keys, and addresses."""
 import numpy as np
 import pytest
 
-from protocol_tpu.client.attestation import (
-    AttestationData,
-    SignatureData,
-    SignedAttestationData,
-)
+from protocol_tpu.client.attestation import SignedAttestationData
 from protocol_tpu.client.ingest import (
     attestation_hashes_batch,
     recover_signers_batch,
@@ -18,13 +14,12 @@ from protocol_tpu.crypto.secp256k1 import EcdsaKeypair
 DOMAIN = b"\x42" + b"\x00" * 19
 
 
+from conftest import make_signed_attestation
+
+
 def make_signed(kp: EcdsaKeypair, about: bytes, value: int,
                 message: bytes = b"\x00" * 32) -> SignedAttestationData:
-    att = AttestationData(about=about, domain=DOMAIN, value=value,
-                          message=message)
-    msg_hash = int(att.to_scalar().hash())
-    sig = kp.sign(msg_hash)
-    return SignedAttestationData(att, SignatureData.from_signature(sig))
+    return make_signed_attestation(kp, about, DOMAIN, value, message)
 
 
 @pytest.fixture(scope="module")

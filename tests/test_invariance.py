@@ -13,13 +13,10 @@ import random
 import numpy as np
 import pytest
 
-from protocol_tpu.client.attestation import (
-    AttestationData,
-    SignatureData,
-    SignedAttestationData,
-)
 from protocol_tpu.client.client import Client, ClientConfig
 from protocol_tpu.crypto.secp256k1 import EcdsaKeypair
+
+from conftest import make_signed_attestation
 
 rng = random.Random(0xA11CE)
 
@@ -28,9 +25,7 @@ DOMAIN = b"\x00" * 20
 
 
 def sign_att(kp, about, value):
-    att = AttestationData(about=about, domain=DOMAIN, value=value)
-    sig = kp.sign(int(att.to_scalar().hash()))
-    return SignedAttestationData(att, SignatureData.from_signature(sig))
+    return make_signed_attestation(kp, about, DOMAIN, value)
 
 
 @pytest.fixture(scope="module")

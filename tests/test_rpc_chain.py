@@ -4,8 +4,6 @@ ethers-rs Abigen bindings (``eigentrust/src/att_station.rs``):
 ``attest(AttestationData[])`` calldata, the ``attestations`` view, and
 ``AttestationCreated`` log decoding with its three indexed topics."""
 
-import json
-
 import pytest
 
 from protocol_tpu.client.chain import (
@@ -135,21 +133,42 @@ class TestViewAndLogs:
 
 
 class TestLocalParity:
-    def test_abi_roundtrip_matches_local_chain_semantics(self):
-        """The wire codecs and the in-memory chain agree: an entry
-        attested through LocalChain comes back byte-identical to what
-        the ABI layer would put on the wire."""
-        local = LocalChain()
-        creator = b"\xaa" * 20
-        entries = [(b"\xbb" * 20, b"\xcc" * 32, b"\x01\x02\x03")]
-        local.attest(creator, entries)
-        log = local.get_logs()[0]
-        assert log.val == entries[0][2]
+    def test_abi_attest_calldata_layout(self):
+        """Walk abi_encode_attest's ACTUAL offsets: selector, array
+        offset, element offsets, per-element tuple fields, and the
+        dynamic bytes payloads — the layout a real node will parse."""
+        entries = [
+            (b"\xbb" * 20, b"\xcc" * 32, b"\x01\x02\x03"),
+            (b"\xdd" * 20, b"\xee" * 32, b"longer payload" * 3),
+        ]
         encoded = abi_encode_attest(entries)
-        # decode the dynamic bytes payload back out of the calldata tail
-        assert entries[0][2] in encoded
-        assert abi_decode_bytes(
-            (32).to_bytes(32, "big")
-            + len(entries[0][2]).to_bytes(32, "big")
-            + entries[0][2].ljust(32, b"\x00")
-        ) == entries[0][2]
+        from protocol_tpu.utils.keccak import keccak256
+
+        assert encoded[:4] == keccak256(
+            b"attest((address,bytes32,bytes)[])")[:4]
+        body = encoded[4:]
+
+        def word(i):
+            return body[32 * i:32 * (i + 1)]
+
+        array_off = int.from_bytes(word(0), "big")
+        array = body[array_off:]
+        count = int.from_bytes(array[:32], "big")
+        assert count == len(entries)
+        for idx, (about, key, payload) in enumerate(entries):
+            elem_off = int.from_bytes(
+                array[32 * (1 + idx):32 * (2 + idx)], "big")
+            elem = array[32 + elem_off:]
+            assert elem[:32] == b"\x00" * 12 + about
+            assert elem[32:64] == key
+            bytes_off = int.from_bytes(elem[64:96], "big")
+            tail = elem[bytes_off:]
+            assert abi_decode_bytes(
+                (32).to_bytes(32, "big") + tail[:32]
+                + tail[32:32 + -(-len(payload) // 32) * 32]) == payload
+
+    def test_local_chain_round_trip(self):
+        local = LocalChain()
+        entries = [(b"\xbb" * 20, b"\xcc" * 32, b"\x01\x02\x03")]
+        local.attest(b"\xaa" * 20, entries)
+        assert local.get_logs()[0].val == entries[0][2]
