@@ -82,6 +82,25 @@ def build_parser() -> argparse.ArgumentParser:
 
     sub.add_parser("show", help="print the current config")
 
+    p = sub.add_parser(
+        "sparse-scores",
+        help="converge a raw edge-list trust graph (the scale path)")
+    p.add_argument("--edges", required=True,
+                   help="CSV of src,dst,weight rows (no header)")
+    p.add_argument("--n", type=int, required=True, help="number of peers")
+    p.add_argument("--tol", type=float, default=1e-6,
+                   help="relative L1 stopping tolerance")
+    p.add_argument("--alpha", type=float, default=0.0,
+                   help="pre-trust damping factor (0 = reference semantics)")
+    p.add_argument("--max-iterations", type=int, default=500)
+    p.add_argument("--initial-score", type=float, default=1000.0)
+    p.add_argument("--checkpoint-dir",
+                   help="run sharded over all devices with chunked "
+                        "checkpoint/resume in this directory")
+    p.add_argument("--checkpoint-every", type=int, default=25)
+    p.add_argument("--out", default="sparse-scores.csv",
+                   help="output CSV (peer_id,score), relative to assets")
+
     p = sub.add_parser("th-proof", help="generate the Threshold proof")
     p.add_argument("--peer", required=True, help="peer address (0x..)")
     p.add_argument("--threshold", type=int, required=True)
@@ -365,6 +384,103 @@ def handle_th_verify(args, files, config):
     return 0 if ok else 1
 
 
+def handle_sparse_scores(args, files, config):
+    """The north-star scale path from the command line: edge list in,
+    converged scores out, optionally sharded + checkpointed."""
+    import csv
+
+    import numpy as np
+
+    from ..utils.platform import honor_jax_platforms_env
+
+    honor_jax_platforms_env()
+
+    from pathlib import Path
+
+    edges_path = Path(args.edges)
+    if not edges_path.is_absolute():
+        edges_path = files.assets / edges_path
+    src_l, dst_l, val_l = [], [], []
+    try:
+        with open(edges_path) as f:
+            for row in csv.reader(f):
+                if not row or row[0].startswith("#"):
+                    continue
+                src_l.append(int(row[0]))
+                dst_l.append(int(row[1]))
+                val_l.append(float(row[2]) if len(row) > 2 else 1.0)
+    except (OSError, ValueError, IndexError) as e:
+        raise EigenError("file_io_error", f"bad edge list: {e}") from e
+    if not src_l:
+        raise EigenError("validation_error", "edge list is empty")
+    src = np.asarray(src_l)
+    dst = np.asarray(dst_l)
+    val = np.asarray(val_l)
+    if (src.min() < 0 or dst.min() < 0
+            or src.max() >= args.n or dst.max() >= args.n):
+        raise EigenError("validation_error",
+                         f"edge endpoints must be in [0, {args.n})")
+
+    from ..utils import trace
+
+    if args.checkpoint_dir:
+        import jax
+        import jax.numpy as jnp
+
+        from ..parallel import (
+            build_sharded_operator,
+            make_mesh,
+            sharded_converge_checkpointed,
+        )
+        from ..utils.checkpoint import CheckpointManager
+
+        ck_dir = Path(args.checkpoint_dir)
+        if not ck_dir.is_absolute():
+            ck_dir = files.assets / ck_dir
+        n_dev = len(jax.devices())
+        mesh = make_mesh(n_dev)
+        sop = build_sharded_operator(args.n, src, dst, val,
+                                     num_shards=n_dev)
+        s0 = sop.initial_scores(args.initial_score, dtype=jnp.float32)
+        try:
+            with trace.span("cli.sparse_scores", mode="sharded", n=args.n):
+                scores, iters, delta = sharded_converge_checkpointed(
+                    sop, s0, mesh, CheckpointManager(str(ck_dir)),
+                    tol=args.tol, max_iterations=args.max_iterations,
+                    alpha=args.alpha,
+                    checkpoint_every=args.checkpoint_every,
+                )
+        except ValueError as e:
+            # bad checkpoint_every / stale-checkpoint mismatch on resume
+            raise EigenError("validation_error", str(e)) from e
+        scores = np.asarray(scores)[: args.n]
+    else:
+        from ..backend import JaxSparseBackend
+
+        backend = JaxSparseBackend()
+        valid = np.ones(args.n, dtype=bool)
+        with trace.span("cli.sparse_scores", mode="single", n=args.n):
+            scores, iters, delta = backend.converge_edges(
+                args.n, src, dst, val, valid, args.initial_score,
+                args.max_iterations, tol=args.tol, alpha=args.alpha,
+            )
+
+    out_path = Path(args.out)
+    if not out_path.is_absolute():
+        out_path = files.assets / out_path
+    with open(out_path, "w", newline="") as f:
+        writer = csv.writer(f)
+        writer.writerow(["peer_id", "score"])
+        for i, s in enumerate(np.asarray(scores)[: args.n]):
+            writer.writerow([i, repr(float(s))])
+    converged = delta <= args.tol
+    print(f"{args.n} peers, {len(src)} edges: "
+          f"{'converged' if converged else 'NOT converged'} after "
+          f"{int(iters)} iterations (delta {float(delta):.2e})")
+    print(f"saved {out_path}")
+    return 0 if converged else 1
+
+
 HANDLERS = {
     "attest": handle_attest,
     "attestations": handle_attestations,
@@ -375,6 +491,7 @@ HANDLERS = {
     "et-verify": handle_et_verify,
     "kzg-params": handle_kzg_params,
     "show": handle_show,
+    "sparse-scores": handle_sparse_scores,
     "th-proof": handle_th_proof,
     "th-proving-key": handle_th_pk,
     "th-verify": handle_th_verify,

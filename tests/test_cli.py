@@ -21,6 +21,7 @@ def test_parser_accepts_all_verbs():
     for verb, extra in [
         ("attest", ["--to", "0x" + "11" * 20, "--score", "5"]),
         ("attestations", []),
+        ("sparse-scores", ["--edges", "e.csv", "--n", "10"]),
         ("bandada", ["--action", "add", "--identity-commitment", "1", "--address", "0xaa"]),
         ("deploy", []),
         ("et-proof", []),
@@ -144,3 +145,81 @@ def test_batched_ingest_flag_parses(tmp_path):
     """--batched-ingest on local-scores parses; with no attestations the
     verb still fails cleanly like the plain path."""
     assert run(tmp_path, "local-scores", "--batched-ingest") == 1
+
+
+def test_sparse_scores_verb(tmp_path, capsys):
+    """The scale path from the CLI: edge CSV in, converged scores out."""
+    import csv
+    import random
+
+    rng = random.Random(9)
+    n = 64
+    edges = []
+    for i in range(n):
+        for _ in range(3):
+            j = rng.randrange(n)
+            if j != i:
+                edges.append((i, j, rng.randrange(1, 100)))
+    with open(tmp_path / "edges.csv", "w", newline="") as f:
+        csv.writer(f).writerows(edges)
+
+    code = run(tmp_path, "sparse-scores", "--edges", "edges.csv",
+               "--n", str(n), "--alpha", "0.15", "--tol", "1e-6")
+    assert code == 0
+    out = capsys.readouterr().out
+    assert "converged" in out
+    with open(tmp_path / "sparse-scores.csv") as f:
+        rows = list(csv.DictReader(f))
+    assert len(rows) == n
+    total = sum(float(r["score"]) for r in rows)
+    assert abs(total - n * 1000.0) / (n * 1000.0) < 1e-3  # conservation
+
+
+def test_sparse_scores_checkpointed(tmp_path):
+    import csv
+    import random
+
+    rng = random.Random(10)
+    n = 48
+    edges = [(i, (i + 1) % n, 1.0) for i in range(n)]
+    edges += [(i, rng.randrange(n), 2.0) for i in range(n) if rng.random() < 0.8]
+    with open(tmp_path / "edges.csv", "w", newline="") as f:
+        csv.writer(f).writerows(e for e in edges if e[0] != e[1])
+
+    ck = tmp_path / "ck"
+    code = run(tmp_path, "sparse-scores", "--edges", "edges.csv",
+               "--n", str(n), "--alpha", "0.2", "--tol", "1e-7",
+               "--checkpoint-dir", str(ck), "--checkpoint-every", "10")
+    assert code == 0
+    assert list(ck.glob("step-*.npz"))
+    # resume idempotently (already converged -> exits immediately, code 0)
+    assert run(tmp_path, "sparse-scores", "--edges", "edges.csv",
+               "--n", str(n), "--alpha", "0.2", "--tol", "1e-7",
+               "--checkpoint-dir", str(ck)) == 0
+
+
+def test_sparse_scores_bad_inputs(tmp_path):
+    (tmp_path / "edges.csv").write_text("0,99,1\n")
+    assert run(tmp_path, "sparse-scores", "--edges", "edges.csv",
+               "--n", "10") == 1
+    (tmp_path / "empty.csv").write_text("")
+    assert run(tmp_path, "sparse-scores", "--edges", "empty.csv",
+               "--n", "10") == 1
+
+
+def test_sparse_scores_negative_endpoint_rejected(tmp_path, capsys):
+    (tmp_path / "edges.csv").write_text("5,-1,1.0\n")
+    assert run(tmp_path, "sparse-scores", "--edges", "edges.csv",
+               "--n", "10") == 1
+    assert "error" in capsys.readouterr().err
+
+
+def test_sparse_scores_bad_checkpoint_every_clean_error(tmp_path, capsys):
+    (tmp_path / "edges.csv").write_text("0,1,1.0\n1,0,1.0\n")
+    code = run(tmp_path, "sparse-scores", "--edges", "edges.csv",
+               "--n", "2", "--checkpoint-dir", "ck",
+               "--checkpoint-every", "0")
+    assert code == 1
+    assert "error" in capsys.readouterr().err
+    # checkpoint dir resolves under assets
+    assert (tmp_path / "ck").exists()
