@@ -9,17 +9,26 @@ judged against, so ``vs_baseline`` = target_seconds / measured_seconds
 Prints exactly one JSON line:
   {"metric": ..., "value": ..., "unit": "s", "vs_baseline": ...}
 
-Methodology: graph build + operator packing (host, numpy) and compile are
-excluded; the timed region is the adaptive converge call's device compute,
-synced by fetching the scalar convergence delta (over tunneled transports
-``block_until_ready`` can return early, and fetching the full score vector
-would time the tunnel's transfer bandwidth, not the kernel). Median of 3.
+Backends: ``routed`` (default at scale) runs the Clos-routed SpMV
+(ops/routed.py) — no general gathers, the sparse transpose executes as a
+permutation network of lane shuffles; ``gather`` runs the bucketed-ELL
+gather SpMV (ops/converge.py). The routing plan is compiled once per
+graph on the host (C++ planner) and cached under ``--cache-dir`` so
+repeat runs skip straight to the device phase.
+
+Methodology: graph build, operator packing/plan compilation (host, numpy/
+C++) and jit compile are excluded; the timed region is the adaptive
+converge call's device compute, synced by fetching the scalar convergence
+delta (over tunneled transports ``block_until_ready`` can return early,
+and fetching the full score vector would time the tunnel's transfer
+bandwidth, not the kernel). Median of 3.
 """
 
 import argparse
 import json
 import sys
 import time
+from pathlib import Path
 
 import numpy as np
 
@@ -40,6 +49,10 @@ def main():
     parser.add_argument("--alpha", type=float, default=0.1)
     parser.add_argument("--max-iters", type=int, default=500)
     parser.add_argument("--repeats", type=int, default=3)
+    parser.add_argument("--backend", choices=["auto", "routed", "gather"],
+                        default="auto")
+    parser.add_argument("--cache-dir", default="bench_cache",
+                        help="routed-operator cache ('' disables)")
     args = parser.parse_args()
 
     from protocol_tpu.utils.platform import honor_jax_platforms_env
@@ -51,46 +64,101 @@ def main():
 
     from protocol_tpu.graph import barabasi_albert_edges, build_operator
     from protocol_tpu.ops.converge import converge_sparse_adaptive, operator_arrays
+    from protocol_tpu.ops.routed import (
+        RoutedOperator,
+        build_routed_operator,
+        converge_routed_adaptive,
+        routed_arrays,
+    )
+
+    backend = args.backend
+    if backend == "auto":
+        # the routed path wins beyond ~100K peers; below that the plan
+        # compilation outweighs the per-iteration gather savings
+        backend = "routed" if args.n >= 100_000 else "gather"
+    if backend == "routed":
+        # the pure-Python planner fallback is per-edge host work —
+        # without the native planner, large routed builds take hours
+        from protocol_tpu import native as pn
+
+        if not pn.available():
+            print("bench: native Clos planner unavailable; "
+                  "falling back to gather backend", file=sys.stderr)
+            backend = "gather"
 
     t0 = time.perf_counter()
-    src, dst, val = barabasi_albert_edges(args.n, args.m, seed=0)
-    op = build_operator(args.n, src, dst, val)
-    build_s = time.perf_counter() - t0
+    rop = None
+    cache_path = None
+    if backend == "routed" and args.cache_dir:
+        cache_path = (Path(args.cache_dir)
+                      / f"routed_ba_n{args.n}_m{args.m}_s0_v1.npz")
+        if cache_path.exists():
+            rop = RoutedOperator.load(cache_path)
 
-    arrs = operator_arrays(op, dtype=jnp.float32, alpha=args.alpha)
-    s0 = jnp.asarray(op.valid, dtype=jnp.float32) * 1000.0
-    # move to device & compile outside the timed region
-    arrs = jax.device_put(arrs)
-    s0 = jax.device_put(s0)
-    scores, iters, delta = converge_sparse_adaptive(
-        arrs, s0, tol=args.tol, max_iterations=args.max_iters
-    )
-    # sync via a host transfer of the scalar delta: over tunneled TPU
-    # transports, block_until_ready can return before execution finishes
+    if backend == "routed":
+        if rop is None:
+            src, dst, val = barabasi_albert_edges(args.n, args.m, seed=0)
+            rop = build_routed_operator(args.n, src, dst, val)
+            if cache_path is not None:
+                cache_path.parent.mkdir(parents=True, exist_ok=True)
+                rop.save(cache_path)
+        build_s = time.perf_counter() - t0
+        arrs, static = routed_arrays(rop, dtype=jnp.float32, alpha=args.alpha)
+        arrs = jax.device_put(arrs)
+        s0 = jax.device_put(jnp.asarray(rop.initial_scores(1000.0)))
+        n_valid = rop.n_valid
+        nnz = rop.nnz
+
+        def run():
+            return converge_routed_adaptive(
+                arrs, static, s0, tol=args.tol, max_iterations=args.max_iters
+            )
+
+        def final_total(scores):
+            return float(rop.scores_for_nodes(np.asarray(scores)).sum())
+    else:
+        src, dst, val = barabasi_albert_edges(args.n, args.m, seed=0)
+        op = build_operator(args.n, src, dst, val)
+        build_s = time.perf_counter() - t0
+        arrs = jax.device_put(operator_arrays(op, dtype=jnp.float32,
+                                              alpha=args.alpha))
+        s0 = jax.device_put(jnp.asarray(op.valid, dtype=jnp.float32) * 1000.0)
+        n_valid = op.n_valid
+        nnz = int(sum(int((b != 0).sum()) for b in op.bucket_val))
+
+        def run():
+            return converge_sparse_adaptive(
+                arrs, s0, tol=args.tol, max_iterations=args.max_iters
+            )
+
+        def final_total(scores):
+            return float(np.asarray(scores).sum())
+
+    # compile outside the timed region; sync via a host transfer of the
+    # scalar delta (over tunneled TPU transports, block_until_ready can
+    # return before execution finishes)
+    scores, iters, delta = run()
     float(delta)
 
     times = []
     for _ in range(args.repeats):
         t1 = time.perf_counter()
-        scores, iters, delta = converge_sparse_adaptive(
-            arrs, s0, tol=args.tol, max_iterations=args.max_iters
-        )
+        scores, iters, delta = run()
         float(delta)
         times.append(time.perf_counter() - t1)
     wall = float(np.median(times))
 
-    # sanity: converged and conserved
-    scores_np = np.asarray(scores)
-    total = float(scores_np.sum())
-    expected = op.n_valid * 1000.0
+    total = final_total(scores)
+    expected = n_valid * 1000.0
     meta = {
+        "backend": backend,
         "n_peers": args.n,
-        "edges": int(sum(int((b != 0).sum()) for b in op.bucket_val)),
+        "edges": nnz,
         "iterations": int(iters),
         "final_delta": float(delta),
         "converged": bool(float(delta) <= args.tol),
         "conservation_rel_err": abs(total - expected) / expected,
-        "graph_build_s": round(build_s, 1),
+        "build_s": round(build_s, 1),
         "device": str(jax.devices()[0]),
         "times_s": [round(t, 4) for t in times],
     }

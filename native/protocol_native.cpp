@@ -891,3 +891,249 @@ void quotient_eval(const u64 *mod_limbs, const u64 *wires_e, const u64 *z_e,
 }
 
 }  // extern "C"
+
+// ---------------------------------------------------------------------------
+// Clos-network routing planner (ops/clos.py's native twin).
+//
+// Decomposes a static permutation of E = 2^e slots into lane-permutation
+// stages executable at streaming speed on TPU (see protocol_tpu/ops/clos.py
+// for the network structure). The level decomposition assigns each edge of
+// the 128-regular bipartite row multigraph a color (= middle subnetwork) via
+// recursive Euler halving; colors give the input/output lane-permutation
+// stages and the recursive middle sub-permutations.
+//
+// The reference has no counterpart (its trust matrix is 4x4); this planner
+// exists to make the 10M-peer SpMV run as vector shuffles instead of
+// scalar-unit gathers.
+
+namespace clos_planner {
+
+typedef int32_t i32;
+typedef int64_t i64;
+typedef uint8_t u8;
+
+// Shared scratch, sized once for the top level and reused at every level
+// (deeper levels only touch prefixes). The walk arrays are split-local
+// (indexed by local edge id) so the Euler chase stays in the smallest
+// possible working set.
+struct ColorScratch {
+    std::vector<i32> eids;     // edge ids, partitioned in place
+    std::vector<i32> tmp;      // partition buffer
+    std::vector<i32> ls, rs;   // pre-gathered endpoints per local edge
+    std::vector<i32> ladj, radj;
+    std::vector<i32> lcur, rcur;
+    std::vector<i64> lptr, rptr;
+    std::vector<u8> used, side_a;
+
+    void ensure(i64 El, i64 m) {
+        if ((i64)eids.size() < El) {
+            eids.resize(El); tmp.resize(El); ls.resize(El); rs.resize(El);
+            ladj.resize(El); radj.resize(El); used.resize(El);
+            side_a.resize(El);
+        }
+        if ((i64)lptr.size() < m + 1) {
+            lptr.resize(m + 1); rptr.resize(m + 1);
+            lcur.resize(m); rcur.resize(m);
+        }
+    }
+};
+
+// 2-color the subset eids[lo..hi) of an even-regular bipartite multigraph
+// alternately along closed walks; stable-partition side-A first and
+// return its size. i_src: per-edge left vertex; right vertex = eid >> 7.
+static i64 euler_split(const i32 *i_src, ColorScratch &S, i64 lo, i64 hi,
+                       i64 m) {
+    i64 k = hi - lo;
+    i32 *e = S.eids.data() + lo;
+    i32 *ls = S.ls.data();
+    i32 *rs = S.rs.data();
+    for (i64 j = 0; j < k; ++j) {
+        i32 eid = e[j];
+        ls[j] = i_src[eid];
+        rs[j] = eid >> 7;
+    }
+    i64 *lptr = S.lptr.data();
+    i64 *rptr = S.rptr.data();
+    std::fill(lptr, lptr + m + 1, 0);
+    std::fill(rptr, rptr + m + 1, 0);
+    for (i64 j = 0; j < k; ++j) {
+        lptr[ls[j] + 1]++;
+        rptr[rs[j] + 1]++;
+    }
+    for (i64 v = 0; v < m; ++v) {
+        lptr[v + 1] += lptr[v];
+        rptr[v + 1] += rptr[v];
+    }
+    i32 *lcur = S.lcur.data();
+    i32 *rcur = S.rcur.data();
+    for (i64 v = 0; v < m; ++v) {
+        lcur[v] = (i32)lptr[v];
+        rcur[v] = (i32)rptr[v];
+    }
+    i32 *ladj = S.ladj.data();
+    i32 *radj = S.radj.data();
+    for (i64 j = 0; j < k; ++j) {
+        ladj[lcur[ls[j]]++] = (i32)j;
+        radj[rcur[rs[j]]++] = (i32)j;
+    }
+    for (i64 v = 0; v < m; ++v) {
+        lcur[v] = (i32)lptr[v];
+        rcur[v] = (i32)rptr[v];
+    }
+    u8 *used = S.used.data();
+    u8 *side_a = S.side_a.data();
+    std::memset(used, 0, k);
+
+    for (i64 start = 0; start < k; ++start) {
+        if (used[start]) continue;
+        i32 v = ls[start];
+        bool on_left = true;
+        u8 parity = 1;
+        for (;;) {
+            i32 eid = -1;
+            if (on_left) {
+                while (lcur[v] < (i32)lptr[v + 1]) {
+                    i32 cand = ladj[lcur[v]++];
+                    if (!used[cand]) { eid = cand; break; }
+                }
+            } else {
+                while (rcur[v] < (i32)rptr[v + 1]) {
+                    i32 cand = radj[rcur[v]++];
+                    if (!used[cand]) { eid = cand; break; }
+                }
+            }
+            if (eid < 0) break;  // closed walk complete
+            used[eid] = 1;
+            side_a[eid] = parity;
+            parity ^= 1;
+            v = on_left ? rs[eid] : ls[eid];
+            on_left = !on_left;
+        }
+    }
+
+    // stable partition: side-A edges first
+    i32 *tmp = S.tmp.data();
+    i64 na = 0;
+    for (i64 j = 0; j < k; ++j)
+        if (side_a[j]) tmp[na++] = e[j];
+    i64 nb = na;
+    for (i64 j = 0; j < k; ++j)
+        if (!side_a[j]) tmp[nb++] = e[j];
+    std::copy(tmp, tmp + k, e);
+    return na;
+}
+
+// Color the r-regular bipartite multigraph (r a power of two) with r
+// colors; writes color[eid] for local edge ids 0..El.
+static void color_edges(const i32 *i_src, i64 El, i64 m, i32 r,
+                        ColorScratch &S, u8 *color) {
+    S.ensure(El, m);
+    for (i64 j = 0; j < El; ++j) S.eids[j] = (i32)j;
+    struct Frame { i64 lo, hi; i32 d; u8 c0; };
+    std::vector<Frame> stack;
+    stack.push_back({0, El, r, 0});
+    while (!stack.empty()) {
+        Frame f = stack.back();
+        stack.pop_back();
+        if (f.d == 1) {
+            for (i64 j = f.lo; j < f.hi; ++j) color[S.eids[j]] = f.c0;
+            continue;
+        }
+        i64 na = euler_split(i_src, S, f.lo, f.hi, m);
+        stack.push_back({f.lo, f.lo + na, f.d / 2, f.c0});
+        stack.push_back({f.lo + na, f.hi, f.d / 2, (u8)(f.c0 + f.d / 2)});
+    }
+}
+
+struct PlanCtx {
+    u8 *stages;            // (2*nlevels-1) arrays of E bytes each
+    i64 E;
+    const i32 *bits;
+    i32 nlevels;
+    std::vector<std::vector<i32>> mid;    // per-level middle perms
+    std::vector<i32> isrc;                // shared source-row scratch
+    std::vector<u8> color;                // shared color scratch
+    ColorScratch cscratch;                // shared walk scratch
+};
+
+static void plan_rec(PlanCtx &C, const i32 *perm_l, i64 El, i64 slot_off,
+                     i32 level) {
+    i32 nstages = 2 * C.nlevels - 1;
+    if (level == C.nlevels - 1) {
+        i32 r = 1 << C.bits[level];
+        u8 *st = C.stages + (i64)level * C.E;
+        for (i64 d = 0; d < El; ++d) {
+            i64 sl = slot_off + d;
+            st[sl] = (u8)(((sl & 127) & ~(i64)(r - 1)) + perm_l[d]);
+        }
+        return;
+    }
+    i64 ml = El >> 7;
+    i32 *isrc = C.isrc.data();
+    for (i64 d = 0; d < El; ++d) isrc[d] = perm_l[d] >> 7;
+    u8 *color = C.color.data();
+    color_edges(isrc, El, ml, 128, C.cscratch, color);
+
+    u8 *st_in = C.stages + (i64)level * C.E;
+    u8 *st_out = C.stages + (i64)(nstages - 1 - level) * C.E;
+    i32 *mid = C.mid[level].data();
+    for (i64 d = 0; d < El; ++d) {
+        i64 i = isrc[d];
+        i64 k = color[d];
+        st_in[slot_off + i * 128 + k] = (u8)(perm_l[d] & 127);
+        st_out[slot_off + d] = (u8)k;
+        mid[k * ml + (d >> 7)] = (i32)i;
+    }
+    for (i64 k = 0; k < 128; ++k)
+        plan_rec(C, mid + k * ml, ml, slot_off + k * ml, level + 1);
+}
+
+}  // namespace clos_planner
+
+extern "C" {
+
+// Plan a Clos route for permutation perm (y[d] = x[perm[d]]).
+// perm: int32[E], E = 1<<e a power of two >= 128; bits: per-level radix
+// bits, interior levels must be 7, sum == e. stages_out:
+// uint8[(2*nlevels-1)*E]. Returns 0 ok, 1 not a permutation, 2 bad bits.
+int clos_plan(const int32_t *perm, int64_t E, const int32_t *bits,
+              int32_t nlevels, uint8_t *stages_out) {
+    using namespace clos_planner;
+    int e = 0;
+    while (((i64)1 << e) < E) ++e;
+    if (((i64)1 << e) != E || e < 7) return 2;
+    i64 sum = 0;
+    for (i32 l = 0; l < nlevels; ++l) {
+        if (l < nlevels - 1 && bits[l] != 7) return 2;
+        if (bits[l] < 1 || bits[l] > 7) return 2;
+        sum += bits[l];
+    }
+    if (sum != e) return 2;
+
+    {   // bijection check
+        std::vector<u8> seen(E, 0);
+        for (i64 d = 0; d < E; ++d) {
+            i32 s = perm[d];
+            if (s < 0 || s >= E || seen[s]) return 1;
+            seen[s] = 1;
+        }
+    }
+
+    PlanCtx C;
+    C.stages = stages_out;
+    C.E = E;
+    C.bits = bits;
+    C.nlevels = nlevels;
+    C.mid.resize(nlevels);
+    if (nlevels > 1) {
+        C.isrc.resize(E);
+        C.color.resize(E);
+        C.cscratch.ensure(E, E >> 7);
+        for (i32 l = 0; l < nlevels - 1; ++l)
+            C.mid[l].resize(E >> (7 * l));
+    }
+    plan_rec(C, perm, E, 0, 0);
+    return 0;
+}
+
+}  // extern "C"

@@ -140,3 +140,38 @@ class JaxSparseBackend(ConvergeBackend):
             arrs, s0, tol=tol, max_iterations=num_iterations
         )
         return np.asarray(scores), int(iters), float(delta)
+
+
+class JaxRoutedBackend(JaxSparseBackend):
+    """Clos-routed SpMV power iteration (ops/routed.py) — the large-graph
+    path: no general gathers; the sparse transpose runs as a permutation
+    network of lane shuffles at streaming bandwidth. Same converge
+    semantics as :class:`JaxSparseBackend`; pays a one-time host routing
+    compilation per graph (reusable via ``RoutedOperator.save``)."""
+
+    def converge_edges(
+        self, n, src, dst, val, valid, initial_score, num_iterations, tol=None,
+        alpha: float = 0.0, operator=None,
+    ):
+        import jax.numpy as jnp
+
+        from .ops.routed import (
+            build_routed_operator,
+            converge_routed_adaptive,
+            converge_routed_fixed,
+            routed_arrays,
+        )
+
+        op = operator
+        if op is None:
+            op = build_routed_operator(n, src, dst, val, valid)
+        arrs, static = routed_arrays(op, dtype=self.dtype, alpha=alpha)
+        s0 = jnp.asarray(op.initial_scores(initial_score, dtype=self.dtype))
+        if tol is None:
+            out = converge_routed_fixed(arrs, static, s0, num_iterations)
+            return op.scores_for_nodes(np.asarray(out))
+        scores, iters, delta = converge_routed_adaptive(
+            arrs, static, s0, tol=tol, max_iterations=num_iterations
+        )
+        return (op.scores_for_nodes(np.asarray(scores)), int(iters),
+                float(delta))

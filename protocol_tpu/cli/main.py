@@ -98,6 +98,13 @@ def build_parser() -> argparse.ArgumentParser:
                    help="run sharded over all devices with chunked "
                         "checkpoint/resume in this directory")
     p.add_argument("--checkpoint-every", type=int, default=25)
+    p.add_argument("--engine", choices=["auto", "routed", "gather"],
+                   default="auto",
+                   help="single-device SpMV engine: 'routed' compiles the "
+                        "edge permutation to a Clos lane-shuffle network "
+                        "(fastest at scale, one-time plan build); 'auto' "
+                        "picks it beyond 100K peers when the native "
+                        "planner is built")
     p.add_argument("--out", default="sparse-scores.csv",
                    help="output CSV (peer_id,score), relative to assets")
 
@@ -455,11 +462,19 @@ def handle_sparse_scores(args, files, config):
             raise EigenError("validation_error", str(e)) from e
         scores = np.asarray(scores)[: args.n]
     else:
-        from ..backend import JaxSparseBackend
+        from ..backend import JaxRoutedBackend, JaxSparseBackend
 
-        backend = JaxSparseBackend()
+        engine = args.engine
+        if engine == "auto":
+            from .. import native as pn
+
+            engine = ("routed" if args.n >= 100_000 and pn.available()
+                      else "gather")
+        backend = (JaxRoutedBackend() if engine == "routed"
+                   else JaxSparseBackend())
         valid = np.ones(args.n, dtype=bool)
-        with trace.span("cli.sparse_scores", mode="single", n=args.n):
+        with trace.span("cli.sparse_scores", mode="single", n=args.n,
+                        engine=engine):
             scores, iters, delta = backend.converge_edges(
                 args.n, src, dst, val, valid, args.initial_score,
                 args.max_iterations, tol=args.tol, alpha=args.alpha,

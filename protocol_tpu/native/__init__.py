@@ -100,6 +100,12 @@ def _bind(lib, u64p) -> None:
                                           u64p, u64p]
     lib.g1_fixed_base_muls.argtypes = [u64p, u64p, u64p, ctypes.c_long,
                                        u64p]
+    lib.clos_plan.argtypes = [ctypes.POINTER(ctypes.c_int32),
+                              ctypes.c_int64,
+                              ctypes.POINTER(ctypes.c_int32),
+                              ctypes.c_int32,
+                              ctypes.POINTER(ctypes.c_uint8)]
+    lib.clos_plan.restype = ctypes.c_int
 
 
 def available() -> bool:
@@ -181,6 +187,30 @@ def points_to_limbs(points) -> np.ndarray:
         else:
             flat.extend((pt[0], pt[1]))
     return ints_to_limbs(flat).reshape(-1, 8)
+
+
+def clos_plan(perm: np.ndarray, bits) -> np.ndarray | None:
+    """Clos routing planner (ops/clos.py's native twin): permutation
+    ``perm`` (int32, power-of-two length ≥ 128) → flat uint8 stage
+    array of shape ((2·len(bits)−1)·E,). None when the library is
+    unavailable; raises on invalid input."""
+    lib = _load()
+    if lib is None:
+        return None
+    perm = np.ascontiguousarray(perm, dtype=np.int32)
+    bits_arr = np.ascontiguousarray(bits, dtype=np.int32)
+    E = len(perm)
+    out = np.empty((2 * len(bits_arr) - 1) * E, dtype=np.uint8)
+    rc = lib.clos_plan(
+        perm.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)), E,
+        bits_arr.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        len(bits_arr),
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)))
+    if rc == 1:
+        raise ValueError("clos_plan: input is not a permutation")
+    if rc != 0:
+        raise ValueError("clos_plan: invalid level bits")
+    return out
 
 
 # --- array-level API -------------------------------------------------------

@@ -1,6 +1,8 @@
-"""TPU compute kernels: converge (dense + bucketed-ELL SpMV), batched
-big-prime field arithmetic, and batched Poseidon hashing."""
+"""TPU compute kernels: converge (dense + bucketed-ELL SpMV + Clos-routed
+SpMV), static-permutation routing, batched big-prime field arithmetic,
+and batched Poseidon hashing."""
 
+from .clos import RoutePlan, apply_route, plan_route, route_bits
 from .converge import (
     converge_dense_fixed,
     converge_dense_adaptive,
@@ -8,6 +10,14 @@ from .converge import (
     converge_sparse_adaptive,
     operator_arrays,
     spmv,
+)
+from .routed import (
+    RoutedOperator,
+    build_routed_operator,
+    converge_routed_adaptive,
+    converge_routed_fixed,
+    routed_arrays,
+    spmv_routed,
 )
 from .fieldops import (
     FieldCtx,
@@ -27,6 +37,16 @@ from .poseidon_batch import PoseidonBatch
 from .secp_batch import recover_batch, verify_batch
 
 __all__ = [
+    "RoutePlan",
+    "apply_route",
+    "plan_route",
+    "route_bits",
+    "RoutedOperator",
+    "build_routed_operator",
+    "converge_routed_adaptive",
+    "converge_routed_fixed",
+    "routed_arrays",
+    "spmv_routed",
     "converge_dense_fixed",
     "converge_dense_adaptive",
     "converge_sparse_fixed",

@@ -59,6 +59,28 @@ def operator_arrays(
     }
 
 
+def dangling_and_damping(arrs: dict, s: jnp.ndarray, base: jnp.ndarray
+                         ) -> jnp.ndarray:
+    """Shared tail of every SpMV backend: the dangling-mass rank-1
+    correction plus damped pre-trust mixing.
+
+    Dangling peers redistribute uniformly to every *other* valid peer
+    (reference native.rs:263-278, as an implicit rank-1 update). α=0 is
+    the pure reference semantics; for α>0, pretrust is scaled by the
+    current total mass so the conservation invariant holds for any α.
+    Both the gather path here and ops.routed share this function so the
+    semantics cannot desynchronize.
+    """
+    d_mass = jnp.sum(s * arrs["dangling"])
+    denom = jnp.maximum(arrs["n_valid"] - 1.0, 1.0)
+    corr = (d_mass - arrs["dangling"] * s) / denom
+    propagated = base + corr * arrs["valid"]
+
+    alpha = arrs["alpha"]
+    total = jnp.sum(s * arrs["valid"])
+    return (1.0 - alpha) * propagated + alpha * arrs["pretrust"] * total
+
+
 def spmv(arrs: dict, s: jnp.ndarray) -> jnp.ndarray:
     """One application of the normalized trust operator: returns Cᵀs with
     the dangling-mass correction.
@@ -74,20 +96,32 @@ def spmv(arrs: dict, s: jnp.ndarray) -> jnp.ndarray:
     parts.append(jnp.zeros((1,), dtype=s.dtype))
     flat = jnp.concatenate(parts)
     base = flat[arrs["row_pos"]]
+    return dangling_and_damping(arrs, s, base)
 
-    # dangling peers redistribute uniformly to every *other* valid peer
-    # (reference native.rs:263-278, as an implicit rank-1 update)
-    d_mass = jnp.sum(s * arrs["dangling"])
-    denom = jnp.maximum(arrs["n_valid"] - 1.0, 1.0)
-    corr = (d_mass - arrs["dangling"] * s) / denom
-    propagated = base + corr * arrs["valid"]
 
-    # damped mixing with the pre-trust distribution (α=0 → pure reference
-    # semantics); pretrust is scaled by the current total mass so the
-    # conservation invariant holds exactly for any α
-    alpha = arrs["alpha"]
-    total = jnp.sum(s * arrs["valid"])
-    return (1.0 - alpha) * propagated + alpha * arrs["pretrust"] * total
+def adaptive_loop(step, s0: jnp.ndarray, tol: float, max_iterations: int):
+    """Shared adaptive-convergence driver: iterate ``step`` until the
+    relative L1 delta ≤ tol (or max_iterations). Every backend (dense,
+    gather-sparse, routed) runs this exact loop so tolerance semantics
+    and iteration counts cannot diverge between them.
+
+    Returns (scores, iterations_run, final_relative_delta).
+    """
+    norm = jnp.maximum(jnp.sum(jnp.abs(s0)), 1.0)
+
+    def cond(state):
+        _, i, delta = state
+        return (delta > tol) & (i < max_iterations)
+
+    def body(state):
+        s, i, _ = state
+        s_next = step(s)
+        delta = jnp.sum(jnp.abs(s_next - s)) / norm
+        return s_next, i + 1, delta
+
+    return lax.while_loop(
+        cond, body, (s0, jnp.int32(0), jnp.asarray(jnp.inf, s0.dtype))
+    )
 
 
 @partial(jax.jit, static_argnames=("num_iterations",))
@@ -104,20 +138,7 @@ def converge_sparse_adaptive(
 
     Returns (scores, iterations_run, final_relative_delta).
     """
-    norm = jnp.maximum(jnp.sum(jnp.abs(s0)), 1.0)
-
-    def cond(state):
-        _, i, delta = state
-        return (delta > tol) & (i < max_iterations)
-
-    def body(state):
-        s, i, _ = state
-        s_next = spmv(arrs, s)
-        delta = jnp.sum(jnp.abs(s_next - s)) / norm
-        return s_next, i + 1, delta
-
-    s, iters, delta = lax.while_loop(cond, body, (s0, jnp.int32(0), jnp.asarray(jnp.inf, s0.dtype)))
-    return s, iters, delta
+    return adaptive_loop(lambda s: spmv(arrs, s), s0, tol, max_iterations)
 
 
 @partial(jax.jit, static_argnames=("num_iterations",))
@@ -134,17 +155,4 @@ def converge_dense_fixed(c_norm: jnp.ndarray, s0: jnp.ndarray, num_iterations: i
 def converge_dense_adaptive(
     c_norm: jnp.ndarray, s0: jnp.ndarray, tol: float = 1e-6, max_iterations: int = 100
 ):
-    norm = jnp.maximum(jnp.sum(jnp.abs(s0)), 1.0)
-
-    def cond(state):
-        _, i, delta = state
-        return (delta > tol) & (i < max_iterations)
-
-    def body(state):
-        s, i, _ = state
-        s_next = s @ c_norm
-        delta = jnp.sum(jnp.abs(s_next - s)) / norm
-        return s_next, i + 1, delta
-
-    s, iters, delta = lax.while_loop(cond, body, (s0, jnp.int32(0), jnp.asarray(jnp.inf, s0.dtype)))
-    return s, iters, delta
+    return adaptive_loop(lambda s: s @ c_norm, s0, tol, max_iterations)

@@ -1,0 +1,500 @@
+"""Clos-routed sparse converge — the streaming SpMV for large trust graphs.
+
+The gather-SpMV in ``ops.converge`` pays ~7 ns per edge on TPU (XLA's
+general gather runs on the scalar unit). This module reformulates the
+power iteration so that *no general gather appears at all*:
+
+1. **broadcast** (streaming/MXU): edge values ``s[src]·w`` materialize in
+   source-major order — block-diagonal expansion matmuls broadcast each
+   node's score across its out-row lanes;
+2. **route** (streaming): the edge array moves from source-major to
+   destination-major order through a Clos network of lane permutations
+   and transposes (``ops.clos``) — the sparse-matrix transpose as a
+   permutation-network program;
+3. **reduce** (streaming/MXU): lane-segmented sums collapse each
+   destination row, and the per-node totals route back to state order
+   through a second (node-sized) Clos network; the dangling-mass rank-1
+   correction and pre-trust damping are elementwise.
+
+Semantics are identical to ``ops.converge.spmv`` (same filtering,
+normalization, redistribution — ``dynamic_sets/native.rs:234-337``).
+
+**Memory layout rule** (the reason for the blocked representation): XLA
+tiles the last two dims of every array as (8, 128); a ``[rows, 8]``
+bucket array would be padded 16× in HBM — fatal at 2^28 slots. So every
+large array here is either 1-D or ``[X, 128]`` with ``X ≡ 0 (mod 8)``:
+a width-w < 128 bucket packs ``g = 128/w`` logical rows per lane-row,
+its row-adjacent values live in lane runs, and the per-row broadcast/
+reduce are contractions with constant ``[g, 128]`` / ``[128, g]``
+0/1 block matrices. Row positions in the state/z vectors are
+column-major in the ``[g, X]`` grid so the skinny operands of those
+contractions reshape without padded copies.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from functools import partial
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from .clos import _apply_route_jit, _use_pallas, plan_route
+from .converge import adaptive_loop, dangling_and_damping
+from ..graph import filter_edges
+
+__all__ = [
+    "RoutedOperator",
+    "build_routed_operator",
+    "routed_arrays",
+    "RoutedStatic",
+    "spmv_routed",
+    "converge_routed_fixed",
+    "converge_routed_adaptive",
+]
+
+
+def _ceil_pow2_exp(x: int) -> int:
+    e = 7
+    while (1 << e) < x:
+        e += 1
+    return e
+
+
+class _Side(NamedTuple):
+    """One blocked ELL side (source or destination).
+
+    widths[b]: logical row width (pow2). xs[b]: physical lane-rows,
+    multiple of 8. weight[b]: [X, 128] float64. slot_base[b]: first flat
+    slot. pos_base[b]: first row-position in the side's position space
+    (state order for the source side, z order for the destination side).
+    row_nodes[b]: node id per logical row (length ≤ g·X; pad rows absent).
+    row_pos[b]: position of each logical row — column-major in the
+    [g, X] grid. edge_slot: flat slot per input edge. n_slots / n_pos:
+    totals (pads included).
+    """
+
+    widths: tuple
+    xs: tuple
+    weight: list
+    slot_base: tuple
+    pos_base: tuple
+    row_nodes: list
+    row_pos: list
+    edge_slot: np.ndarray
+    n_slots: int
+    n_pos: int
+
+
+def _bucketize_blocked(n, key, other, weight, min_width=8):
+    """Group edges by ``key`` node into blocked pow2-width ELL buckets."""
+    order = np.argsort(key, kind="stable")
+    key_s = key[order].astype(np.int64)
+    w_s = weight[order]
+
+    deg = np.bincount(key_s, minlength=n).astype(np.int64)
+    ptr = np.zeros(n + 1, dtype=np.int64)
+    np.cumsum(deg, out=ptr[1:])
+    offset_in_row = np.arange(len(key_s), dtype=np.int64) - ptr[key_s]
+
+    widths_per_row = np.maximum(
+        min_width, 2 ** np.ceil(np.log2(np.maximum(deg, 1))).astype(np.int64)
+    )
+    widths_per_row[deg == 0] = 0
+    used = tuple(sorted(int(w) for w in np.unique(widths_per_row) if w > 0))
+
+    widths, xs, wmats, slot_bases, pos_bases = [], [], [], [], []
+    row_nodes_l, row_pos_l = [], []
+    edge_slot = np.empty(len(key_s), dtype=np.int64)
+    slot_base = 0
+    pos_base = 0
+    for w in used:
+        rows = np.nonzero(widths_per_row == w)[0]
+        nb = len(rows)
+        if w < 128:
+            g = 128 // w                 # logical rows per lane-row
+            X = -(-nb // g)              # lane-rows…
+            X = -(-X // 8) * 8           # …padded to a multiple of 8
+            n_pos_b = g * X              # padded grid positions
+        else:
+            X = nb * (w // 128)
+            X = -(-X // 8) * 8
+            # X stays divisible by w/128 (either w/128 ≤ 8 and X is a
+            # multiple of 8, or nb·w/128 is already a multiple of 8)
+            n_pos_b = X * 128 // w       # padded row count
+
+        local = np.full(n, -1, dtype=np.int64)
+        local[rows] = np.arange(nb)
+        mask = widths_per_row[key_s] == w
+        r = local[key_s[mask]]
+        off = offset_in_row[mask]
+        if w < 128:
+            slot = (r // g) * 128 + (r % g) * w + off
+            rpos = (np.arange(nb) % g) * X + np.arange(nb) // g
+        else:
+            slot = r * w + off           # [X, 128] row-major view
+            rpos = np.arange(nb)
+
+        wm = np.zeros(X * 128, dtype=np.float64)
+        wm[slot] = w_s[mask]
+        wmats.append(wm.reshape(X, 128))
+        edge_slot[mask] = slot_base + slot
+
+        widths.append(w)
+        xs.append(X)
+        slot_bases.append(slot_base)
+        pos_bases.append(pos_base)
+        row_nodes_l.append(rows)
+        row_pos_l.append(pos_base + rpos)
+        slot_base += X * 128
+        pos_base += n_pos_b
+
+    # undo the sort for edge_slot
+    inv = np.empty_like(order)
+    inv[order] = np.arange(len(order))
+    return _Side(
+        widths=tuple(widths),
+        xs=tuple(xs),
+        weight=wmats,
+        slot_base=tuple(slot_bases),
+        pos_base=tuple(pos_bases),
+        row_nodes=row_nodes_l,
+        row_pos=row_pos_l,
+        edge_slot=edge_slot[inv],
+        n_slots=slot_base,
+        n_pos=pos_base,
+    )
+
+
+@dataclass
+class RoutedOperator:
+    """Host-side routed operator: blocked layouts, masks, route plans."""
+
+    n: int
+    n_valid: int
+    nnz: int
+    out_widths: tuple
+    out_xs: tuple
+    out_weight: list       # per bucket [X, 128] float64
+    n_src_pos: int         # state slots occupied by source rows (pads incl.)
+    state_to_node: np.ndarray  # state slot -> node id, -1 for dead slots
+    in_widths: tuple
+    in_xs: tuple
+    in_n_pos: int
+    edge_e: int
+    edge_bits: tuple
+    edge_stages: list
+    state_e: int
+    state_bits: tuple
+    state_stages: list
+    valid: np.ndarray      # [2^state_e] f32
+    dangling: np.ndarray
+
+    @property
+    def n_state(self) -> int:
+        return 1 << self.state_e
+
+    def initial_scores(self, initial: float, dtype=np.float32) -> np.ndarray:
+        return (self.valid * initial).astype(dtype)
+
+    def scores_for_nodes(self, state_scores: np.ndarray) -> np.ndarray:
+        """Translate a state-order score vector to node order."""
+        state_scores = np.asarray(state_scores)
+        out = np.zeros(self.n, dtype=state_scores.dtype)
+        live = self.state_to_node >= 0
+        out[self.state_to_node[live]] = state_scores[live]
+        return out
+
+    def save(self, path) -> None:
+        """Persist the compiled operator (uncompressed .npz) so the
+        one-time routing-plan compilation is reusable across runs."""
+        payload = {
+            "meta": np.asarray(
+                [self.n, self.n_valid, self.nnz, self.n_src_pos,
+                 self.edge_e, self.state_e, self.in_n_pos],
+                dtype=np.int64),
+            "out_widths": np.asarray(self.out_widths, dtype=np.int64),
+            "out_xs": np.asarray(self.out_xs, dtype=np.int64),
+            "in_widths": np.asarray(self.in_widths, dtype=np.int64),
+            "in_xs": np.asarray(self.in_xs, dtype=np.int64),
+            "edge_bits": np.asarray(self.edge_bits, dtype=np.int64),
+            "state_bits": np.asarray(self.state_bits, dtype=np.int64),
+            "edge_stages": np.stack(self.edge_stages),
+            "state_stages": np.stack(self.state_stages),
+            "state_to_node": self.state_to_node.astype(np.int64),
+            "valid": self.valid,
+            "dangling": self.dangling,
+        }
+        for i, w in enumerate(self.out_weight):
+            payload[f"out_weight_{i}"] = w  # keep float64: the f64
+            # converge path must round-trip losslessly
+        np.savez(path, **payload)
+
+    @classmethod
+    def load(cls, path) -> "RoutedOperator":
+        with np.load(path) as z:
+            meta = z["meta"]
+            out_widths = tuple(int(w) for w in z["out_widths"])
+            return cls(
+                n=int(meta[0]),
+                n_valid=int(meta[1]),
+                nnz=int(meta[2]),
+                out_widths=out_widths,
+                out_xs=tuple(int(x) for x in z["out_xs"]),
+                out_weight=[z[f"out_weight_{i}"]
+                            for i in range(len(out_widths))],
+                n_src_pos=int(meta[3]),
+                state_to_node=z["state_to_node"],
+                in_widths=tuple(int(w) for w in z["in_widths"]),
+                in_xs=tuple(int(x) for x in z["in_xs"]),
+                in_n_pos=int(meta[6]),
+                edge_e=int(meta[4]),
+                edge_bits=tuple(int(b) for b in z["edge_bits"]),
+                edge_stages=list(z["edge_stages"]),
+                state_e=int(meta[5]),
+                state_bits=tuple(int(b) for b in z["state_bits"]),
+                state_stages=list(z["state_stages"]),
+                valid=z["valid"],
+                dangling=z["dangling"],
+            )
+
+
+def build_routed_operator(
+    n, src, dst, val, valid=None, min_width: int = 8,
+    prefer_native: bool = True,
+) -> RoutedOperator:
+    """Filter + normalize an edge list and compile the routing program.
+
+    Semantics of ``filter_edges`` (the reference's opinion filter,
+    ``dynamic_sets/native.rs:234-283``) are shared with the gather path.
+    """
+    src, dst, weight, valid_mask, dangling = filter_edges(n, src, dst, val, valid)
+
+    out_side = _bucketize_blocked(n, src, dst, weight, min_width)
+    in_side = _bucketize_blocked(n, dst, src, weight, min_width)
+
+    # state order: source-row positions first (column-major grids, dead
+    # pad slots included), then out-edge-less nodes
+    n_src_pos = out_side.n_pos
+    src_pos = (np.concatenate(out_side.row_pos) if out_side.row_pos
+               else np.zeros(0, dtype=np.int64))
+    src_nodes = (np.concatenate(out_side.row_nodes) if out_side.row_nodes
+                 else np.zeros(0, dtype=np.int64))
+    has_out = np.zeros(n, dtype=bool)
+    has_out[src_nodes] = True
+    rest = np.nonzero(~has_out)[0]
+
+    state_e = _ceil_pow2_exp(max(n_src_pos + len(rest), in_side.n_pos, 128))
+    N2 = 1 << state_e
+    state_to_node = np.full(N2, -1, dtype=np.int64)
+    state_to_node[src_pos] = src_nodes
+    state_to_node[n_src_pos : n_src_pos + len(rest)] = rest
+    node_to_state = np.full(n, -1, dtype=np.int64)
+    live = state_to_node >= 0
+    node_to_state[state_to_node[live]] = np.nonzero(live)[0]
+
+    # --- edge route: in slot <- out slot ---------------------------------
+    edge_e = _ceil_pow2_exp(max(out_side.n_slots, in_side.n_slots, 128))
+    E2 = 1 << edge_e
+    perm = np.full(E2, -1, dtype=np.int64)
+    perm[in_side.edge_slot] = out_side.edge_slot
+    src_used = np.zeros(E2, dtype=bool)
+    src_used[out_side.edge_slot] = True
+    free_src = np.nonzero(~src_used)[0]   # out-ELL pads + tail: all zeros
+    need = np.nonzero(perm < 0)[0]        # in-ELL pads + tail
+    perm[need] = free_src[: len(need)]
+    plan = plan_route(perm.astype(np.int32), prefer_native=prefer_native)
+
+    # --- state route: state slot <- z position ---------------------------
+    # z = concatenated per-bucket in-row sums (column-major positions)
+    in_nodes = (np.concatenate(in_side.row_nodes) if in_side.row_nodes
+                else np.zeros(0, dtype=np.int64))
+    in_pos = (np.concatenate(in_side.row_pos) if in_side.row_pos
+              else np.zeros(0, dtype=np.int64))
+    node_in_pos = np.full(n, -1, dtype=np.int64)
+    node_in_pos[in_nodes] = in_pos
+    sperm = np.full(N2, -1, dtype=np.int64)
+    live_nodes = state_to_node[live]
+    live_slots = np.nonzero(live)[0]
+    with_in = node_in_pos[live_nodes] >= 0
+    sperm[live_slots[with_in]] = node_in_pos[live_nodes[with_in]]
+    sp_used = np.zeros(N2, dtype=bool)
+    sp_used[sperm[sperm >= 0]] = True
+    free_zero = np.nonzero(~sp_used)[0]   # z pads + tail: all zeros
+    need = np.nonzero(sperm < 0)[0]
+    sperm[need] = free_zero[: len(need)]
+    splan = plan_route(sperm.astype(np.int32), prefer_native=prefer_native)
+
+    valid_state = np.zeros(N2, dtype=np.float32)
+    valid_state[live_slots] = valid_mask[live_nodes].astype(np.float32)
+    dangling_state = np.zeros(N2, dtype=np.float32)
+    dangling_state[live_slots] = dangling[live_nodes].astype(np.float32)
+
+    return RoutedOperator(
+        n=n,
+        n_valid=int(valid_mask.sum()),
+        nnz=len(src),
+        out_widths=out_side.widths,
+        out_xs=out_side.xs,
+        out_weight=out_side.weight,
+        n_src_pos=n_src_pos,
+        state_to_node=state_to_node,
+        in_widths=in_side.widths,
+        in_xs=in_side.xs,
+        in_n_pos=in_side.n_pos,
+        edge_e=plan.e,
+        edge_bits=plan.bits,
+        edge_stages=plan.stages,
+        state_e=splan.e,
+        state_bits=splan.bits,
+        state_stages=splan.stages,
+        valid=valid_state,
+        dangling=dangling_state,
+    )
+
+
+class RoutedStatic(NamedTuple):
+    """Hashable static config for the jitted routed kernels."""
+
+    out_widths: tuple
+    out_xs: tuple
+    in_widths: tuple
+    in_xs: tuple
+    in_n_pos: int
+    edge_e: int
+    edge_bits: tuple
+    state_e: int
+    state_bits: tuple
+    pallas: bool
+
+
+def _expand_matrix(w: int, dtype) -> np.ndarray:
+    """B[g, 128]: lane l takes grid row l // w."""
+    g = 128 // w
+    lanes = np.arange(128)
+    return (lanes // w == np.arange(g)[:, None]).astype(dtype)
+
+
+def routed_arrays(op: RoutedOperator, dtype=jnp.float32, alpha: float = 0.0,
+                  pretrust=None, pallas: bool | None = None):
+    """Device pytree + static config. ``alpha`` as in
+    ``ops.converge.operator_arrays``. ``pretrust``, unlike the gather
+    path's node-order vector, must be in **state order** with length
+    ``2^state_e`` (zero on dead slots) — translate a node-order vector u
+    via ``u[op.state_to_node] * (op.state_to_node >= 0)`` padded to
+    ``op.n_state``; the default is uniform over valid peers."""
+    if pallas is None:
+        pallas = _use_pallas()
+    if pretrust is None:
+        pretrust = op.valid.astype(np.float64) / max(op.n_valid, 1)
+    arrs = {
+        "out_weight": tuple(jnp.asarray(w, dtype=dtype) for w in op.out_weight),
+        "out_expand": tuple(
+            jnp.asarray(_expand_matrix(w, np.float32), dtype=dtype)
+            if w < 128 else None
+            for w in op.out_widths),
+        "in_reduce": tuple(
+            jnp.asarray(_expand_matrix(w, np.float32), dtype=dtype)
+            if w < 128 else None
+            for w in op.in_widths),
+        "edge_stages": tuple(jnp.asarray(s) for s in op.edge_stages),
+        "state_stages": tuple(jnp.asarray(s) for s in op.state_stages),
+        "valid": jnp.asarray(op.valid, dtype=dtype),
+        "dangling": jnp.asarray(op.dangling, dtype=dtype),
+        "n_valid": jnp.asarray(float(op.n_valid), dtype=dtype),
+        "alpha": jnp.asarray(float(alpha), dtype=dtype),
+        "pretrust": jnp.asarray(pretrust, dtype=dtype),
+    }
+    static = RoutedStatic(
+        out_widths=op.out_widths,
+        out_xs=op.out_xs,
+        in_widths=op.in_widths,
+        in_xs=op.in_xs,
+        in_n_pos=op.in_n_pos,
+        edge_e=op.edge_e,
+        edge_bits=op.edge_bits,
+        state_e=op.state_e,
+        state_bits=op.state_bits,
+        pallas=bool(pallas),
+    )
+    return arrs, static
+
+
+_PREC = lax.Precision.HIGHEST
+
+
+def spmv_routed(arrs: dict, static: RoutedStatic, s: jnp.ndarray) -> jnp.ndarray:
+    """One application of the normalized trust operator (state order):
+    broadcast → route → reduce → route-back → dangling + damping."""
+    E2 = 1 << static.edge_e
+    N2 = 1 << static.state_e
+
+    # broadcast: per bucket, expand the state slice across lanes and
+    # weight. All arrays stay [X, 128] or 1-D.
+    parts = []
+    pos = 0
+    for bi, (w, X) in enumerate(zip(static.out_widths, static.out_xs)):
+        w_mat = arrs["out_weight"][bi]
+        if w < 128:
+            g = 128 // w
+            s2t = lax.slice_in_dim(s, pos, pos + g * X).reshape(g, X)
+            v = jnp.einsum("gl,gx->xl", arrs["out_expand"][bi], s2t,
+                           precision=_PREC) * w_mat
+            pos += g * X
+        else:
+            nb_pad = X * 128 // w        # padded row count
+            rows = lax.slice_in_dim(s, pos, pos + nb_pad)
+            srep = jnp.broadcast_to(
+                rows[:, None], (nb_pad, w // 128)).reshape(X, 1)
+            v = srep * w_mat
+            pos += nb_pad
+        parts.append(v.reshape(-1))
+    used = sum(X * 128 for X in static.out_xs)
+    parts.append(jnp.zeros((E2 - used,), dtype=s.dtype))
+    x = jnp.concatenate(parts)
+
+    y = _apply_route_jit(x, arrs["edge_stages"], static.edge_e,
+                         static.edge_bits, static.pallas)
+
+    # reduce: per bucket, lane-segmented sums to column-major positions
+    sums = []
+    off = 0
+    for bi, (w, X) in enumerate(zip(static.in_widths, static.in_xs)):
+        y2 = lax.slice_in_dim(y, off, off + X * 128).reshape(X, 128)
+        if w < 128:
+            z2 = jnp.einsum("xl,gl->gx", y2, arrs["in_reduce"][bi],
+                            precision=_PREC)
+            sums.append(z2.reshape(-1))
+        else:
+            nb_pad = X * 128 // w
+            z = y2.sum(axis=-1).reshape(nb_pad, w // 128).sum(axis=-1)
+            sums.append(z)
+        off += X * 128
+    sums.append(jnp.zeros((N2 - static.in_n_pos,), dtype=s.dtype))
+    z = jnp.concatenate(sums)
+
+    base = _apply_route_jit(z, arrs["state_stages"], static.state_e,
+                            static.state_bits, static.pallas)
+
+    return dangling_and_damping(arrs, s, base)
+
+
+@partial(jax.jit, static_argnames=("static", "num_iterations"))
+def converge_routed_fixed(arrs, static: RoutedStatic, s0, num_iterations: int):
+    """Reference-parity fixed-iteration power iteration, routed."""
+    return lax.fori_loop(
+        0, num_iterations, lambda _, s: spmv_routed(arrs, static, s), s0
+    )
+
+
+@partial(jax.jit, static_argnames=("static", "max_iterations"))
+def converge_routed_adaptive(arrs, static: RoutedStatic, s0,
+                             tol: float = 1e-6, max_iterations: int = 100):
+    """Iterate until the relative L1 delta ≤ tol (or max_iterations).
+    Returns (scores, iterations_run, final_relative_delta)."""
+    return adaptive_loop(
+        lambda s: spmv_routed(arrs, static, s), s0, tol, max_iterations
+    )

@@ -1,0 +1,237 @@
+"""Clos routing planner/executor and the routed converge backend.
+
+The routed path must agree with the gather path (ops/converge.py) and
+hence with the native EigenTrustSet oracle — the reference's
+native-vs-accelerated equivalence pattern (SURVEY.md §4.2) applied to
+the permutation-network SpMV.
+"""
+
+import numpy as np
+import pytest
+
+jnp = pytest.importorskip("jax.numpy")
+
+from protocol_tpu.graph import barabasi_albert_edges, build_operator
+from protocol_tpu.ops.clos import (
+    apply_route,
+    apply_route_np,
+    plan_route,
+    plan_route_py,
+    route_bits,
+)
+from protocol_tpu.ops.converge import converge_sparse_adaptive, operator_arrays, spmv
+from protocol_tpu.ops.routed import (
+    build_routed_operator,
+    converge_routed_adaptive,
+    converge_routed_fixed,
+    routed_arrays,
+    spmv_routed,
+)
+
+
+def test_route_bits_schedule():
+    assert route_bits(7) == (7,)
+    assert route_bits(8) == (7, 1)
+    assert route_bits(14) == (7, 7)
+    assert route_bits(25) == (7, 7, 7, 4)
+    assert route_bits(28) == (7, 7, 7, 7)
+
+
+@pytest.mark.parametrize("e", [7, 8, 10, 14, 16])
+def test_python_planner_routes_any_permutation(e):
+    rng = np.random.default_rng(e)
+    E = 1 << e
+    perm = rng.permutation(E)
+    plan = plan_route_py(perm)
+    assert len(plan.stages) == 2 * len(plan.bits) - 1
+    x = rng.standard_normal(E).astype(np.float32)
+    assert np.array_equal(apply_route_np(plan, x), x[perm])
+
+
+@pytest.mark.parametrize("e", [7, 9, 13, 15])
+def test_native_planner_routes_any_permutation(e):
+    from protocol_tpu import native as pn
+
+    if not pn.available():
+        pytest.skip("native library unavailable")
+    rng = np.random.default_rng(100 + e)
+    E = 1 << e
+    perm = rng.permutation(E)
+    plan = plan_route(perm, prefer_native=True)
+    x = rng.standard_normal(E).astype(np.float32)
+    assert np.array_equal(apply_route_np(plan, x), x[perm])
+
+
+def test_native_planner_rejects_non_permutation():
+    from protocol_tpu import native as pn
+
+    if not pn.available():
+        pytest.skip("native library unavailable")
+    perm = np.zeros(128, dtype=np.int32)  # constant: not a bijection
+    with pytest.raises(ValueError):
+        pn.clos_plan(perm, route_bits(7))
+
+
+def test_planner_requires_pow2():
+    with pytest.raises(ValueError):
+        plan_route_py(np.arange(129))
+    with pytest.raises(ValueError):
+        plan_route_py(np.arange(64))
+
+
+@pytest.mark.parametrize("e", [7, 12, 15])
+def test_device_executor_matches_numpy(e):
+    rng = np.random.default_rng(7 * e)
+    E = 1 << e
+    perm = rng.permutation(E)
+    plan = plan_route(perm)
+    x = rng.standard_normal(E).astype(np.float32)
+    stages = tuple(jnp.asarray(s) for s in plan.stages)
+    y = np.asarray(apply_route(jnp.asarray(x), stages, plan.e, plan.bits))
+    assert np.array_equal(y, x[perm])
+
+
+def test_identity_route_is_identity():
+    E = 1 << 10
+    plan = plan_route_py(np.arange(E))
+    x = np.arange(E, dtype=np.float32)
+    assert np.array_equal(apply_route_np(plan, x), x)
+
+
+def _graphs():
+    yield 300, 3, 11, 0
+    yield 1500, 5, 22, 15  # with invalidated peers
+
+
+@pytest.mark.parametrize("n,m,seed,n_invalid", list(_graphs()))
+def test_routed_spmv_matches_gather_spmv(n, m, seed, n_invalid):
+    rng = np.random.default_rng(seed)
+    src, dst, val = barabasi_albert_edges(n, m, seed=seed)
+    valid = np.ones(n, dtype=bool)
+    if n_invalid:
+        valid[rng.choice(n, n_invalid, replace=False)] = False
+
+    gop = build_operator(n, src, dst, val, valid=valid)
+    garrs = operator_arrays(gop, dtype=jnp.float32, alpha=0.1)
+    rop = build_routed_operator(n, src, dst, val, valid=valid)
+    rarrs, rstatic = routed_arrays(rop, dtype=jnp.float32, alpha=0.1)
+
+    s0g = jnp.asarray(gop.valid, dtype=jnp.float32) * 1000.0
+    s0r = jnp.asarray(rop.initial_scores(1000.0))
+
+    yg = np.asarray(spmv(garrs, s0g))
+    yr = rop.scores_for_nodes(np.asarray(spmv_routed(rarrs, rstatic, s0r)))
+    # same products, same reduction order → float-exact per application
+    np.testing.assert_allclose(yr, yg, rtol=1e-6, atol=1e-3)
+
+
+def test_routed_converge_matches_gather_and_conserves():
+    n, m = 1200, 4
+    src, dst, val = barabasi_albert_edges(n, m, seed=5)
+    gop = build_operator(n, src, dst, val)
+    garrs = operator_arrays(gop, dtype=jnp.float32, alpha=0.1)
+    rop = build_routed_operator(n, src, dst, val)
+    rarrs, rstatic = routed_arrays(rop, dtype=jnp.float32, alpha=0.1)
+
+    s0g = jnp.asarray(gop.valid, dtype=jnp.float32) * 1000.0
+    s0r = jnp.asarray(rop.initial_scores(1000.0))
+
+    sg, itg, dg = converge_sparse_adaptive(garrs, s0g, tol=1e-6,
+                                           max_iterations=300)
+    sr, itr, dr = converge_routed_adaptive(rarrs, rstatic, s0r, tol=1e-6,
+                                           max_iterations=300)
+    assert int(itr) == int(itg)
+    assert float(dr) <= 1e-6
+    srn = rop.scores_for_nodes(np.asarray(sr))
+    np.testing.assert_allclose(srn, np.asarray(sg), rtol=1e-4, atol=0.5)
+    total = float(srn.sum())
+    assert abs(total - rop.n_valid * 1000.0) / (rop.n_valid * 1000.0) < 1e-4
+
+
+def test_routed_fixed_matches_gather_fixed():
+    from protocol_tpu.ops.converge import converge_sparse_fixed
+
+    n, m = 800, 4
+    src, dst, val = barabasi_albert_edges(n, m, seed=9)
+    gop = build_operator(n, src, dst, val)
+    garrs = operator_arrays(gop, dtype=jnp.float32)  # alpha=0: parity mode
+    rop = build_routed_operator(n, src, dst, val)
+    rarrs, rstatic = routed_arrays(rop, dtype=jnp.float32)
+
+    s0g = jnp.asarray(gop.valid, dtype=jnp.float32) * 1000.0
+    s0r = jnp.asarray(rop.initial_scores(1000.0))
+    sg = np.asarray(converge_sparse_fixed(garrs, s0g, 20))
+    sr = rop.scores_for_nodes(
+        np.asarray(converge_routed_fixed(rarrs, rstatic, s0r, 20)))
+    np.testing.assert_allclose(sr, sg, rtol=1e-4, atol=0.5)
+
+
+def test_routed_operator_save_load_roundtrip(tmp_path):
+    n, m = 600, 3
+    src, dst, val = barabasi_albert_edges(n, m, seed=13)
+    rop = build_routed_operator(n, src, dst, val)
+    path = tmp_path / "op.npz"
+    rop.save(path)
+    rop2 = rop.load(path)
+
+    rarrs, rstatic = routed_arrays(rop2, dtype=jnp.float32, alpha=0.1)
+    s0 = jnp.asarray(rop2.initial_scores(1000.0))
+    sr, it, dl = converge_routed_adaptive(rarrs, rstatic, s0, tol=1e-6,
+                                          max_iterations=300)
+    srn = rop2.scores_for_nodes(np.asarray(sr))
+
+    gop = build_operator(n, src, dst, val)
+    garrs = operator_arrays(gop, dtype=jnp.float32, alpha=0.1)
+    s0g = jnp.asarray(gop.valid, dtype=jnp.float32) * 1000.0
+    sg, _, _ = converge_sparse_adaptive(garrs, s0g, tol=1e-6,
+                                        max_iterations=300)
+    np.testing.assert_allclose(srn, np.asarray(sg), rtol=1e-4, atol=0.5)
+    assert rop2.nnz == rop.nnz and rop2.n_valid == rop.n_valid
+
+
+def test_routed_backend_seam_matches_rational_oracle():
+    from protocol_tpu.backend import JaxRoutedBackend, NativeRationalBackend
+
+    n = 10
+    rng = np.random.default_rng(21)
+    mat = rng.integers(0, 6, size=(n, n)).astype(np.float64)
+    np.fill_diagonal(mat, 0)
+    oracle = NativeRationalBackend().converge(mat, 1000.0, 25)
+    src, dst = np.nonzero(mat)
+    routed = JaxRoutedBackend().converge_edges(
+        n, src, dst, mat[src, dst], mat.sum(axis=1) > 0, 1000.0, 25)
+    np.testing.assert_allclose(routed, oracle, rtol=1e-4, atol=0.1)
+
+
+def test_routed_matches_native_oracle_small():
+    """Routed backend vs the exact rational oracle on a dense-style
+    small set (the reference's canonical equivalence pattern)."""
+    from fractions import Fraction
+
+    n = 12
+    rng = np.random.default_rng(3)
+    mat = rng.integers(0, 8, size=(n, n)).astype(np.float64)
+    np.fill_diagonal(mat, 0)
+    src, dst = np.nonzero(mat > 0)
+    val = mat[src, dst]
+
+    rop = build_routed_operator(n, src, dst, val)
+    rarrs, rstatic = routed_arrays(rop, dtype=jnp.float64)
+    s0 = jnp.asarray(rop.initial_scores(1000.0, dtype=np.float64))
+    sr = rop.scores_for_nodes(
+        np.asarray(converge_routed_fixed(rarrs, rstatic, s0, 30)))
+
+    # exact rational power iteration (reference converge_rational twin)
+    row_sums = mat.sum(axis=1)
+    c = [[Fraction(0)] * n for _ in range(n)]
+    for i in range(n):
+        for j in range(n):
+            if row_sums[i] > 0:
+                c[i][j] = Fraction(int(mat[i, j]), int(row_sums[i]))
+            elif i != j:
+                c[i][j] = Fraction(1, n - 1)  # dangling redistribution
+    s = [Fraction(1000)] * n
+    for _ in range(30):
+        s = [sum(c[j][i] * s[j] for j in range(n)) for i in range(n)]
+    expected = np.array([float(x) for x in s])
+    np.testing.assert_allclose(sr, expected, rtol=1e-9, atol=1e-6)
