@@ -288,23 +288,33 @@ def _lane_perm(x, stage, pallas: bool):
     return jnp.take_along_axis(x2, i2.astype(jnp.int32), axis=1)
 
 
-@partial(jax.jit, static_argnames=("e", "bits", "pallas"))
-def _apply_route_jit(x, stages, e, bits, pallas):
-    E = 1 << e
-    si = 0
+def route_core(x, stages, si: int, e_sub: int, bits: tuple, pallas: bool):
+    """Apply a route program to ``x`` of length B·2^e_sub — B independent
+    subproblems batched contiguously (every reshape/transpose below works
+    on El-sized chunks, so subproblem boundaries are never crossed). The
+    sharded executor (parallel/routed.py) uses B > 1 for the device-local
+    middle levels of a distributed route."""
+    E = x.size
     for li in range(len(bits) - 1):
-        B, m = 1 << (7 * li), E >> (7 * (li + 1))
+        El = 1 << (e_sub - 7 * li)
+        B, m = E // El, El >> 7
         x = _lane_perm(x, stages[si], pallas)
         x = x.reshape(B, m, 128).swapaxes(1, 2).reshape(E)
         si += 1
     x = _lane_perm(x, stages[si], pallas).reshape(E)
     si += 1
     for li in reversed(range(len(bits) - 1)):
-        B, m = 1 << (7 * li), E >> (7 * (li + 1))
+        El = 1 << (e_sub - 7 * li)
+        B, m = E // El, El >> 7
         x = x.reshape(B, 128, m).swapaxes(1, 2).reshape(E)
         x = _lane_perm(x, stages[si], pallas).reshape(E)
         si += 1
     return x
+
+
+@partial(jax.jit, static_argnames=("e", "bits", "pallas"))
+def _apply_route_jit(x, stages, e, bits, pallas):
+    return route_core(x, stages, 0, e, bits, pallas)
 
 
 def apply_route(x, stages, e: int, bits: tuple, pallas: bool | None = None):

@@ -57,11 +57,71 @@ __all__ = [
 ]
 
 
-def _ceil_pow2_exp(x: int) -> int:
-    e = 7
+def _ceil_pow2_exp(x: int, floor: int = 7) -> int:
+    e = floor
     while (1 << e) < x:
         e += 1
     return e
+
+
+def _initial_scores(valid: np.ndarray, initial: float, dtype) -> np.ndarray:
+    return (valid * initial).astype(dtype)
+
+
+def _scores_for_nodes(state_to_node: np.ndarray, n: int,
+                      state_scores) -> np.ndarray:
+    state_scores = np.asarray(state_scores)
+    out = np.zeros(n, dtype=state_scores.dtype)
+    live = state_to_node >= 0
+    out[state_to_node[live]] = state_scores[live]
+    return out
+
+
+def blocked_broadcast(arrs: dict, s, widths: tuple, xs: tuple,
+                      total_len: int):
+    """Expand a state(-slice) vector into weighted edge values across the
+    blocked buckets: the shared source side of the routed SpMV (used by
+    the single-device and the per-shard kernels)."""
+    parts = []
+    pos = 0
+    for bi, (w, X) in enumerate(zip(widths, xs)):
+        w_mat = arrs["out_weight"][bi]
+        if w < 128:
+            g = 128 // w
+            s2t = lax.slice_in_dim(s, pos, pos + g * X).reshape(g, X)
+            v = jnp.einsum("gl,gx->xl", arrs["out_expand"][bi], s2t,
+                           precision=_PREC) * w_mat
+            pos += g * X
+        else:
+            nb_pad = X * 128 // w        # padded row count
+            rows = lax.slice_in_dim(s, pos, pos + nb_pad)
+            v = jnp.broadcast_to(
+                rows[:, None], (nb_pad, w // 128)).reshape(X, 1) * w_mat
+            pos += nb_pad
+        parts.append(v.reshape(-1))
+    used = sum(X * 128 for X in xs)
+    parts.append(jnp.zeros((total_len - used,), dtype=s.dtype))
+    return jnp.concatenate(parts)
+
+
+def blocked_reduce(arrs: dict, y, widths: tuple, xs: tuple, n_pos: int,
+                   total_len: int):
+    """Lane-segmented per-row sums of a routed edge array: the shared
+    destination side of the routed SpMV."""
+    sums = []
+    off = 0
+    for bi, (w, X) in enumerate(zip(widths, xs)):
+        y2 = lax.slice_in_dim(y, off, off + X * 128).reshape(X, 128)
+        if w < 128:
+            z2 = jnp.einsum("xl,gl->gx", y2, arrs["in_reduce"][bi],
+                            precision=_PREC)
+            sums.append(z2.reshape(-1))
+        else:
+            nb_pad = X * 128 // w
+            sums.append(y2.sum(axis=-1).reshape(nb_pad, w // 128).sum(axis=-1))
+        off += X * 128
+    sums.append(jnp.zeros((total_len - n_pos,), dtype=y.dtype))
+    return jnp.concatenate(sums)
 
 
 class _Side(NamedTuple):
@@ -198,15 +258,11 @@ class RoutedOperator:
         return 1 << self.state_e
 
     def initial_scores(self, initial: float, dtype=np.float32) -> np.ndarray:
-        return (self.valid * initial).astype(dtype)
+        return _initial_scores(self.valid, initial, dtype)
 
     def scores_for_nodes(self, state_scores: np.ndarray) -> np.ndarray:
         """Translate a state-order score vector to node order."""
-        state_scores = np.asarray(state_scores)
-        out = np.zeros(self.n, dtype=state_scores.dtype)
-        live = self.state_to_node >= 0
-        out[self.state_to_node[live]] = state_scores[live]
-        return out
+        return _scores_for_nodes(self.state_to_node, self.n, state_scores)
 
     def save(self, path) -> None:
         """Persist the compiled operator (uncompressed .npz) so the
@@ -429,56 +485,14 @@ _PREC = lax.Precision.HIGHEST
 def spmv_routed(arrs: dict, static: RoutedStatic, s: jnp.ndarray) -> jnp.ndarray:
     """One application of the normalized trust operator (state order):
     broadcast → route → reduce → route-back → dangling + damping."""
-    E2 = 1 << static.edge_e
-    N2 = 1 << static.state_e
-
-    # broadcast: per bucket, expand the state slice across lanes and
-    # weight. All arrays stay [X, 128] or 1-D.
-    parts = []
-    pos = 0
-    for bi, (w, X) in enumerate(zip(static.out_widths, static.out_xs)):
-        w_mat = arrs["out_weight"][bi]
-        if w < 128:
-            g = 128 // w
-            s2t = lax.slice_in_dim(s, pos, pos + g * X).reshape(g, X)
-            v = jnp.einsum("gl,gx->xl", arrs["out_expand"][bi], s2t,
-                           precision=_PREC) * w_mat
-            pos += g * X
-        else:
-            nb_pad = X * 128 // w        # padded row count
-            rows = lax.slice_in_dim(s, pos, pos + nb_pad)
-            srep = jnp.broadcast_to(
-                rows[:, None], (nb_pad, w // 128)).reshape(X, 1)
-            v = srep * w_mat
-            pos += nb_pad
-        parts.append(v.reshape(-1))
-    used = sum(X * 128 for X in static.out_xs)
-    parts.append(jnp.zeros((E2 - used,), dtype=s.dtype))
-    x = jnp.concatenate(parts)
-
+    x = blocked_broadcast(arrs, s, static.out_widths, static.out_xs,
+                          1 << static.edge_e)
     y = _apply_route_jit(x, arrs["edge_stages"], static.edge_e,
                          static.edge_bits, static.pallas)
-
-    # reduce: per bucket, lane-segmented sums to column-major positions
-    sums = []
-    off = 0
-    for bi, (w, X) in enumerate(zip(static.in_widths, static.in_xs)):
-        y2 = lax.slice_in_dim(y, off, off + X * 128).reshape(X, 128)
-        if w < 128:
-            z2 = jnp.einsum("xl,gl->gx", y2, arrs["in_reduce"][bi],
-                            precision=_PREC)
-            sums.append(z2.reshape(-1))
-        else:
-            nb_pad = X * 128 // w
-            z = y2.sum(axis=-1).reshape(nb_pad, w // 128).sum(axis=-1)
-            sums.append(z)
-        off += X * 128
-    sums.append(jnp.zeros((N2 - static.in_n_pos,), dtype=s.dtype))
-    z = jnp.concatenate(sums)
-
+    z = blocked_reduce(arrs, y, static.in_widths, static.in_xs,
+                       static.in_n_pos, 1 << static.state_e)
     base = _apply_route_jit(z, arrs["state_stages"], static.state_e,
                             static.state_bits, static.pallas)
-
     return dangling_and_damping(arrs, s, base)
 
 

@@ -9,6 +9,12 @@ from .converge import (
     sharded_converge_adaptive,
 )
 from .checkpointed import run_with_retries, sharded_converge_checkpointed
+from .routed import (
+    ShardedRoutedOperator,
+    build_sharded_routed_operator,
+    sharded_routed_converge_fixed,
+    sharded_routed_converge_adaptive,
+)
 
 __all__ = [
     "make_mesh",
@@ -20,4 +26,8 @@ __all__ = [
     "sharded_converge_adaptive",
     "sharded_converge_checkpointed",
     "run_with_retries",
+    "ShardedRoutedOperator",
+    "build_sharded_routed_operator",
+    "sharded_routed_converge_fixed",
+    "sharded_routed_converge_adaptive",
 ]

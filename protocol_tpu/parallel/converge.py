@@ -159,6 +159,41 @@ def build_sharded_operator(
     )
 
 
+def psum_dangling_and_damping(arrs: dict, s_block, base, n_valid: float):
+    """Mesh twin of ``ops.converge.dangling_and_damping``: the dangling
+    rank-1 correction and damped pre-trust mixing with the cross-shard
+    mass totals carried by psum. Shared by the gather and routed sharded
+    kernels so the semantics cannot desynchronize."""
+    d_mass = lax.psum(jnp.sum(s_block * arrs["dangling"]), rows_axis)
+    denom = max(n_valid - 1.0, 1.0)
+    corr = (d_mass - arrs["dangling"] * s_block) / denom
+    propagated = base + corr * arrs["valid"]
+
+    alpha = arrs["alpha"][0]
+    total = lax.psum(jnp.sum(s_block * arrs["valid"]), rows_axis)
+    return (1.0 - alpha) * propagated + alpha * arrs["pretrust"] * total
+
+
+def mesh_adaptive_loop(step, s, tol: float, max_iterations: int):
+    """Mesh twin of ``ops.converge.adaptive_loop``: the relative-L1
+    stopping predicate with the norm and delta psum'd across shards."""
+    norm = jnp.maximum(lax.psum(jnp.sum(jnp.abs(s)), rows_axis), 1.0)
+
+    def cond(state):
+        _, i, delta = state
+        return (delta > tol) & (i < max_iterations)
+
+    def body(state):
+        s_block, i, _ = state
+        s_next = step(s_block)
+        delta = lax.psum(jnp.sum(jnp.abs(s_next - s_block)), rows_axis) / norm
+        return s_next, i + 1, delta
+
+    return lax.while_loop(
+        cond, body, (s, jnp.int32(0), jnp.asarray(jnp.inf, s.dtype))
+    )
+
+
 def _local_spmv(arrs: dict, s_block: jnp.ndarray, n_valid: float) -> jnp.ndarray:
     """Per-device SpMV: all_gather scores, gather-reduce local buckets,
     psum the dangling mass."""
@@ -170,16 +205,7 @@ def _local_spmv(arrs: dict, s_block: jnp.ndarray, n_valid: float) -> jnp.ndarray
     parts.append(jnp.zeros((1,), dtype=s_block.dtype))
     flat = jnp.concatenate(parts)
     base = flat[arrs["row_pos"]]
-
-    d_mass = lax.psum(jnp.sum(s_block * arrs["dangling"]), rows_axis)
-    denom = max(n_valid - 1.0, 1.0)
-    corr = (d_mass - arrs["dangling"] * s_block) / denom
-    propagated = base + corr * arrs["valid"]
-
-    # damped pre-trust mixing (see ops.converge.spmv); total mass via psum
-    alpha = arrs["alpha"][0]
-    total = lax.psum(jnp.sum(s_block * arrs["valid"]), rows_axis)
-    return (1.0 - alpha) * propagated + alpha * arrs["pretrust"] * total
+    return psum_dangling_and_damping(arrs, s_block, base, n_valid)
 
 
 @lru_cache(maxsize=32)
@@ -206,22 +232,10 @@ def _fixed_fn(mesh: Mesh, n_valid: float, num_iterations: int):
 def _adaptive_fn(mesh: Mesh, n_valid: float, tol: float, max_iterations: int):
     def run(arrs, s):
         arrs = jax.tree.map(lambda x: x[0], arrs)
-        norm = jnp.maximum(lax.psum(jnp.sum(jnp.abs(s)), rows_axis), 1.0)
-
-        def cond(state):
-            _, i, delta = state
-            return (delta > tol) & (i < max_iterations)
-
-        def body(state):
-            s_block, i, _ = state
-            s_next = _local_spmv(arrs, s_block, n_valid)
-            delta = lax.psum(jnp.sum(jnp.abs(s_next - s_block)), rows_axis) / norm
-            return s_next, i + 1, delta
-
-        s_final, iters, delta = lax.while_loop(
-            cond, body, (s, jnp.int32(0), jnp.asarray(jnp.inf, s.dtype))
+        return mesh_adaptive_loop(
+            lambda s_block: _local_spmv(arrs, s_block, n_valid),
+            s, tol, max_iterations,
         )
-        return s_final, iters, delta
 
     shmapped = shard_map(
         run,
