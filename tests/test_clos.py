@@ -48,8 +48,10 @@ def test_python_planner_routes_any_permutation(e):
     assert np.array_equal(apply_route_np(plan, x), x[perm])
 
 
-@pytest.mark.parametrize("e", [7, 9, 13, 15])
+@pytest.mark.parametrize("e", [7, 9, 13, 15, 17])
 def test_native_planner_routes_any_permutation(e):
+    """e=17 crosses the 2^16 threshold into the interleaved-walker
+    coloring path; smaller sizes take the cursor walk."""
     from protocol_tpu import native as pn
 
     if not pn.available():

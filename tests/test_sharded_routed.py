@@ -97,6 +97,31 @@ def test_sharded_routed_matches_single_device_routed():
         rtol=1e-4, atol=0.5)
 
 
+def test_sharded_routed_hub_buckets():
+    """A star-heavy graph forces w ≥ 128 (multi-lane-row) buckets on both
+    sides; the sharded route must still agree with the gather path."""
+    rng = np.random.default_rng(2)
+    n, D = 600, 8
+    hub = 0
+    others = np.arange(1, n)
+    src = np.concatenate([np.full(n - 1, hub), others,
+                          rng.integers(1, n, 800)])
+    dst = np.concatenate([others, np.full(n - 1, hub),
+                          rng.integers(1, n, 800)])
+    val = rng.integers(1, 10, len(src)).astype(np.float64)
+    mesh = make_mesh(D)
+    op = build_sharded_routed_operator(n, src, dst, val, num_shards=D)
+    assert max(op.in_widths) >= 128 or max(op.out_widths) >= 128
+    scores, iters, delta = sharded_routed_converge_adaptive(
+        op, op.initial_scores(1000.0), mesh, tol=1e-6, max_iterations=400,
+        alpha=0.1)
+    sg, itg, _ = _gather_reference(n, src, dst, val, None, 0.1, 1e-6, 400)
+    assert int(iters) == int(itg)
+    np.testing.assert_allclose(
+        op.scores_for_nodes(np.asarray(scores)), np.asarray(sg),
+        rtol=1e-4, atol=0.5)
+
+
 def test_sharded_routed_rejects_bad_shard_count():
     src, dst, val = barabasi_albert_edges(100, 3, seed=1)
     with pytest.raises(AssertionError):
