@@ -994,23 +994,17 @@ static i64 euler_split(const i32 *i_src, ColorScratch &S, i64 lo, i64 hi,
         ladj[lcur[ls[j]]++] = (i32)j;
         radj[rcur[rs[j]]++] = (i32)j;
     }
+    u8 *side_a = S.side_a.data();   // pre-flip color: member=1, lpart=0
+
     if (k < (1 << 16)) {
         // small splits are cache-resident: the plain cursor walk beats
         // the interleaved machinery's bookkeeping (and its pairing
-        // construction) there
-        u8 *side_small = S.side_a.data();
+        // construction) there; fall through to the shared partition
         euler_split_cursor(ls, rs, S, k, m);
-        i32 *tmp_s = S.tmp.data();
-        i64 na_s = 0;
-        for (i64 j = 0; j < k; ++j)
-            if (side_small[j]) tmp_s[na_s++] = e[j];
-        i64 nb_s = na_s;
-        for (i64 j = 0; j < k; ++j)
-            if (!side_small[j]) tmp_s[nb_s++] = e[j];
-        std::copy(tmp_s, tmp_s + k, e);
-        return na_s;
+        goto partition;
     }
 
+    {
     // pair consecutive incident edges per vertex (degrees are even)
     i32 *lpart = S.lpart.data();
     i32 *rpart = S.rpart.data();
@@ -1026,7 +1020,6 @@ static i64 euler_split(const i32 *i_src, ColorScratch &S, i64 lo, i64 hi,
     }
 
     u8 *colored = S.used.data();
-    u8 *side_a = S.side_a.data();   // pre-flip color: member=1, lpart=0
     i32 *seg_of = S.seg_of.data();
     std::memset(colored, 0, k);
 
@@ -1175,6 +1168,9 @@ static i64 euler_split(const i32 *i_src, ColorScratch &S, i64 lo, i64 hi,
         }
     }
 
+    }
+
+partition:
     // stable partition: side-A edges first
     i32 *tmp = S.tmp.data();
     i64 na = 0;

@@ -100,9 +100,11 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--checkpoint-every", type=int, default=25)
     p.add_argument("--engine", choices=["auto", "routed", "gather"],
                    default="auto",
-                   help="single-device SpMV engine: 'routed' compiles the "
-                        "edge permutation to a Clos lane-shuffle network "
-                        "(fastest at scale, one-time plan build); 'auto' "
+                   help="SpMV engine (single-device and sharded/"
+                        "checkpointed runs): 'routed' compiles the edge "
+                        "permutation to a Clos lane-shuffle network "
+                        "(fastest at scale, one-time plan build; sharded "
+                        "runs need a device count dividing 128); 'auto' "
                         "picks it beyond 100K peers when the native "
                         "planner is built")
     p.add_argument("--out", default="sparse-scores.csv",
@@ -436,6 +438,7 @@ def handle_sparse_scores(args, files, config):
 
         from ..parallel import (
             build_sharded_operator,
+            build_sharded_routed_operator,
             make_mesh,
             sharded_converge_checkpointed,
         )
@@ -446,11 +449,29 @@ def handle_sparse_scores(args, files, config):
             ck_dir = files.assets / ck_dir
         n_dev = len(jax.devices())
         mesh = make_mesh(n_dev)
-        sop = build_sharded_operator(args.n, src, dst, val,
-                                     num_shards=n_dev)
-        s0 = sop.initial_scores(args.initial_score, dtype=jnp.float32)
+        engine = args.engine
+        if engine == "auto":
+            from .. import native as pn
+
+            engine = ("routed" if args.n >= 100_000 and pn.available()
+                      and 128 % n_dev == 0 else "gather")
+        if engine == "routed" and 128 % n_dev != 0:
+            raise EigenError(
+                "validation_error",
+                f"routed engine needs a device count dividing 128, "
+                f"have {n_dev}")
+        if engine == "routed":
+            sop = build_sharded_routed_operator(args.n, src, dst, val,
+                                                num_shards=n_dev)
+            s0 = jnp.asarray(sop.initial_scores(
+                args.initial_score, dtype=np.float32))
+        else:
+            sop = build_sharded_operator(args.n, src, dst, val,
+                                         num_shards=n_dev)
+            s0 = sop.initial_scores(args.initial_score, dtype=jnp.float32)
         try:
-            with trace.span("cli.sparse_scores", mode="sharded", n=args.n):
+            with trace.span("cli.sparse_scores", mode="sharded", n=args.n,
+                            engine=engine):
                 scores, iters, delta = sharded_converge_checkpointed(
                     sop, s0, mesh, CheckpointManager(str(ck_dir)),
                     tol=args.tol, max_iterations=args.max_iterations,
@@ -460,7 +481,10 @@ def handle_sparse_scores(args, files, config):
         except ValueError as e:
             # bad checkpoint_every / stale-checkpoint mismatch on resume
             raise EigenError("validation_error", str(e)) from e
-        scores = np.asarray(scores)[: args.n]
+        if engine == "routed":
+            scores = sop.scores_for_nodes(np.asarray(scores))
+        else:
+            scores = np.asarray(scores)[: args.n]
     else:
         from ..backend import JaxRoutedBackend, JaxSparseBackend
 

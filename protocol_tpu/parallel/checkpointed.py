@@ -41,29 +41,61 @@ def sharded_converge_checkpointed(
 ):
     """Adaptive sharded convergence with periodic checkpoints.
 
-    Returns (scores_padded, total_iterations, final_relative_delta).
-    ``total_iterations`` counts work done across all runs including the
-    iterations replayed from checkpoints on resume.
+    ``sop`` may be a gather-path ``ShardedOperator`` (optionally paired
+    with placed arrays, see ``_resolve_sharded``) or a Clos-routed
+    ``ShardedRoutedOperator``; the chunked driver and resume semantics
+    are identical. Returns (scores_padded, total_iterations,
+    final_relative_delta). ``total_iterations`` counts work done across
+    all runs including the iterations replayed from checkpoints on
+    resume.
     """
     if checkpoint_every < 1:
         raise ValueError("checkpoint_every must be >= 1")
-    meta, arrs = _resolve_sharded(sop, mesh, s0.dtype, alpha)
+
+    from .routed import ShardedRoutedOperator, sharded_routed_converge_adaptive
+
+    if isinstance(sop, ShardedRoutedOperator):
+        # Clos-routed sharded backend: state lives in the operator's
+        # padded state order; the chunked driver is otherwise identical
+        meta = sop
+        state_len = sop.n_state
+        engine = "routed"
+
+        def run_chunk(scores, chunk):
+            return sharded_routed_converge_adaptive(
+                sop, scores, mesh, tol=tol, max_iterations=chunk,
+                alpha=alpha,
+            )
+    else:
+        meta, arrs = _resolve_sharded(sop, mesh, s0.dtype, alpha)
+        state_len = meta.n_pad
+        engine = "gather"
+
+        def run_chunk(scores, chunk):
+            return sharded_converge_adaptive(
+                (meta, arrs), scores, mesh, tol=tol, max_iterations=chunk,
+                alpha=alpha,
+            )
 
     done = 0
     delta = float("inf")
     if resume and checkpoints.latest() is not None:
         step, arrays, ck_meta = checkpoints.restore()
-        if arrays["scores"].shape[0] != meta.n_pad:
+        if arrays["scores"].shape[0] != state_len:
             raise ValueError(
                 f"checkpoint score length {arrays['scores'].shape[0]} does "
-                f"not match operator n_pad {meta.n_pad}"
+                f"not match the operator's state length {state_len}"
             )
         # a resume under a different configuration would silently blend
         # two trajectories; n/n_valid fingerprint the graph, alpha the
-        # iteration semantics (tol may legitimately change — it only
-        # affects the stopping predicate of a memoryless iteration)
+        # iteration semantics, engine the score-vector ORDER (gather =
+        # node order, routed = permuted device-major state order — same
+        # length does not mean same meaning). tol may legitimately
+        # change — it only affects the stopping predicate of a
+        # memoryless iteration.
         for key, current in (("n", meta.n), ("n_valid", meta.n_valid),
-                             ("alpha", float(alpha))):
+                             ("alpha", float(alpha)),
+                             ("engine", engine)):
             recorded = ck_meta.get(key)
             if recorded is not None and recorded != current:
                 raise ValueError(
@@ -82,10 +114,7 @@ def sharded_converge_checkpointed(
         while done < max_iterations and delta > tol:
             chunk = min(checkpoint_every, max_iterations - done)
             with trace.span("converge.chunk", start=done, size=chunk):
-                scores, iters, delta_dev = sharded_converge_adaptive(
-                    (meta, arrs), scores, mesh, tol=tol,
-                    max_iterations=chunk, alpha=alpha,
-                )
+                scores, iters, delta_dev = run_chunk(scores, chunk)
             iters = int(iters)
             delta = float(delta_dev)
             done += iters
@@ -94,8 +123,8 @@ def sharded_converge_checkpointed(
                 done,
                 {"scores": np.asarray(scores)},
                 meta={"delta": delta, "tol": tol, "alpha": float(alpha),
-                      "n": meta.n, "n_pad": meta.n_pad,
-                      "n_valid": meta.n_valid,
+                      "n": meta.n, "n_pad": state_len,
+                      "n_valid": meta.n_valid, "engine": engine,
                       "converged": delta <= tol},
             )
             if iters < chunk:

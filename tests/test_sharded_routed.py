@@ -122,6 +122,44 @@ def test_sharded_routed_hub_buckets():
         rtol=1e-4, atol=0.5)
 
 
+def test_sharded_routed_checkpoint_resume(tmp_path):
+    """The chunked checkpoint driver accepts the routed operator: an
+    interrupted run resumes from the newest checkpoint and lands on the
+    uninterrupted trajectory."""
+    from protocol_tpu.parallel import (
+        build_sharded_routed_operator as build,
+        sharded_routed_converge_adaptive,
+    )
+    from protocol_tpu.parallel.checkpointed import (
+        sharded_converge_checkpointed,
+    )
+    from protocol_tpu.utils.checkpoint import CheckpointManager
+
+    n, m, D = 512, 3, 8
+    src, dst, val = barabasi_albert_edges(n, m, seed=17)
+    mesh = make_mesh(D)
+    op = build(n, src, dst, val, num_shards=D)
+    s0 = jnp.asarray(op.initial_scores(1000.0))
+
+    # uninterrupted reference
+    ref, ref_iters, _ = sharded_routed_converge_adaptive(
+        op, s0, mesh, tol=1e-6, max_iterations=200, alpha=0.1)
+
+    # run a few chunks, "crash", resume to completion
+    ck = CheckpointManager(str(tmp_path / "ck"))
+    sharded_converge_checkpointed(
+        op, s0, mesh, ck, tol=1e-6, max_iterations=6, alpha=0.1,
+        checkpoint_every=3)
+    scores, total, delta = sharded_converge_checkpointed(
+        op, s0, mesh, ck, tol=1e-6, max_iterations=200, alpha=0.1,
+        checkpoint_every=50, resume=True)
+    assert total == int(ref_iters)
+    assert float(delta) <= 1e-6
+    np.testing.assert_allclose(
+        op.scores_for_nodes(np.asarray(scores)),
+        op.scores_for_nodes(np.asarray(ref)), rtol=1e-5, atol=1e-2)
+
+
 def test_sharded_routed_rejects_bad_shard_count():
     src, dst, val = barabasi_albert_edges(100, 3, seed=1)
     with pytest.raises(AssertionError):
