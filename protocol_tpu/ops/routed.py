@@ -504,11 +504,15 @@ def converge_routed_fixed(arrs, static: RoutedStatic, s0, num_iterations: int):
     )
 
 
-@partial(jax.jit, static_argnames=("static", "max_iterations"))
+@partial(jax.jit, static_argnames=("static", "max_iterations", "accel_every"))
 def converge_routed_adaptive(arrs, static: RoutedStatic, s0,
-                             tol: float = 1e-6, max_iterations: int = 100):
+                             tol: float = 1e-6, max_iterations: int = 100,
+                             accel_every: int = 0):
     """Iterate until the relative L1 delta ≤ tol (or max_iterations).
-    Returns (scores, iterations_run, final_relative_delta)."""
+    ``accel_every`` enables the safeguarded extrapolation (see
+    ``ops.converge.adaptive_loop``). Returns (scores, iterations_run,
+    final_relative_delta)."""
     return adaptive_loop(
-        lambda s: spmv_routed(arrs, static, s), s0, tol, max_iterations
+        lambda s: spmv_routed(arrs, static, s), s0, tol, max_iterations,
+        accel_every,
     )

@@ -12,6 +12,7 @@ from .checkpointed import run_with_retries, sharded_converge_checkpointed
 from .routed import (
     ShardedRoutedOperator,
     build_sharded_routed_operator,
+    place_sharded_routed,
     sharded_routed_converge_fixed,
     sharded_routed_converge_adaptive,
 )
@@ -28,6 +29,7 @@ __all__ = [
     "run_with_retries",
     "ShardedRoutedOperator",
     "build_sharded_routed_operator",
+    "place_sharded_routed",
     "sharded_routed_converge_fixed",
     "sharded_routed_converge_adaptive",
 ]

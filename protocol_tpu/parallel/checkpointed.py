@@ -52,18 +52,25 @@ def sharded_converge_checkpointed(
     if checkpoint_every < 1:
         raise ValueError("checkpoint_every must be >= 1")
 
-    from .routed import ShardedRoutedOperator, sharded_routed_converge_adaptive
+    from .routed import (
+        ShardedRoutedOperator,
+        place_sharded_routed,
+        sharded_routed_converge_adaptive,
+    )
 
     if isinstance(sop, ShardedRoutedOperator):
         # Clos-routed sharded backend: state lives in the operator's
-        # padded state order; the chunked driver is otherwise identical
+        # padded state order; the chunked driver is otherwise identical.
+        # Stage/weight arrays are placed ONCE — they are gigabytes at
+        # scale and must not be re-staged per chunk.
         meta = sop
         state_len = sop.n_state
         engine = "routed"
+        placed = place_sharded_routed(sop, mesh, s0.dtype, alpha)
 
         def run_chunk(scores, chunk):
             return sharded_routed_converge_adaptive(
-                sop, scores, mesh, tol=tol, max_iterations=chunk,
+                (sop, placed), scores, mesh, tol=tol, max_iterations=chunk,
                 alpha=alpha,
             )
     else:
@@ -97,6 +104,10 @@ def sharded_converge_checkpointed(
                              ("alpha", float(alpha)),
                              ("engine", engine)):
             recorded = ck_meta.get(key)
+            if key == "engine" and recorded is None:
+                # checkpoints written before the engine key existed were
+                # always gather (node-order scores)
+                recorded = "gather"
             if recorded is not None and recorded != current:
                 raise ValueError(
                     f"checkpoint was written with {key}={recorded}, "
