@@ -107,6 +107,11 @@ def build_parser() -> argparse.ArgumentParser:
                         "runs need a device count dividing 128); 'auto' "
                         "picks it beyond 100K peers when the native "
                         "planner is built")
+    p.add_argument("--operator-cache",
+                   help="directory for compiled routed operators, keyed "
+                        "on the edge-list digest: the one-time routing-"
+                        "plan build (minutes at 10M peers) is paid once "
+                        "and reused across invocations")
     p.add_argument("--out", default="sparse-scores.csv",
                    help="output CSV (peer_id,score), relative to assets")
 
@@ -432,6 +437,23 @@ def handle_sparse_scores(args, files, config):
 
     from ..utils import trace
 
+    def _operator_cache_path(kind, num_shards):
+        """Cache key = digest of the exact edge list + build geometry, so
+        a changed graph can never load a stale plan."""
+        if not args.operator_cache:
+            return None
+        import hashlib
+
+        h = hashlib.sha256()
+        h.update(f"{kind}:v1:n={args.n}:D={num_shards}".encode())
+        for a in (src, dst, val):
+            h.update(np.ascontiguousarray(a).tobytes())
+        cache_dir = Path(args.operator_cache)
+        if not cache_dir.is_absolute():
+            cache_dir = files.assets / cache_dir
+        cache_dir.mkdir(parents=True, exist_ok=True)
+        return cache_dir / f"{kind}_{h.hexdigest()[:24]}.npz"
+
     if args.checkpoint_dir:
         import jax
         import jax.numpy as jnp
@@ -461,8 +483,26 @@ def handle_sparse_scores(args, files, config):
                 f"routed engine needs a device count dividing 128, "
                 f"have {n_dev}")
         if engine == "routed":
-            sop = build_sharded_routed_operator(args.n, src, dst, val,
-                                                num_shards=n_dev)
+            from ..parallel.routed import ShardedRoutedOperator
+
+            cache_path = _operator_cache_path("sharded_routed", n_dev)
+            sop = None
+            if cache_path is not None and cache_path.exists():
+                try:
+                    with trace.span("cli.operator_load",
+                                    path=str(cache_path)):
+                        sop = ShardedRoutedOperator.load(cache_path,
+                                                         num_shards=n_dev)
+                except Exception as e:
+                    # a corrupt/stale cache entry must never brick the
+                    # run — rebuild and overwrite it
+                    print(f"warning: ignoring unreadable operator cache "
+                          f"{cache_path}: {e}", file=sys.stderr)
+            if sop is None:
+                sop = build_sharded_routed_operator(args.n, src, dst, val,
+                                                    num_shards=n_dev)
+                if cache_path is not None:
+                    sop.save(cache_path)
             s0 = jnp.asarray(sop.initial_scores(
                 args.initial_score, dtype=np.float32))
         else:
@@ -497,11 +537,31 @@ def handle_sparse_scores(args, files, config):
         backend = (JaxRoutedBackend() if engine == "routed"
                    else JaxSparseBackend())
         valid = np.ones(args.n, dtype=bool)
+        extra = {}
+        if engine == "routed":
+            from ..ops.routed import RoutedOperator, build_routed_operator
+
+            cache_path = _operator_cache_path("routed", 1)
+            if cache_path is not None:
+                if cache_path.exists():
+                    try:
+                        with trace.span("cli.operator_load",
+                                        path=str(cache_path)):
+                            extra["operator"] = RoutedOperator.load(
+                                cache_path)
+                    except Exception as e:
+                        print(f"warning: ignoring unreadable operator "
+                              f"cache {cache_path}: {e}", file=sys.stderr)
+                if "operator" not in extra:
+                    extra["operator"] = build_routed_operator(
+                        args.n, src, dst, val, valid)
+                    extra["operator"].save(cache_path)
         with trace.span("cli.sparse_scores", mode="single", n=args.n,
                         engine=engine):
             scores, iters, delta = backend.converge_edges(
                 args.n, src, dst, val, valid, args.initial_score,
                 args.max_iterations, tol=args.tol, alpha=args.alpha,
+                **extra,
             )
 
     out_path = Path(args.out)

@@ -209,7 +209,9 @@ def clos_plan(perm: np.ndarray, bits) -> np.ndarray | None:
     if rc == 1:
         raise ValueError("clos_plan: input is not a permutation")
     if rc != 0:
-        raise ValueError("clos_plan: invalid level bits")
+        # the C++ returns 2 both for bad level bits and for a length
+        # that is not a power of two >= 128
+        raise ValueError("clos_plan: invalid length or level bits")
     return out
 
 

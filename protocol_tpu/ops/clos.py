@@ -184,9 +184,19 @@ def plan_route_py(perm: np.ndarray) -> RoutePlan:
     return RoutePlan(e=e, bits=bits, stages=stages)
 
 
-def plan_route(perm: np.ndarray, prefer_native: bool = True) -> RoutePlan:
+def plan_route(perm: np.ndarray, prefer_native: bool = True,
+               validate: bool = True) -> RoutePlan:
     """Plan a static permutation route; uses the C++ planner when built
-    (required in practice beyond ~2^20 slots), Python otherwise."""
+    (required in practice beyond ~2^20 slots), Python otherwise.
+
+    ``validate`` replays the finished plan on the host
+    (``apply_route_np`` over ``arange(E)`` — seconds, vs minutes of
+    planning at scale) and requires it to reproduce ``perm`` exactly: a
+    consistent-but-wrong coloring would otherwise yield a non-bijective
+    plan that silently corrupts every score it routes. On mismatch the
+    native plan is discarded and the Python planner is tried once; if
+    that also fails, raises.
+    """
     import warnings
 
     perm = np.asarray(perm)
@@ -194,6 +204,23 @@ def plan_route(perm: np.ndarray, prefer_native: bool = True) -> RoutePlan:
     e = E.bit_length() - 1
     if (1 << e) != E or e < 7:
         raise ValueError("plan_route: length must be a power of two ≥ 128")
+
+    native_plan_rejected = False
+
+    def _check(plan, source):
+        if not validate:
+            return True
+        probe = np.arange(E, dtype=np.int32 if e < 31 else np.int64)
+        if np.array_equal(apply_route_np(plan, probe), perm):
+            return True
+        warnings.warn(
+            f"plan_route: {source} planner produced a plan that does not "
+            f"reproduce the permutation — discarding it",
+            RuntimeWarning,
+            stacklevel=3,
+        )
+        return False
+
     if prefer_native:
         from .. import native as pn
 
@@ -202,21 +229,33 @@ def plan_route(perm: np.ndarray, prefer_native: bool = True) -> RoutePlan:
             stages_flat = pn.clos_plan(perm.astype(np.int32), bits)
             if stages_flat is not None:
                 nstages = 2 * len(bits) - 1
-                return RoutePlan(
+                plan = RoutePlan(
                     e=e,
                     bits=bits,
                     stages=[stages_flat[s * E : (s + 1) * E]
                             for s in range(nstages)],
                 )
+                if _check(plan, "native"):
+                    return plan
+                native_plan_rejected = True
     if e > 20:
+        reason = ("native planner produced an invalid plan (bug — please "
+                  "report)" if native_plan_rejected
+                  else "native planner unavailable")
         warnings.warn(
-            f"plan_route: native planner unavailable; the pure-Python "
-            f"Euler-split planner visits every one of the 2^{e} slots in "
-            f"Python — expect this to take a very long time",
+            f"plan_route: {reason}; falling back to the pure-Python "
+            f"Euler-split planner, which visits every one of the 2^{e} "
+            f"slots in Python — expect this to take a very long time",
             RuntimeWarning,
             stacklevel=2,
         )
-    return plan_route_py(perm)
+    plan = plan_route_py(perm)
+    if not _check(plan, "python"):
+        raise RuntimeError(
+            "plan_route: no planner produced a valid plan for this "
+            "permutation"
+        )
+    return plan
 
 
 # --------------------------------------------------------------------------

@@ -138,10 +138,11 @@ def adaptive_loop(step, s0: jnp.ndarray, tol: float, max_iterations: int,
             den = jnp.sum(d1 * d1)
             r = jnp.sum(d2 * d1) / jnp.maximum(den, jnp.finfo(s.dtype).tiny)
             r = jnp.clip(r, 0.0, 0.9)
-            # never jump on the stopping iteration: the returned vector
-            # must be the one the reported delta describes
+            # never jump on the stopping iteration — neither a tol stop
+            # nor the max_iterations cap: the returned vector must be the
+            # one the reported delta describes
             do_acc = (((i % accel_every) == accel_every - 1) & (i >= 1)
-                      & (delta > tol))
+                      & (delta > tol) & (i + 1 < max_iterations))
             s_next = jnp.where(do_acc, s_next + (r / (1.0 - r)) * d2, s_next)
         return s, s_next, i + 1, delta
 

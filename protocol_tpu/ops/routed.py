@@ -229,6 +229,63 @@ def _bucketize_blocked(n, key, other, weight, min_width=8):
     )
 
 
+def save_operator_npz(op, path) -> None:
+    """Field-driven npz serialization shared by the routed operators.
+
+    Every dataclass field is stored under a named, type-tagged key
+    (``int_*`` scalar, ``tup_*`` int tuple, ``arr_*`` array,
+    ``lst_*_{i}`` list of arrays) — no positional meta vector to
+    mis-index. The write is atomic (tmp + rename) so an interrupted run
+    can never leave a truncated file under the final name."""
+    import dataclasses
+    import os
+
+    payload = {"fmt_version": np.asarray(2, dtype=np.int64)}
+    for f in dataclasses.fields(op):
+        v = getattr(op, f.name)
+        if isinstance(v, (int, np.integer)):
+            payload[f"int_{f.name}"] = np.asarray(v, dtype=np.int64)
+        elif isinstance(v, tuple):
+            payload[f"tup_{f.name}"] = np.asarray(v, dtype=np.int64)
+        elif isinstance(v, np.ndarray):
+            payload[f"arr_{f.name}"] = v
+        elif isinstance(v, list):
+            payload[f"cnt_{f.name}"] = np.asarray(len(v), dtype=np.int64)
+            for i, a in enumerate(v):
+                payload[f"lst_{f.name}_{i}"] = np.asarray(a)
+        else:  # pragma: no cover - new field types need a tag here
+            raise TypeError(f"unserializable field {f.name}: {type(v)}")
+    path = str(path)
+    tmp = f"{path}.tmp.{os.getpid()}"
+    try:
+        with open(tmp, "wb") as fh:  # file object: savez cannot append
+            np.savez(fh, **payload)  # its own .npz suffix to the tmp name
+        os.replace(tmp, path)
+    finally:
+        if os.path.exists(tmp):
+            os.unlink(tmp)
+
+
+def load_operator_npz(cls, z):
+    """Inverse of :func:`save_operator_npz` for an open npz handle."""
+    import dataclasses
+
+    kwargs = {}
+    for f in dataclasses.fields(cls):
+        if f"int_{f.name}" in z:
+            kwargs[f.name] = int(z[f"int_{f.name}"])
+        elif f"tup_{f.name}" in z:
+            kwargs[f.name] = tuple(int(x) for x in z[f"tup_{f.name}"])
+        elif f"arr_{f.name}" in z:
+            kwargs[f.name] = z[f"arr_{f.name}"]
+        elif f"cnt_{f.name}" in z:
+            kwargs[f.name] = [z[f"lst_{f.name}_{i}"]
+                              for i in range(int(z[f"cnt_{f.name}"]))]
+        else:
+            raise ValueError(f"operator file is missing field {f.name}")
+    return cls(**kwargs)
+
+
 @dataclass
 class RoutedOperator:
     """Host-side routed operator: blocked layouts, masks, route plans."""
@@ -265,33 +322,19 @@ class RoutedOperator:
         return _scores_for_nodes(self.state_to_node, self.n, state_scores)
 
     def save(self, path) -> None:
-        """Persist the compiled operator (uncompressed .npz) so the
-        one-time routing-plan compilation is reusable across runs."""
-        payload = {
-            "meta": np.asarray(
-                [self.n, self.n_valid, self.nnz, self.n_src_pos,
-                 self.edge_e, self.state_e, self.in_n_pos],
-                dtype=np.int64),
-            "out_widths": np.asarray(self.out_widths, dtype=np.int64),
-            "out_xs": np.asarray(self.out_xs, dtype=np.int64),
-            "in_widths": np.asarray(self.in_widths, dtype=np.int64),
-            "in_xs": np.asarray(self.in_xs, dtype=np.int64),
-            "edge_bits": np.asarray(self.edge_bits, dtype=np.int64),
-            "state_bits": np.asarray(self.state_bits, dtype=np.int64),
-            "edge_stages": np.stack(self.edge_stages),
-            "state_stages": np.stack(self.state_stages),
-            "state_to_node": self.state_to_node.astype(np.int64),
-            "valid": self.valid,
-            "dangling": self.dangling,
-        }
-        for i, w in enumerate(self.out_weight):
-            payload[f"out_weight_{i}"] = w  # keep float64: the f64
-            # converge path must round-trip losslessly
-        np.savez(path, **payload)
+        """Persist the compiled operator (uncompressed .npz, atomic) so
+        the one-time routing-plan compilation is reusable across runs.
+        Weights stay float64: the f64 converge path must round-trip
+        losslessly."""
+        save_operator_npz(self, path)
 
     @classmethod
     def load(cls, path) -> "RoutedOperator":
         with np.load(path) as z:
+            if "fmt_version" in z:
+                return load_operator_npz(cls, z)
+            # legacy v1 format (positional meta vector), kept readable so
+            # pre-existing operator caches stay valid
             meta = z["meta"]
             out_widths = tuple(int(w) for w in z["out_widths"])
             return cls(

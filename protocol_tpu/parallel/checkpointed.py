@@ -100,14 +100,32 @@ def sharded_converge_checkpointed(
         # length does not mean same meaning). tol may legitimately
         # change — it only affects the stopping predicate of a
         # memoryless iteration.
-        for key, current in (("n", meta.n), ("n_valid", meta.n_valid),
-                             ("alpha", float(alpha)),
-                             ("engine", engine)):
+        fingerprint = [("n", meta.n), ("n_valid", meta.n_valid),
+                       ("alpha", float(alpha)), ("engine", engine)]
+        if engine == "routed":
+            # the routed state vector is a device-major permutation of the
+            # node scores: its LAYOUT depends on the shard count and state
+            # exponent even when the length 2^state_e happens to match
+            # (state_need*D is ~constant in D), so a resume under a
+            # different D would silently continue from a scrambled vector
+            fingerprint += [("num_shards", sop.num_shards),
+                            ("state_e", sop.state_e)]
+        for key, current in fingerprint:
             recorded = ck_meta.get(key)
             if key == "engine" and recorded is None:
                 # checkpoints written before the engine key existed were
                 # always gather (node-order scores)
                 recorded = "gather"
+            if recorded is None and key in ("num_shards", "state_e"):
+                # a routed checkpoint without a layout fingerprint (written
+                # before these keys existed) cannot prove its device-major
+                # order matches this run — refuse rather than risk resuming
+                # a scrambled vector
+                raise ValueError(
+                    f"routed checkpoint records no {key}; cannot verify its "
+                    f"score layout matches this run — delete the checkpoint "
+                    f"directory to restart"
+                )
             if recorded is not None and recorded != current:
                 raise ValueError(
                     f"checkpoint was written with {key}={recorded}, "
@@ -136,7 +154,10 @@ def sharded_converge_checkpointed(
                 meta={"delta": delta, "tol": tol, "alpha": float(alpha),
                       "n": meta.n, "n_pad": state_len,
                       "n_valid": meta.n_valid, "engine": engine,
-                      "converged": delta <= tol},
+                      "converged": delta <= tol,
+                      **({"num_shards": sop.num_shards,
+                          "state_e": sop.state_e}
+                         if engine == "routed" else {})},
             )
             if iters < chunk:
                 break  # stopping predicate fired inside the chunk

@@ -134,6 +134,27 @@ class ShardedRoutedOperator:
     def scores_for_nodes(self, state_scores: np.ndarray) -> np.ndarray:
         return _scores_for_nodes(self.state_to_node, self.n, state_scores)
 
+    def save(self, path) -> None:
+        """Persist the compiled device-major operator (uncompressed .npz,
+        atomic) so the one-time routing-plan compilation is reusable
+        across runs. The layout is D-specific: ``load`` refuses a
+        different shard count rather than silently permuting scores."""
+        from ..ops.routed import save_operator_npz
+
+        save_operator_npz(self, path)
+
+    @classmethod
+    def load(cls, path, num_shards=None) -> "ShardedRoutedOperator":
+        from ..ops.routed import load_operator_npz
+
+        with np.load(path) as z:
+            op = load_operator_npz(cls, z)
+        if num_shards is not None and op.num_shards != num_shards:
+            raise ValueError(
+                f"cached operator was compiled for "
+                f"num_shards={op.num_shards}, requested {num_shards}")
+        return op
+
     def device_arrays(self, dtype=jnp.float32, alpha: float = 0.0,
                       pretrust=None) -> dict:
         """Stacked pytree with leading shard axis, for shard_map."""

@@ -191,6 +191,58 @@ def test_routed_operator_save_load_roundtrip(tmp_path):
     assert rop2.nnz == rop.nnz and rop2.n_valid == rop.n_valid
 
 
+def test_routed_operator_legacy_v1_format_still_loads(tmp_path):
+    """Operator caches written by the round-1 positional-meta format must
+    keep loading (the 10M bench cache is expensive to rebuild)."""
+    n, m = 600, 3
+    src, dst, val = barabasi_albert_edges(n, m, seed=13)
+    rop = build_routed_operator(n, src, dst, val)
+    path = tmp_path / "op_v1.npz"
+    payload = {
+        "meta": np.asarray(
+            [rop.n, rop.n_valid, rop.nnz, rop.n_src_pos,
+             rop.edge_e, rop.state_e, rop.in_n_pos], dtype=np.int64),
+        "out_widths": np.asarray(rop.out_widths, dtype=np.int64),
+        "out_xs": np.asarray(rop.out_xs, dtype=np.int64),
+        "in_widths": np.asarray(rop.in_widths, dtype=np.int64),
+        "in_xs": np.asarray(rop.in_xs, dtype=np.int64),
+        "edge_bits": np.asarray(rop.edge_bits, dtype=np.int64),
+        "state_bits": np.asarray(rop.state_bits, dtype=np.int64),
+        "edge_stages": np.stack(rop.edge_stages),
+        "state_stages": np.stack(rop.state_stages),
+        "state_to_node": rop.state_to_node.astype(np.int64),
+        "valid": rop.valid,
+        "dangling": rop.dangling,
+    }
+    for i, w in enumerate(rop.out_weight):
+        payload[f"out_weight_{i}"] = w
+    np.savez(path, **payload)
+
+    from protocol_tpu.ops.routed import RoutedOperator
+
+    rop2 = RoutedOperator.load(path)
+    assert rop2.nnz == rop.nnz and rop2.state_e == rop.state_e
+    np.testing.assert_array_equal(rop2.state_to_node, rop.state_to_node)
+    for a, b in zip(rop2.out_weight, rop.out_weight):
+        np.testing.assert_array_equal(a, b)
+
+
+def test_sharded_routed_operator_save_load_roundtrip(tmp_path):
+    from protocol_tpu.parallel.routed import ShardedRoutedOperator
+    from protocol_tpu.parallel import build_sharded_routed_operator
+
+    n, m = 600, 3
+    src, dst, val = barabasi_albert_edges(n, m, seed=13)
+    sop = build_sharded_routed_operator(n, src, dst, val, num_shards=4)
+    path = tmp_path / "sop.npz"
+    sop.save(path)
+    sop2 = ShardedRoutedOperator.load(path, num_shards=4)
+    assert sop2.num_shards == 4 and sop2.nnz == sop.nnz
+    np.testing.assert_array_equal(sop2.state_to_node, sop.state_to_node)
+    with pytest.raises(ValueError, match="num_shards"):
+        ShardedRoutedOperator.load(path, num_shards=2)
+
+
 def test_routed_backend_seam_matches_rational_oracle():
     from protocol_tpu.backend import JaxRoutedBackend, NativeRationalBackend
 
