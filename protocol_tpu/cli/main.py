@@ -454,6 +454,22 @@ def handle_sparse_scores(args, files, config):
         cache_dir.mkdir(parents=True, exist_ok=True)
         return cache_dir / f"{kind}_{h.hexdigest()[:24]}.npz"
 
+    def _cached_operator(cache_path, load_fn, build_fn):
+        """Load the compiled operator from the cache, else build and
+        cache it. A corrupt/stale entry must never brick the run —
+        warn, rebuild, overwrite."""
+        if cache_path is not None and cache_path.exists():
+            try:
+                with trace.span("cli.operator_load", path=str(cache_path)):
+                    return load_fn(cache_path)
+            except Exception as e:
+                print(f"warning: ignoring unreadable operator cache "
+                      f"{cache_path}: {e}", file=sys.stderr)
+        op = build_fn()
+        if cache_path is not None:
+            op.save(cache_path)
+        return op
+
     if args.checkpoint_dir:
         import jax
         import jax.numpy as jnp
@@ -485,24 +501,11 @@ def handle_sparse_scores(args, files, config):
         if engine == "routed":
             from ..parallel.routed import ShardedRoutedOperator
 
-            cache_path = _operator_cache_path("sharded_routed", n_dev)
-            sop = None
-            if cache_path is not None and cache_path.exists():
-                try:
-                    with trace.span("cli.operator_load",
-                                    path=str(cache_path)):
-                        sop = ShardedRoutedOperator.load(cache_path,
-                                                         num_shards=n_dev)
-                except Exception as e:
-                    # a corrupt/stale cache entry must never brick the
-                    # run — rebuild and overwrite it
-                    print(f"warning: ignoring unreadable operator cache "
-                          f"{cache_path}: {e}", file=sys.stderr)
-            if sop is None:
-                sop = build_sharded_routed_operator(args.n, src, dst, val,
-                                                    num_shards=n_dev)
-                if cache_path is not None:
-                    sop.save(cache_path)
+            sop = _cached_operator(
+                _operator_cache_path("sharded_routed", n_dev),
+                lambda p: ShardedRoutedOperator.load(p, num_shards=n_dev),
+                lambda: build_sharded_routed_operator(args.n, src, dst, val,
+                                                      num_shards=n_dev))
             s0 = jnp.asarray(sop.initial_scores(
                 args.initial_score, dtype=np.float32))
         else:
@@ -543,19 +546,10 @@ def handle_sparse_scores(args, files, config):
 
             cache_path = _operator_cache_path("routed", 1)
             if cache_path is not None:
-                if cache_path.exists():
-                    try:
-                        with trace.span("cli.operator_load",
-                                        path=str(cache_path)):
-                            extra["operator"] = RoutedOperator.load(
-                                cache_path)
-                    except Exception as e:
-                        print(f"warning: ignoring unreadable operator "
-                              f"cache {cache_path}: {e}", file=sys.stderr)
-                if "operator" not in extra:
-                    extra["operator"] = build_routed_operator(
-                        args.n, src, dst, val, valid)
-                    extra["operator"].save(cache_path)
+                extra["operator"] = _cached_operator(
+                    cache_path, RoutedOperator.load,
+                    lambda: build_routed_operator(args.n, src, dst, val,
+                                                  valid))
         with trace.span("cli.sparse_scores", mode="single", n=args.n,
                         engine=engine):
             scores, iters, delta = backend.converge_edges(

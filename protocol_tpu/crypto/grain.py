@@ -8,11 +8,14 @@ specification (GKRRS'19, https://eprint.iacr.org/2019/458 — the
 auditable, and no constant tables to maintain. The MDS matrix is a Cauchy
 matrix built from subsequent Grain stream elements.
 
-Hashes are therefore self-consistent across this framework (native oracle,
-TPU batched ops, and the zk circuit layer all share these constants) but are
-not bit-identical to the reference's table-driven instance — the reference
-is unrunnable here (no Rust toolchain) so cross-fixture parity is not a
-testable property; self-consistency is (SURVEY.md §4).
+For the instances the reference ships tables for, the table-driven params
+in ``crypto/tables/`` are authoritative (see ``poseidon.poseidon_params``);
+Grain remains the generator for every other instance. Notably the Grain
+output here reproduces the reference's width-5 Poseidon table bit-for-bit
+(round constants AND Cauchy MDS — two independent implementations
+agreeing; tested in ``tests/test_reference_params.py``), while the
+reference's 10x5 MDS and Rescue-Prime constants come from different
+procedures and genuinely need the tables.
 """
 
 from __future__ import annotations

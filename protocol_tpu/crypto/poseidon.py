@@ -5,8 +5,10 @@ Semantics mirror the reference's Hades implementation
 partial rounds, half full rounds; round constants added to *every* lane in
 every round — the un-optimized schedule of ``params/hasher/mod.rs``) and its
 sponge (``poseidon/native/sponge.rs``: rate = WIDTH additive absorb, squeeze
-returns ``state[0]``). Constants come from ``grain.py`` rather than the
-reference's literal tables.
+returns ``state[0]``). Constants for the reference's shipped instances
+(BN254 Fr width 5/10) come from its vendored tables
+(``crypto/tables/``, bit-parity verified against the reference's golden
+permutation vectors); other instances are Grain-generated (``grain.py``).
 
 Internals run on raw Python ints mod p for speed; the public API accepts and
 returns ``FieldElement``s.
@@ -26,13 +28,40 @@ DEFAULT_FULL_ROUNDS = 8
 DEFAULT_PARTIAL_ROUNDS = 60
 
 
+def _table_params(width: int, modulus: int, full_rounds: int,
+                  partial_rounds: int):
+    """The reference's literal constant tables (vendored by
+    tools/gen_hasher_tables.py) for the instances it ships: BN254 Fr at
+    width 5 and 10. Using these makes every hash in this framework
+    bit-identical to reference-produced data — an attestation signed
+    under the reference's Poseidon validates here and vice versa."""
+    if modulus != Fr.MODULUS:
+        return None
+    if (width, full_rounds, partial_rounds) == (5, 8, 60):
+        from .tables import poseidon_bn254_5x5 as t
+    elif (width, full_rounds, partial_rounds) == (10, 8, 60):
+        from .tables import poseidon_bn254_10x5 as t
+    else:
+        return None
+    return tuple(t.ROUND_CONSTANTS), t.MDS
+
+
 def poseidon_params(width: int = DEFAULT_WIDTH, modulus: int = Fr.MODULUS,
                     full_rounds: int = DEFAULT_FULL_ROUNDS,
                     partial_rounds: int | None = None):
-    """(round_constants, mds, full_rounds, partial_rounds) for an instance."""
+    """(round_constants, mds, full_rounds, partial_rounds) for an instance.
+
+    Instances the reference ships constants for (BN254 Fr, width 5/10)
+    use its vendored tables — bit-parity with reference hashes; any
+    other instance falls back to Grain-LFSR generation (grain.py)."""
     if partial_rounds is None:
         partial_rounds = DEFAULT_PARTIAL_ROUNDS if width == 5 else 60
-    rc, mds = generate_poseidon_params(modulus, width, full_rounds, partial_rounds)
+    table = _table_params(width, modulus, full_rounds, partial_rounds)
+    if table is not None:
+        rc, mds = table
+    else:
+        rc, mds = generate_poseidon_params(modulus, width, full_rounds,
+                                           partial_rounds)
     return rc, mds, full_rounds, partial_rounds
 
 

@@ -6,11 +6,14 @@ sbox (``params/hasher/rescue_prime_bn254_5x5.rs:8-36``). Each round is
 sbox → MDS → add-consts(i) → sbox⁻¹ → MDS → add-consts(i+1), run for
 ``full_rounds - 1`` iterations (``rescue_prime/native/mod.rs:28-56``).
 
-Round constants and the MDS matrix are Grain-generated (see
-``grain.py`` module docstring for why this framework generates rather
-than ships tables). The sponge mirrors the reference's: buffered absorb,
-``state += chunk; permute`` per WIDTH-chunk, squeeze returns state[0]
-(``rescue_prime/native/sponge.rs:46-64``).
+The BN254 width-5 instance uses the reference's literal constant tables
+(vendored by ``tools/gen_hasher_tables.py`` from
+``params/hasher/rescue_prime_bn254_5x5.rs``) for bit-parity — verified
+against the matter-labs/rescue-poseidon golden vector the reference's
+own test uses (``rescue_prime/native/mod.rs:93-100``). Other instances
+are Grain-generated (``grain.py``). The sponge mirrors the reference's:
+buffered absorb, ``state += chunk; permute`` per WIDTH-chunk, squeeze
+returns state[0] (``rescue_prime/native/sponge.rs:46-64``).
 """
 
 from __future__ import annotations
@@ -28,7 +31,12 @@ FULL_ROUNDS = 8
 @lru_cache(maxsize=None)
 def rescue_prime_params(width: int = DEFAULT_WIDTH, modulus: int = Fr.MODULUS):
     """(round_constants, mds, inv_exponent) for a Rescue-Prime instance."""
-    rc, mds = generate_poseidon_params(modulus, width, FULL_ROUNDS, 0)
+    if width == 5 and modulus == Fr.MODULUS:
+        from .tables import rescue_prime_bn254_5x5 as t
+
+        rc, mds = tuple(t.ROUND_CONSTANTS), t.MDS
+    else:
+        rc, mds = generate_poseidon_params(modulus, width, FULL_ROUNDS, 0)
     inv5 = pow(5, -1, modulus - 1)
     return rc, mds, inv5
 

@@ -1,0 +1,1 @@
+"""Vendored reference hasher constant tables (see tools/gen_hasher_tables.py)."""
