@@ -12,6 +12,7 @@
 // Build: g++ -O3 -shared -fPIC -o libprotocol_native.so protocol_native.cpp
 // (driven by protocol_tpu/native/__init__.py, which caches the .so).
 
+#include <algorithm>
 #include <cstdint>
 #include <cstring>
 #include <vector>
@@ -263,6 +264,50 @@ void fr_poly_divide_linear(const u64 *mod_limbs, const u64 *coeffs, long n,
 // in-place radix-2 DIT NTT over the subgroup generated by omega (standard
 // form in/out). dir=0 forward, dir=1 inverse (uses omega^-1 and scales by
 // n^-1).
+// radix-2 NTT on a Montgomery-form array in place (internal helper).
+// ``tw_ready`` marks the twiddle table as already built for this
+// (omega, n) — the four-step path reuses one table across all rows of a
+// stage instead of rebuilding it per row.
+static void ntt_core(Fp *a, long n, const Fp &omega, const FieldCtx &f,
+                     std::vector<Fp> &tw, bool tw_ready = false) {
+    // bit reversal
+    for (long i = 1, j = 0; i < n; ++i) {
+        long bit = n >> 1;
+        for (; j & bit; bit >>= 1) j ^= bit;
+        j ^= bit;
+        if (i < j) std::swap(a[i], a[j]);
+    }
+    if (!tw_ready) {
+        if ((long)tw.size() < n / 2) tw.resize(n / 2 > 0 ? n / 2 : 1);
+        tw[0] = f.one;
+        for (long j = 1; j < n / 2; ++j) mont_mul(tw[j], tw[j - 1], omega, f);
+    }
+    for (long len = 2; len <= n; len <<= 1) {
+        long stride = n / len;
+        for (long i = 0; i < n; i += len) {
+            for (long j = 0; j < len / 2; ++j) {
+                Fp u = a[i + j];
+                Fp v;
+                mont_mul(v, a[i + j + len / 2], tw[j * stride], f);
+                add_mod(a[i + j], u, v, f);
+                sub_mod(a[i + j + len / 2], u, v, f);
+            }
+        }
+    }
+}
+
+// blocked out-of-place transpose of an A x B Fp matrix
+static void fp_transpose(const Fp *src, Fp *dst, long rows, long cols) {
+    const long BLK = 32;
+    for (long i0 = 0; i0 < rows; i0 += BLK)
+        for (long j0 = 0; j0 < cols; j0 += BLK) {
+            long i1 = std::min(i0 + BLK, rows), j1 = std::min(j0 + BLK, cols);
+            for (long i = i0; i < i1; ++i)
+                for (long j = j0; j < j1; ++j)
+                    dst[j * rows + i] = src[i * cols + j];
+        }
+}
+
 void ntt(const u64 *mod_limbs, u64 *data, long n, const u64 *omega_limbs,
          int dir) {
     FieldCtx f = make_ctx(mod_limbs);
@@ -278,30 +323,50 @@ void ntt(const u64 *mod_limbs, u64 *data, long n, const u64 *omega_limbs,
         std::memcpy(x.v, data + 4 * i, 32);
         to_mont(a[i], x, f);
     }
-    // bit reversal
-    for (long i = 1, j = 0; i < n; ++i) {
-        long bit = n >> 1;
-        for (; j & bit; bit >>= 1) j ^= bit;
-        j ^= bit;
-        if (i < j) std::swap(a[i], a[j]);
-    }
-    // twiddle table: tw[j] = omega^j for j < n/2; level `len` uses
-    // stride n/len — one multiply per butterfly instead of two
-    std::vector<Fp> tw(n / 2 > 0 ? n / 2 : 1);
-    tw[0] = f.one;
-    for (long j = 1; j < n / 2; ++j) mont_mul(tw[j], tw[j - 1], omega, f);
-    for (long len = 2; len <= n; len <<= 1) {
-        long stride = n / len;
-        for (long i = 0; i < n; i += len) {
-            for (long j = 0; j < len / 2; ++j) {
-                Fp u = a[i + j];
-                Fp v;
-                mont_mul(v, a[i + j + len / 2], tw[j * stride], f);
-                add_mod(a[i + j], u, v, f);
-                sub_mod(a[i + j + len / 2], u, v, f);
+
+    std::vector<Fp> tw;
+    if (n <= (1 << 14)) {
+        ntt_core(a.data(), n, omega, f, tw);
+    } else {
+        // cache-blocked four-step: n = A·B, x[j1·B + j2];
+        //   X[k1 + k2·A] = Σ_{j2} ω^{A j2 k2} · ( ω^{j2 k1} ·
+        //                  Σ_{j1} ω^{B j1 k1} x[j1·B + j2] )
+        // inner/outer NTTs are length-A/B rows that fit in cache, the
+        // cross-stage twiddle is one running-product multiply per
+        // element, and data movement is three blocked transposes.
+        int lg = 0;
+        while ((1L << lg) < n) ++lg;
+        long A = 1L << (lg / 2), B = n / A;
+        Fp omega_A, omega_B;
+        u64 expB[1] = {(u64)B}, expA[1] = {(u64)A};
+        mont_pow(omega_A, omega, expB, 1, f);  // ω^B (order A)
+        mont_pow(omega_B, omega, expA, 1, f);  // ω^A (order B)
+        std::vector<Fp> t(n);
+        // transpose to (B rows of A): t[j2][j1]
+        fp_transpose(a.data(), t.data(), A, B);
+        // inner A-point NTTs along rows of t, then the cross twiddle:
+        // t[j2][k1] *= ω^{j2·k1} via a per-row running power of ω^{j2}
+        std::vector<Fp> wrow(B);
+        wrow[0] = f.one;
+        for (long j2 = 1; j2 < B; ++j2) mont_mul(wrow[j2], wrow[j2 - 1], omega, f);
+        for (long j2 = 0; j2 < B; ++j2) {
+            Fp *row = &t[j2 * A];
+            ntt_core(row, A, omega_A, f, tw, j2 > 0);
+            Fp w = wrow[j2], pw = w;
+            for (long k1 = 1; k1 < A; ++k1) {
+                mont_mul(row[k1], row[k1], pw, f);
+                mont_mul(pw, pw, w, f);
             }
         }
+        // transpose to (A rows of B): u[k1][j2], outer B-point NTTs
+        fp_transpose(t.data(), a.data(), B, A);
+        for (long k1 = 0; k1 < A; ++k1)
+            ntt_core(&a[k1 * B], B, omega_B, f, tw, k1 > 0);
+        // a[k1][k2] holds X[k1 + k2·A]; natural order = transpose
+        fp_transpose(a.data(), t.data(), A, B);
+        a.swap(t);
     }
+
     if (dir) {
         // scale by n^{-1}
         Fp n_fp = {{(u64)n, 0, 0, 0}};
@@ -469,59 +534,286 @@ static void jac_add(JacPoint &r, const JacPoint &p_in, const JacPoint &q_in,
     mont_mul(r.z, t, h, f);              // Z3 = ((Z1+Z2)^2 - Z1Z1 - Z2Z2) H
 }
 
+// --- Pippenger MSM (signed digits + batch-affine accumulation) -------------
+
+struct AffPt {
+    Fp x, y;  // Montgomery form; MSM tracks infinity out-of-band
+};
+
+static inline void neg_mod(Fp &out, const Fp &a, const FieldCtx &f) {
+    if (is_zero_fp(a)) { out = a; return; }
+    sub_nored(out, f.mod, a);
+}
+
+// mixed addition r = p(Jac) + q(affine, finite): madd-2007-bl, 7M + 4S
+static void jac_add_mixed(JacPoint &r, const JacPoint &p_in, const AffPt &q,
+                          const FieldCtx &f) {
+    JacPoint p = p_in;
+    if (is_zero_fp(p.z)) { r.x = q.x; r.y = q.y; r.z = f.one; return; }
+    Fp z1z1, u2, s2, h, hh, i, j, rr, v, t;
+    mont_sqr(z1z1, p.z, f);
+    mont_mul(u2, q.x, z1z1, f);
+    mont_mul(s2, q.y, p.z, f);
+    mont_mul(s2, s2, z1z1, f);
+    sub_mod(h, u2, p.x, f);
+    sub_mod(rr, s2, p.y, f);
+    if (is_zero_fp(h)) {
+        if (is_zero_fp(rr)) {
+            JacPoint qj;
+            qj.x = q.x; qj.y = q.y; qj.z = f.one;
+            jac_double(r, qj, f);
+            return;
+        }
+        r.z = Fp{{0, 0, 0, 0}};
+        return;
+    }
+    mont_sqr(hh, h, f);                  // HH = H^2
+    add_mod(i, hh, hh, f);
+    add_mod(i, i, i, f);                 // I = 4*HH
+    mont_mul(j, h, i, f);                // J = H*I
+    add_mod(rr, rr, rr, f);              // r = 2*(S2-Y1)
+    mont_mul(v, p.x, i, f);              // V = X1*I
+    mont_sqr(r.x, rr, f);
+    sub_mod(r.x, r.x, j, f);
+    sub_mod(r.x, r.x, v, f);
+    sub_mod(r.x, r.x, v, f);             // X3 = r^2 - J - 2V
+    sub_mod(t, v, r.x, f);
+    mont_mul(t, t, rr, f);
+    Fp y1j;
+    mont_mul(y1j, p.y, j, f);
+    add_mod(y1j, y1j, y1j, f);
+    sub_mod(r.y, t, y1j, f);             // Y3 = r*(V-X3) - 2*Y1*J
+    add_mod(t, p.z, h, f);
+    mont_sqr(t, t, f);
+    sub_mod(t, t, z1z1, f);
+    sub_mod(r.z, t, hh, f);              // Z3 = (Z1+H)^2 - Z1Z1 - HH
+}
+
+// r += k·p for a small positive k (the sparse bucket-reduction skip)
+static void jac_add_small_mul(JacPoint &r, const JacPoint &p, u64 k,
+                              const FieldCtx &f) {
+    if (!k || is_zero_fp(p.z)) return;
+    JacPoint acc;
+    acc.z = Fp{{0, 0, 0, 0}};
+    int top = 63 - __builtin_clzll(k);
+    for (int bit = top; bit >= 0; --bit) {
+        jac_double(acc, acc, f);
+        if ((k >> bit) & 1) jac_add(acc, acc, p, f);
+    }
+    jac_add(r, r, acc, f);
+}
+
 // Pippenger MSM: bases affine standard-form (x,y) pairs (8 limbs each,
 // zero-zero = identity), scalars standard-form 4-limb. Result affine
 // standard form written to out (8 limbs; zeros for identity).
+//
+// Signed-digit windows (buckets halved) with batch-affine bucket
+// accumulation: per window, points are counting-sorted by |digit| and
+// each bucket's segment is summed level-by-level as independent affine
+// additions sharing ONE batched inversion per level (~6M per add vs 16M
+// for Jacobian-Jacobian). Windows with no nonzero digit are skipped
+// outright, which makes small-scalar MSMs (0/1 selector columns) cost a
+// single window pass.
 void g1_msm(const u64 *mod_limbs, const u64 *bases, const u64 *scalars,
             long n, u64 *out) {
     FieldCtx f = make_ctx(mod_limbs);
     int c = 4;
     if (n > 32) c = 8;
     if (n > 1024) c = 12;
-    if (n > 262144) c = 16;
-    int windows = (256 + c - 1) / c;
+    if (n > 131072) c = 16;
+    const long half = 1L << (c - 1);
+    const int windows = (256 + c - 1) / c + 1;  // +1 for the signed carry
 
-    std::vector<JacPoint> pts(n);
-    std::vector<bool> infinite(n);
+    std::vector<AffPt> pts(n);
+    std::vector<unsigned char> finite(n);
+    long n_finite = 0;
     for (long i = 0; i < n; ++i) {
         Fp x, y;
         std::memcpy(x.v, bases + 8 * i, 32);
         std::memcpy(y.v, bases + 8 * i + 4, 32);
         bool inf = is_zero_fp(x) && is_zero_fp(y);
-        infinite[i] = inf;
+        finite[i] = !inf;
         if (!inf) {
             to_mont(pts[i].x, x, f);
             to_mont(pts[i].y, y, f);
-            pts[i].z = f.one;
+            ++n_finite;
         }
     }
 
+    // signed-digit recode: scalar = Σ d_w·2^{cw}, d_w ∈ [-2^{c-1}, 2^{c-1}]
+    std::vector<int32_t> digits((size_t)windows * n, 0);
+    for (long i = 0; i < n; ++i) {
+        if (!finite[i]) continue;
+        u64 carry = 0;
+        for (int w = 0; w < windows; ++w) {
+            long bit0 = (long)w * c;
+            u64 raw = 0;
+            if (bit0 < 256) {
+                int word = (int)(bit0 / 64), off = (int)(bit0 % 64);
+                raw = scalars[4 * i + word] >> off;
+                if (off && word + 1 < 4)
+                    raw |= scalars[4 * i + word + 1] << (64 - off);
+                raw &= ((u64)1 << c) - 1;
+            }
+            raw += carry;
+            if (raw > (u64)half) {
+                digits[(size_t)w * n + i] = (int32_t)raw - (int32_t)(1L << c);
+                carry = 1;
+            } else {
+                digits[(size_t)w * n + i] = (int32_t)raw;
+                carry = 0;
+            }
+        }
+    }
+
+    // per-level scratch (ping-pong): x, y, bucket id
+    std::vector<Fp> ax(n_finite), ay(n_finite), nx(n_finite), ny(n_finite);
+    std::vector<int32_t> abid(n_finite), nbid(n_finite);
+    std::vector<long> counts(half + 1);
+    std::vector<Fp> dens, prefix;
+    dens.reserve(n_finite / 2 + 1);
+    prefix.reserve(n_finite / 2 + 1);
+
     JacPoint total;
     total.z = Fp{{0, 0, 0, 0}};
-    std::vector<JacPoint> buckets((size_t)1 << c);
     for (int w = windows - 1; w >= 0; --w) {
-        for (int d = 0; d < c; ++d) jac_double(total, total, f);
-        for (auto &b : buckets) b.z = Fp{{0, 0, 0, 0}};
-        long bit0 = (long)w * c;
-        for (long i = 0; i < n; ++i) {
-            if (infinite[i]) continue;
-            // extract c bits starting at bit0 from scalar i
-            u64 idx = 0;
-            for (int bit = c - 1; bit >= 0; --bit) {
-                long pos = bit0 + bit;
-                if (pos >= 256) { idx <<= 1; continue; }
-                u64 word = scalars[4 * i + pos / 64];
-                idx = (idx << 1) | ((word >> (pos % 64)) & 1);
-            }
-            if (idx) jac_add(buckets[idx], buckets[idx], pts[i], f);
+        if (!is_zero_fp(total.z))
+            for (int d = 0; d < c; ++d) jac_double(total, total, f);
+        const int32_t *dw = &digits[(size_t)w * n];
+
+        // counting sort by |digit|, sign applied to y on placement
+        std::fill(counts.begin(), counts.end(), 0);
+        long m = 0;
+        for (long i = 0; i < n; ++i)
+            if (dw[i]) { ++counts[dw[i] < 0 ? -dw[i] : dw[i]]; ++m; }
+        if (!m) continue;
+        long acc_off = 0;
+        for (long b = 1; b <= half; ++b) {
+            long cnt = counts[b];
+            counts[b] = acc_off;
+            acc_off += cnt;
         }
+        for (long i = 0; i < n; ++i) {
+            int32_t d = dw[i];
+            if (!d) continue;
+            long b = d < 0 ? -d : d;
+            long pos = counts[b]++;
+            ax[pos] = pts[i].x;
+            if (d > 0) ay[pos] = pts[i].y;
+            else neg_mod(ay[pos], pts[i].y, f);
+            abid[pos] = (int32_t)b;
+        }
+
+        // level-by-level batch-affine segment sums. Each level pairs
+        // adjacent same-bucket entries; all pair additions share one
+        // batched inversion (Montgomery trick).
+        std::vector<unsigned char> role(n_finite);  // 0=solo 1=pair-first
+        while (true) {
+            // fix the pairing once (greedy adjacent within segments) so
+            // both passes below agree for odd-length segments
+            long pairs = 0;
+            for (long i = 0; i < m;) {
+                if (i + 1 < m && abid[i + 1] == abid[i]) {
+                    role[i] = 1;
+                    role[i + 1] = 2;
+                    ++pairs;
+                    i += 2;
+                } else {
+                    role[i] = 0;
+                    ++i;
+                }
+            }
+            if (!pairs) break;
+            dens.clear();
+            prefix.clear();
+            // pass 1: denominators + running product
+            Fp run = f.one;
+            std::vector<unsigned char> kind; // 0=add 1=double 2=infinity
+            kind.reserve(pairs);
+            for (long i = 0; i < m; ++i) {
+                if (role[i] != 1) continue;
+                Fp d;
+                sub_mod(d, ax[i + 1], ax[i], f);
+                if (is_zero_fp(d)) {
+                    Fp sy;
+                    add_mod(sy, ay[i], ay[i + 1], f);
+                    if (is_zero_fp(sy)) { kind.push_back(2); d = f.one; }
+                    else { kind.push_back(1); add_mod(d, ay[i], ay[i], f); }
+                } else kind.push_back(0);
+                dens.push_back(d);
+                prefix.push_back(run);
+                mont_mul(run, run, d, f);
+            }
+            Fp inv;
+            mont_inv(inv, run, f);
+            // count outputs: infinity pairs drop out
+            long n_out = m - pairs;
+            for (long pi = 0; pi < pairs; ++pi)
+                if (kind[pi] == 2) --n_out;
+            // pass 2 (backward): per-pair inverse, then the affine add
+            long write = n_out;
+            long pi = pairs - 1;
+            for (long i = m - 1; i >= 0; --i) {
+                if (role[i] == 2) continue;  // handled with its pair head
+                if (role[i] == 1) {
+                    Fp dinv;
+                    mont_mul(dinv, inv, prefix[pi], f);
+                    mont_mul(inv, inv, dens[pi], f);
+                    if (kind[pi] != 2) {
+                        long a = i, b = i + 1;
+                        Fp lam, num, x3, y3;
+                        if (kind[pi] == 1) {
+                            mont_sqr(num, ax[a], f);
+                            Fp n3;
+                            add_mod(n3, num, num, f);
+                            add_mod(num, n3, num, f);  // 3x^2
+                        } else {
+                            sub_mod(num, ay[b], ay[a], f);
+                        }
+                        mont_mul(lam, num, dinv, f);
+                        mont_sqr(x3, lam, f);
+                        sub_mod(x3, x3, ax[a], f);
+                        sub_mod(x3, x3, ax[b], f);
+                        sub_mod(y3, ax[a], x3, f);
+                        mont_mul(y3, y3, lam, f);
+                        sub_mod(y3, y3, ay[a], f);
+                        --write;
+                        nx[write] = x3;
+                        ny[write] = y3;
+                        nbid[write] = abid[i];
+                    }
+                    --pi;
+                } else {
+                    --write;
+                    nx[write] = ax[i];
+                    ny[write] = ay[i];
+                    nbid[write] = abid[i];
+                }
+            }
+            m = n_out;
+            ax.swap(nx);
+            ay.swap(ny);
+            abid.swap(nbid);
+        }
+
+        // bucket reduction: one affine point per surviving bucket id,
+        // ascending. Walk descending with the running/sum scan; empty
+        // gaps advance `sum` by gap·running via a small double-and-add.
         JacPoint running, sum;
         running.z = Fp{{0, 0, 0, 0}};
         sum.z = Fp{{0, 0, 0, 0}};
-        for (long b = ((long)1 << c) - 1; b >= 1; --b) {
-            jac_add(running, running, buckets[b], f);
+        long prev_b = half + 1;
+        for (long i = m - 1; i >= 0; --i) {
+            long b = abid[i];
+            jac_add_small_mul(sum, running, (u64)(prev_b - b - 1), f);
+            AffPt q;
+            q.x = ax[i];
+            q.y = ay[i];
+            jac_add_mixed(running, running, q, f);
             jac_add(sum, sum, running, f);
+            prev_b = b;
         }
+        jac_add_small_mul(sum, running, (u64)(prev_b - 1), f);
         jac_add(total, total, sum, f);
     }
 
@@ -530,16 +822,16 @@ void g1_msm(const u64 *mod_limbs, const u64 *bases, const u64 *scalars,
         std::memset(out, 0, 64);
         return;
     }
-    Fp zinv, zinv2, zinv3, ax, ay;
+    Fp zinv, zinv2, zinv3, axx, ayy;
     mont_inv(zinv, total.z, f);
     mont_sqr(zinv2, zinv, f);
     mont_mul(zinv3, zinv2, zinv, f);
-    mont_mul(ax, total.x, zinv2, f);
-    mont_mul(ay, total.y, zinv3, f);
-    from_mont(ax, ax, f);
-    from_mont(ay, ay, f);
-    std::memcpy(out, ax.v, 32);
-    std::memcpy(out + 4, ay.v, 32);
+    mont_mul(axx, total.x, zinv2, f);
+    mont_mul(ayy, total.y, zinv3, f);
+    from_mont(axx, axx, f);
+    from_mont(ayy, ayy, f);
+    std::memcpy(out, axx.v, 32);
+    std::memcpy(out + 4, ayy.v, 32);
 }
 
 // Many scalar multiples of ONE fixed affine base: out[i] = scalars[i]·B.

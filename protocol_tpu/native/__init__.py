@@ -32,10 +32,17 @@ _build_failed = False
 
 def _build() -> bool:
     _BUILD_DIR.mkdir(exist_ok=True)
-    cmd = ["g++", "-O3", "-shared", "-fPIC", "-std=c++17",
+    cmd = ["g++", "-O3", "-march=native", "-shared", "-fPIC", "-std=c++17",
            "-o", str(_LIB_PATH), str(_SRC)]
     try:
-        subprocess.run(cmd, check=True, capture_output=True, timeout=300)
+        try:
+            subprocess.run(cmd, check=True, capture_output=True, timeout=300)
+        except subprocess.CalledProcessError:
+            # toolchains without -march=native support (or aliased
+            # compilers): retry portable rather than silently losing the
+            # entire native layer
+            cmd = [a for a in cmd if a != "-march=native"]
+            subprocess.run(cmd, check=True, capture_output=True, timeout=300)
         return True
     except (subprocess.CalledProcessError, FileNotFoundError,
             subprocess.TimeoutExpired):

@@ -1,0 +1,362 @@
+"""Limb-plane BN254 field engine — the TPU prover pipeline's arithmetic.
+
+A second-generation device field engine next to ``fieldops.py``, built
+for the prover's polynomial pipeline (``ops/ntt_tpu.py``,
+``zk/prover_tpu.py``) where arrays are millions of elements:
+
+- **Layout**: n elements are stored as ``(L, n)`` int32 — L=22 little-
+  endian 12-bit limbs on the *sublane* axis. XLA pads the minor two dims
+  to (8, 128) tiles, so the fieldops.py ``(n, L)`` layout burns 5.8× HBM
+  and VPU lanes (22 → 128); limb-plane pads only 22 → 24.
+- **Montgomery domain throughout**: device arrays hold x̃ = x·R mod p
+  (R = 2^264). ``mont_mul(x̃, ỹ) = (xy)~`` closes over the domain; host
+  conversion happens in numpy at the wire boundary (`pack`/`unpack`).
+- **Relaxed form**: limbs < 2^13, value < 2p. ``mont_mul`` accepts and
+  produces relaxed rows (CIOS with a 2-pass carry ripple, no trailing
+  conditional subtract) — exactness is by-value mod p, tested against
+  Python ints.
+- **MXU interface**: ``to_mxu_planes``/``reduce_mxu_planes`` convert to
+  and from 6-bit int8 planes for exact f32/int8 systolic matmuls (a
+  6-bit × 6-bit product summed over ≤ 2^12 terms stays below 2^24 —
+  exact in f32 — and below 2^31 across ≤ 44 plane-combines in int32).
+
+Reference anchor: this replaces the scalar Rust field arithmetic the
+reference's halo2 prover runs on the CPU (``utils.rs:206-228``); the
+layout choices are TPU-tiling-driven, not a translation.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..utils.fields import BN254_FR_MODULUS
+
+B = 12
+L = 22
+MASK = (1 << B) - 1
+B6 = 6
+L6 = 2 * L  # 44 six-bit planes
+MASK6 = (1 << B6) - 1
+
+P = BN254_FR_MODULUS
+# Montgomery radix: L+1 reduction steps (one more than the limb count),
+# so CIOS output values land below p + 2^237 — the top limb plane is
+# then provably tiny and the partial carry ripple cannot lose a carry
+# past plane L−1 (see mont_mul).
+R_EXP = B * (L + 1)                  # 276
+R_MONT = pow(2, R_EXP, P)            # R mod p
+R2_MONT = R_MONT * R_MONT % P        # R^2 mod p
+P_INV_NEG = (-pow(P, -1, 1 << B)) % (1 << B)
+
+_P_LIMBS = tuple((P >> (B * i)) & MASK for i in range(L))
+
+
+def _const_planes(v: int, n: int | None = None) -> jnp.ndarray:
+    """(L, 1) or (L, n) int32 limb planes of a Python int (< 2^264)."""
+    limbs = [(v >> (B * i)) & MASK for i in range(L)]
+    arr = jnp.asarray(limbs, dtype=jnp.int32).reshape(L, 1)
+    if n is not None:
+        arr = jnp.broadcast_to(arr, (L, n))
+    return arr
+
+
+P_PLANES = None  # initialized lazily inside jit via _const_planes(P)
+
+
+# --- host <-> device packing (numpy, vectorized) ---------------------------
+
+def pack_u64(arr_u64: np.ndarray, to_mont: bool = False) -> np.ndarray:
+    """(n, 4) little-endian u64 standard-form array → (L, n) int32 limb
+    planes. ``to_mont`` is handled on device (`enter_mont`), not here."""
+    n = arr_u64.shape[0]
+    a = np.ascontiguousarray(arr_u64).view(np.uint64).reshape(n, 4)
+    out = np.empty((L, n), dtype=np.int32)
+    # limb i covers bits [12i, 12i+12): source word + shift
+    for i in range(L):
+        bit = B * i
+        w, off = bit // 64, bit % 64
+        lo = a[:, w] >> np.uint64(off)
+        if off > 52 and w + 1 < 4:
+            lo = lo | (a[:, w + 1] << np.uint64(64 - off))
+        out[i] = (lo & np.uint64(MASK)).astype(np.int32)
+    return out
+
+
+def unpack_u64(planes: np.ndarray) -> np.ndarray:
+    """(L, n) canonical int32 planes → (n, 4) little-endian u64 array."""
+    planes = np.asarray(planes)
+    n = planes.shape[1]
+    out = np.zeros((n, 4), dtype=np.uint64)
+    for i in range(L):
+        bit = B * i
+        w, off = bit // 64, bit % 64
+        v = planes[i].astype(np.uint64)
+        out[:, w] |= (v << np.uint64(off)) & np.uint64(0xFFFFFFFFFFFFFFFF)
+        if off > 52 and w + 1 < 4:
+            out[:, w + 1] |= v >> np.uint64(64 - off)
+    return out.view("<u8")
+
+
+# --- carries ----------------------------------------------------------------
+
+def ripple(t: jnp.ndarray, passes: int = 2) -> jnp.ndarray:
+    """Partial carry propagation on (K, n) planes: each pass divides the
+    excess by 2^B. Two passes take CIOS output (< 2^18 per limb) to
+    relaxed (< 2^13). The TOP plane is never masked — it accumulates
+    incoming carries instead of silently dropping its own carry-out, so
+    the represented value is always preserved exactly (values within
+    ~2^13·2^{B(K−1)} of the top stay representable)."""
+    for _ in range(passes):
+        carry = t[:-1] >> B
+        low = t[:-1] & MASK
+        t = jnp.concatenate([low, t[-1:]], axis=0) + jnp.concatenate(
+            [jnp.zeros((1,) + t.shape[1:], jnp.int32), carry], axis=0)
+    return t
+
+
+def canon_limbs(x: jnp.ndarray) -> jnp.ndarray:
+    """Full carry propagation to limbs < 2^B (value untouched, may still
+    be in [0, 2p))."""
+    return ripple(x, passes=3)
+
+
+# --- core multiply ----------------------------------------------------------
+
+def mont_mul(x: jnp.ndarray, y: jnp.ndarray) -> jnp.ndarray:
+    """(L, n) relaxed × (L, n) relaxed → (L, n) relaxed: x·y·R⁻¹ mod p
+    by value. CIOS over limb planes with L+1 reduction steps: the output
+    value is < p + 2^237, so the top limb plane is ≤ 2^3 pre-ripple (all
+    lazy limbs are non-negative, so t[L−1] ≤ value/2^252) and the 2-pass
+    ripple cannot push a carry off the truncated top. All intermediates
+    stay below 2^31 for limbs < 2^13."""
+    n = x.shape[1]
+    p_planes = _const_planes(P, None)  # (L, 1), broadcasts over lanes
+    t = jnp.zeros((L + 2, n), dtype=jnp.int32)
+
+    def reduce_step(t):
+        u = ((t[0] & MASK) * P_INV_NEG) & MASK
+        t = t.at[:L].add(u[None, :] * p_planes)
+        carry0 = t[0] >> B
+        t = jnp.concatenate([t[1:], jnp.zeros((1, n), jnp.int32)], axis=0)
+        t = t.at[0].add(carry0)
+        return t
+
+    def step(i, t):
+        xi = lax.dynamic_slice_in_dim(x, i, 1, axis=0)  # (1, n)
+        t = t.at[:L].add(xi * y)
+        return reduce_step(t)
+
+    t = lax.fori_loop(0, L, step, t)
+    t = reduce_step(t)  # the extra division by 2^B (R = 2^{B(L+1)})
+    return ripple(t[:L].astype(jnp.int32), passes=2)
+
+
+def mont_mul_const(x: jnp.ndarray, c: int) -> jnp.ndarray:
+    """x̃ · c̃ with a host-int constant already in the Montgomery domain
+    (c = value·R mod p passed as plain int)."""
+    return mont_mul(x, _const_planes(c, x.shape[1]))
+
+
+def add(x: jnp.ndarray, y: jnp.ndarray) -> jnp.ndarray:
+    """Addition: one ripple pass keeps limbs < 2^13. VALUES accumulate
+    (no modular reduction) — fine for the butterfly/gate patterns where
+    sums feed a ``mont_mul`` (CIOS is exact for values < 2^262) and are
+    bounded by ≤ ~30p; not for unbounded accumulation."""
+    return ripple(x + y, passes=1)
+
+
+def sub(x: jnp.ndarray, y: jnp.ndarray) -> jnp.ndarray:
+    """x − y + 2p. CONTRACT: y's value must be < 2p (a fresh ``mont_mul``
+    output or canonical input — exactly the NTT butterfly / gate-term
+    shape); x is unconstrained. The result is then non-negative and
+    value-correct mod p."""
+    two_p = _const_planes(2 * P, None)
+    return ripple(x + two_p - y, passes=2)
+
+
+def neg(x: jnp.ndarray) -> jnp.ndarray:
+    """2p − x for x with value < 2p (same contract as ``sub``)."""
+    two_p = _const_planes(2 * P, None)
+    return ripple(two_p - x, passes=2)
+
+
+def enter_mont(x_plain: jnp.ndarray) -> jnp.ndarray:
+    """Plain (L, n) → Montgomery domain (multiply by R²)."""
+    return mont_mul(x_plain, _const_planes(R2_MONT, x_plain.shape[1]))
+
+
+def exit_mont(x_mont: jnp.ndarray) -> jnp.ndarray:
+    """Montgomery (L, n) → plain (multiply by 1)."""
+    one = jnp.zeros_like(x_mont).at[0].set(1)
+    return mont_mul(x_mont, one)
+
+
+def canonical(x: jnp.ndarray) -> jnp.ndarray:
+    """Relaxed → canonical (< p): full carries + one conditional
+    subtract of p."""
+    x = canon_limbs(x)
+    p_planes = _const_planes(P, None)
+    p_bcast = jnp.broadcast_to(p_planes, x.shape)
+    # lexicographic x >= p, top limb down
+    gt = jnp.zeros(x.shape[1:], dtype=jnp.bool_)
+    eq = jnp.ones(x.shape[1:], dtype=jnp.bool_)
+    for i in range(L - 1, -1, -1):
+        gt = gt | (eq & (x[i] > p_bcast[i]))
+        eq = eq & (x[i] == p_bcast[i])
+    geq = gt | eq
+    x = x - jnp.where(geq[None], p_bcast, 0)
+    return ripple(x, passes=L)
+
+
+# --- batched inverse (Fermat) ----------------------------------------------
+
+def mont_pow_const(x: jnp.ndarray, e: int) -> jnp.ndarray:
+    """x̃^e (static exponent), Montgomery domain."""
+    nbits = e.bit_length()
+    bits = jnp.asarray([(e >> i) & 1 for i in range(nbits)], dtype=jnp.int32)
+    one_m = _const_planes(R_MONT, x.shape[1])
+
+    def step(i, state):
+        acc, base = state
+        hit = mont_mul(acc, base)
+        acc = jnp.where(bits[i] == 1, hit, acc)
+        base = mont_mul(base, base)
+        return acc, base
+
+    acc, _ = lax.fori_loop(0, nbits, step, (one_m, x))
+    return acc
+
+
+def inv(x: jnp.ndarray) -> jnp.ndarray:
+    """Batched x̃⁻¹ (0 → 0) via Fermat."""
+    return mont_pow_const(x, P - 2)
+
+
+# --- MXU plane interface ----------------------------------------------------
+
+def to_mxu_planes(x: jnp.ndarray) -> jnp.ndarray:
+    """(L, n) relaxed → (L6, n) int8 canonical 6-bit planes."""
+    x = canon_limbs(x)
+    lo = (x & MASK6).astype(jnp.int8)
+    hi = (x >> B6).astype(jnp.int8)
+    return jnp.stack([lo, hi], axis=1).reshape(L6, *x.shape[1:])
+
+
+def reduce_mxu_planes(planes: jnp.ndarray) -> jnp.ndarray:
+    """(K, …) int32 lazy base-2^6 planes (each < 2^31) → (L, …) relaxed
+    12-bit planes, value-exact mod p.
+
+    Carry-propagates base-64 planes, regroups into 12-bit limbs, then
+    folds everything above limb L−1 with hi·R ≡ hi·R²·R⁻¹ (one CIOS)."""
+    K = planes.shape[0]
+    t = planes
+    # base-64 carries: excess shrinks 64× per pass; 2^31 → <2^6+1 in 5
+    ext = 5  # room for carries walking past the top plane
+    t = jnp.concatenate(
+        [t, jnp.zeros((ext,) + t.shape[1:], jnp.int32)], axis=0)
+    for _ in range(6):
+        carry = t >> B6
+        t = (t & MASK6) + jnp.concatenate(
+            [jnp.zeros((1,) + t.shape[1:], jnp.int32), carry[:-1]], axis=0)
+    K2 = t.shape[0]
+    if K2 % 2:
+        t = jnp.concatenate(
+            [t, jnp.zeros((1,) + t.shape[1:], jnp.int32)], axis=0)
+        K2 += 1
+    # regroup pairs of 6-bit planes into 12-bit limbs
+    t12 = t.reshape(K2 // 2, 2, *t.shape[1:])
+    t12 = t12[:, 0] + (t12[:, 1] << B6)
+    # fold chunks of L limbs: value = Σ_c 2^{264·c}·chunk_c; each chunk
+    # above the first folds via mont_mul with Cc = 2^{264·c}·R (so the
+    # R⁻¹ cancels and the product is the plain shifted value)
+    n12 = t12.shape[0]
+    acc = None
+    for c in range(0, (n12 + L - 1) // L):
+        chunk = t12[c * L : (c + 1) * L]
+        if chunk.shape[0] < L:
+            chunk = jnp.concatenate(
+                [chunk,
+                 jnp.zeros((L - chunk.shape[0],) + chunk.shape[1:],
+                           jnp.int32)], axis=0)
+        if c == 0:
+            acc = chunk
+            continue
+        cc = pow(2, 264 * c, P) * R_MONT % P
+        flat = chunk.reshape(L, -1)
+        folded = mont_mul(flat, _const_planes(cc, flat.shape[1]))
+        acc = ripple(acc + folded.reshape((L,) + chunk.shape[1:]), passes=2)
+    return acc
+
+
+# --- compact 16-bit storage (device-resident ext arrays) -------------------
+
+def _resolve_carries_16(t16: jnp.ndarray) -> jnp.ndarray:
+    """Exact base-2^16 carry resolution via while_loop (terminates in
+    ≤ planes iterations; typically 2-3)."""
+    def cond(t):
+        return jnp.any(t > 0xFFFF)
+
+    def body(t):
+        carry = t[:-1] >> 16
+        low = t[:-1] & 0xFFFF
+        return jnp.concatenate([low, t[-1:]], axis=0) + jnp.concatenate(
+            [jnp.zeros((1,) + t.shape[1:], jnp.int32), carry], axis=0)
+
+    return lax.while_loop(cond, body, t16)
+
+
+def pack16(x: jnp.ndarray) -> jnp.ndarray:
+    """(L, n) relaxed → (16, n) uint16 planes of the value (< 2^256
+    required — any relaxed value qualifies). Each 12-bit limb is
+    assigned wholly to the 16-bit window containing its base bit, then
+    base-2^16 carries are resolved exactly. Halves the HBM footprint of
+    resident arrays."""
+    x = canon_limbs(x)
+    outs = [jnp.zeros(x.shape[1:], dtype=jnp.int32) for _ in range(16)]
+    for a in range(L):
+        bit = B * a
+        t, s = bit // 16, bit % 16
+        outs[t] = outs[t] + (x[a] << s)
+    t16 = _resolve_carries_16(jnp.stack(outs, axis=0))
+    return t16.astype(jnp.uint16)
+
+
+def unpack16(x16: jnp.ndarray) -> jnp.ndarray:
+    """(16, n) uint16 → (L, n) int32 canonical 12-bit limbs."""
+    w = x16.astype(jnp.int32)
+    outs = []
+    for i in range(L):
+        bit = B * i
+        t, s = bit // 16, bit % 16
+        v = w[t] >> s
+        if s > 4 and t + 1 < 16:
+            v = v | (w[t + 1] << (16 - s))
+        outs.append(v & MASK)
+    return jnp.stack(outs, axis=0)
+
+
+# --- host-side reference (tests) -------------------------------------------
+
+def planes_to_ints(planes) -> list:
+    """(L, n) planes (any laziness) → Python ints (not reduced mod p)."""
+    planes = np.asarray(planes)
+    out = []
+    for j in range(planes.shape[1]):
+        out.append(sum(int(planes[i, j]) << (B * i)
+                       for i in range(planes.shape[0])))
+    return out
+
+
+def ints_to_planes(vals) -> np.ndarray:
+    out = np.zeros((L, len(vals)), dtype=np.int32)
+    for j, v in enumerate(vals):
+        v = int(v)
+        for i in range(L):
+            out[i, j] = (v >> (B * i)) & MASK
+    return out

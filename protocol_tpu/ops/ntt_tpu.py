@@ -1,0 +1,215 @@
+"""Device-resident NTT over BN254 Fr — four-step matmul formulation.
+
+The number-theoretic transforms dominating PLONK proving (SURVEY/VERDICT
+round 1: 14 forward 8n-coset NTTs + the 8n inverse per proof) run here
+as MXU matmuls instead of host butterflies:
+
+    X[k1 + k2·A] = Σ_{j2} ω^{A·j2·k2} · ( ω^{j2·k1} ·
+                   Σ_{j1} ω^{B·j1·k1} · x[j1·B + j2] ),   N = A·B
+
+Both inner sums are length-≤2048 NTTs applied to every row/column at
+once — (A×A)@(A×B) field matmuls. A field matmul decomposes into 6-bit
+limb planes multiplied as *exact f32* MXU matmuls (6+6+11 ≤ 24 mantissa
+bits; the f32 path measures ~32 TFLOPs on v5e vs ~18 TOPs for int8
+through XLA) and re-assembled by ``fieldops2.reduce_mxu_planes``. Data
+stays in the Montgomery domain; the W matrices are plain-valued, so a
+stage matmul maps Montgomery inputs to Montgomery outputs with no extra
+R factors.
+
+The 8n extension domain is handled as 8 independent size-n coset NTTs
+(shift·ω₈ⁿ-cosets) plus a cross-chunk radix-8 combine for the inverse —
+every plan stays n-sized, so the same machinery scales from k=14 tests
+to the k=22 flagship without 8192-wide W matrices.
+
+Forward output (and inverse input) use the "FS layout": element
+X[k1 + k2·A] lives at flat position k1·B + k2. Pointwise consumers (the
+quotient kernel) never notice; ``intt`` inverts the layout back to
+natural coefficient order.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..utils.fields import BN254_FR_MODULUS as P
+from . import fieldops2 as f2
+
+L, L6 = f2.L, f2.L6
+
+
+def _root_of_unity(k: int) -> int:
+    """Primitive 2^k-th root of unity in Fr (matches zk/domain.py)."""
+    # 5 generates the full multiplicative group quotient; 2-adicity 28
+    g = pow(5, (P - 1) >> 28, P)
+    return pow(g, 1 << (28 - k), P)
+
+
+def _mont(v: int) -> int:
+    return v * f2.R_MONT % P
+
+
+class NttPlan:
+    """Per-k device tables: stage matrices as 6-bit int8 planes and the
+    cross twiddle as packed uint16 Montgomery planes. ~0.3 GB at k=20.
+    Build happens on device (uploading only A+B generator scalars)."""
+
+    _cache: dict = {}
+
+    def __init__(self, k: int):
+        self.k = k
+        self.n = 1 << k
+        a = (k + 1) // 2
+        self.A, self.B = 1 << a, 1 << (k - a)
+        omega = _root_of_unity(k)
+        self.omega = omega
+        w_a = pow(omega, self.B, P)   # order A
+        w_b = pow(omega, self.A, P)   # order B
+        self.W_A = self._build_w(w_a, self.A)
+        self.W_B = self._build_w(w_b, self.B)
+        # the stage matrices invert by row-flip (their roots have order
+        # = size), but the cross twiddle's root ω has order N, so the
+        # inverse needs its own table built from ω⁻¹
+        self.T16 = self._build_t(omega)
+        self.T16_inv = self._build_t(pow(omega, -1, P))
+        self.n_inv_mont = _mont(pow(self.n, -1, P))
+
+    @classmethod
+    def get(cls, k: int) -> "NttPlan":
+        plan = cls._cache.get(k)
+        if plan is None:
+            plan = cls._cache[k] = cls(k)
+        return plan
+
+    @staticmethod
+    def _pow_table_scan(gen_mont: jnp.ndarray, cols: int) -> jnp.ndarray:
+        """rows of powers: out[:, c] = gen^c (Montgomery), via a scan.
+        gen_mont: (L, rows). Returns (cols, L, rows) int32."""
+        rows = gen_mont.shape[1]
+        one = f2._const_planes(f2.R_MONT, rows)
+
+        def step(carry, _):
+            nxt = f2.mont_mul(carry, gen_mont)
+            return nxt, carry
+
+        _, ys = lax.scan(step, one, None, length=cols)
+        return ys
+
+    def _build_w(self, w_root: int, size: int) -> jnp.ndarray:
+        """(L6, size, size) int8 plain planes of W[r, c] = w_root^{r·c}."""
+        gens = [pow(w_root, r, P) for r in range(size)]
+        gen_mont = jnp.asarray(
+            f2.ints_to_planes([_mont(g) for g in gens]))
+
+        @jax.jit
+        def build(gen_mont):
+            cols = self._pow_table_scan(gen_mont, size)  # (c, L, r) Mont
+            flat = jnp.moveaxis(cols, 0, 2).reshape(L, size * size)
+            plain = f2.exit_mont(flat)
+            return f2.to_mxu_planes(plain).reshape(L6, size, size)
+
+        return build(gen_mont)
+
+    def _build_t(self, omega: int) -> jnp.ndarray:
+        """(16, A, B) uint16 packed Montgomery planes of the cross
+        twiddle T[k1, j2] = ω^{k1·j2}."""
+        gens = [pow(omega, k1, P) for k1 in range(self.A)]
+        gen_mont = jnp.asarray(
+            f2.ints_to_planes([_mont(g) for g in gens]))
+
+        @jax.jit
+        def build(gen_mont):
+            cols = self._pow_table_scan(gen_mont, self.B)  # (j2, L, k1)
+            flat = jnp.moveaxis(cols, 0, 2).reshape(L, self.A * self.B)
+            return f2.pack16(flat).reshape(16, self.A, self.B)
+
+        return build(gen_mont)
+
+
+def _plane_matmul_left(w_planes: jnp.ndarray, x6: jnp.ndarray) -> jnp.ndarray:
+    """Σ_j W[i, j]·X[j, c] over 6-bit planes: w_planes (L6, A, A) int8,
+    x6 (L6, A, C) int8 → (L, A, C) Montgomery relaxed planes."""
+    n_out = 2 * L6 - 1
+    A = x6.shape[1]
+    C = x6.shape[2]
+    xf = x6.astype(jnp.float32).transpose(1, 0, 2).reshape(A, L6 * C)
+    out = jnp.zeros((n_out, A, C), dtype=jnp.int32)
+    for m in range(L6):
+        wf = w_planes[m].astype(jnp.float32)
+        cm = jax.lax.dot_general(
+            wf, xf, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        cm = cm.astype(jnp.int32).reshape(A, L6, C).transpose(1, 0, 2)
+        out = out.at[m : m + L6].add(cm)
+    return f2.reduce_mxu_planes(out.reshape(n_out, A * C)).reshape(L, A, C)
+
+
+def _plane_matmul_right(x6: jnp.ndarray, w_planes: jnp.ndarray) -> jnp.ndarray:
+    """Σ_j X[r, j]·W[i, j] over planes: x6 (L6, A, B) int8, w_planes
+    (L6, B, B) int8 (indexed W[out, in]) → (L, A, B) Montgomery
+    relaxed."""
+    n_out = 2 * L6 - 1
+    _, A, Bd = x6.shape
+    xf = x6.astype(jnp.float32).reshape(L6 * A, Bd)
+    out = jnp.zeros((n_out, A, Bd), dtype=jnp.int32)
+    for m in range(L6):
+        wf = w_planes[m].astype(jnp.float32)  # (out, in)
+        cm = jax.lax.dot_general(
+            xf, wf, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        cm = cm.astype(jnp.int32).reshape(L6, A, Bd)
+        out = out.at[m : m + L6].add(cm)
+    return f2.reduce_mxu_planes(out.reshape(n_out, A * Bd)).reshape(
+        L, A, Bd)
+
+
+def _flip_rows(planes: jnp.ndarray) -> jnp.ndarray:
+    """index map r → (size − r) mod size on axis 1: turns W into W⁻¹
+    (ω^{-rc} = ω^{(size−r)c}) without storing a second table."""
+    head = planes[:, :1]
+    tail = planes[:, 1:][:, ::-1]
+    return jnp.concatenate([head, tail], axis=1)
+
+
+@jax.jit
+def _ntt_impl(x, w_a, w_b, t16):
+    A = w_a.shape[1]
+    B = w_b.shape[1]
+    x6 = f2.to_mxu_planes(x).reshape(L6, A, B)
+    y = _plane_matmul_left(w_a, x6)                  # (L, A, B) [k1, j2]
+    tw = f2.unpack16(t16.reshape(16, A * B)).reshape(L, A, B)
+    y = f2.mont_mul(y.reshape(L, A * B), tw.reshape(L, A * B))
+    y6 = f2.to_mxu_planes(y).reshape(L6, A, B)
+    z = _plane_matmul_right(y6, w_b)                 # (L, A, B) [k1, k2]
+    return z.reshape(L, A * B)
+
+
+@jax.jit
+def _intt_impl(z, w_a, w_b, t16_inv, n_inv_planes):
+    A = w_a.shape[1]
+    B = w_b.shape[1]
+    z6 = f2.to_mxu_planes(z).reshape(L6, A, B)
+    y = _plane_matmul_right(z6, _flip_rows(w_b))     # (L, A, B) [k1, j2]
+    t_inv = f2.unpack16(t16_inv.reshape(16, A * B)).reshape(L, A, B)
+    y = f2.mont_mul(y.reshape(L, A * B), t_inv.reshape(L, A * B))
+    y6 = f2.to_mxu_planes(y).reshape(L6, A, B)
+    out = _plane_matmul_left(_flip_rows(w_a), y6)    # (L, j1, j2)
+    out = out.reshape(L, A * B)
+    return f2.mont_mul(out, n_inv_planes)
+
+
+def ntt(x: jnp.ndarray, plan: NttPlan) -> jnp.ndarray:
+    """Forward NTT: (L, n) Montgomery planes, natural order → FS layout
+    (element X[k1 + k2·A] at flat k1·B + k2)."""
+    return _ntt_impl(x, plan.W_A, plan.W_B, plan.T16)
+
+
+def intt(z: jnp.ndarray, plan: NttPlan) -> jnp.ndarray:
+    """Inverse NTT: FS layout → natural coefficient order (scaled n⁻¹)."""
+    n_inv = f2._const_planes(plan.n_inv_mont, 1)
+    return _intt_impl(z, plan.W_A, plan.W_B, plan.T16_inv, n_inv)

@@ -85,12 +85,12 @@ def _load_params(params: bytes):
 
 
 def _load_pk(pk: bytes):
-    """Format-sniffing load: FPK1 limb-array keys (native kernels) or
+    """Format-sniffing load: FPK1/FPK2 limb-array keys (native kernels) or
     the pure-Python ProvingKey JSON — each proves via its own path in
     ``_prove``."""
     from .prover_fast import FastProvingKey
 
-    if pk[:4] == b"FPK1":
+    if pk[:4] in (b"FPK1", b"FPK2"):
         return FastProvingKey.from_bytes(pk)
     from .plonk import ProvingKey
 

@@ -46,6 +46,11 @@ class KZGParams:
     k: int
     g1_powers: list  # [τⁱ·G1] for i in 0..n_max
     s_g2: tuple  # τ·G2
+    # optional Lagrange-basis form [L_i(τ)·G1] over the 2^k domain,
+    # emitted by the fast setup (which knows τ before discarding it, the
+    # same way real trusted setups publish both bases). Enables
+    # committing straight from evaluations — no iNTT before the MSM.
+    g1_lagrange: list | None = None
 
     @classmethod
     def setup(cls, k: int, extra: int = 8, seed: bytes | None = None) -> "KZGParams":
@@ -79,6 +84,13 @@ class KZGParams:
         for pt in self.g1_powers:
             out.append(g1_to_bytes(pt))
         out.append(g2_to_bytes(self.s_g2))
+        if self.g1_lagrange is not None:
+            # optional trailing section — old readers that check exact
+            # length must be tolerant (verifier_from_bytes is)
+            out.append(b"LAG1")
+            out.append(len(self.g1_lagrange).to_bytes(4, "little"))
+            for pt in self.g1_lagrange:
+                out.append(g1_to_bytes(pt))
         return b"".join(out)
 
     @classmethod
@@ -91,7 +103,16 @@ class KZGParams:
             powers.append(g1_from_bytes(data[off : off + 64]))
             off += 64
         s_g2 = g2_from_bytes(data[off : off + 128])
-        return cls(k, powers, s_g2)
+        off += 128
+        lagrange = None
+        if data[off : off + 4] == b"LAG1":
+            lcount = int.from_bytes(data[off + 4 : off + 8], "little")
+            off += 8
+            lagrange = []
+            for _ in range(lcount):
+                lagrange.append(g1_from_bytes(data[off : off + 64]))
+                off += 64
+        return cls(k, powers, s_g2, lagrange)
 
     @classmethod
     def verifier_from_bytes(cls, data: bytes) -> "KZGParams":
@@ -101,10 +122,13 @@ class KZGParams:
         params must not be used for committing."""
         k = int.from_bytes(data[0:4], "little")
         count = int.from_bytes(data[4:8], "little")
-        expected = 8 + 64 * count + 128
-        if len(data) != expected:
-            raise ValueError(f"bad params length {len(data)} != {expected}")
-        return cls(k, [], g2_from_bytes(data[-128:]))
+        g2_off = 8 + 64 * count
+        expected = g2_off + 128
+        if len(data) < expected:
+            raise ValueError(f"bad params length {len(data)} < {expected}")
+        if len(data) > expected and data[expected : expected + 4] != b"LAG1":
+            raise ValueError("bad params trailer")
+        return cls(k, [], g2_from_bytes(data[g2_off : g2_off + 128]))
 
 
 # --- point codecs ---------------------------------------------------------

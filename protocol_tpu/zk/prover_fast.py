@@ -72,7 +72,7 @@ def _parse_key_header(data: bytes) -> tuple:
     """(header_dict, payload_offset) for either serialized key format:
     FPK1 (limb arrays after a JSON header) or the pure-Python
     ProvingKey's bare JSON (payload_offset = None)."""
-    if data[:4] == b"FPK1":
+    if data[:4] in (b"FPK1", b"FPK2"):
         hlen = int.from_bytes(data[4:12], "little")
         return json.loads(data[12 : 12 + hlen].decode()), 12 + hlen
     try:
@@ -105,10 +105,86 @@ def commit_limbs(params: KZGParams, coeffs: np.ndarray):
     return native.g1_msm(Q, srs_limbs(params)[: len(coeffs)], coeffs)
 
 
+def lagrange_limbs(params: KZGParams) -> np.ndarray:
+    """(n, 8) limb view of the Lagrange-basis G1 points, cached."""
+    if params.g1_lagrange is None:
+        raise EigenError("proving_error",
+                         "params carry no Lagrange basis (regenerate with "
+                         "setup_params_fast)")
+    cached = getattr(params, "_lag_limbs", None)
+    if cached is None or len(cached) != len(params.g1_lagrange):
+        cached = native.points_to_limbs(params.g1_lagrange)
+        params._lag_limbs = cached
+    return cached
+
+
+def _msm_signed(bases: np.ndarray, scalars: np.ndarray):
+    """MSM with scalar-balancing: each scalar s is replaced by
+    min(s, R−s) with the base's y negated when R−s is the smaller —
+    a scalar like −1 (= R−1, full-width) then costs one window pass
+    instead of seventeen. Pays off whenever a column is ±small
+    (selector/coefficient columns); a wash on dense columns."""
+    n = len(scalars)
+    s = scalars.astype(np.uint64, copy=False)
+    R_limbs = np.frombuffer(int(R).to_bytes(32, "little"), dtype="<u8")
+    half_limbs = np.frombuffer(((R + 1) // 2).to_bytes(32, "little"),
+                               dtype="<u8")
+    # lexicographic s >= (R+1)/2, top limb first
+    ge = np.zeros(n, dtype=bool)
+    eq = np.ones(n, dtype=bool)
+    for j in (3, 2, 1, 0):
+        ge |= eq & (s[:, j] > half_limbs[j])
+        eq &= s[:, j] == half_limbs[j]
+    ge |= eq
+    if not ge.any():
+        return native.g1_msm(Q, bases, scalars)
+    # s' = R - s on the flipped rows (4-limb borrow subtract)
+    flipped = s.copy()
+    rows = np.nonzero(ge)[0]
+    borrow = np.zeros(len(rows), dtype=np.uint64)
+    for j in range(4):
+        sub = s[rows, j] + borrow
+        wrapped = sub < borrow  # s_j + borrow overflowed 2^64
+        diff = R_limbs[j] - sub  # uint64 wraps, which is the borrow case
+        new_borrow = ((R_limbs[j] < sub) | wrapped).astype(np.uint64)
+        flipped[rows, j] = diff
+        borrow = new_borrow
+    # negate base y for flipped rows: y' = Q - y (y == 0 stays 0)
+    b = bases.astype(np.uint64, copy=True)
+    Q_limbs = np.frombuffer(int(Q).to_bytes(32, "little"), dtype="<u8")
+    y = b[rows][:, 4:8]
+    nz = (y != 0).any(axis=1)
+    yr = rows[nz]
+    borrow = np.zeros(len(yr), dtype=np.uint64)
+    for j in range(4):
+        sub = b[yr, 4 + j] + borrow
+        wrapped = sub < borrow
+        diff = Q_limbs[j] - sub
+        new_borrow = ((Q_limbs[j] < sub) | wrapped).astype(np.uint64)
+        b[yr, 4 + j] = diff
+        borrow = new_borrow
+    return native.g1_msm(Q, np.ascontiguousarray(b),
+                         np.ascontiguousarray(flipped))
+
+
+def commit_evals_limbs(params: KZGParams, evals: np.ndarray):
+    """Commit a polynomial from its evaluations on the 2^k domain via the
+    Lagrange-basis SRS: commit(p) = Σ p(ωⁱ)·L_i(τ)·G — no iNTT. Equals
+    ``commit_limbs(params, intt(evals))`` exactly (tested)."""
+    n = 1 << params.k
+    if len(evals) != n:
+        raise EigenError("proving_error", "evals length must equal 2^k")
+    return _msm_signed(lagrange_limbs(params), evals)
+
+
 def setup_params_fast(k: int, extra: int = 8, seed: bytes | None = None
                       ) -> KZGParams:
     """``KZGParams.setup`` with the powers-of-τ G1 chain on the native
-    fixed-base kernel (identical output for identical seed)."""
+    fixed-base kernel (identical output for identical seed). Also emits
+    the Lagrange-basis G1 points L_i(τ)·G over the 2^k domain — the
+    setup is the one party that knows τ, exactly like real trusted
+    setups that publish both bases — enabling commits straight from
+    evaluations (``commit_evals_limbs``)."""
     n = (1 << k) + extra
     if seed is None:
         tau = secrets.randbelow(R - 1) + 1
@@ -119,13 +195,33 @@ def setup_params_fast(k: int, extra: int = 8, seed: bytes | None = None
         powers[i] = powers[i - 1] * tau % R
     from .bn254 import g2_mul, G2_GEN
 
+    def aff_list(pts_arr, count):
+        vals = native.limbs_to_ints(pts_arr.reshape(-1, 4))
+        out = []
+        for i in range(count):
+            x, y = vals[2 * i], vals[2 * i + 1]
+            out.append(None if x == 0 and y == 0 else (x, y))
+        return out
+
     pts = native.g1_fixed_base_muls(Q, G1_GEN, native.ints_to_limbs(powers))
-    vals = native.limbs_to_ints(pts.reshape(-1, 4))
-    g1_powers = []
-    for i in range(n):
-        x, y = vals[2 * i], vals[2 * i + 1]
-        g1_powers.append(None if x == 0 and y == 0 else (x, y))
-    return KZGParams(k, g1_powers, g2_mul(G2_GEN, tau))
+    g1_powers = aff_list(pts, n)
+
+    # Lagrange scalars L_i(τ) = ωⁱ·(τⁿ−1) / (n·(τ−ωⁱ)) over H = <ω>,
+    # n = 2^k; computed with the native field kernels then turned into
+    # points with the fixed-base ladder.
+    nn = 1 << k
+    d = EvaluationDomain(k)
+    fk = _kernel()
+    omegas = np.zeros((nn, 4), dtype="<u8")
+    omegas[:, 0] = 1
+    fk.coset_scale(omegas, d.omega)                    # ωⁱ
+    den = fk.scalar_mul(fk.scalar_sub(omegas, tau), (R - nn) % R)
+    fk.batch_inverse(den)                              # 1/(n(τ−ωⁱ))
+    zh_tau = (pow(tau, nn, R) - 1) % R
+    lag_scalars = fk.vec_mul(fk.scalar_mul(omegas, zh_tau), den)
+    lag_pts = native.g1_fixed_base_muls(Q, G1_GEN, lag_scalars)
+    g1_lagrange = aff_list(lag_pts, nn)
+    return KZGParams(k, g1_powers, g2_mul(G2_GEN, tau), g1_lagrange)
 
 
 # --- proving key -----------------------------------------------------------
@@ -136,13 +232,16 @@ class FastProvingKey:
     surface that ``succinct_verify``/``verify``/the aggregator touch."""
 
     k: int
-    fixed_limbs: np.ndarray  # (9, n, 4) coeff form, FIXED_NAMES order
-    sigma_limbs: np.ndarray  # (6, n, 4) coeff form
+    fixed_limbs: np.ndarray  # (9, n, 4), FIXED_NAMES order (see eval_form)
+    sigma_limbs: np.ndarray  # (6, n, 4)
     sigma_eval_limbs: np.ndarray  # (6, n, 4) row form
     shifts: list
     public_rows: list
     lookup_bits: int | None
     vk_commits: dict
+    # eval_form=True (FPK2): fixed_limbs/sigma_limbs hold EVALS on H, and
+    # sigma_eval_limbs aliases sigma_limbs; False (FPK1): coefficients.
+    eval_form: bool = False
 
     def domain(self) -> EvaluationDomain:
         return EvaluationDomain(self.k)
@@ -151,22 +250,43 @@ class FastProvingKey:
         return ([self.vk_commits[name] for name in FIXED_NAMES]
                 + [self.vk_commits[f"sigma_{w}"] for w in range(NUM_WIRES)])
 
+    def coeff_forms(self):
+        """(fixed_coeffs, sigma_coeffs) — identity for FPK1; for FPK2,
+        host-iNTTs of the evals, cached (the TPU prove path derives
+        these on device instead)."""
+        if not self.eval_form:
+            return self.fixed_limbs, self.sigma_limbs
+        cached = getattr(self, "_coeffs", None)
+        if cached is None:
+            fk = _kernel()
+            omega = self.domain().omega
+            fixed = self.fixed_limbs.copy()
+            for idx in range(len(FIXED_NAMES)):
+                fk.ntt(fixed[idx], omega, inverse=True)
+            sigma = self.sigma_limbs.copy()
+            for w in range(NUM_WIRES):
+                fk.ntt(sigma[w], omega, inverse=True)
+            cached = self._coeffs = (fixed, sigma)
+        return cached
+
     def to_bytes(self) -> bytes:
         header = json.dumps({
             "k": self.k,
             "shifts": self.shifts,
             "public_rows": self.public_rows,
             "lookup_bits": self.lookup_bits,
+            "eval_form": self.eval_form,
             "vk_commits": {name: g1_to_bytes(pt).hex()
                            for name, pt in self.vk_commits.items()},
         }).encode()
-        return (b"FPK1" + len(header).to_bytes(8, "little") + header
+        magic = b"FPK2" if self.eval_form else b"FPK1"
+        return (magic + len(header).to_bytes(8, "little") + header
                 + np.ascontiguousarray(self.fixed_limbs).tobytes()
                 + np.ascontiguousarray(self.sigma_limbs).tobytes())
 
     @classmethod
     def from_bytes(cls, data: bytes) -> "FastProvingKey":
-        if data[:4] != b"FPK1":
+        if data[:4] not in (b"FPK1", b"FPK2"):
             raise EigenError("proving_error", "bad proving key magic")
         p, off = _parse_key_header(data)
         n = 1 << p["k"]
@@ -175,6 +295,11 @@ class FastProvingKey:
         off += 9 * n * 4 * 8
         sigma = np.frombuffer(data, dtype="<u8", count=6 * n * 4,
                               offset=off).reshape(6, n, 4).copy()
+        if p.get("eval_form"):
+            # FPK2: arrays are evals; the row form IS sigma_limbs
+            return cls(p["k"], fixed, sigma, sigma, p["shifts"],
+                       p["public_rows"], p.get("lookup_bits"),
+                       _decode_vk_commits(p), eval_form=True)
         # sigma row form is derivable — recompute so the two copies can
         # never disagree in a key file (same rule as ProvingKey.to_bytes)
         fk = _kernel()
@@ -217,8 +342,15 @@ class VerifyingKey:
 
 
 def keygen_fast(params: KZGParams, cs: ConstraintSystem,
-                k: int | None = None) -> FastProvingKey:
-    """``plonk.keygen`` on native kernels; same key material."""
+                k: int | None = None,
+                eval_pk: bool = False) -> FastProvingKey:
+    """``plonk.keygen`` on native kernels; same key material.
+
+    ``eval_pk`` returns the key in evaluation form (FPK2): the fixed and
+    sigma columns stay as evals on H and keygen runs NO iNTTs — commits
+    come from the Lagrange basis (required) plus the σ = shift·SRS[1] +
+    swapped-cell-correction identity. The vk commitments are identical
+    to the coefficient-form key's."""
     rows = cs.num_rows
     if k is None:
         k = max(MIN_K, (max(rows, 1) - 1).bit_length())
@@ -234,18 +366,42 @@ def keygen_fast(params: KZGParams, cs: ConstraintSystem,
     d = EvaluationDomain(k)
     _table_values(cs.lookup_bits, n)  # validates table fits the domain
 
-    # fixed columns: scatter the sparse selector maps, then iNTT in place
+    # fixed columns: scatter the sparse selector maps; commit from the
+    # EVALS when the params carry a Lagrange basis (selector values are
+    # 0/1/small, so the signed-window MSM skips all high windows), then
+    # iNTT in place for the pk polys
+    use_lagrange = (params.g1_lagrange is not None
+                    and len(params.g1_lagrange) == n)
+    if eval_pk and not use_lagrange:
+        raise EigenError(
+            "proving_error",
+            "eval_pk keygen needs params with a matching Lagrange basis")
     fixed = np.zeros((len(FIXED_NAMES), n, 4), dtype="<u8")
     for idx, name in enumerate(SELECTORS):
         sel = cs.selectors[name]
         if sel:
             rows_idx = np.fromiter(sel.keys(), dtype=np.int64)
-            fixed[idx, rows_idx] = native.ints_to_limbs(list(sel.values()))
+            # selector columns hold few DISTINCT values (0/±1/small
+            # constants) — convert each distinct value once and gather,
+            # instead of millions of int→bytes conversions
+            vals = list(sel.values())
+            uniq = list(set(vals))
+            uniq_limbs = native.ints_to_limbs(uniq)
+            lut = {v: i for i, v in enumerate(uniq)}
+            sel_idx = np.fromiter((lut[v] for v in vals), dtype=np.int64,
+                                  count=len(vals))
+            fixed[idx, rows_idx] = uniq_limbs[sel_idx]
     table_size = 1 << cs.lookup_bits if cs.lookup_bits else 1
     fixed[len(SELECTORS), :table_size, 0] = np.arange(table_size,
                                                       dtype=np.uint64)
-    for idx in range(len(FIXED_NAMES)):
-        fk.ntt(fixed[idx], d.omega, inverse=True)
+    vk_commits = {}
+    if use_lagrange:
+        for idx, name in enumerate(FIXED_NAMES):
+            vk_commits[name] = commit_evals_limbs(params, fixed[idx])
+    fixed_evals = fixed
+    if not eval_pk:
+        for idx in range(len(FIXED_NAMES)):
+            fk.ntt(fixed[idx], d.omega, inverse=True)
 
     # permutation σ: baseline shifts[w]·ωʳ, then swap along copy cycles.
     # Union-find only over cells that appear in copies — every other cell
@@ -279,19 +435,60 @@ def keygen_fast(params: KZGParams, cs: ConstraintSystem,
             continue
         parent[ra] = rb
         nxt[a], nxt[b] = nxt[b], nxt[a]
+    # apply the cycle swaps with vectorized gathers: group the nxt map
+    # by (wire, target wire) — at most 36 numpy fancy assignments instead
+    # of a per-cell Python loop (millions of cells at k=20)
+    shifted = [sigma_evals[w].copy() for w in range(NUM_WIRES)]
+    groups: dict = {}
     for (w, r), (tw, tr) in nxt.items():
-        _set_int(sigma_evals[w], r,
-                 shifts[tw] * _get_int(omegas, tr) % R)
+        g = groups.get((w, tw))
+        if g is None:
+            g = groups[(w, tw)] = ([], [])
+        g[0].append(r)
+        g[1].append(tr)
+    swapped_rows: list = [[] for _ in range(NUM_WIRES)]
+    for (w, tw), (rs, trs) in groups.items():
+        rs_a = np.asarray(rs, dtype=np.int64)
+        sigma_evals[w][rs_a] = shifted[tw][np.asarray(trs, dtype=np.int64)]
+        swapped_rows[w].append(rs_a)
+
+    if use_lagrange:
+        # σ_w evals are shift_w·ωʳ EXCEPT at cells in copy cycles, and
+        # Σ_r ωʳ·L_r(τ)G = τG = SRS[1] (the poly with evals ωʳ is X), so
+        # commit(σ_w) = shift_w·SRS[1] + Σ_{swapped r} (σ_w(ωʳ) −
+        # shift_w·ωʳ)·L_r(τ)G — an MSM over only the swapped cells.
+        from .bn254 import g1_add, g1_mul
+
+        lag = lagrange_limbs(params)
+        for w in range(NUM_WIRES):
+            rows_w = (np.concatenate(swapped_rows[w])
+                      if swapped_rows[w] else np.empty(0, dtype=np.int64))
+            base = g1_mul(params.g1_powers[1], shifts[w])
+            if len(rows_w):
+                diff = fk.vec_sub(
+                    np.ascontiguousarray(sigma_evals[w][rows_w]),
+                    np.ascontiguousarray(shifted[w][rows_w]))
+                corr_pt = _msm_signed(
+                    np.ascontiguousarray(lag[rows_w]), diff)
+                base = g1_add(base, corr_pt)
+            vk_commits[f"sigma_{w}"] = base
+
+    if eval_pk:
+        # evaluation-form key: no iNTTs at all — the prover derives any
+        # coefficient forms it needs (on device in the TPU pipeline)
+        return FastProvingKey(k, fixed_evals, sigma_evals, sigma_evals,
+                              shifts, list(cs.public_rows), cs.lookup_bits,
+                              vk_commits, eval_form=True)
 
     sigma = sigma_evals.copy()
     for w in range(NUM_WIRES):
         fk.ntt(sigma[w], d.omega, inverse=True)
 
-    vk_commits = {}
-    for idx, name in enumerate(FIXED_NAMES):
-        vk_commits[name] = commit_limbs(params, fixed[idx])
-    for w in range(NUM_WIRES):
-        vk_commits[f"sigma_{w}"] = commit_limbs(params, sigma[w])
+    if not use_lagrange:
+        for idx, name in enumerate(FIXED_NAMES):
+            vk_commits[name] = commit_limbs(params, fixed[idx])
+        for w in range(NUM_WIRES):
+            vk_commits[f"sigma_{w}"] = commit_limbs(params, sigma[w])
 
     return FastProvingKey(k, fixed, sigma, sigma_evals, shifts,
                           list(cs.public_rows), cs.lookup_bits, vk_commits)
@@ -299,16 +496,35 @@ def keygen_fast(params: KZGParams, cs: ConstraintSystem,
 
 # --- prover ----------------------------------------------------------------
 
-def _blind_arr(coeffs: np.ndarray, n: int, count: int, randint) -> np.ndarray:
-    """(b₀+b₁X+…)·Z_H blinding on a coefficient array; returns an array
-    of length n+count."""
+def _blind_arr(coeffs: np.ndarray, n: int, count: int, randint):
+    """(b₀+b₁X+…)·Z_H blinding on a coefficient array; returns
+    (array of length n+count, blinding values) — the blinds let eval-
+    basis commits apply the correction Σ bᵢ·(SRS[n+i] − SRS[i])."""
     out = np.zeros((n + count, 4), dtype="<u8")
     out[: len(coeffs)] = coeffs[: n + count]
+    blinds = []
     for i in range(count):
         b = randint()
+        blinds.append(b)
         _set_int(out, i, (_get_int(out, i) - b) % R)
         _set_int(out, n + i, (_get_int(out, n + i) + b) % R)
-    return out
+    return out, blinds
+
+
+def _commit_blinded_evals(params: KZGParams, evals: np.ndarray, blinds: list):
+    """Commit p + Σ bᵢ(X^{n+i} − X^i)·1 from p's evals via the Lagrange
+    basis: the Z_H-multiple blinding vanishes on H, so it re-enters as a
+    τ-basis correction on 2·count SRS points."""
+    from .bn254 import g1_add, g1_mul
+
+    n = 1 << params.k
+    cm = commit_evals_limbs(params, evals)
+    for i, b in enumerate(blinds):
+        if b == 0:
+            continue
+        cm = g1_add(cm, g1_mul(params.g1_powers[n + i], b))
+        cm = g1_add(cm, g1_mul(params.g1_powers[i], (R - b) % R))
+    return cm
 
 
 def prove_fast(params: KZGParams, pk: FastProvingKey, cs: ConstraintSystem,
@@ -330,6 +546,9 @@ def prove_fast(params: KZGParams, pk: FastProvingKey, cs: ConstraintSystem,
     for v in pubs:
         tr.absorb_fr(v)
 
+    use_lagrange = (params.g1_lagrange is not None
+                    and len(params.g1_lagrange) == n)
+
     # round 1: wires + lookup multiplicities
     wire_vals = np.zeros((NUM_WIRES, n, 4), dtype="<u8")
     for w in range(NUM_WIRES):
@@ -337,11 +556,20 @@ def prove_fast(params: KZGParams, pk: FastProvingKey, cs: ConstraintSystem,
         if col:
             wire_vals[w, : len(col)] = native.ints_to_limbs(col)
     wire_coeffs = []
+    wire_blinds = []
     for w in range(NUM_WIRES):
         c = wire_vals[w].copy()
         fk.ntt(c, d.omega, inverse=True)
-        wire_coeffs.append(_blind_arr(c, n, 2, randint))
-    wire_commits = [commit_limbs(params, c) for c in wire_coeffs]
+        blinded, blinds = _blind_arr(c, n, 2, randint)
+        wire_coeffs.append(blinded)
+        wire_blinds.append(blinds)
+    if use_lagrange:
+        wire_commits = [
+            _commit_blinded_evals(params, wire_vals[w], wire_blinds[w])
+            for w in range(NUM_WIRES)
+        ]
+    else:
+        wire_commits = [commit_limbs(params, c) for c in wire_coeffs]
     for cm in wire_commits:
         tr.absorb_point(cm)
 
@@ -359,8 +587,9 @@ def prove_fast(params: KZGParams, pk: FastProvingKey, cs: ConstraintSystem,
     m_vals[:table_size, 0] = m_small
     m_coeffs_base = m_vals.copy()
     fk.ntt(m_coeffs_base, d.omega, inverse=True)
-    m_coeffs = _blind_arr(m_coeffs_base, n, 2, randint)
-    m_commit = commit_limbs(params, m_coeffs)
+    m_coeffs, m_blinds = _blind_arr(m_coeffs_base, n, 2, randint)
+    m_commit = (_commit_blinded_evals(params, m_vals, m_blinds)
+                if use_lagrange else commit_limbs(params, m_coeffs))
     tr.absorb_point(m_commit)
 
     beta = tr.challenge()
@@ -375,8 +604,9 @@ def prove_fast(params: KZGParams, pk: FastProvingKey, cs: ConstraintSystem,
                                    pk.shifts, omegas, beta, gamma)
     z_base = z_vals.copy()
     fk.ntt(z_base, d.omega, inverse=True)
-    z_coeffs = _blind_arr(z_base, n, 3, randint)
-    z_commit = commit_limbs(params, z_coeffs)
+    z_coeffs, z_blinds = _blind_arr(z_base, n, 3, randint)
+    z_commit = (_commit_blinded_evals(params, z_vals, z_blinds)
+                if use_lagrange else commit_limbs(params, z_coeffs))
     tr.absorb_point(z_commit)
 
     # round 2b: LogUp running sum (native kernel)
@@ -386,8 +616,9 @@ def prove_fast(params: KZGParams, pk: FastProvingKey, cs: ConstraintSystem,
                                     m_vals, beta_lk)
     phi_base = phi_vals.copy()
     fk.ntt(phi_base, d.omega, inverse=True)
-    phi_coeffs = _blind_arr(phi_base, n, 3, randint)
-    phi_commit = commit_limbs(params, phi_coeffs)
+    phi_coeffs, phi_blinds = _blind_arr(phi_base, n, 3, randint)
+    phi_commit = (_commit_blinded_evals(params, phi_vals, phi_blinds)
+                  if use_lagrange else commit_limbs(params, phi_coeffs))
     tr.absorb_point(phi_commit)
 
     alpha = tr.challenge()
@@ -416,12 +647,13 @@ def prove_fast(params: KZGParams, pk: FastProvingKey, cs: ConstraintSystem,
     phiw_coeffs = phi_coeffs.copy()
     fk.coset_scale(phiw_coeffs, d.omega)
     phiw_e = ext(phiw_coeffs)
+    pk_fixed_c, pk_sigma_c = pk.coeff_forms()
     fixed_e = np.empty((len(FIXED_NAMES), ext_n, 4), dtype="<u8")
     for idx in range(len(FIXED_NAMES)):
-        fixed_e[idx] = ext(pk.fixed_limbs[idx])
+        fixed_e[idx] = ext(pk_fixed_c[idx])
     sigma_e = np.empty((NUM_WIRES, ext_n, 4), dtype="<u8")
     for w in range(NUM_WIRES):
-        sigma_e[w] = ext(pk.sigma_limbs[w])
+        sigma_e[w] = ext(pk_sigma_c[w])
     pi_vals = np.zeros((n, 4), dtype="<u8")
     for row, value in zip(pk.public_rows, pubs):
         _set_int(pi_vals, row, (-int(value)) % R)
@@ -469,8 +701,8 @@ def prove_fast(params: KZGParams, pk: FastProvingKey, cs: ConstraintSystem,
 
     # round 4: evaluations via one stacked Horner pass per point
     all_polys = (wire_coeffs + [m_coeffs, z_coeffs, phi_coeffs] + chunks
-                 + [pk.fixed_limbs[i] for i in range(len(FIXED_NAMES))]
-                 + [pk.sigma_limbs[w] for w in range(NUM_WIRES)])
+                 + [pk_fixed_c[i] for i in range(len(FIXED_NAMES))]
+                 + [pk_sigma_c[w] for w in range(NUM_WIRES)])
     max_len = max(len(p) for p in all_polys)
     stacked = np.zeros((len(all_polys), max_len, 4), dtype="<u8")
     for i, p in enumerate(all_polys):

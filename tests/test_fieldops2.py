@@ -1,0 +1,126 @@
+"""Limb-plane field engine (ops/fieldops2.py): bit-exactness vs Python
+ints — the same contract test_fieldops.py enforces for the row-layout
+engine, over the prover pipeline's (L, n) layout."""
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+import jax.numpy as jnp  # noqa: E402
+
+from protocol_tpu.ops import fieldops2 as f2  # noqa: E402
+
+P = f2.P
+R = f2.R_MONT
+
+
+@pytest.fixture(scope="module")
+def vals():
+    rng = np.random.default_rng(7)
+    out = [int.from_bytes(rng.bytes(32), "little") % P for _ in range(256)]
+    out[:6] = [0, 1, 2, P - 1, P - 2, (P + 1) // 2]
+    return out
+
+
+def test_pack_unpack_roundtrip(vals):
+    u64 = np.zeros((len(vals), 4), dtype="<u8")
+    for i, v in enumerate(vals):
+        u64[i] = np.frombuffer(int(v).to_bytes(32, "little"), dtype="<u8")
+    planes = f2.pack_u64(u64)
+    assert f2.planes_to_ints(planes) == vals
+    back = f2.unpack_u64(planes)
+    assert np.array_equal(back, u64)
+
+
+def test_mont_mul_exact(vals):
+    n = len(vals)
+    x = jnp.asarray(f2.ints_to_planes(vals))
+    y = jnp.asarray(f2.ints_to_planes(list(reversed(vals))))
+    out = f2.mont_mul(x, y)
+    got = [v % P for v in f2.planes_to_ints(out)]
+    rinv = pow(R, -1, P)
+    exp = [a * b * rinv % P for a, b in zip(vals, reversed(vals))]
+    assert got == exp
+    # relaxed-form bound: limbs below 2^13
+    assert int(np.max(np.asarray(out))) < 1 << 13
+
+
+def test_mont_domain_roundtrip(vals):
+    x = jnp.asarray(f2.ints_to_planes(vals))
+    m = f2.enter_mont(x)
+    got = [v % P for v in f2.planes_to_ints(m)]
+    assert got == [v * R % P for v in vals]
+    back = f2.exit_mont(m)
+    assert [v % P for v in f2.planes_to_ints(back)] == list(vals)
+
+
+def test_add_sub_neg(vals):
+    x = jnp.asarray(f2.ints_to_planes(vals))
+    y = jnp.asarray(f2.ints_to_planes(list(reversed(vals))))
+    s = f2.add(x, y)
+    assert [v % P for v in f2.planes_to_ints(s)] == \
+        [(a + b) % P for a, b in zip(vals, reversed(vals))]
+    d = f2.sub(x, y)
+    assert [v % P for v in f2.planes_to_ints(d)] == \
+        [(a - b) % P for a, b in zip(vals, reversed(vals))]
+    ng = f2.neg(x)
+    assert [v % P for v in f2.planes_to_ints(ng)] == [(-a) % P for a in vals]
+
+
+def test_chained_relaxed_ops_stay_exact(vals):
+    """The NTT-butterfly usage pattern: accumulating sums on one path,
+    subtrahends always fresh mont_mul outputs (the sub/neg contract).
+    Values must stay exact across many levels without overflow."""
+    a = jnp.asarray(f2.ints_to_planes(vals))
+    b = jnp.asarray(f2.ints_to_planes(list(reversed(vals))))
+    rinv = pow(R, -1, P)
+    ra = list(vals)
+    rb = list(reversed(vals))
+    for it in range(10):
+        wb = f2.mont_mul(b, b)          # fresh mul output (< 2p)
+        a, b = f2.add(a, wb), f2.sub(a, wb)
+        rwb = [x * x * rinv % P for x in rb]
+        ra, rb = ([(x + y) % P for x, y in zip(ra, rwb)],
+                  [(x - y) % P for x, y in zip(ra, rwb)])
+        assert int(np.max(np.abs(np.asarray(a)))) < (1 << 14)
+    assert [v % P for v in f2.planes_to_ints(a)] == ra
+    assert [v % P for v in f2.planes_to_ints(b)] == rb
+
+
+def test_canonical(vals):
+    x = jnp.asarray(f2.ints_to_planes([(v * 2) % P + P if (v * 2) % P < P
+                                       else (v * 2) % P for v in vals[:50]]))
+    # feed values in [p, 2p) and check canonical() lands in [0, p)
+    c = f2.canonical(x)
+    ints = f2.planes_to_ints(c)
+    assert all(0 <= v < P for v in ints)
+
+
+def test_inv(vals):
+    nz = [v for v in vals if v][:32]
+    x = f2.enter_mont(jnp.asarray(f2.ints_to_planes(nz)))
+    xi = f2.inv(x)
+    prod = f2.exit_mont(f2.mont_mul(x, xi))
+    assert [v % P for v in f2.planes_to_ints(prod)] == [1] * len(nz)
+
+
+def test_mxu_plane_roundtrip(vals):
+    x = jnp.asarray(f2.ints_to_planes(vals))
+    p6 = f2.to_mxu_planes(x)
+    assert p6.dtype == jnp.int8 and p6.shape[0] == f2.L6
+    back = f2.reduce_mxu_planes(p6.astype(jnp.int32))
+    assert [v % P for v in f2.planes_to_ints(back)] == \
+        [v % P for v in vals]
+
+
+def test_reduce_mxu_planes_lazy_sums(vals):
+    """Simulate a stage matmul: lazy base-64 planes holding sums of many
+    6-bit products (the real MXU output shape) reduce exactly."""
+    rng = np.random.default_rng(3)
+    n = 64
+    K = 87
+    lazy = rng.integers(0, 1 << 26, (K, n), dtype=np.int64)
+    expect = [int(sum(int(lazy[k, j]) << (6 * k) for k in range(K))) % P
+              for j in range(n)]
+    out = f2.reduce_mxu_planes(jnp.asarray(lazy, dtype=jnp.int32))
+    assert [v % P for v in f2.planes_to_ints(out)] == expect

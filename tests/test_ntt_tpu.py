@@ -1,0 +1,79 @@
+"""Device four-step NTT (ops/ntt_tpu.py) vs the host C++ NTT oracle."""
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+import jax.numpy as jnp  # noqa: E402
+
+from protocol_tpu.ops import fieldops2 as f2  # noqa: E402
+from protocol_tpu.ops import ntt_tpu  # noqa: E402
+from protocol_tpu.utils.fields import BN254_FR_MODULUS as P  # noqa: E402
+
+
+def _host_ntt(vals, k, inverse=False):
+    from protocol_tpu import native
+    from protocol_tpu.zk.domain import EvaluationDomain
+
+    if not native.available():
+        pytest.skip("native library unavailable")
+    fk = native.FieldKernel(P)
+    data = native.ints_to_limbs([int(v) % P for v in vals])
+    fk.ntt(data, EvaluationDomain(k).omega, inverse=inverse)
+    return native.limbs_to_ints(data)
+
+
+def _fs_to_natural(flat, A, B):
+    out = [0] * (A * B)
+    for k1 in range(A):
+        for k2 in range(B):
+            out[k1 + k2 * A] = flat[k1 * B + k2]
+    return out
+
+
+def _natural_to_fs(vals, A, B):
+    out = [0] * (A * B)
+    for k1 in range(A):
+        for k2 in range(B):
+            out[k1 * B + k2] = vals[k1 + k2 * A]
+    return out
+
+
+@pytest.mark.parametrize("k", [4, 7, 10])
+def test_forward_matches_host(k):
+    rng = np.random.default_rng(k)
+    n = 1 << k
+    vals = [int.from_bytes(rng.bytes(32), "little") % P for _ in range(n)]
+    plan = ntt_tpu.NttPlan.get(k)
+
+    x = f2.enter_mont(jnp.asarray(f2.ints_to_planes(vals)))
+    z = ntt_tpu.ntt(x, plan)
+    got_fs = [v % P for v in f2.planes_to_ints(f2.exit_mont(z))]
+    got = _fs_to_natural(got_fs, plan.A, plan.B)
+    assert got == _host_ntt(vals, k)
+
+
+@pytest.mark.parametrize("k", [4, 7, 10])
+def test_inverse_matches_host(k):
+    rng = np.random.default_rng(100 + k)
+    n = 1 << k
+    vals = [int.from_bytes(rng.bytes(32), "little") % P for _ in range(n)]
+    plan = ntt_tpu.NttPlan.get(k)
+
+    fs_vals = _natural_to_fs(vals, plan.A, plan.B)
+    z = f2.enter_mont(jnp.asarray(f2.ints_to_planes(fs_vals)))
+    x = ntt_tpu.intt(z, plan)
+    got = [v % P for v in f2.planes_to_ints(f2.exit_mont(x))]
+    assert got == _host_ntt(vals, k, inverse=True)
+
+
+def test_roundtrip_without_host():
+    k = 8
+    rng = np.random.default_rng(5)
+    n = 1 << k
+    vals = [int.from_bytes(rng.bytes(32), "little") % P for _ in range(n)]
+    plan = ntt_tpu.NttPlan.get(k)
+    x = f2.enter_mont(jnp.asarray(f2.ints_to_planes(vals)))
+    back = ntt_tpu.intt(ntt_tpu.ntt(x, plan), plan)
+    got = [v % P for v in f2.planes_to_ints(f2.exit_mont(back))]
+    assert got == vals

