@@ -288,16 +288,24 @@ def handle_bandada(args, files, config):
 def handle_deploy(args, files, config):
     from ..utils.keccak import keccak256
 
-    if config.node_url != "memory":
-        raise EigenError(
-            "contract_error",
-            "deploying to a live node needs contract bytecode; point node_url"
-            " at an existing AttestationStation via `update --as-address`",
-        )
-    address = keccak256(b"protocol_tpu.attestation_station")[12:]
-    config.as_address = "0x" + address.hex()
+    if config.node_url == "memory":
+        address = keccak256(b"protocol_tpu.attestation_station")[12:]
+        config.as_address = "0x" + address.hex()
+        _save_config(files, config)
+        print(f"local AttestationStation at {config.as_address}")
+        return
+    # live node: sign and send a creation transaction carrying the
+    # vendored AttestationStation bytecode (reference: eth.rs:18-25,
+    # bytecode att_station.rs:119)
+    from ..client.chain import RpcChain
+    from ..client.eth import ecdsa_keypairs_from_mnemonic
+
+    keypair = ecdsa_keypairs_from_mnemonic(load_mnemonic(), 1)[0]
+    chain = RpcChain.deploy_signed(config.node_url, keypair,
+                                   chain_id=int(config.chain_id))
+    config.as_address = "0x" + chain.contract_address.hex()
     _save_config(files, config)
-    print(f"local AttestationStation at {config.as_address}")
+    print(f"deployed AttestationStation at {config.as_address}")
 
 
 def handle_update(args, files, config):

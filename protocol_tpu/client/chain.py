@@ -211,6 +211,40 @@ class RpcChain(AttestationStation):
             "RpcChain needs a signing key; use attest_signed(keypair, entries)",
         )
 
+    @classmethod
+    def deploy_signed(cls, node_url: str, keypair, chain_id: int = 31337,
+                      gas: int = 2_000_000) -> "RpcChain":
+        """Deploy the AttestationStation contract (the vendored creation
+        bytecode, ``att_station_bytecode.py``) from ``keypair`` and
+        return an RpcChain bound to the created address — the
+        reference's ``deploy_as`` (``eigentrust/src/eth.rs:18-25``).
+
+        The created address is derived the EVM way:
+        keccak256(rlp([sender, nonce]))[12:]."""
+        from .att_station_bytecode import creation_bytecode
+        from .eth import address_from_public_key, rlp_encode, sign_legacy_tx
+
+        chain = cls(node_url, b"\x00" * 20, chain_id)
+        sender_b = address_from_public_key(keypair.public_key)
+        sender = "0x" + sender_b.hex()
+        nonce = int(chain.rpc("eth_getTransactionCount",
+                              [sender, "pending"]), 16)
+        gas_price = int(chain.rpc("eth_gasPrice", []), 16)
+        raw = sign_legacy_tx(
+            keypair,
+            nonce=nonce,
+            gas_price=gas_price,
+            gas=gas,
+            to=b"",  # contract creation
+            value=0,
+            data=creation_bytecode(),
+            chain_id=chain_id,
+        )
+        chain.rpc("eth_sendRawTransaction", ["0x" + raw.hex()])
+        created = keccak256(rlp_encode([sender_b, nonce]))[12:]
+        chain.contract_address = created
+        return chain
+
     def get_attestation(self, creator: bytes, about: bytes, key: bytes) -> bytes:
         selector = keccak256(b"attestations(address,address,bytes32)")[:4]
         data = selector + _pad32(b"\x00" * 12 + creator) + _pad32(b"\x00" * 12 + about) + key

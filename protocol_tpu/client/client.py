@@ -95,7 +95,7 @@ class Client:
             self.chain = RpcChain(
                 config.node_url,
                 bytes.fromhex(config.as_address.removeprefix("0x")),
-                config.chain_id,
+                int(config.chain_id),
             )
 
     # --- helpers ----------------------------------------------------------

@@ -238,6 +238,27 @@ def inv(x: jnp.ndarray) -> jnp.ndarray:
     return mont_pow_const(x, P - 2)
 
 
+def batch_inv(x: jnp.ndarray) -> jnp.ndarray:
+    """Montgomery-trick batched inverse over the lane axis: two
+    associative prefix-product scans + ONE Fermat inversion, ~2·n·log n
+    multiplies instead of 254·n. All inputs must be nonzero."""
+    n = x.shape[1]
+
+    def combine(a, b):
+        return mont_mul(a, b)
+
+    pre = lax.associative_scan(combine, x, axis=1)          # Πx_{≤i}
+    suf = lax.associative_scan(combine, x[:, ::-1], axis=1)[:, ::-1]
+    total_inv = mont_pow_const(pre[:, -1:], P - 2)          # (L, 1)
+    one_m = _const_planes(R_MONT, 1)
+    pre_prev = jnp.concatenate(
+        [jnp.broadcast_to(one_m, (L, 1)), pre[:, :-1]], axis=1)
+    suf_next = jnp.concatenate(
+        [suf[:, 1:], jnp.broadcast_to(one_m, (L, 1))], axis=1)
+    out = mont_mul(pre_prev, suf_next)
+    return mont_mul(out, jnp.broadcast_to(total_inv, (L, n)))
+
+
 # --- MXU plane interface ----------------------------------------------------
 
 def to_mxu_planes(x: jnp.ndarray) -> jnp.ndarray:

@@ -87,10 +87,17 @@ def _hx(v: int) -> str:
     return hex(int(v))
 
 
-def gen_evm_verifier_code(params: KZGParams, vk) -> str:
+def gen_evm_verifier_code(params: KZGParams, vk,
+                          transcript: str = "poseidon") -> str:
     """Generate the Yul verifier for a verifying key (any of
     ProvingKey / FastProvingKey / VerifyingKey: needs ``k``, ``shifts``,
-    ``public_rows``, ``commit_list()``) and the SRS tau point."""
+    ``public_rows``, ``commit_list()``) and the SRS tau point.
+
+    ``transcript="keccak"`` emits the on-chain-cheap variant (the
+    reference's snark-verifier shape, verifier/mod.rs:116-145): one
+    keccak256 per challenge instead of Poseidon permutations — it
+    verifies proofs produced with ``prove(..., transcript="keccak")``.
+    "poseidon" keeps protocol parity with the in-circuit aggregator."""
     n_pub = len(vk.public_rows)
     layout = proof_layout(n_pub)
     if _BUF + 32 * (n_pub + 64) > _RC:
@@ -115,12 +122,19 @@ def gen_evm_verifier_code(params: KZGParams, vk) -> str:
     lines: list = []
     emit = lines.append
 
-    # --- constant tables --------------------------------------------------
-    for i, c in enumerate(rc):
-        emit(f"mstore({_hx(_RC + 32 * i)}, {_hx(c)})")
-    for i in range(5):
-        for j in range(5):
-            emit(f"mstore({_hx(_MDS + 32 * (5 * i + j))}, {_hx(mds[i][j])})")
+    # --- constant tables (Poseidon round constants only when used) --------
+    if transcript == "poseidon":
+        for i, c in enumerate(rc):
+            emit(f"mstore({_hx(_RC + 32 * i)}, {_hx(c)})")
+        for i in range(5):
+            for j in range(5):
+                emit(f"mstore({_hx(_MDS + 32 * (5 * i + j))}, "
+                     f"{_hx(mds[i][j])})")
+    else:
+        from ..utils.keccak import keccak256 as _k
+
+        seed = int.from_bytes(_k(b"protocol-tpu-plonk"), "big")
+        emit(f"mstore({_hx(_STATE)}, {_hx(seed)})")
     for i, row in enumerate(vk.public_rows):
         emit(f"mstore({_hx(_WTAB + 32 * i)}, {_hx(pow(d.omega, row, R))})")
     commits = vk.commit_list()
@@ -172,21 +186,8 @@ def gen_evm_verifier_code(params: KZGParams, vk) -> str:
     a, b, c_, dd, e_ = (ev(i) for i in range(5))
     q = {name: ev(_EV_FIXED + i) for i, name in enumerate(FIXED_NAMES)}
 
-    code = f"""
-object "PlonkVerifier" {{
-  code {{
-    datacopy(0, dataoffset("runtime"), datasize("runtime"))
-    return(0, datasize("runtime"))
-  }}
-  object "runtime" {{
-    code {{
-      // ---- generated for vk: k={vk.k}, {n_pub} public inputs ----
-      let RMOD := {_hx(R)}
-      let QMOD := {_hx(Q)}
-      let NDOM := {_hx(1 << vk.k)}
-      let OMEGA := {_hx(d.omega)}
-
-      function pow5(x) -> y {{
+    if transcript == "poseidon":
+        sponge_fns = f"""      function pow5(x) -> y {{
         let x2 := mulmod(x, x, {_hx(R)})
         let x4 := mulmod(x2, x2, {_hx(R)})
         y := mulmod(x4, x, {_hx(R)})
@@ -263,7 +264,45 @@ object "PlonkVerifier" {{
           sp_push(and(y, {_hx((1 << 128) - 1)}))
           sp_push(shr(128, y))
         }}
+      }}"""
+    else:
+        sponge_fns = f"""
+      function sp_push(v) {{
+        let cnt := mload({_hx(0x1c0)})
+        mstore(add({_hx(_STATE + 32)}, mul(cnt, 32)), v)
+        mstore({_hx(0x1c0)}, add(cnt, 1))
       }}
+      function absorb_pt(x, y) {{
+        sp_push(x)
+        sp_push(y)
+      }}
+      function challenge() -> c {{
+        let r := add(mload({_hx(0x1e0)}), 1)
+        mstore({_hx(0x1e0)}, r)
+        let cnt := mload({_hx(0x1c0)})
+        mstore(add({_hx(_STATE + 32)}, mul(cnt, 32)), r)
+        let h := keccak256({_hx(_STATE)}, mul(add(cnt, 2), 32))
+        mstore({_hx(_STATE)}, h)
+        mstore({_hx(0x1c0)}, 0)
+        c := mod(h, {_hx(R)})
+      }}"""
+    label_init = (f"sp_push({_hx(_LABEL_SEED)})"
+                  if transcript == "poseidon" else "")
+    code = f"""
+object "PlonkVerifier" {{
+  code {{
+    datacopy(0, dataoffset("runtime"), datasize("runtime"))
+    return(0, datasize("runtime"))
+  }}
+  object "runtime" {{
+    code {{
+      // ---- generated for vk: k={vk.k}, {n_pub} public inputs ----
+      let RMOD := {_hx(R)}
+      let QMOD := {_hx(Q)}
+      let NDOM := {_hx(1 << vk.k)}
+      let OMEGA := {_hx(d.omega)}
+
+{sponge_fns}
       function check_point(x, y) {{
         if and(iszero(x), iszero(y)) {{ leave }}
         if iszero(and(lt(x, {_hx(Q)}), lt(y, {_hx(Q)}))) {{ revert(0, 0) }}
@@ -301,7 +340,7 @@ object "PlonkVerifier" {{
       {preamble}
 
       // ---- transcript: label, instances, commitments ----
-      sp_push({_hx(_LABEL_SEED)})
+      {label_init}
       for {{ let i := 0 }} lt(i, {n_pub}) {{ i := add(i, 1) }} {{
         let v := calldataload(mul(i, 32))
         if iszero(lt(v, RMOD)) {{ revert(0, 0) }}

@@ -51,7 +51,7 @@ from .kzg import (
     g1_to_bytes,
     open_batch,
 )
-from .transcript import PoseidonTranscript
+from .transcript import PoseidonTranscript, make_transcript
 
 R = BN254_FR_MODULUS
 
@@ -445,13 +445,13 @@ def _pi_evals(cs_public_rows, pubs, n) -> list:
 
 
 def prove(params: KZGParams, pk: ProvingKey, cs: ConstraintSystem,
-          public_inputs=None) -> bytes:
+          public_inputs=None, transcript: str = "poseidon") -> bytes:
     d = pk.domain()
     n = d.n
     if cs.num_rows > n:
         raise EigenError("proving_error", "circuit larger than key domain")
     pubs = list(public_inputs) if public_inputs is not None else cs.public_values()
-    tr = PoseidonTranscript()
+    tr = make_transcript(transcript)
     for v in pubs:
         tr.absorb_fr(v)
 
@@ -620,7 +620,8 @@ def prove(params: KZGParams, pk: ProvingKey, cs: ConstraintSystem,
     return proof.to_bytes()
 
 
-def succinct_verify(pk: ProvingKey, public_inputs, proof_bytes: bytes):
+def succinct_verify(pk: ProvingKey, public_inputs, proof_bytes: bytes,
+                    transcript: str = "poseidon"):
     """The full verifier computation except the final pairing: returns
     the KZG accumulator (acc_l, acc_r), or None when any algebraic check
     fails. Needs no SRS — only the pairing decider does. This is the
@@ -637,7 +638,7 @@ def succinct_verify(pk: ProvingKey, public_inputs, proof_bytes: bytes):
     if len(pubs) != len(pk.public_rows):
         return None
 
-    tr = PoseidonTranscript()
+    tr = make_transcript(transcript)
     for v in pubs:
         tr.absorb_fr(v)
     for cm in proof.wire_commits:
@@ -729,8 +730,9 @@ def succinct_verify(pk: ProvingKey, public_inputs, proof_bytes: bytes):
 
 
 def verify(params: KZGParams, pk: ProvingKey, public_inputs,
-           proof_bytes: bytes) -> bool:
-    acc = succinct_verify(pk, public_inputs, proof_bytes)
+           proof_bytes: bytes, transcript: str = "poseidon") -> bool:
+    acc = succinct_verify(pk, public_inputs, proof_bytes,
+                          transcript=transcript)
     if acc is None:
         return False
     return decide(params, *acc)

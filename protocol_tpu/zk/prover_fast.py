@@ -46,7 +46,7 @@ from .plonk import (
     _find_coset_shifts,
     _table_values,
 )
-from .transcript import PoseidonTranscript
+from .transcript import PoseidonTranscript, make_transcript
 
 R = BN254_FR_MODULUS
 Q = BN254_FQ_MODULUS
@@ -527,8 +527,27 @@ def _commit_blinded_evals(params: KZGParams, evals: np.ndarray, blinds: list):
     return cm
 
 
+
+def _lookup_multiplicities(cs: ConstraintSystem, n: int,
+                           table_size: int) -> np.ndarray:
+    """(n, 4) limb array of the LogUp multiplicity column — shared by
+    the host and TPU prove paths, which must stay transcript-lockstep."""
+    for v in cs.wires[LOOKUP_WIRE]:
+        if v >= table_size:
+            raise EigenError("proving_error",
+                             f"lookup value {v} outside range table")
+    lk_small = np.fromiter(cs.wires[LOOKUP_WIRE], dtype=np.int64,
+                           count=cs.num_rows)
+    m_small = np.bincount(lk_small, minlength=table_size).astype(np.uint64)
+    m_small[0] += n - cs.num_rows  # padding rows pool at table entry 0
+    m_vals = np.zeros((n, 4), dtype="<u8")
+    m_vals[:table_size, 0] = m_small
+    return m_vals
+
+
 def prove_fast(params: KZGParams, pk: FastProvingKey, cs: ConstraintSystem,
-               public_inputs=None, randint=None) -> bytes:
+               public_inputs=None, randint=None,
+               transcript: str = "poseidon") -> bytes:
     """``plonk.prove`` on native kernels; transcript-identical, so the
     output verifies under ``plonk.verify``/``succinct_verify`` and
     aggregates under the aggregator chipset. ``randint`` overrides the
@@ -542,7 +561,7 @@ def prove_fast(params: KZGParams, pk: FastProvingKey, cs: ConstraintSystem,
         raise EigenError("proving_error", "circuit larger than key domain")
     pubs = (list(public_inputs) if public_inputs is not None
             else cs.public_values())
-    tr = PoseidonTranscript()
+    tr = make_transcript(transcript)
     for v in pubs:
         tr.absorb_fr(v)
 
@@ -574,17 +593,7 @@ def prove_fast(params: KZGParams, pk: FastProvingKey, cs: ConstraintSystem,
         tr.absorb_point(cm)
 
     table_size = 1 << pk.lookup_bits if pk.lookup_bits else 1
-    for v in cs.wires[LOOKUP_WIRE]:
-        if v >= table_size:
-            raise EigenError("proving_error",
-                             f"lookup value {v} outside range table")
-    # in range ⇒ values are table indices, safe as int64
-    lk_small = np.fromiter(cs.wires[LOOKUP_WIRE], dtype=np.int64,
-                           count=cs.num_rows)
-    m_small = np.bincount(lk_small, minlength=table_size).astype(np.uint64)
-    m_small[0] += n - cs.num_rows  # padding rows pool at table entry 0
-    m_vals = np.zeros((n, 4), dtype="<u8")
-    m_vals[:table_size, 0] = m_small
+    m_vals = _lookup_multiplicities(cs, n, table_size)
     m_coeffs_base = m_vals.copy()
     fk.ntt(m_coeffs_base, d.omega, inverse=True)
     m_coeffs, m_blinds = _blind_arr(m_coeffs_base, n, 2, randint)
@@ -742,6 +751,231 @@ def prove_fast(params: KZGParams, pk: FastProvingKey, cs: ConstraintSystem,
 
     w_x = open_group(all_polys, zeta)
     w_wx = open_group([z_coeffs, phi_coeffs], zeta_w)
+
+    proof = Proof(wire_commits, m_commit, z_commit, phi_commit, t_commits,
+                  wire_evals, m_eval, z_eval, z_next, phi_eval, phi_next,
+                  t_evals, fixed_evals, sigma_zeta, w_x, w_wx)
+    return proof.to_bytes()
+
+
+# --- TPU-pipelined prover ---------------------------------------------------
+
+_DEVICE_PROVER: list = [None, None]  # [pk object, DeviceProver]
+
+
+def _device_prover(pk: FastProvingKey):
+    """Cached DeviceProver for the last-used pk (the pk's fixed/sigma
+    cosets are device-resident, like halo2's ProvingKey holds its
+    cosets in RAM). The cache holds a strong reference to the pk and
+    compares identity — an id()-keyed map could alias a new key to a
+    garbage-collected one's DeviceProver."""
+    from . import prover_tpu
+
+    if _DEVICE_PROVER[0] is pk:
+        return _DEVICE_PROVER[1]
+    ext_n = (1 << pk.k) * 8
+    shift = _find_coset_shifts(ext_n, 2)[1]
+    dp = prover_tpu.DeviceProver(
+        pk.k, shift,
+        [pk.fixed_limbs[i] for i in range(len(FIXED_NAMES))],
+        [pk.sigma_limbs[w] for w in range(NUM_WIRES)])
+    _DEVICE_PROVER[0] = pk
+    _DEVICE_PROVER[1] = dp
+    return dp
+
+
+def prove_fast_tpu(params: KZGParams, pk: FastProvingKey,
+                   cs: ConstraintSystem, public_inputs=None,
+                   randint=None, transcript: str = "poseidon") -> bytes:
+    """``prove_fast`` with rounds 3–4 on the TPU: extension-domain NTTs,
+    the quotient identity, the 8n inverse, the opening folds and the ζ
+    evaluations are device-resident (zk/prover_tpu.py); the host keeps
+    witness generation, grand products, the Poseidon transcript and the
+    MSM commits. Requires an eval-form (FPK2) key and Lagrange-basis
+    params. Proof bytes are identical to the host path's for the same
+    blinding stream (tested).
+
+    LOCKSTEP WARNING: rounds 1-2 here mirror ``prove_fast``'s absorb and
+    blinding-draw ORDER exactly — any edit to one path's transcript
+    sequence must be mirrored in the other or the two provers' proofs
+    (and the verifier) silently diverge."""
+    from . import prover_tpu as ptpu
+
+    if not pk.eval_form:
+        raise EigenError("proving_error", "prove_fast_tpu needs an FPK2 key")
+    if randint is None:
+        randint = lambda: secrets.randbelow(R)  # noqa: E731
+    fk = _kernel()
+    d = pk.domain()
+    n = d.n
+    if cs.num_rows > n:
+        raise EigenError("proving_error", "circuit larger than key domain")
+    if (params.g1_lagrange is None or len(params.g1_lagrange) != n):
+        raise EigenError("proving_error",
+                         "prove_fast_tpu needs a matching Lagrange basis")
+    dp = _device_prover(pk)
+    pubs = (list(public_inputs) if public_inputs is not None
+            else cs.public_values())
+    tr = make_transcript(transcript)
+    for v in pubs:
+        tr.absorb_fr(v)
+
+    # round 1: wires + lookup multiplicities (commits from evals; the
+    # blinding stream consumption order matches _blind_arr exactly)
+    wire_vals = np.zeros((NUM_WIRES, n, 4), dtype="<u8")
+    for w in range(NUM_WIRES):
+        col = cs.wires[w]
+        if col:
+            wire_vals[w, : len(col)] = native.ints_to_limbs(col)
+    wire_dev = [ptpu.upload_mont(wire_vals[w]) for w in range(NUM_WIRES)]
+    wire_coeff_dev = [dp.intt_natural(e) for e in wire_dev]
+    wire_blinds = [[randint() for _ in range(2)] for _ in range(NUM_WIRES)]
+    wire_commits = [
+        _commit_blinded_evals(params, wire_vals[w], wire_blinds[w])
+        for w in range(NUM_WIRES)
+    ]
+    for cm in wire_commits:
+        tr.absorb_point(cm)
+
+    table_size = 1 << pk.lookup_bits if pk.lookup_bits else 1
+    m_vals = _lookup_multiplicities(cs, n, table_size)
+    m_dev = ptpu.upload_mont(m_vals)
+    m_coeff_dev = dp.intt_natural(m_dev)
+    m_blinds = [randint() for _ in range(2)]
+    m_commit = _commit_blinded_evals(params, m_vals, m_blinds)
+    tr.absorb_point(m_commit)
+
+    beta = tr.challenge()
+    gamma = tr.challenge()
+    beta_lk = tr.challenge()
+
+    # round 2: grand products on host kernels, commits from evals
+    omegas = np.zeros((n, 4), dtype="<u8")
+    omegas[:, 0] = 1
+    fk.coset_scale(omegas, d.omega)
+    z_vals = fk.perm_grand_product(wire_vals, pk.sigma_eval_limbs,
+                                   pk.shifts, omegas, beta, gamma)
+    z_dev = ptpu.upload_mont(z_vals)
+    z_coeff_dev = dp.intt_natural(z_dev)
+    z_blinds = [randint() for _ in range(3)]
+    z_commit = _commit_blinded_evals(params, z_vals, z_blinds)
+    tr.absorb_point(z_commit)
+
+    table_limbs = np.zeros((n, 4), dtype="<u8")
+    table_limbs[:table_size, 0] = np.arange(table_size, dtype=np.uint64)
+    phi_vals = fk.logup_running_sum(wire_vals[LOOKUP_WIRE], table_limbs,
+                                    m_vals, beta_lk)
+    phi_dev = ptpu.upload_mont(phi_vals)
+    phi_coeff_dev = dp.intt_natural(phi_dev)
+    phi_blinds = [randint() for _ in range(3)]
+    phi_commit = _commit_blinded_evals(params, phi_vals, phi_blinds)
+    tr.absorb_point(phi_commit)
+
+    alpha = tr.challenge()
+
+    # round 3 (device): ext chunks → quotient → 8n inverse → chunks
+    pi_vals = np.zeros((n, 4), dtype="<u8")
+    for row, value in zip(pk.public_rows, pubs):
+        _set_int(pi_vals, row, (-int(value)) % R)
+    pi_coeff_dev = dp.intt_natural(ptpu.upload_mont(pi_vals))
+
+    ch_planes = dp.challenge_planes(beta, gamma, beta_lk, alpha, pk.shifts)
+    t_chunks_fs = []
+    for j in range(8):
+        wires_e = [dp.ext_chunk(wire_coeff_dev[w], j, wire_blinds[w])
+                   for w in range(NUM_WIRES)]
+        z_e = dp.ext_chunk(z_coeff_dev, j, z_blinds)
+        m_e = dp.ext_chunk(m_coeff_dev, j, m_blinds)
+        phi_e = dp.ext_chunk(phi_coeff_dev, j, phi_blinds)
+        pi_e = dp.ext_chunk(pi_coeff_dev, j)
+        t_chunks_fs.append(dp.quotient_chunk(j, wires_e, z_e, m_e, phi_e,
+                                             pi_e, ch_planes))
+    t_coeff_chunks = dp.intt8(t_chunks_fs)
+    chunk_arrs = [ptpu.download_std(t_coeff_chunks[u])
+                  for u in range(QUOTIENT_CHUNKS)]
+    top = ptpu.download_std(t_coeff_chunks[QUOTIENT_CHUNKS])
+    if top.any():
+        raise EigenError(
+            "proving_error",
+            "quotient degree overflow — witness does not satisfy the circuit",
+        )
+    t_commits = [commit_limbs(params, ch) for ch in chunk_arrs]
+    for cm in t_commits:
+        tr.absorb_point(cm)
+    zeta = tr.challenge()
+
+    # round 4: ζ evaluations — barycentric on device + blind corrections
+    zh_zeta = (pow(zeta, n, R) - 1) % R
+    zeta_w = zeta * d.omega % R
+    zh_zeta_w = (pow(zeta_w, n, R) - 1) % R
+
+    def blind_corr(blinds, at, zh):
+        b = 0
+        xp = 1
+        for bi in blinds:
+            b = (b + bi * xp) % R
+            xp = xp * at % R
+        return b * zh % R
+
+    base_evals = dp.eval_at_many(
+        wire_dev + [m_dev, z_dev, phi_dev] + dp.fixed_evals
+        + dp.sigma_evals, zeta)
+    wire_evals = [
+        (base_evals[w] + blind_corr(wire_blinds[w], zeta, zh_zeta)) % R
+        for w in range(NUM_WIRES)
+    ]
+    m_eval = (base_evals[6] + blind_corr(m_blinds, zeta, zh_zeta)) % R
+    z_eval = (base_evals[7] + blind_corr(z_blinds, zeta, zh_zeta)) % R
+    phi_eval = (base_evals[8] + blind_corr(phi_blinds, zeta, zh_zeta)) % R
+    fixed_evals = base_evals[9 : 9 + len(FIXED_NAMES)]
+    sigma_zeta = base_evals[9 + len(FIXED_NAMES) :]
+    shifted_evals = dp.eval_at_many([z_dev, phi_dev], zeta_w)
+    z_next = (shifted_evals[0] + blind_corr(z_blinds, zeta_w, zh_zeta_w)) % R
+    phi_next = (shifted_evals[1]
+                + blind_corr(phi_blinds, zeta_w, zh_zeta_w)) % R
+    stacked = np.stack(chunk_arrs)
+    t_evals = [int(v) for v in fk.poly_eval_many(stacked, zeta)]
+
+    for v in (wire_evals + [m_eval, z_eval, z_next, phi_eval, phi_next]
+              + t_evals + fixed_evals + sigma_zeta):
+        tr.absorb_fr(v)
+    v_ch = tr.challenge()
+    tr.challenge()  # u — verifier-side fold
+
+    # batched openings: fold base coeffs on device, patch blinds on host
+    base_polys = (wire_coeff_dev + [m_coeff_dev, z_coeff_dev, phi_coeff_dev]
+                  + [t_coeff_chunks[u] for u in range(QUOTIENT_CHUNKS)]
+                  + dp.fixed_coeffs + dp.sigma_coeffs)
+    blind_map = {w: wire_blinds[w] for w in range(NUM_WIRES)}
+    blind_map[NUM_WIRES] = m_blinds
+    blind_map[NUM_WIRES + 1] = z_blinds
+    blind_map[NUM_WIRES + 2] = phi_blinds
+
+    def open_group_dev(poly_idx: list, polys_dev: list, at: int):
+        g_pows = []
+        g = 1
+        for _ in poly_idx:
+            g_pows.append(g)
+            g = g * v_ch % R
+        folded_dev = dp.fold_coeffs(polys_dev, g_pows)
+        folded = np.zeros((n + 3, 4), dtype="<u8")
+        folded[:n] = ptpu.download_std(folded_dev)
+        for gi, idx in zip(g_pows, poly_idx):
+            blinds = blind_map.get(idx)
+            if not blinds:
+                continue
+            for i, b in enumerate(blinds):
+                corr = gi * b % R
+                _set_int(folded, i, (_get_int(folded, i) - corr) % R)
+                _set_int(folded, n + i,
+                         (_get_int(folded, n + i) + corr) % R)
+        quotient = fk.poly_divide_linear(folded, at)
+        return commit_limbs(params, quotient)
+
+    all_idx = list(range(len(base_polys)))
+    w_x = open_group_dev(all_idx, base_polys, zeta)
+    w_wx = open_group_dev([NUM_WIRES + 1, NUM_WIRES + 2],
+                          [z_coeff_dev, phi_coeff_dev], zeta_w)
 
     proof = Proof(wire_commits, m_commit, z_commit, phi_commit, t_commits,
                   wire_evals, m_eval, z_eval, z_next, phi_eval, phi_next,

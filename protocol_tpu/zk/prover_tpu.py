@@ -1,0 +1,453 @@
+"""Device round-3/4 engine for the PLONK prover (TPU-resident).
+
+Replaces the host C++ extension-domain work inside ``prove_fast`` when
+the proving key is eval-form (FPK2) and a JAX device is available:
+
+- extension evaluation: the 8n coset splits into 8 size-n cosets
+  shift·ωₑʲ·H; each poly's ext chunk is ``ntt_tpu.ntt`` of its
+  coset-scaled coefficients (all chunks share one n-sized plan). A
+  blinded poly p + b·Z_H needs only the closed-form correction
+  zh_c·b(x) per chunk, because Z_H is the CONSTANT shift_jⁿ−1 on a
+  coset.
+- z(ωX), φ(ωX): multiplying the argument by ω_n stays inside a coset,
+  so the shifted polys are a static index roll of the unshifted chunk —
+  no extra NTTs.
+- the quotient identity (an exact twin of the C++ ``quotient_eval``)
+  runs pointwise per chunk in the limb-plane engine; Z_H and its
+  inverse are per-chunk scalars.
+- the 8n inverse NTT is 8 per-chunk iNTTs plus a radix-8 cross-chunk
+  combine (derivation at ``intt8``), emitting the quotient coefficient
+  chunks a[u·n:(u+1)·n] directly.
+- round 4: γ-power folds of the device-resident coefficient arrays
+  (host divides and commits) and barycentric ζ-evaluations from the
+  resident evals (host applies the blinding corrections).
+
+Every entry point is a module-level ``jax.jit`` function — through the
+remote-device tunnel, eager op-by-op dispatch is unusable, so the class
+methods only marshal constants (challenge scalars travel as (L, 1)
+Montgomery planes, never as traced Python ints).
+
+Everything is exact field arithmetic: t chunks, folds and evaluations
+are bit-identical to the host path (tested)."""
+
+from __future__ import annotations
+
+from functools import partial
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..ops import fieldops2 as f2
+from ..ops import ntt_tpu
+from ..utils.fields import BN254_FR_MODULUS as P
+
+L, L6 = f2.L, f2.L6
+
+
+def available() -> bool:
+    try:
+        return len(jax.devices()) > 0
+    except Exception:
+        return False
+
+
+def _mont(v: int) -> int:
+    return int(v) % P * f2.R_MONT % P
+
+
+def _cplane(v: int) -> jnp.ndarray:
+    """(L, 1) Montgomery plane of a host scalar (device constant arg)."""
+    return jnp.asarray(f2.ints_to_planes([_mont(v)]))
+
+
+@jax.jit
+def _enter(x):
+    return f2.enter_mont(x)
+
+
+@jax.jit
+def _to_u64_ready(x):
+    return f2.canonical(f2.exit_mont(x))
+
+
+def upload_mont(arr_u64: np.ndarray) -> jnp.ndarray:
+    """(n, 4) u64 standard → (L, n) Montgomery planes on device."""
+    return _enter(jnp.asarray(f2.pack_u64(np.ascontiguousarray(arr_u64))))
+
+
+def download_std(x: jnp.ndarray) -> np.ndarray:
+    """(L, n) Montgomery planes → (n, 4) u64 standard on host. The
+    explicit sync matters: through the remote-device tunnel, a bare
+    np.asarray can read back a buffer before its producer ran."""
+    ready = _to_u64_ready(x)
+    jax.block_until_ready(ready)
+    return f2.unpack_u64(np.asarray(ready))
+
+
+@partial(jax.jit, static_argnames=("n",))
+def _powers_impl(sq_planes: jnp.ndarray, n: int) -> jnp.ndarray:
+    out = jnp.asarray(f2.ints_to_planes([_mont(1)]))
+    t = 0
+    while out.shape[1] < n:
+        c = jnp.broadcast_to(sq_planes[:, t : t + 1], (L, out.shape[1]))
+        out = jnp.concatenate([out, f2.mont_mul(out, c)], axis=1)
+        t += 1
+    return out[:, :n]
+
+
+def powers_vector(base: int, n: int) -> jnp.ndarray:
+    """(L, n) Montgomery planes of (baseⁱ)_{i<n}: log-step doubling.
+    The base's repeated squares are host-computed and passed as data, so
+    every base shares one compiled program per n."""
+    nbits = max(1, (n - 1).bit_length())
+    sqs = []
+    sq = base % P
+    for _ in range(nbits):
+        sqs.append(_mont(sq))
+        sq = sq * sq % P
+    return _powers_impl(
+        jnp.asarray(f2.ints_to_planes(sqs)).reshape(L, nbits), n)
+
+
+def fs_from_natural(x: jnp.ndarray, A: int, B: int) -> jnp.ndarray:
+    """Natural-order (L, n) → FS layout (element i = k1 + k2·A moves to
+    flat k1·B + k2)."""
+    return x.reshape(L, B, A).transpose(0, 2, 1).reshape(L, A * B)
+
+
+def natural_from_fs(x: jnp.ndarray, A: int, B: int) -> jnp.ndarray:
+    return x.reshape(L, A, B).transpose(0, 2, 1).reshape(L, A * B)
+
+
+def _fs_roll_next(x: jnp.ndarray, A: int, B: int) -> jnp.ndarray:
+    """FS twin of "value at natural index i+1 (mod n)": p'(xᵢ)=p(ω·xᵢ)."""
+    m = x.reshape(L, A, B)
+    main = m[:, 1:, :]
+    wrap = jnp.roll(m[:, :1, :], -1, axis=2)
+    return jnp.concatenate([main, wrap], axis=1).reshape(L, A * B)
+
+
+fs_roll_next = _fs_roll_next  # public alias (pure reshapes, jit-safe)
+
+
+# --- jitted kernels ---------------------------------------------------------
+
+@partial(jax.jit, static_argnames=("nblinds",))
+def _ext_chunk_impl(coeffs, coset_pows, xs_fs, zh_plane, blind_planes,
+                    w_a, w_b, t16, nblinds: int):
+    scaled = f2.mont_mul(coeffs, coset_pows)
+    chunk = ntt_tpu._ntt_impl(scaled, w_a, w_b, t16)
+    if nblinds:
+        n = chunk.shape[1]
+        corr = jnp.broadcast_to(blind_planes[:, 0:1], (L, n))
+        xp = xs_fs
+        for i in range(1, nblinds):
+            corr = f2.add(corr, f2.mont_mul(
+                xp, jnp.broadcast_to(blind_planes[:, i : i + 1], (L, n))))
+            if i + 1 < nblinds:
+                xp = f2.mont_mul(xp, xs_fs)
+        chunk = f2.add(chunk, f2.mont_mul(
+            corr, jnp.broadcast_to(zh_plane, (L, n))))
+    return chunk
+
+
+@partial(jax.jit, static_argnames=("A", "B"))
+def _quotient_chunk_impl(wires, z_e, m_e, phi_e, pi_e, fixed16, sigma16,
+                         xs, l0, ch, zh_inv_plane, A: int, B: int):
+    """ch: (L, 10) planes of [beta, gamma, beta_lk, alpha, a2, a3, a4,
+    beta·shift_0.., ] — laid out below."""
+    n = A * B
+
+    def cc(idx):
+        return jnp.broadcast_to(ch[:, idx : idx + 1], (L, n))
+
+    one = f2._const_planes(_mont(1), n)
+    fx = [f2.unpack16(fixed16[i]) for i in range(9)]
+    sg = [f2.unpack16(sigma16[i]) for i in range(6)]
+    w = [wires[i] for i in range(6)]
+    zi, phii, mi, pii = z_e, phi_e, m_e, pi_e
+    zwi = _fs_roll_next(zi, A, B)
+    phiwi = _fs_roll_next(phii, A, B)
+
+    gate = f2.mont_mul(fx[0], w[0])
+    for kk in range(1, 5):
+        gate = f2.add(gate, f2.mont_mul(fx[kk], w[kk]))
+    gate = f2.add(gate, f2.mont_mul(fx[5], f2.mont_mul(w[0], w[1])))
+    gate = f2.add(gate, f2.mont_mul(fx[6], f2.mont_mul(w[2], w[3])))
+    gate = f2.add(gate, fx[7])
+    gate = f2.add(gate, pii)
+
+    # ch layout: 0 beta, 1 gamma, 2 beta_lk, 3 alpha, 4 a2, 5 a3, 6 a4,
+    # 7..12 beta·shift_k
+    pn, pd = zi, zwi
+    for kk in range(6):
+        f1 = f2.mont_mul(xs, cc(7 + kk))
+        f1 = f2.add(f2.add(f1, w[kk]), cc(1))
+        pn = f2.mont_mul(pn, f1)
+        g2 = f2.mont_mul(sg[kk], cc(0))
+        g2 = f2.add(f2.add(g2, w[kk]), cc(1))
+        pd = f2.mont_mul(pd, g2)
+    perm = f2.sub(pn, pd)
+
+    # LogUp: lk = (dphi·ba − 1)·bt + m·ba
+    ba = f2.add(w[5], cc(2))
+    bt = f2.add(fx[8], cc(2))
+    dphi = f2.sub(phiwi, phii)
+    lk = f2.mont_mul(dphi, ba)
+    lk = f2.sub(lk, one)
+    lk = f2.mont_mul(lk, bt)
+    lk = f2.add(lk, f2.mont_mul(mi, ba))
+
+    total = f2.add(gate, f2.mont_mul(perm, cc(3)))
+    zm1 = f2.sub(zi, one)
+    total = f2.add(total, f2.mont_mul(f2.mont_mul(l0, zm1), cc(4)))
+    total = f2.add(total, f2.mont_mul(lk, cc(5)))
+    total = f2.add(total, f2.mont_mul(f2.mont_mul(l0, phii), cc(6)))
+    return f2.mont_mul(total, jnp.broadcast_to(zh_inv_plane, (L, n)))
+
+
+@jax.jit
+def _combine8_impl(hats, zc_planes, s_neg_pows, su_planes):
+    """hats: (8, L, n) twiddled per-chunk iNTTs; zc_planes: (8, 8, L, 1)
+    ζ-DFT constants (already /8); su_planes: (8, L, 1) (s^{−n})^u."""
+    n = hats.shape[2]
+    chunks = []
+    for u in range(8):
+        acc = None
+        for j in range(8):
+            term = f2.mont_mul(
+                hats[j], jnp.broadcast_to(zc_planes[u, j], (L, n)))
+            acc = term if acc is None else f2.add(acc, term)
+        acc = f2.mont_mul(acc, s_neg_pows)
+        acc = f2.mont_mul(acc, jnp.broadcast_to(su_planes[u], (L, n)))
+        chunks.append(acc)
+    return jnp.stack(chunks)
+
+
+@jax.jit
+def _twiddle_mul(x, pows):
+    return f2.mont_mul(x, pows)
+
+
+@jax.jit
+def _fold_impl(polys, scalars):
+    """polys: (m, L, n); scalars: (m, L, 1) Montgomery → Σ scalarᵢ·pᵢ."""
+    m, _, n = polys.shape
+    acc = None
+    for i in range(m):
+        term = f2.mont_mul(polys[i], jnp.broadcast_to(scalars[i], (L, n)))
+        acc = term if acc is None else f2.add(acc, term)
+    return acc
+
+
+@jax.jit
+def _bary_weights_impl(zeta_plane, zh_plane, n_plane, omega_pows):
+    n = omega_pows.shape[1]
+    den = f2.mont_mul(
+        f2.sub(jnp.broadcast_to(zeta_plane, (L, n)), omega_pows),
+        jnp.broadcast_to(n_plane, (L, n)))
+    return f2.mont_mul(
+        f2.mont_mul(f2.batch_inv(den), omega_pows),
+        jnp.broadcast_to(zh_plane, (L, n)))
+
+
+@jax.jit
+def _sum_reduce_mont(prod: jnp.ndarray) -> jnp.ndarray:
+    """Exact Σ over lanes of (L, n) Montgomery-relaxed planes → (L, 1)."""
+    x = prod
+    extra = 0
+    while x.shape[1] > 1:
+        blk = 128 if x.shape[1] >= 128 else x.shape[1]
+        while x.shape[1] % blk:
+            blk //= 2
+        s = x.reshape(L, x.shape[1] // blk, blk).sum(axis=2)
+        # block sums carry limbs up to blk·2^13 — ripple back into CIOS
+        # range before the reducing multiply (128·2^13 = 2^20 < 2^31 is
+        # safe for the plain sum itself)
+        s = f2.ripple(s, passes=2)
+        x = f2.mont_mul(s, f2._const_planes(f2.R2_MONT, s.shape[1]))
+        extra += 1
+    fix = pow(f2.R_MONT, -extra, P) * f2.R_MONT % P
+    return f2.mont_mul(x, f2._const_planes(fix, 1))
+
+
+@jax.jit
+def _dots_impl(evals_stack, weights):
+    """evals_stack: (m, L, n); weights (L, n) → (m, L, 1) Σ eᵢ·w."""
+    outs = [
+        _sum_reduce_mont(f2.mont_mul(evals_stack[i], weights))
+        for i in range(evals_stack.shape[0])
+    ]
+    return jnp.stack(outs)
+
+
+@jax.jit
+def _xs_l0_impl(omega_pows, shift_plane, zh_plane, n_plane):
+    n = omega_pows.shape[1]
+    xs_nat = f2.mont_mul(omega_pows, jnp.broadcast_to(shift_plane, (L, n)))
+    one = f2._const_planes(_mont(1), n)
+    den = f2.mont_mul(f2.sub(xs_nat, one),
+                      jnp.broadcast_to(n_plane, (L, n)))
+    l0 = f2.mont_mul(f2.batch_inv(den),
+                     jnp.broadcast_to(zh_plane, (L, n)))
+    return xs_nat, l0
+
+
+class DeviceProver:
+    """Per-(k, shift, pk) device state: NTT plan, coset tables, and the
+    pk's fixed/sigma columns resident as evals + coeffs + packed ext
+    chunks (~4 GB at k=20)."""
+
+    def __init__(self, k: int, shift: int, fixed_evals_u64, sigma_evals_u64):
+        self.k = k
+        self.n = n = 1 << k
+        self.plan = ntt_tpu.NttPlan.get(k)
+        self.A, self.B = self.plan.A, self.plan.B
+        omega_e = ntt_tpu._root_of_unity(k + 3)     # order 8n
+        self.omega = self.plan.omega                # order n
+        self.omega_e = omega_e
+        self.shift = shift
+        self.shifts8 = [shift * pow(omega_e, j, P) % P for j in range(8)]
+        self.zh_c = [(pow(s, n, P) - 1) % P for s in self.shifts8]
+        self.zh_inv_c = [pow(z, -1, P) for z in self.zh_c]
+        self.zh_planes = [_cplane(z) for z in self.zh_c]
+        self.zh_inv_planes = [_cplane(z) for z in self.zh_inv_c]
+
+        self.omega_pows = powers_vector(self.omega, n)          # natural
+        self.coset_pows = [powers_vector(s, n) for s in self.shifts8]
+        n_plane = _cplane(n)
+        self.xs_fs, self.l0_fs = [], []
+        for j in range(8):
+            xs_nat, l0 = _xs_l0_impl(self.omega_pows,
+                                     _cplane(self.shifts8[j]),
+                                     self.zh_planes[j], n_plane)
+            self.xs_fs.append(fs_from_natural(xs_nat, self.A, self.B))
+            self.l0_fs.append(l0)
+
+        # pk columns: natural evals, coeffs, packed ext chunks
+        self.fixed_evals = [upload_mont(a) for a in fixed_evals_u64]
+        self.sigma_evals = [upload_mont(a) for a in sigma_evals_u64]
+        self.fixed_coeffs = [self.intt_natural(e) for e in self.fixed_evals]
+        self.sigma_coeffs = [self.intt_natural(e) for e in self.sigma_evals]
+        pk16 = jax.jit(f2.pack16)
+        self.fixed_ext = [
+            [pk16(self.ext_chunk(cf, j)) for j in range(8)]
+            for cf in self.fixed_coeffs
+        ]
+        self.sigma_ext = [
+            [pk16(self.ext_chunk(cf, j)) for j in range(8)]
+            for cf in self.sigma_coeffs
+        ]
+
+        # intt8 combine tables
+        self.we_neg_pows = [powers_vector(pow(omega_e, -j, P), n)
+                            for j in range(8)]
+        self.s_neg_pows = powers_vector(pow(shift, -1, P), n)
+        zeta8 = pow(omega_e, n, P)                  # primitive 8th root
+        inv8 = pow(8, -1, P)
+        s_n_inv = pow(shift, -n, P)
+        self.zc_planes = jnp.stack([
+            jnp.stack([_cplane(pow(zeta8, (-j * u) % 8, P) * inv8 % P)
+                       for j in range(8)])
+            for u in range(8)
+        ])
+        self.su_planes = jnp.stack(
+            [_cplane(pow(s_n_inv, u, P)) for u in range(8)])
+
+        self._bary: dict = {}
+
+    # --- transforms -------------------------------------------------------
+
+    def intt_natural(self, evals_nat: jnp.ndarray) -> jnp.ndarray:
+        """Natural-order evals on H → natural-order coefficients."""
+        return ntt_tpu.intt(fs_from_natural(evals_nat, self.A, self.B),
+                            self.plan)
+
+    def ext_chunk(self, coeffs: jnp.ndarray, j: int,
+                  blinds=None) -> jnp.ndarray:
+        """One FS-layout ext chunk of a (possibly blinded) polynomial."""
+        if blinds:
+            bp = jnp.asarray(
+                f2.ints_to_planes([_mont(b) for b in blinds]))
+            nb = len(blinds)
+        else:
+            bp = jnp.zeros((L, 1), jnp.int32)
+            nb = 0
+        return _ext_chunk_impl(coeffs, self.coset_pows[j], self.xs_fs[j],
+                               self.zh_planes[j], bp, self.plan.W_A,
+                               self.plan.W_B, self.plan.T16, nb)
+
+    def ext_chunks(self, coeffs: jnp.ndarray, blinds=None) -> list:
+        return [self.ext_chunk(coeffs, j, blinds) for j in range(8)]
+
+    # --- quotient ---------------------------------------------------------
+
+    def challenge_planes(self, beta, gamma, beta_lk, alpha, shifts):
+        a2 = alpha * alpha % P
+        a3 = a2 * alpha % P
+        a4 = a3 * alpha % P
+        vals = [beta, gamma, beta_lk, alpha, a2, a3, a4] + \
+            [beta * s % P for s in shifts]
+        return jnp.concatenate([_cplane(v) for v in vals], axis=1)
+
+    def quotient_chunk(self, j, wires_e, z_e, m_e, phi_e, pi_e,
+                       ch_planes) -> jnp.ndarray:
+        """Device twin of the C++ quotient_eval on coset chunk j;
+        ``ch_planes`` from :meth:`challenge_planes`."""
+        return _quotient_chunk_impl(
+            jnp.stack(wires_e), z_e, m_e, phi_e, pi_e,
+            jnp.stack([self.fixed_ext[i][j] for i in range(9)]),
+            jnp.stack([self.sigma_ext[i][j] for i in range(6)]),
+            self.xs_fs[j], self.l0_fs[j], ch_planes,
+            self.zh_inv_planes[j], self.A, self.B)
+
+    # --- 8n inverse -------------------------------------------------------
+
+    def intt8(self, t_chunks: list) -> jnp.ndarray:
+        """FS coset chunks of t → (8, L, n) coefficient chunks
+        a[u·n:(u+1)·n] (derivation: iNTT_n folds coefficients; after the
+        ωₑ^{−jd} twiddle, an 8-point inverse DFT across chunks recovers
+        b_u[d] = a_{d+un}·s^{d+un}, then the s-power unscale)."""
+        hats = []
+        for j in range(8):
+            cj = ntt_tpu.intt(t_chunks[j], self.plan)
+            hats.append(_twiddle_mul(cj, self.we_neg_pows[j]))
+        return _combine8_impl(jnp.stack(hats), self.zc_planes,
+                              self.s_neg_pows, self.su_planes)
+
+    # --- round 4 ----------------------------------------------------------
+
+    def fold_coeffs(self, polys: list, scalars: list) -> jnp.ndarray:
+        """Σ scalarᵢ·pᵢ over same-length device coeff arrays."""
+        sc = jnp.stack([_cplane(s) for s in scalars])
+        return _fold_impl(jnp.stack(polys), sc)
+
+    def barycentric_weights(self, zeta: int) -> jnp.ndarray:
+        key = zeta % P
+        w = self._bary.get(key)
+        if w is None:
+            zh = (pow(zeta, self.n, P) - 1) % P
+            w = _bary_weights_impl(_cplane(zeta), _cplane(zh),
+                                   _cplane(self.n), self.omega_pows)
+            self._bary = {key: w}
+        return w
+
+    def eval_at_many(self, evals_list: list, zeta: int) -> list:
+        """[pᵢ(ζ)] from natural-order eval arrays (deg pᵢ < n)."""
+        w = self.barycentric_weights(zeta)
+        outs = _dots_impl(jnp.stack(evals_list), w)
+        res = []
+        # outs is (m, L, 1): move the limb-plane axis first — a raw
+        # reshape would interleave planes across polynomials
+        stacked = outs.transpose(1, 0, 2).reshape(L, -1)
+        ready = _to_u64_ready(stacked)
+        jax.block_until_ready(ready)
+        host = f2.unpack_u64(np.asarray(ready))
+        for i in range(len(evals_list)):
+            res.append(int.from_bytes(host[i].tobytes(), "little"))
+        return res
+
+    def eval_at(self, evals_nat: jnp.ndarray, zeta: int) -> int:
+        return self.eval_at_many([evals_nat], zeta)[0]

@@ -51,3 +51,53 @@ class PoseidonTranscript:
         self.rounds += 1
         self.sponge.update([Fr(self.rounds)])
         return int(self.sponge.squeeze())
+
+
+class KeccakTranscript:
+    """Keccak-256 Fiat–Shamir transcript — the on-chain-cheap variant.
+
+    The reference's EVM proofs use snark-verifier's keccak
+    ``EvmTranscript`` (``verifier/mod.rs:116-145``) because one keccak
+    of the absorbed data costs ~hundreds of gas where a Poseidon
+    permutation costs tens of thousands. Same trade here; the native
+    and generated-Yul sides replay the identical byte layout:
+
+        challenge = keccak256(state ‖ absorbed 32-byte words ‖ round)
+        state    ← challenge
+
+    Points absorb as x‖y big-endian words (identity = two zero words —
+    unambiguous, since (0, 0) is not on the curve)."""
+
+    def __init__(self, label: bytes = b"protocol-tpu-plonk"):
+        from ..utils.keccak import keccak256
+
+        self._keccak = keccak256
+        self.state = keccak256(label)
+        self.buf = bytearray()
+        self.rounds = 0
+
+    def absorb_fr(self, value: int) -> None:
+        self.buf += (int(value) % Fr.MODULUS).to_bytes(32, "big")
+
+    def absorb_point(self, pt) -> None:
+        if pt is None:
+            self.buf += b"\x00" * 64
+            return
+        x, y = pt
+        self.buf += int(x).to_bytes(32, "big") + int(y).to_bytes(32, "big")
+
+    def challenge(self) -> int:
+        self.rounds += 1
+        data = self.state + bytes(self.buf) + self.rounds.to_bytes(32, "big")
+        self.state = self._keccak(data)
+        self.buf.clear()
+        return int.from_bytes(self.state, "big") % Fr.MODULUS
+
+
+def make_transcript(kind: str = "poseidon"):
+    """Transcript factory shared by prover and verifier paths."""
+    if kind == "poseidon":
+        return PoseidonTranscript()
+    if kind == "keccak":
+        return KeccakTranscript()
+    raise ValueError(f"unknown transcript kind {kind!r}")

@@ -18,6 +18,8 @@ Supported subset (everything the generator emits):
 - builtins: 256-bit ``add sub mul div mod addmod mulmod exp lt gt eq
   iszero and or xor not shl shr``, ``mload mstore calldataload
   calldatasize staticcall revert return stop pop``;
+- ``keccak256(offset, size)`` with the yellow-paper gas schedule
+  (30 + 6/word) — the keccak-transcript verifier's workhorse;
 - precompiles via ``staticcall``: 0x05 modexp (fixed 32/32/32 layout),
   0x06 ecAdd, 0x07 ecMul, 0x08 ecPairing (BN254).
 
@@ -503,6 +505,12 @@ class YulVM:
         if name == "mstore":
             self._mem_write(a[0], a[1].to_bytes(32, "big"))
             return 0
+        if name == "keccak256":
+            data = self._mem(a[0], a[1])
+            self.gas += 30 + 6 * ((len(data) + 31) // 32)
+            from ..utils.keccak import keccak256 as _k
+
+            return int.from_bytes(_k(bytes(data)), "big")
         if name == "calldataload":
             chunk = self.calldata[a[0]:a[0] + 32]
             return int.from_bytes(chunk.ljust(32, b"\x00"), "big")

@@ -14,9 +14,8 @@ from protocol_tpu.zk.plonk import ConstraintSystem, keygen, prove
 from protocol_tpu.zk.yul import VMRevert, YulVM
 
 
-@pytest.fixture(scope="module")
-def snark():
-    """Small real proof exercising every selector + the lookup table."""
+def _build_circuit() -> Chips:
+    """Small real circuit exercising every selector + the lookup table."""
     c = Chips(ConstraintSystem(lookup_bits=4))
     x, y = c.witness(3), c.witness(4)
     s = c.add(x, y)
@@ -27,6 +26,12 @@ def snark():
     c.public(out)
     c.public(x)
     c.cs.check_satisfied()
+    return c
+
+
+@pytest.fixture(scope="module")
+def snark():
+    c = _build_circuit()
     params = KZGParams.setup(8, seed=b"evm-test")
     pk = keygen(params, c.cs)
     proof = prove(params, pk, c.cs)
@@ -172,3 +177,57 @@ class TestEvmVerifier:
         code = evm.gen_evm_verifier_code(params, vk)
         ok, _ = evm.evm_verify(code, evm.encode_calldata(pubs, proof))
         assert ok
+
+
+class TestKeccakTranscriptVariant:
+    """VERDICT round 1, item 8: the keccak-transcript verifier — the
+    reference's snark-verifier EVM shape (verifier/mod.rs:116-145) —
+    must verify keccak-transcript proofs at a fraction of the Poseidon
+    variant's gas."""
+
+    @pytest.fixture(scope="class")
+    def kc(self, snark):
+        params, pk, pubs, _ = snark
+        # re-prove under the keccak transcript (the EVM-targeted flow)
+        from protocol_tpu.zk.plonk import prove as plonk_prove
+
+        cs = _build_circuit().cs
+        proof = plonk_prove(params, pk, cs, transcript="keccak")
+        verifier = evm.gen_evm_verifier_code(params, pk,
+                                             transcript="keccak")
+        return params, pk, pubs, proof, verifier
+
+    def test_native_keccak_cycle(self, kc):
+        params, pk, pubs, proof, _ = kc
+        from protocol_tpu.zk.plonk import verify as plonk_verify
+
+        assert plonk_verify(params, pk, pubs, proof, transcript="keccak")
+        # a poseidon-transcript verify of a keccak proof must fail
+        assert not plonk_verify(params, pk, pubs, proof)
+
+    def test_evm_verifies_and_gas_under_600k(self, kc):
+        params, pk, pubs, proof, verifier = kc
+        ok, gas = evm.evm_verify(verifier, evm.encode_calldata(pubs, proof))
+        assert ok
+        assert gas < 600_000, f"keccak-variant gas {gas}"
+
+    def test_tamper_rejected(self, kc):
+        params, pk, pubs, proof, verifier = kc
+        bad = bytearray(proof)
+        bad[70] ^= 1
+        ok, _ = evm.evm_verify(verifier, evm.encode_calldata(pubs,
+                                                            bytes(bad)))
+        assert not ok
+
+    def test_poseidon_variant_unchanged(self, snark, kc):
+        """Both variants coexist: the poseidon verifier still accepts
+        poseidon proofs and rejects keccak ones."""
+        params, pk, pubs, proof_p = snark
+        _, _, _, proof_k, _ = kc
+        verifier_p = evm.gen_evm_verifier_code(params, pk)
+        ok, gas_p = evm.evm_verify(verifier_p,
+                                   evm.encode_calldata(pubs, proof_p))
+        assert ok
+        ok2, _ = evm.evm_verify(verifier_p,
+                                evm.encode_calldata(pubs, proof_k))
+        assert not ok2

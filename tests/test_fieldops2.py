@@ -124,3 +124,25 @@ def test_reduce_mxu_planes_lazy_sums(vals):
               for j in range(n)]
     out = f2.reduce_mxu_planes(jnp.asarray(lazy, dtype=jnp.int32))
     assert [v % P for v in f2.planes_to_ints(out)] == expect
+
+
+def test_dots_impl_multi_poly_ordering():
+    """Review regression: eval_at_many's stacked reductions must not
+    interleave limb planes across polynomials."""
+    from protocol_tpu.zk import prover_tpu as ptpu
+
+    n = 64
+    vals0 = [(7 * i + 3) % P for i in range(n)]
+    vals1 = [(11 * i + 5) % P for i in range(n)]
+    w_vals = [(13 * i + 1) % P for i in range(n)]
+    e0 = f2.enter_mont(jnp.asarray(f2.ints_to_planes(vals0)))
+    e1 = f2.enter_mont(jnp.asarray(f2.ints_to_planes(vals1)))
+    w = f2.enter_mont(jnp.asarray(f2.ints_to_planes(w_vals)))
+    outs = ptpu._dots_impl(jnp.stack([e0, e1]), w)
+    stacked = outs.transpose(1, 0, 2).reshape(f2.L, -1)
+    host = f2.unpack_u64(
+        __import__("numpy").asarray(ptpu._to_u64_ready(stacked)))
+    got = [int.from_bytes(host[i].tobytes(), "little") for i in range(2)]
+    exp = [sum(a * b for a, b in zip(vs, w_vals)) % P
+           for vs in (vals0, vals1)]
+    assert got == exp

@@ -1,0 +1,115 @@
+"""Live-RPC integration tests against the in-repo mock devnet
+(VERDICT round 1, item 6): deploy with the vendored AttestationStation
+bytecode, attest via signed raw transactions with sender recovery,
+read logs back, and run the full client scores flow over HTTP —
+the reference's Anvil-pattern (``eigentrust/src/lib.rs:695-788``)
+without an external node."""
+
+import pytest
+
+from protocol_tpu.client.chain import RpcChain
+from protocol_tpu.client.eth import (
+    address_from_public_key,
+    ecdsa_keypairs_from_mnemonic,
+)
+from protocol_tpu.client.mocknode import MockNode
+from protocol_tpu.utils.errors import EigenError
+
+MNEMONIC = ("test test test test test test test test test test test junk")
+
+
+@pytest.fixture()
+def node():
+    n = MockNode()
+    url = n.start()
+    yield n, url
+    n.stop()
+
+
+def test_deploy_attest_logs_roundtrip(node):
+    _, url = node
+    kps = ecdsa_keypairs_from_mnemonic(MNEMONIC, 2)
+    chain = RpcChain.deploy_signed(url, kps[0])
+    assert len(chain.contract_address) == 20
+
+    about = address_from_public_key(kps[1].public_key)
+    key = b"\x11" * 32
+    chain.attest_signed(kps[0], [(about, key, b"payload-bytes")])
+
+    logs = chain.get_logs()
+    assert len(logs) == 1
+    creator = address_from_public_key(kps[0].public_key)
+    assert logs[0].creator == creator
+    assert logs[0].about == about
+    assert logs[0].key == key
+    assert logs[0].val == b"payload-bytes"
+
+    # the attestations(address,address,bytes32) view over eth_call
+    assert chain.get_attestation(creator, about, key) == b"payload-bytes"
+    assert chain.get_attestation(about, creator, key) == b""
+
+
+def test_deploy_address_matches_create_semantics(node):
+    """Two deploys from one sender land at distinct, nonce-derived
+    addresses; the receipt reports the same address."""
+    n, url = node
+    kp = ecdsa_keypairs_from_mnemonic(MNEMONIC, 1)[0]
+    c1 = RpcChain.deploy_signed(url, kp)
+    c2 = RpcChain.deploy_signed(url, kp)
+    assert c1.contract_address != c2.contract_address
+    assert c1.contract_address in n.contracts
+    assert c2.contract_address in n.contracts
+
+
+def test_bad_nonce_rejected(node):
+    _, url = node
+    from protocol_tpu.client.chain import abi_encode_attest
+    from protocol_tpu.client.eth import sign_legacy_tx
+
+    kps = ecdsa_keypairs_from_mnemonic(MNEMONIC, 1)
+    chain = RpcChain.deploy_signed(url, kps[0])
+    raw = sign_legacy_tx(kps[0], nonce=99, gas_price=1, gas=100000,
+                         to=chain.contract_address, value=0,
+                         data=abi_encode_attest([(b"\x01" * 20, b"\x02" * 32,
+                                                  b"v")]),
+                         chain_id=chain.chain_id)
+    with pytest.raises(EigenError, match="nonce"):
+        chain.rpc("eth_sendRawTransaction", ["0x" + raw.hex()])
+
+
+def test_full_client_scores_over_rpc(node):
+    """The reference's end-to-end integration shape: deploy, every peer
+    attests every other over raw txs, then the client fetches the logs
+    over eth_getLogs and converges scores (lib.rs test_get_logs +
+    handle_scores Fetch)."""
+    from protocol_tpu.client import Client, ClientConfig
+
+    _, url = node
+    deployer = ecdsa_keypairs_from_mnemonic(MNEMONIC, 1)[0]
+    chain = RpcChain.deploy_signed(url, deployer)
+
+    config = ClientConfig(
+        as_address="0x" + chain.contract_address.hex(),
+        node_url=url,
+        chain_id="31337",
+        domain="0x" + "00" * 20,
+    )
+    client = Client(config, MNEMONIC)
+    assert isinstance(client.chain, RpcChain)
+
+    n_peers = 3
+    kps = ecdsa_keypairs_from_mnemonic(MNEMONIC, n_peers)
+    addrs = [address_from_public_key(kp.public_key) for kp in kps]
+    for i in range(n_peers):
+        client.keypairs[0] = kps[i]  # rotate the signing identity
+        for j in range(n_peers):
+            if i == j:
+                continue
+            client.attest(addrs[j], 5 + (i + j) % 3)
+
+    atts = client.get_attestations()
+    assert len(atts) == n_peers * (n_peers - 1)
+    scores = client.calculate_scores(atts)
+    assert len(scores) == n_peers
+    total = sum(s.score_int for s in scores)
+    assert abs(total - n_peers * 1000) <= n_peers  # integer division slack
