@@ -13,8 +13,14 @@
 // (driven by protocol_tpu/native/__init__.py, which caches the .so).
 
 #include <algorithm>
+#include <atomic>
+#include <cstdlib>
+#ifdef __linux__
+#include <sched.h>
+#endif
 #include <cstdint>
 #include <cstring>
+#include <thread>
 #include <vector>
 
 typedef unsigned __int128 u128;
@@ -1551,14 +1557,33 @@ struct PlanCtx {
     i64 E;
     const i32 *bits;
     i32 nlevels;
-    std::vector<std::vector<i32>> mid;    // per-level middle perms
-    std::vector<i32> isrc;                // shared source-row scratch
-    std::vector<u8> color;                // shared color scratch
-    ColorScratch cscratch;                // shared walk scratch
 };
 
-static void plan_rec(PlanCtx &C, const i32 *perm_l, i64 El, i64 slot_off,
-                     i32 level) {
+// per-walker scratch: the recursion below a fork point runs entirely in
+// one of these, so independent sub-splits can run on separate threads
+struct SubScratch {
+    std::vector<std::vector<i32>> mid;    // per-level middle perms
+    std::vector<i32> isrc;
+    std::vector<u8> color;
+    ColorScratch cscratch;
+
+    void ensure(i64 El, i32 level, i32 nlevels) {
+        if ((i64)isrc.size() < El) {
+            isrc.resize(El);
+            color.resize(El);
+        }
+        cscratch.ensure(El, El >> 7);
+        if ((i64)mid.size() < (size_t)nlevels) mid.resize(nlevels);
+        i64 sz = El;
+        for (i32 l = level; l < nlevels - 1; ++l) {
+            if ((i64)mid[l].size() < sz) mid[l].resize(sz);
+            sz >>= 7;
+        }
+    }
+};
+
+static void plan_rec(PlanCtx &C, SubScratch &S, const i32 *perm_l, i64 El,
+                     i64 slot_off, i32 level) {
     i32 nstages = 2 * C.nlevels - 1;
     if (level == C.nlevels - 1) {
         i32 r = 1 << C.bits[level];
@@ -1570,14 +1595,14 @@ static void plan_rec(PlanCtx &C, const i32 *perm_l, i64 El, i64 slot_off,
         return;
     }
     i64 ml = El >> 7;
-    i32 *isrc = C.isrc.data();
+    i32 *isrc = S.isrc.data();
     for (i64 d = 0; d < El; ++d) isrc[d] = perm_l[d] >> 7;
-    u8 *color = C.color.data();
-    color_edges(isrc, El, ml, 128, C.cscratch, color);
+    u8 *color = S.color.data();
+    color_edges(isrc, El, ml, 128, S.cscratch, color);
 
     u8 *st_in = C.stages + (i64)level * C.E;
     u8 *st_out = C.stages + (i64)(nstages - 1 - level) * C.E;
-    i32 *mid = C.mid[level].data();
+    i32 *mid = S.mid[level].data();
     for (i64 d = 0; d < El; ++d) {
         i64 i = isrc[d];
         i64 k = color[d];
@@ -1585,8 +1610,47 @@ static void plan_rec(PlanCtx &C, const i32 *perm_l, i64 El, i64 slot_off,
         st_out[slot_off + d] = (u8)k;
         mid[k * ml + (d >> 7)] = (i32)i;
     }
+    if (level == 0 && C.nlevels > 2) {
+        // the 128 sub-splits are independent (disjoint slot ranges):
+        // fan them out across hardware threads, each with its own
+        // scratch. The level-0 coloring above is the serial fraction
+        // (1/nlevels of total coloring work).
+        unsigned nt = 0;
+        if (const char *env = std::getenv("CLOS_PLAN_THREADS"))
+            nt = (unsigned)std::atoi(env);
+        if (!nt) {
+#ifdef __linux__
+            // the AFFINITY count, not hardware_concurrency: containers
+            // often expose all host threads while pinning one core, and
+            // oversubscribing the cache-hostile walk is ~3x slower
+            cpu_set_t set;
+            if (sched_getaffinity(0, sizeof(set), &set) == 0)
+                nt = (unsigned)CPU_COUNT(&set);
+#endif
+            if (!nt) nt = std::thread::hardware_concurrency();
+        }
+        if (nt > 16) nt = 16;
+        if (nt > 1) {
+            std::atomic<i64> next(0);
+            auto worker = [&]() {
+                SubScratch local;
+                local.ensure(ml, 1, C.nlevels);
+                for (;;) {
+                    i64 k = next.fetch_add(1);
+                    if (k >= 128) break;
+                    plan_rec(C, local, mid + k * ml, ml,
+                             slot_off + k * ml, 1);
+                }
+            };
+            std::vector<std::thread> pool;
+            for (unsigned t = 0; t < nt; ++t)
+                pool.emplace_back(worker);
+            for (auto &th : pool) th.join();
+            return;
+        }
+    }
     for (i64 k = 0; k < 128; ++k)
-        plan_rec(C, mid + k * ml, ml, slot_off + k * ml, level + 1);
+        plan_rec(C, S, mid + k * ml, ml, slot_off + k * ml, level + 1);
 }
 
 }  // namespace clos_planner
@@ -1625,15 +1689,10 @@ int clos_plan(const int32_t *perm, int64_t E, const int32_t *bits,
     C.E = E;
     C.bits = bits;
     C.nlevels = nlevels;
-    C.mid.resize(nlevels);
-    if (nlevels > 1) {
-        C.isrc.resize(E);
-        C.color.resize(E);
-        C.cscratch.ensure(E, E >> 7);
-        for (i32 l = 0; l < nlevels - 1; ++l)
-            C.mid[l].resize(E >> (7 * l));
-    }
-    plan_rec(C, perm, E, 0, 0);
+    SubScratch S;
+    if (nlevels > 1) S.ensure(E, 0, nlevels);
+    else S.mid.resize(1);
+    plan_rec(C, S, perm, E, 0, 0);
     return 0;
 }
 

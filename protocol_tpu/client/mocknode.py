@@ -30,12 +30,7 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 from ..crypto.secp256k1 import Signature, recover_public_key
 from ..utils.keccak import keccak256
-from .chain import (
-    ATTEST_SELECTOR,
-    EVENT_TOPIC,
-    LocalChain,
-    abi_decode_bytes,
-)
+from .chain import ATTEST_SELECTOR, EVENT_TOPIC, LocalChain
 from .eth import address_from_public_key, rlp_encode
 
 ATTESTATIONS_SELECTOR = keccak256(b"attestations(address,address,bytes32)")[:4]

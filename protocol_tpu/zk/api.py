@@ -62,17 +62,21 @@ def _keygen(params, cs):
     from .prover_fast import available, keygen_fast
 
     if available():
-        return keygen_fast(params, cs)
+        # "auto": eval-form key (no keygen iNTTs, 8× faster at k=20)
+        # whenever the params carry a matching Lagrange basis
+        return keygen_fast(params, cs, eval_pk="auto")
     from .plonk import keygen
 
     return keygen(params, cs)
 
 
 def _prove(params, pk, cs):
-    from .prover_fast import FastProvingKey, prove_fast
+    from .prover_fast import FastProvingKey, prove_auto
 
     if isinstance(pk, FastProvingKey):
-        return prove_fast(params, pk, cs)
+        # TPU round-3/4 when a device + eval-form key are available;
+        # degrades to the host path on any device fault
+        return prove_auto(params, pk, cs)
     from .plonk import prove
 
     return prove(params, pk, cs)

@@ -97,12 +97,39 @@ class EdwardsChip:
                           c.select(bit, p.y, q.y),
                           c.select(bit, p.z, q.z))
 
+    def _assert_bits_below(self, bits: list, bound: int) -> None:
+        """Constrain the little-endian bit cells to compose a value
+        STRICTLY below ``bound`` (MSB-down lexicographic scan). Without
+        this, a 254-bit decomposition of an Fr element is non-canonical:
+        bits of value+R also satisfy ``to_bits``, letting a prover
+        smuggle a different effective scalar into ``mul_scalar``."""
+        c = self.chips
+        eq = c.constant(1)
+        lt = c.constant(0)
+        for i in range(len(bits) - 1, -1, -1):
+            b = (bound >> i) & 1
+            x = bits[i]
+            if b == 1:
+                lt = c.logic_or(lt, c.logic_and(eq, c.logic_not(x)))
+                eq = c.logic_and(eq, x)
+            else:
+                eq = c.logic_and(eq, c.logic_not(x))
+        c.assert_equal(lt, c.constant(1))
+
     def mul_scalar(self, p: PointCells, scalar: Cell,
-                   num_bits: int = 254) -> PointCells:
+                   num_bits: int = 254,
+                   canonical_below: int | None = None) -> PointCells:
         """Double-and-add over the scalar's little-endian bits (the
-        native ``mul_scalar`` loop with a select per bit)."""
+        native ``mul_scalar`` loop with a select per bit).
+
+        ``canonical_below``: when the scalar's range admits a second
+        valid decomposition (num_bits wide enough to hold value+R), pass
+        the tight bound so the bits are pinned to the canonical ones —
+        soundness, not just correctness."""
         c = self.chips
         bits = c.to_bits(scalar, num_bits)
+        if canonical_below is not None:
+            self._assert_bits_below(bits, canonical_below)
         acc = PointCells(c.constant(0), c.constant(1), c.constant(1))
         exp = p
         for bit in bits:
@@ -142,8 +169,13 @@ class EddsaChip:
         c.assert_equal(ok, c.constant(1))
 
         h = self.poseidon.hash([big_r.x, big_r.y, pk.x, pk.y, msg])
+        # s ≤ SUBORDER < 2^252, and s + R > 2^252: 252 bits make the
+        # decomposition canonical by range alone. h is a full-width Fr
+        # element, so its 254-bit decomposition needs the explicit
+        # canonical bound (review finding: bits of h + R would otherwise
+        # also satisfy the decomposition, verifying forged signatures).
         cl = self.ed.mul_scalar(self.ed.constant_point(EdwardsPoint.b8()),
-                                s_cell)
-        pk_h = self.ed.mul_scalar(pk, h)
+                                s_cell, num_bits=252)
+        pk_h = self.ed.mul_scalar(pk, h, num_bits=254, canonical_below=R)
         cr = self.ed.add(big_r, pk_h)
         self.ed.assert_points_equal(cl, cr)

@@ -30,8 +30,10 @@ from .kzg import KZGParams
 from .plonk import FIXED_NAMES, NUM_WIRES, QUOTIENT_CHUNKS
 from .yul import VMRevert, YulVM
 
+from .transcript import TRANSCRIPT_LABEL
+
 # transcript label seed (PoseidonTranscript's default label)
-_LABEL_SEED = int.from_bytes(b"protocol-tpu-plonk", "little") % R
+_LABEL_SEED = int.from_bytes(TRANSCRIPT_LABEL, "little") % R
 
 _NPTS = NUM_WIRES + 3 + QUOTIENT_CHUNKS  # wires, m, z, phi, t chunks
 _NEVALS = NUM_WIRES + 5 + QUOTIENT_CHUNKS + len(FIXED_NAMES) + NUM_WIRES
@@ -133,7 +135,7 @@ def gen_evm_verifier_code(params: KZGParams, vk,
     else:
         from ..utils.keccak import keccak256 as _k
 
-        seed = int.from_bytes(_k(b"protocol-tpu-plonk"), "big")
+        seed = int.from_bytes(_k(TRANSCRIPT_LABEL), "big")
         emit(f"mstore({_hx(_STATE)}, {_hx(seed)})")
     for i, row in enumerate(vk.public_rows):
         emit(f"mstore({_hx(_WTAB + 32 * i)}, {_hx(pow(d.omega, row, R))})")

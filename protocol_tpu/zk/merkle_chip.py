@@ -9,8 +9,6 @@ Poseidon chip, and the last row's first cell is the root."""
 
 from __future__ import annotations
 
-from typing import Sequence
-
 from ..crypto.merkle import WIDTH, MerklePath
 from .gadgets import Cell, Chips
 from .poseidon_chip import PoseidonChip

@@ -372,6 +372,8 @@ def keygen_fast(params: KZGParams, cs: ConstraintSystem,
     # iNTT in place for the pk polys
     use_lagrange = (params.g1_lagrange is not None
                     and len(params.g1_lagrange) == n)
+    if eval_pk == "auto":
+        eval_pk = use_lagrange
     if eval_pk and not use_lagrange:
         raise EigenError(
             "proving_error",
@@ -543,6 +545,38 @@ def _lookup_multiplicities(cs: ConstraintSystem, n: int,
     m_vals = np.zeros((n, 4), dtype="<u8")
     m_vals[:table_size, 0] = m_small
     return m_vals
+
+
+def prove_auto(params: KZGParams, pk: FastProvingKey, cs: ConstraintSystem,
+               public_inputs=None, transcript: str = "poseidon") -> bytes:
+    """Prove with the TPU round-3/4 engine when an accelerator and an
+    eval-form key are present, falling back to the host path on any
+    device failure (the remote-tunnel worker can fault mid-session; the
+    host path is bit-compatible, so callers only lose speed). Blinding
+    uses fresh randomness per attempt, so the fallback is sound."""
+    from . import prover_tpu
+
+    use_tpu = False
+    if pk.eval_form:
+        try:
+            import jax
+
+            use_tpu = jax.devices()[0].platform in ("tpu", "axon")
+        except Exception:
+            use_tpu = False
+    if use_tpu:
+        try:
+            return prove_fast_tpu(params, pk, cs,
+                                  public_inputs=public_inputs,
+                                  transcript=transcript)
+        except Exception as e:  # device fault → host fallback
+            import sys
+
+            print(f"warning: TPU prove failed ({type(e).__name__}: "
+                  f"{str(e)[:120]}); falling back to the host path",
+                  file=sys.stderr)
+    return prove_fast(params, pk, cs, public_inputs=public_inputs,
+                      transcript=transcript)
 
 
 def prove_fast(params: KZGParams, pk: FastProvingKey, cs: ConstraintSystem,

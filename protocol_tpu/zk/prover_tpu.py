@@ -46,13 +46,6 @@ from ..utils.fields import BN254_FR_MODULUS as P
 L, L6 = f2.L, f2.L6
 
 
-def available() -> bool:
-    try:
-        return len(jax.devices()) > 0
-    except Exception:
-        return False
-
-
 def _mont(v: int) -> int:
     return int(v) % P * f2.R_MONT % P
 
@@ -303,6 +296,14 @@ class DeviceProver:
     def __init__(self, k: int, shift: int, fixed_evals_u64, sigma_evals_u64):
         self.k = k
         self.n = n = 1 << k
+        # pre-compile the upload/download programs at the working shape
+        # BEFORE the heavy jit battery: the remote worker has repeatedly
+        # faulted when the download program compiles after dozens of
+        # large programs are resident (tunnel instability), and warming
+        # it first also gives retry wrappers a clean failure point
+        warm = np.zeros((n, 4), dtype="<u8")
+        warm[:, 0] = 1
+        download_std(upload_mont(warm))
         self.plan = ntt_tpu.NttPlan.get(k)
         self.A, self.B = self.plan.A, self.plan.B
         omega_e = ntt_tpu._root_of_unity(k + 3)     # order 8n

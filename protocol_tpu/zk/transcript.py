@@ -20,12 +20,17 @@ from ..utils.fields import Fr
 
 _MASK128 = (1 << 128) - 1
 
+# Single source of truth for the Fiat-Shamir domain label: the native
+# transcripts AND the generated Yul verifiers derive their initial state
+# from this exact byte string.
+TRANSCRIPT_LABEL = b"protocol-tpu-plonk"
+
 
 class PoseidonTranscript:
     """Shared prover/verifier transcript; both sides replay the same
     absorb sequence, so challenges agree."""
 
-    def __init__(self, label: bytes = b"protocol-tpu-plonk"):
+    def __init__(self, label: bytes = TRANSCRIPT_LABEL):
         self.sponge = PoseidonSponge()
         self.rounds = 0
         seed = int.from_bytes(label, "little") % Fr.MODULUS
@@ -68,7 +73,7 @@ class KeccakTranscript:
     Points absorb as x‖y big-endian words (identity = two zero words —
     unambiguous, since (0, 0) is not on the curve)."""
 
-    def __init__(self, label: bytes = b"protocol-tpu-plonk"):
+    def __init__(self, label: bytes = TRANSCRIPT_LABEL):
         from ..utils.keccak import keccak256
 
         self._keccak = keccak256

@@ -172,3 +172,37 @@ class TestMerkleChipSoundness:
         with pytest.raises(EigenError):
             root = MerklePathChip(c, arity=2).verify(path)
             c.cs.check_satisfied()
+
+
+class TestScalarDecompositionSoundness:
+    def test_non_canonical_scalar_bits_rejected(self):
+        """Review regression: a 254-bit decomposition of v can also be
+        satisfied by the bits of v+R (same value mod R); the canonical
+        bound must reject the alias or scalar-mul verifies forgeries."""
+        from protocol_tpu.utils.fields import Fr
+
+        R = Fr.MODULUS
+        c = Chips()
+        ed = EdwardsChip(c)
+        v = 12345  # v + R < 2^254: the alias exists
+        cell = c.witness(v)
+        bits = c.to_bits(cell, 254)
+        alias = v + R
+        for i, b in enumerate(bits):
+            c.cs.wires[b.wire][b.row] = (alias >> i) & 1
+        with pytest.raises(EigenError):
+            # the builder rejects at constraint-build time (the lt bit
+            # witnesses 0 against the constant 1); a prover bypassing
+            # the builder is caught by the same row at check time
+            ed._assert_bits_below(bits, R)
+            c.cs.check_satisfied()
+
+    def test_canonical_bits_accepted(self):
+        from protocol_tpu.utils.fields import Fr
+
+        c = Chips()
+        ed = EdwardsChip(c)
+        cell = c.witness(Fr.MODULUS - 2)  # near the top, still canonical
+        bits = c.to_bits(cell, 254)
+        ed._assert_bits_below(bits, Fr.MODULUS)
+        c.cs.check_satisfied()

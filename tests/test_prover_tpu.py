@@ -16,8 +16,8 @@ if not native.available():
 # The device-prover pipeline targets the TPU; under the CPU+x64 test
 # harness the XLA compile of the fused ext-chunk program does not
 # terminate in reasonable time (known x64-CPU issue), so these run
-# only when a real accelerator backend is present. The TPU run is part
-# of the bench/verify flow (tools/drive_prover_tpu.py).
+# only when a real accelerator backend is present (PTPU_FORCE=1
+# overrides for scripted CPU validation).
 import os as _os  # noqa: E402
 
 if (jax.devices()[0].platform not in ("tpu", "axon")
