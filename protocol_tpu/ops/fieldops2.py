@@ -318,26 +318,35 @@ def reduce_mxu_planes(planes: jnp.ndarray) -> jnp.ndarray:
 # --- compact 16-bit storage (device-resident ext arrays) -------------------
 
 def _resolve_carries_16(t16: jnp.ndarray) -> jnp.ndarray:
-    """Exact base-2^16 carry resolution via while_loop (terminates in
-    ≤ planes iterations; typically 2-3)."""
-    def cond(t):
-        return jnp.any(t > 0xFFFF)
+    """Exact base-2^16 carry resolution, fixed unrolled passes.
 
-    def body(t):
+    2 passes shrink any int32 excess below a unit carry; a unit carry
+    can then ripple through at most the remaining 15 planes, so 18
+    passes are provably enough for ANY int32 input. Unrolled (not
+    lax.while_loop): a dynamic-trip-count While around concat ops sends
+    the XLA CPU pipeline into minutes-long compiles, and the fixed pass
+    count keeps CPU tests and the TPU path on identical programs."""
+    t = t16
+    for _ in range(18):
         carry = t[:-1] >> 16
         low = t[:-1] & 0xFFFF
-        return jnp.concatenate([low, t[-1:]], axis=0) + jnp.concatenate(
+        t = jnp.concatenate([low, t[-1:]], axis=0) + jnp.concatenate(
             [jnp.zeros((1,) + t.shape[1:], jnp.int32), carry], axis=0)
-
-    return lax.while_loop(cond, body, t16)
+    return t
 
 
 def pack16(x: jnp.ndarray) -> jnp.ndarray:
-    """(L, n) relaxed → (16, n) uint16 planes of the value (< 2^256
-    required — any relaxed value qualifies). Each 12-bit limb is
-    assigned wholly to the 16-bit window containing its base bit, then
-    base-2^16 carries are resolved exactly. Halves the HBM footprint of
-    resident arrays."""
+    """(L, n) planes with value < 2^256 → (16, n) uint16 value planes.
+
+    CONTRACT: the input's represented VALUE must be < 2^256 (e.g. any
+    mont_mul output, < 2p). A *lazy* limb-plane value (a raw
+    ``reduce_mxu_planes``/NTT output, limbs < 2^13 across all 22
+    planes) can reach ~2^264 and silently loses its top bits here —
+    callers must normalize first with ``mont_mul_const(x, R_MONT)``
+    (value-preserving fold into [0, 2p)), as ``_ext_chunk_impl`` does.
+    Each 12-bit limb is assigned wholly to the 16-bit window containing
+    its base bit, then base-2^16 carries are resolved exactly. Halves
+    the HBM footprint of resident arrays."""
     x = canon_limbs(x)
     outs = [jnp.zeros(x.shape[1:], dtype=jnp.int32) for _ in range(16)]
     for a in range(L):

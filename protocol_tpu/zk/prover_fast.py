@@ -553,9 +553,11 @@ def prove_auto(params: KZGParams, pk: FastProvingKey, cs: ConstraintSystem,
     eval-form key are present, falling back to the host path on any
     device failure (the remote-tunnel worker can fault mid-session; the
     host path is bit-compatible, so callers only lose speed). Blinding
-    uses fresh randomness per attempt, so the fallback is sound."""
-    from . import prover_tpu
+    uses fresh randomness per attempt, so the fallback is sound.
 
+    Deliberately imports nothing device-side at entry: on a jax-less
+    host the probe below fails closed and the numpy+native host path
+    runs (prove_fast_tpu does its own jax imports)."""
     use_tpu = False
     if pk.eval_form:
         try:

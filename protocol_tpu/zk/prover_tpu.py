@@ -143,7 +143,11 @@ def _ext_chunk_impl(coeffs, coset_pows, xs_fs, zh_plane, blind_planes,
                 xp = f2.mont_mul(xp, xs_fs)
         chunk = f2.add(chunk, f2.mont_mul(
             corr, jnp.broadcast_to(zh_plane, (L, n))))
-    return chunk
+    # normalize into [0, 2p): the raw NTT output is a LAZY limb-plane
+    # value (up to ~2^264), which breaks downstream consumers whose
+    # contracts need < 2p — f2.sub's subtrahend in the quotient kernel
+    # and pack16's 256-bit window. One value-preserving CIOS by R̃.
+    return f2.mont_mul_const(chunk, f2.R_MONT)
 
 
 @partial(jax.jit, static_argnames=("A", "B"))
@@ -325,7 +329,11 @@ class DeviceProver:
                                      _cplane(self.shifts8[j]),
                                      self.zh_planes[j], n_plane)
             self.xs_fs.append(fs_from_natural(xs_nat, self.A, self.B))
-            self.l0_fs.append(l0)
+            # l0 is produced in natural order like xs — BOTH must be
+            # FS-converted (a natural-order l0 here permutes the L0 row
+            # weights across the whole chunk; caught by
+            # test_quotient_chunk_matches_host)
+            self.l0_fs.append(fs_from_natural(l0, self.A, self.B))
 
         # pk columns: natural evals, coeffs, packed ext chunks
         self.fixed_evals = [upload_mont(a) for a in fixed_evals_u64]

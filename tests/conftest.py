@@ -12,16 +12,27 @@ rational oracle at f64 precision (the TPU bench path stays f32).
 
 import os
 
-# env vars still help any subprocesses tests may spawn
-os.environ["JAX_PLATFORMS"] = "cpu"
-_flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in _flags:
-    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+# PTPU_TPU=1 skips the CPU pin so the session runs against the real TPU
+# chip. It is meant ONLY for the device-prover battery —
+# `PTPU_TPU=1 pytest tests/test_prover_tpu.py` is the committed
+# real-hardware entry point. It is session-global (the platform must be
+# chosen before jax initializes), so running the WHOLE suite under it
+# is unsupported: the virtual 8-device mesh and the f64 rational-oracle
+# comparisons need the CPU pin.
+_REAL_TPU = os.environ.get("PTPU_TPU", "") in ("1", "true", "yes")
+
+if not _REAL_TPU:
+    # env vars still help any subprocesses tests may spawn
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    _flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in _flags:
+        os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
 
 import jax  # noqa: E402
 
-jax.config.update("jax_platforms", "cpu")
-jax.config.update("jax_enable_x64", True)
+if not _REAL_TPU:
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_enable_x64", True)
 
 
 def make_signed_attestation(kp, about: bytes, domain: bytes, value: int,

@@ -137,6 +137,57 @@ def test_deterministic_blinding_hook(setup):
     assert p1 == p2
 
 
+def test_prove_auto_works_without_jax():
+    """prove_auto on a jax-less host must fall back to the numpy+native
+    prover instead of dying on an import (advisor finding: a top-level
+    ``from . import prover_tpu`` used to break the whole byte-API prove
+    path when jax was absent). Runs in a subprocess with an import hook
+    that refuses jax before any protocol_tpu module loads."""
+    import subprocess
+    import sys
+
+    code = r"""
+import sys
+
+class _NoJax:
+    def find_spec(self, name, path=None, target=None):
+        if name == "jax" or name.startswith("jax."):
+            raise ImportError("jax blocked for this test")
+        return None
+
+for mod in [m for m in sys.modules if m == "jax" or m.startswith("jax.")]:
+    del sys.modules[mod]
+sys.meta_path.insert(0, _NoJax())
+
+import random
+from protocol_tpu.utils.fields import BN254_FR_MODULUS as R
+from protocol_tpu.zk import prover_fast as pf
+from protocol_tpu.zk.plonk import ConstraintSystem, verify
+
+rng = random.Random(3)
+cs = ConstraintSystem(lookup_bits=6)
+for _ in range(10):
+    a, b = rng.randrange(50), rng.randrange(50)
+    cs.add_row([a, b, (a * b + a) % R], q_a=1, q_mul_ab=1, q_c=R - 1)
+cs.public_input(5)
+cs.check_satisfied()
+params = pf.setup_params_fast(6, seed=b"nojax")
+pk = pf.keygen_fast(params, cs, eval_pk=True)  # eval-form probes the TPU path
+proof = pf.prove_auto(params, pk, cs)
+assert verify(params, pk, cs.public_values(), proof)
+assert not any(m == "jax" or m.startswith("jax.") for m in sys.modules), \
+    "prove path imported jax despite the fallback"
+print("OK-NO-JAX")
+"""
+    import os
+
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    out = subprocess.run([sys.executable, "-c", code], cwd=repo_root,
+                         capture_output=True, text=True, timeout=600)
+    assert out.returncode == 0, out.stderr[-2000:]
+    assert "OK-NO-JAX" in out.stdout
+
+
 def test_four_step_ntt_branch_matches_small_path():
     """n > 2^14 takes the blocked four-step path in the C++ NTT — cover
     it against the radix-2 result computed via two half-size NTTs
