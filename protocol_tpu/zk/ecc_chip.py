@@ -39,6 +39,8 @@ from .integer_chip import (
 WINDOW_BITS = 4
 NUM_WINDOWS = TOTAL_BITS // WINDOW_BITS  # 68
 TABLE_SIZE = 1 << WINDOW_BITS
+# native-scalar path: 64 windows cover 256 bits ≥ the 254-bit field
+NATIVE_WINDOWS = 256 // WINDOW_BITS
 
 
 @dataclass
@@ -259,3 +261,105 @@ class EccChip:
                 tables.append(row)
             self._fixed_tables[key] = tables
         return self._fixed_tables[key]
+
+    # --- native-scalar path (same-curve chipset) --------------------------
+    # Circuit twin of the reference's ``ecc/same_curve`` chipset
+    # (eigentrust-zk/src/ecc/same_curve/mod.rs:134-1094 + native.rs):
+    # when the curve's SCALAR field is the circuit's native field (bn254
+    # G1 inside an Fr circuit — the in-circuit verifier's own folds),
+    # the scalar needs no wrong-field RNS integer at all. The reference
+    # Bits2Num's the native cell; here the cell decomposes into 64
+    # lookup-constrained 4-bit windows, and a shared-doubling batched
+    # MSM (its EccBatchedMulConfig counterpart) amortizes the 252
+    # doublings across every point in a verifier fold.
+
+    def native_digits(self, scalar: Cell) -> list:
+        """64 LSB-first 4-bit digit cells of a NATIVE scalar cell.
+
+        The recomposition constraint binds Σ dᵤ·16ᵘ ≡ scalar (mod r)
+        only, so a malicious witness may encode scalar + k·r (k ≤ 5,
+        still < 2^256). That freedom is harmless exactly here: r is the
+        order of the curve's scalar group, so (s + k·r)·P = s·P — the
+        same argument that lets the reference feed raw Bits2Num output
+        to its same-curve mul (same_curve/mod.rs:134)."""
+        c = self.chips
+        v = c.value(scalar)
+        digits = []
+        terms = []
+        for w in range(NATIVE_WINDOWS):
+            dv = (v >> (WINDOW_BITS * w)) & (TABLE_SIZE - 1)
+            d = c.assign_range(dv, WINDOW_BITS)
+            digits.append(d)
+            terms.append((1 << (WINDOW_BITS * w), d))
+        c.assert_equal(c.lincomb(terms), scalar)
+        return digits
+
+    def msm_native(self, items: list) -> AssignedPoint:
+        """Batched MSM Σ sᵢ·Pᵢ with ONE shared doubling chain.
+
+        ``items``: (point, digits) pairs — point an ``AssignedPoint``
+        (in-circuit 16-entry table, 15 adds) or a host (x, y) tuple
+        (constant table, selects are pure lincombs); digits from
+        :meth:`native_digits`. Every point rides the same 252 doubles
+        (the per-point scalar_mul pays them each), which is where the
+        verifier-fold row count collapses. Aux offsets keep the
+        incomplete adds away from the identity; the aggregate aux mass
+        2^252·Aux + K·(Σ16ᵘ)·C leaves with one constant-point add."""
+        if not items:
+            raise EigenError("circuit_error", "msm_native needs items")
+        tables = []
+        for pt, digits in items:
+            if len(digits) != NATIVE_WINDOWS:
+                raise EigenError("circuit_error",
+                                 "expected 64 native window digits")
+            if isinstance(pt, AssignedPoint):
+                tbl = [self.constant_point(self.aux_c)]
+                for _ in range(1, TABLE_SIZE):
+                    tbl.append(self.add(tbl[-1], pt))
+                tables.append((True, tbl))
+            else:
+                row = [self.aux_c]
+                for _ in range(1, TABLE_SIZE):
+                    row.append(self.spec.add(row[-1], pt))
+                tables.append((False, row))
+        acc = self.constant_point(self.aux_init)
+        for w in reversed(range(NATIVE_WINDOWS)):
+            if w != NATIVE_WINDOWS - 1:
+                for _ in range(WINDOW_BITS):
+                    acc = self.double(acc)
+            for (in_circuit, tbl), (pt, digits) in zip(tables, items):
+                sel = (self.select_point(digits[w], tbl) if in_circuit
+                       else self.select_point_const(digits[w], tbl))
+                acc = self.add(acc, sel)
+        s_c = ((1 << (WINDOW_BITS * NATIVE_WINDOWS)) - 1) // (TABLE_SIZE - 1)
+        mass = self.spec.add(
+            self.spec.mul(self.aux_init,
+                          pow(2, WINDOW_BITS * (NATIVE_WINDOWS - 1),
+                              self.spec.n)),
+            self.spec.mul(self.aux_c, len(items) * s_c % self.spec.n),
+        )
+        return self.add(acc, self.constant_point(self.spec.neg(mass)))
+
+    def scalar_mul_native(self, pt: AssignedPoint, scalar: Cell
+                          ) -> AssignedPoint:
+        """Single variable-base mul by a native scalar cell."""
+        return self.msm_native([(pt, self.native_digits(scalar))])
+
+    def scalar_mul_fixed_native(self, digits: list,
+                                base: tuple | None = None) -> AssignedPoint:
+        """Fixed-base mul by native digits: 64 constant per-window
+        tables T_w[d] = (d·16ʷ)·base + C — zero in-circuit doubles."""
+        if len(digits) != NATIVE_WINDOWS:
+            raise EigenError("circuit_error",
+                             "expected 64 native window digits")
+        base = base if base is not None else self.spec.gen
+        # the native windows are exactly the first 64 of the generic 68
+        tables = self._fixed_tables_for(base)[:NATIVE_WINDOWS]
+        acc = self.constant_point(self.aux_init)
+        for w, digit in enumerate(digits):
+            acc = self.add(acc, self.select_point_const(digit, tables[w]))
+        mass = self.spec.add(
+            self.aux_init,
+            self.spec.mul(self.aux_c, NATIVE_WINDOWS % self.spec.n),
+        )
+        return self.add(acc, self.constant_point(self.spec.neg(mass)))

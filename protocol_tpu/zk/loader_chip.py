@@ -108,7 +108,6 @@ class PlonkVerifierChip:
         self.chips = chips
         self.spec = bn254_g1_spec()
         self.fq = IntegerChip(chips, Q)
-        self.fr_bind = IntegerChip(chips, R)
         self.ecc = EccChip(chips, self.fq, self.spec, tag="bn254-g1")
 
     # --- helpers ----------------------------------------------------------
@@ -138,15 +137,6 @@ class PlonkVerifierChip:
             "sigma": [c.witness(v) for v in proof.sigma_zeta],
         }
         return commits, evals
-
-    def _digits(self, scalar_cell: Cell) -> list:
-        """Window digits of a native scalar cell: canonical Fr limb
-        binding (unique representative) then 4-bit decomposition."""
-        c = self.chips
-        limbs = self.fr_bind.assign(c.value(scalar_cell))
-        self.fr_bind.assert_canonical(limbs)
-        c.assert_equal(self.fr_bind.native(limbs), scalar_cell)
-        return self.fr_bind.to_window_digits(limbs)
 
     def _pow_n(self, x: Cell, k: int) -> Cell:
         out = x
@@ -252,6 +242,15 @@ class PlonkVerifierChip:
         c.assert_equal(total, c.mul(zh, t_at_zeta))
 
         # --- batched-opening fold (kzg.fold_batch twin) -------------------
+        # One shared-doubling native-scalar MSM (ecc_chip.msm_native, the
+        # same-curve chipset) computes the whole GWC fold:
+        #   acc_l = Σᵢ vⁱ·Cᵢ + ζ·W₁ − (y₁ + u·y₂)·G
+        #           + u·(z + v·φ) + u·ζω·W₂
+        #   acc_r = W₁ + u·W₂
+        # algebraically identical to the per-point scalar_mul cascade the
+        # native verifier runs, so the accumulator limbs match
+        # byte-for-byte — but every point shares ONE 252-double chain and
+        # the scalars stay native cells (no wrong-field Fr RNS at all).
         vk_pts = pk.commit_list()
         group1 = (
             [(commits["wires"][w], evals["wires"][w], None)
@@ -268,52 +267,58 @@ class PlonkVerifierChip:
                   (commits["phi"], evals["phi_next"], None)]
         omega = d.omega
 
-        acc_l = None
-        acc_r = None
-        u_pow = None  # None = coefficient 1
-        for items, w_pt, z_val in (
-            (group1, commits["w_x"], zeta),
-            (group2, commits["w_wx"], c.mul_const(zeta, omega)),
-        ):
-            g_pow = None
-            f_commit = None
-            y_terms = []
-            for commit, ev, const_pt in items:
-                if g_pow is None:
-                    scaled = (self.ecc.constant_point(const_pt)
-                              if const_pt is not None else commit)
-                    y_terms.append((1, ev))
-                else:
-                    digits = self._digits(g_pow)
-                    if const_pt is not None:
-                        scaled = self.ecc.scalar_mul_fixed(digits, const_pt)
-                    else:
-                        scaled = self.ecc.scalar_mul(commit, digits)
-                    y_terms.append((1, c.mul(g_pow, ev)))
-                f_commit = scaled if f_commit is None \
-                    else self.ecc.add(f_commit, scaled)
-                g_pow = v_ch if g_pow is None else c.mul(g_pow, v_ch)
-            y_folded = c.lincomb(y_terms)
-            zw = self.ecc.scalar_mul(w_pt, self._digits(z_val))
-            y_g1 = self.ecc.scalar_mul_fixed(self._digits(y_folded),
-                                             self.spec.gen)
-            term = self.ecc.add(self.ecc.add(zw, f_commit),
-                                self._neg(y_g1))
-            if u_pow is None:
-                acc_l, acc_r = term, w_pt
-                u_pow = u_ch
-            else:
-                digits_u = self._digits(u_pow)
-                acc_l = self.ecc.add(acc_l,
-                                     self.ecc.scalar_mul(term, digits_u))
-                acc_r = self.ecc.add(acc_r,
-                                     self.ecc.scalar_mul(w_pt, digits_u))
-        return acc_l, acc_r
+        # per-point merged native coefficients (z/φ appear in both groups)
+        entries: list = []   # [point_or_const, coeff_cell, y-unused]
+        index: dict = {}
 
-    def _neg(self, pt: AssignedPoint) -> AssignedPoint:
-        fq = self.fq
-        neg_y = fq.reduce(fq.sub(fq.constant(0), pt.y))
-        return AssignedPoint(pt.x, neg_y)
+        def add_term(key, pt, coeff):
+            slot = index.get(key)
+            if slot is None:
+                index[key] = len(entries)
+                entries.append([pt, coeff])
+            else:
+                entries[slot][1] = c.add(entries[slot][1], coeff)
+
+        unit = None  # the coefficient-1 leader joins by plain add
+        y_terms = []
+        g_pow = None
+        for i, (commit, ev, const_pt) in enumerate(group1):
+            if g_pow is None:
+                unit = commit  # wires[0]
+                y_terms.append((1, ev))
+            else:
+                if const_pt is not None:
+                    add_term(("vk", i), const_pt, g_pow)
+                else:
+                    add_term(("c", id(commit)), commit, g_pow)
+                y_terms.append((1, c.mul(g_pow, ev)))
+            g_pow = v_ch if g_pow is None else c.mul(g_pow, v_ch)
+        add_term(("c", id(commits["w_x"])), commits["w_x"], zeta)
+        # group2, weighted by u: items fold with v powers inside
+        g2_pow = None
+        y2_terms = []
+        for commit, ev, _ in group2:
+            coeff = u_ch if g2_pow is None else c.mul(u_ch, g2_pow)
+            add_term(("c", id(commit)), commit, coeff)
+            y2_terms.append((1, ev) if g2_pow is None
+                            else (1, c.mul(g2_pow, ev)))
+            g2_pow = v_ch if g2_pow is None else c.mul(g2_pow, v_ch)
+        zeta_w = c.mul_const(zeta, omega)
+        add_term(("c", id(commits["w_wx"])), commits["w_wx"],
+                 c.mul(u_ch, zeta_w))
+        # −G carries the whole evaluation mass y₁ + u·y₂
+        y_total = c.mul_add(u_ch, c.lincomb(y2_terms), c.lincomb(y_terms))
+        neg_gen = self.spec.neg(self.spec.gen)
+        add_term(("vk", "gen"), neg_gen, y_total)
+
+        msm_items = [(pt, self.ecc.native_digits(coeff))
+                     for pt, coeff in entries]
+        acc_l = self.ecc.add(self.ecc.msm_native(msm_items), unit)
+        acc_r = self.ecc.add(
+            self.ecc.msm_native(
+                [(commits["w_wx"], self.ecc.native_digits(u_ch))]),
+            commits["w_x"])
+        return acc_l, acc_r
 
 
 class AggregatorChipset:
@@ -342,13 +347,16 @@ class AggregatorChipset:
         r_ch = tr.challenge()
         lhs, rhs = accs[0]
         r_pow = None
+        ecc = self.verifier.ecc
+        lhs_items, rhs_items = [], []
         for al, ar in accs[1:]:
             r_pow = r_ch if r_pow is None else c.mul(r_pow, r_ch)
-            digits = self.verifier._digits(r_pow)
-            lhs = self.verifier.ecc.add(
-                lhs, self.verifier.ecc.scalar_mul(al, digits))
-            rhs = self.verifier.ecc.add(
-                rhs, self.verifier.ecc.scalar_mul(ar, digits))
+            digits = ecc.native_digits(r_pow)
+            lhs_items.append((al, digits))
+            rhs_items.append((ar, digits))
+        if lhs_items:
+            lhs = ecc.add(lhs, ecc.msm_native(lhs_items))
+            rhs = ecc.add(rhs, ecc.msm_native(rhs_items))
         limbs = []
         fq = self.verifier.fq
         for pt in (lhs, rhs):
