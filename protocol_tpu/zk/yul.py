@@ -23,8 +23,22 @@ Supported subset (everything the generator emits):
 - precompiles via ``staticcall``: 0x05 modexp (fixed 32/32/32 layout),
   0x06 ecAdd, 0x07 ecMul, 0x08 ecPairing (BN254).
 
-Gas is an estimate (constant per builtin + EIP-196/197/2565 precompile
-prices), not a replayed EVM trace.
+Gas follows the yellow-paper / post-Berlin schedule, replayed during
+execution (not a per-op estimate):
+
+- quadratic memory expansion C_mem(a) = 3a + ⌊a²/512⌋ charged at every
+  memory touch (mload/mstore/keccak/staticcall/return/revert ranges);
+- dynamic ``exp`` (10 + 50/exponent-byte, EIP-160), EIP-2565 modexp,
+  EIP-196/197 Istanbul curve-precompile prices, warm-account
+  ``staticcall`` base (precompiles are warm by definition, EIP-2929);
+- the transaction view adds the 21000 intrinsic cost plus EIP-2028
+  calldata pricing (4/zero byte, 16/nonzero byte) — ``run_tx``;
+- stack scheduling (the one thing an AST walker cannot see) is modeled
+  explicitly: every literal/variable operand load charges 3 gas (PUSH/
+  DUP), every assignment 3 (SWAP), every user call 11 (JUMP + JUMPDEST
+  + return-jump) — calibrated against solc-compiled verifier gas
+  shapes; see ``tests/test_evm_verifier.py`` for the hand-derived
+  yellow-paper fixture that pins the schedule itself.
 """
 
 from __future__ import annotations
@@ -35,18 +49,46 @@ from ..utils.errors import EigenError
 
 WORD = (1 << 256) - 1
 
-# per-builtin gas (approximate EVM costs; verylow=3, low=5, mid=8)
+# yellow-paper per-opcode costs (Appendix G: W_verylow=3, W_low=5,
+# W_mid=8, W_base=2; keccak/exp/memory dynamics charged in _builtin)
 GAS = {
     "add": 3, "sub": 3, "mul": 5, "div": 5, "mod": 5,
-    "addmod": 8, "mulmod": 8, "exp": 60,
+    "addmod": 8, "mulmod": 8, "exp": 10,
     "lt": 3, "gt": 3, "eq": 3, "iszero": 3,
     "and": 3, "or": 3, "xor": 3, "not": 3, "shl": 3, "shr": 3,
     "mload": 3, "mstore": 3, "calldataload": 3, "calldatasize": 2,
-    "pop": 2, "staticcall": 100,
+    "pop": 2, "gas": 2, "staticcall": 100,  # warm account (EIP-2929)
+    "return": 0, "revert": 0, "stop": 0, "keccak256": 30,
 }
-GAS_PRECOMPILE = {5: 200, 6: 150, 7: 6000}
+GAS_PUSH = 3        # literal / variable operand load (PUSH, DUP)
+GAS_SWAP = 3        # assignment scheduling (SWAP)
+GAS_JUMP = 11       # user call: JUMP(8) + JUMPDEST(1) + return PUSH-ish
+GAS_EXP_BYTE = 50   # EIP-160
+GAS_TX = 21000
+GAS_CALLDATA_ZERO = 4
+GAS_CALLDATA_NONZERO = 16  # EIP-2028
+GAS_PRECOMPILE = {6: 150, 7: 6000}  # EIP-1108 (Istanbul)
 GAS_PAIRING_BASE = 45000
 GAS_PAIRING_PER_PAIR = 34000
+
+
+def _modexp_gas(base_len: int, exp_len: int, mod_len: int,
+                exp_head: int) -> int:
+    """EIP-2565 modexp pricing."""
+    words = (max(base_len, mod_len) + 7) // 8
+    mult_complexity = words * words
+    if exp_len <= 32:
+        iteration_count = max(exp_head.bit_length() - 1, 0)
+    else:  # pragma: no cover — generator always uses 32-byte exponents
+        iteration_count = 8 * (exp_len - 32) + max(
+            exp_head.bit_length() - 1, 0)
+    iteration_count = max(iteration_count, 1)
+    return max(200, mult_complexity * iteration_count // 3)
+
+
+def _mem_cost(words: int) -> int:
+    """C_mem(a) = 3a + ⌊a²/512⌋ (yellow paper eq. 326)."""
+    return 3 * words + words * words // 512
 
 
 class VMRevert(Exception):
@@ -282,7 +324,8 @@ def _precompile(addr: int, data: bytes):
         if (blen, elen, mlen) != (32, 32, 32):
             raise VMRevert("modexp: unsupported layout")
         b, e, m = word(3), word(4), word(5)
-        return (pow(b, e, m) if m else 0).to_bytes(32, "big"), GAS_PRECOMPILE[5]
+        return ((pow(b, e, m) if m else 0).to_bytes(32, "big"),
+                _modexp_gas(32, 32, 32, e))
     if addr == 6:
         return enc(g1_add(pt(0), pt(2))), GAS_PRECOMPILE[6]
     if addr == 7:
@@ -317,24 +360,46 @@ class YulVM:
         self.ast = parse(src_or_ast) if isinstance(src_or_ast, str) else src_or_ast
 
     def run(self, calldata: bytes) -> tuple:
-        """Returns (returndata, gas_used). Raises VMRevert on revert."""
+        """(returndata, execution gas) — the message-call cost, replayed
+        under the yellow-paper schedule. Raises VMRevert on revert."""
         self.calldata = calldata
         self.memory = bytearray()
         self.gas = 0
+        self.mem_words = 0
         try:
             self._block(self.ast, [{}])
         except _Return as r:
             return r.data, self.gas
         return b"", self.gas
 
+    def run_tx(self, calldata: bytes) -> tuple:
+        """(returndata, transaction gas): execution + the 21000
+        intrinsic cost + EIP-2028 calldata bytes — the number an
+        on-chain caller actually pays for `verifier.verify(proof)`."""
+        data, exec_gas = self.run(calldata)
+        cd = sum(GAS_CALLDATA_ZERO if b == 0 else GAS_CALLDATA_NONZERO
+                 for b in calldata)
+        return data, exec_gas + GAS_TX + cd
+
     # memory --------------------------------------------------------------
+    def _touch(self, offset: int, size: int) -> None:
+        """Quadratic memory-expansion charge for [offset, offset+size)."""
+        if size <= 0:
+            return
+        words = (offset + size + 31) // 32
+        if words > self.mem_words:
+            self.gas += _mem_cost(words) - _mem_cost(self.mem_words)
+            self.mem_words = words
+
     def _mem(self, offset: int, size: int) -> bytes:
+        self._touch(offset, size)
         end = offset + size
         if end > len(self.memory):
             self.memory.extend(b"\x00" * (end - len(self.memory)))
         return bytes(self.memory[offset:end])
 
     def _mem_write(self, offset: int, data: bytes) -> None:
+        self._touch(offset, len(data))
         end = offset + len(data)
         if end > len(self.memory):
             self.memory.extend(b"\x00" * (end - len(self.memory)))
@@ -377,6 +442,7 @@ class YulVM:
                 scopes[-1][name] = v
         elif op == "assign":
             values = self._values(st[2], scopes, len(st[1]))
+            self.gas += GAS_SWAP * len(st[1])
             for name, v in zip(st[1], values):
                 self._lookup(scopes, name)[name] = v
         elif op == "if":
@@ -430,8 +496,10 @@ class YulVM:
     def _eval(self, expr, scopes, multi=False):
         kind = expr[0]
         if kind == "lit":
+            self.gas += GAS_PUSH
             return expr[1]
         if kind == "var":
+            self.gas += GAS_PUSH  # DUP/PUSH of the scheduled stack slot
             return self._lookup(scopes, expr[1])[expr[1]]
         name, args = expr[1], expr[2]
         # user function?
@@ -451,7 +519,7 @@ class YulVM:
         for r in rets:
             frame[r] = 0
         fn_scopes = [scopes[0], frame]
-        self.gas += 10  # jump in/out
+        self.gas += GAS_JUMP
         try:
             self._block(body, fn_scopes)
         except _Leave:
@@ -479,6 +547,7 @@ class YulVM:
         if name == "mulmod":
             return (a[0] * a[1]) % a[2] if a[2] else 0
         if name == "exp":
+            self.gas += GAS_EXP_BYTE * ((a[1].bit_length() + 7) // 8)
             return pow(a[0], a[1], 1 << 256)
         if name == "lt":
             return 1 if a[0] < a[1] else 0
@@ -506,8 +575,8 @@ class YulVM:
             self._mem_write(a[0], a[1].to_bytes(32, "big"))
             return 0
         if name == "keccak256":
-            data = self._mem(a[0], a[1])
-            self.gas += 30 + 6 * ((len(data) + 31) // 32)
+            data = self._mem(a[0], a[1])  # 30 base charged from GAS
+            self.gas += 6 * ((len(data) + 31) // 32)
             from ..utils.keccak import keccak256 as _k
 
             return int.from_bytes(_k(bytes(data)), "big")
