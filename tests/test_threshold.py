@@ -54,3 +54,51 @@ def test_score_field_consistency_enforced():
     bad = Threshold(Fr(7), ratio, Fr(100))  # wrong field score
     with pytest.raises(AssertionError):
         bad.check_threshold()
+
+
+class TestDecimalLimbCalibration:
+    """The NUM_DECIMAL_LIMBS × POWER_OF_TEN parameters are DERIVED for
+    this stack, not inherited: tools/calibrate_limbs.py reruns the
+    reference's digit-growth study (threshold/native.rs:309-499) with
+    this model's filtering + rational semantics. Committed results live
+    in calibration/decimal_limbs.json; these tests pin (a) the fast
+    common-denominator study arithmetic to the Fraction oracle and (b)
+    a sampled slice of the study itself."""
+
+    def test_common_denominator_matches_oracle(self):
+        import random
+
+        from protocol_tpu.backend import NativeRationalBackend
+        from tools.calibrate_limbs import (
+            converge_common_denominator,
+            filter_matrix,
+        )
+
+        rng = random.Random(99)
+        backend = NativeRationalBackend()
+        for _ in range(20):
+            m = filter_matrix(
+                [[rng.randrange(256) for _ in range(4)] for _ in range(4)])
+            fast = converge_common_denominator(m)
+            oracle = backend.converge_exact(m, 1000, 20)
+            assert fast == list(oracle)
+
+    def test_n4_digit_budget(self):
+        """50-trial slice: every reduced score fits the shipped (2, 72)
+        budget of 144 digits (full 1000-trial run: max 111 digits,
+        calibration/decimal_limbs.json)."""
+        from tools.calibrate_limbs import run_study
+
+        res = run_study(4, 50, seed=7)
+        assert res["max_digits"] <= 2 * 72
+        assert res["optimal_power_of_ten"] == 72
+
+    @pytest.mark.slow
+    def test_n128_digit_budget(self):
+        """25-trial N=128 slice of the committed 1000-trial study: the
+        reduced scores must fit the 61 × 70 budget the reference derives
+        for its 128-peer instantiation."""
+        from tools.calibrate_limbs import run_study
+
+        res = run_study(128, 25, seed=7)
+        assert res["max_digits"] <= 61 * 70

@@ -1,0 +1,140 @@
+"""Decimal-limb calibration study for the Threshold circuit.
+
+Reproduces — for THIS stack's rational pipeline — the reference's
+empirical derivation of NUM_DECIMAL_LIMBS × POWER_OF_TEN
+(eigentrust-zk/src/circuits/threshold/native.rs:309-499): ≥1000 random
+u8 opinion matrices per peer count, full 20-iteration exact rational
+convergence, recording the maximum decimal-digit length of any reduced
+score numerator/denominator. The limb parameters must cover that
+maximum: digits ≤ NUM_LIMBS × POWER_OF_TEN.
+
+The exact arithmetic runs in common-denominator integer form (one
+denominator D for the whole score vector, multiplied by lcm(row sums)
+per iteration; scores reduce by gcd only at the end) — identical
+reduced fractions to the per-element Fraction oracle
+(``NativeRationalBackend.converge_exact``, asserted for N=4 in
+tests/test_threshold.py), but ~100× faster at N=128, which is what
+makes the 1000-trial study runnable on one core.
+
+Usage:  python tools/calibrate_limbs.py --n 4 --trials 1000
+        python tools/calibrate_limbs.py --n 128 --trials 1000
+Writes/updates calibration/decimal_limbs.json next to the repo root.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import math
+import os
+import random
+import sys
+import time
+from fractions import Fraction
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+OUT = os.path.join(REPO, "calibration", "decimal_limbs.json")
+
+INITIAL_SCORE = 1000
+NUM_ITERATIONS = 20
+
+
+def filter_matrix(m: list) -> list:
+    """The EigenTrustSet filtering semantics for a full peer set: null
+    self-scores; an all-zero row redistributes 1 to every other peer
+    (models/eigentrust.py filter_peers_ops)."""
+    n = len(m)
+    out = [list(row) for row in m]
+    for i in range(n):
+        out[i][i] = 0
+        if all(v == 0 for v in out[i]):
+            out[i] = [1] * n
+            out[i][i] = 0
+    return out
+
+
+def converge_common_denominator(matrix: list) -> list:
+    """Exact rational converge → list of reduced Fractions.
+
+    Scores live as (numerator int, shared denominator D): one iteration
+    multiplies D by L = lcm(row sums) and accumulates
+    sᵢ·m_ij·(L/rᵢ) — no per-element gcd until the very end."""
+    n = len(matrix)
+    r = [sum(row) for row in matrix]
+    s = [INITIAL_SCORE] * n
+    D = 1
+    for _ in range(NUM_ITERATIONS):
+        L = 1
+        for ri in r:
+            if ri:
+                L = L * ri // math.gcd(L, ri)
+        t = [s[i] * (L // r[i]) if r[i] else 0 for i in range(n)]
+        s = [sum(t[i] * matrix[i][j] for i in range(n) if matrix[i][j])
+             for j in range(n)]
+        D *= L
+    out = []
+    for v in s:
+        g = math.gcd(v, D)
+        out.append(Fraction(v // g, D // g))
+    return out
+
+
+def run_study(n: int, trials: int, seed: int = 1) -> dict:
+    rng = random.Random(seed)
+    biggest = 0
+    hist_max = []
+    t0 = time.time()
+    for t in range(trials):
+        m = filter_matrix(
+            [[rng.randrange(256) for _ in range(n)] for _ in range(n)])
+        ratios = converge_common_denominator(m)
+        cur = 0
+        for ratio in ratios:
+            cur = max(cur, len(str(ratio.numerator)),
+                      len(str(ratio.denominator)))
+        hist_max.append(cur)
+        biggest = max(biggest, cur)
+        if (t + 1) % 50 == 0:
+            print(f"{t + 1}/{trials}: max so far {biggest} "
+                  f"({time.time() - t0:.0f}s)", flush=True)
+    # the parameter implied by the study, mirroring the reference's
+    # derivation: POWER_OF_TEN bounded by the field width minus the
+    # integer-score headroom; NUM_LIMBS = ceil(max_digits / POWER_OF_TEN)
+    field_digits = len(str((1 << 254) - 1))
+    max_score_digits = len(str(n * INITIAL_SCORE))
+    power_of_ten = field_digits - max_score_digits - 1
+    return {
+        "num_neighbours": n,
+        "num_iterations": NUM_ITERATIONS,
+        "initial_score": INITIAL_SCORE,
+        "trials": trials,
+        "seed": seed,
+        "max_digits": biggest,
+        "p50_digits": sorted(hist_max)[len(hist_max) // 2],
+        "elapsed_s": round(time.time() - t0, 1),
+        "optimal_power_of_ten": power_of_ten,
+        "implied_num_limbs": -(-biggest // power_of_ten),
+    }
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--n", type=int, default=4)
+    ap.add_argument("--trials", type=int, default=1000)
+    ap.add_argument("--seed", type=int, default=1)
+    args = ap.parse_args()
+
+    res = run_study(args.n, args.trials, args.seed)
+    os.makedirs(os.path.dirname(OUT), exist_ok=True)
+    data = {}
+    if os.path.exists(OUT):
+        data = json.load(open(OUT))
+    data[f"n{args.n}"] = res
+    with open(OUT, "w") as f:
+        json.dump(data, f, indent=1, sort_keys=True)
+    print(json.dumps(res), flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
