@@ -63,8 +63,22 @@ def _keygen(params, cs):
 
     if available():
         # "auto": eval-form key (no keygen iNTTs, 8× faster at k=20)
-        # whenever the params carry a matching Lagrange basis
-        return keygen_fast(params, cs, eval_pk="auto")
+        # whenever the params carry a matching Lagrange basis. When the
+        # circuit's natural domain is SMALLER than the SRS (the
+        # Threshold flow proves its inner EigenTrust snark under the
+        # shared k=21 SRS), snap k up to the SRS domain: a padded
+        # eval-form key + the device prover beat a tight-domain
+        # coefficient-form key by minutes per proof.
+        k = None
+        if params.g1_lagrange is not None:
+            from .prover_fast import natural_k
+
+            needed = natural_k(cs)
+            if needed <= params.k <= needed + 1:
+                # at most one domain doubling of padding — beyond that
+                # the tight-domain coefficient-form key wins again
+                k = params.k
+        return keygen_fast(params, cs, k=k, eval_pk="auto")
     from .plonk import keygen
 
     return keygen(params, cs)

@@ -341,6 +341,16 @@ class VerifyingKey:
                    p.get("lookup_bits"), _decode_vk_commits(p))
 
 
+def natural_k(cs: ConstraintSystem) -> int:
+    """The smallest domain exponent a circuit fits — the k that
+    ``keygen_fast``/``plonk.keygen`` pick when none is forced. Shared
+    with api._keygen's SRS-domain snap so the two can't diverge."""
+    k = max(MIN_K, (max(cs.num_rows, 1) - 1).bit_length())
+    if cs.lookup_bits:
+        k = max(k, cs.lookup_bits)
+    return k
+
+
 def keygen_fast(params: KZGParams, cs: ConstraintSystem,
                 k: int | None = None,
                 eval_pk: bool = False) -> FastProvingKey:
@@ -353,9 +363,7 @@ def keygen_fast(params: KZGParams, cs: ConstraintSystem,
     to the coefficient-form key's."""
     rows = cs.num_rows
     if k is None:
-        k = max(MIN_K, (max(rows, 1) - 1).bit_length())
-        if cs.lookup_bits:
-            k = max(k, cs.lookup_bits)
+        k = natural_k(cs)
     if k < MIN_K:
         raise EigenError("circuit_error",
                          f"k={k} below minimum domain size k={MIN_K}")
@@ -863,8 +871,14 @@ def prove_fast_tpu(params: KZGParams, pk: FastProvingKey,
         col = cs.wires[w]
         if col:
             wire_vals[w, : len(col)] = native.ints_to_limbs(col)
-    wire_dev = [ptpu.upload_mont(wire_vals[w]) for w in range(NUM_WIRES)]
-    wire_coeff_dev = [dp.intt_natural(e) for e in wire_dev]
+    # eval-form device arrays are transient: intt to coeffs, then drop
+    # (ζ-evals run from coeffs; keeping 10 eval arrays resident is what
+    # pushed k=20 over the 16 GB HBM line)
+    wire_coeff_dev = []
+    for w in range(NUM_WIRES):
+        ev = ptpu.upload_mont(wire_vals[w])
+        wire_coeff_dev.append(dp.intt_natural(ev))
+        del ev
     wire_blinds = [[randint() for _ in range(2)] for _ in range(NUM_WIRES)]
     wire_commits = [
         _commit_blinded_evals(params, wire_vals[w], wire_blinds[w])
@@ -877,6 +891,7 @@ def prove_fast_tpu(params: KZGParams, pk: FastProvingKey,
     m_vals = _lookup_multiplicities(cs, n, table_size)
     m_dev = ptpu.upload_mont(m_vals)
     m_coeff_dev = dp.intt_natural(m_dev)
+    del m_dev
     m_blinds = [randint() for _ in range(2)]
     m_commit = _commit_blinded_evals(params, m_vals, m_blinds)
     tr.absorb_point(m_commit)
@@ -893,6 +908,7 @@ def prove_fast_tpu(params: KZGParams, pk: FastProvingKey,
                                    pk.shifts, omegas, beta, gamma)
     z_dev = ptpu.upload_mont(z_vals)
     z_coeff_dev = dp.intt_natural(z_dev)
+    del z_dev
     z_blinds = [randint() for _ in range(3)]
     z_commit = _commit_blinded_evals(params, z_vals, z_blinds)
     tr.absorb_point(z_commit)
@@ -903,6 +919,7 @@ def prove_fast_tpu(params: KZGParams, pk: FastProvingKey,
                                     m_vals, beta_lk)
     phi_dev = ptpu.upload_mont(phi_vals)
     phi_coeff_dev = dp.intt_natural(phi_dev)
+    del phi_dev
     phi_blinds = [randint() for _ in range(3)]
     phi_commit = _commit_blinded_evals(params, phi_vals, phi_blinds)
     tr.absorb_point(phi_commit)
@@ -930,6 +947,7 @@ def prove_fast_tpu(params: KZGParams, pk: FastProvingKey,
     chunk_arrs = [ptpu.download_std(t_coeff_chunks[u])
                   for u in range(QUOTIENT_CHUNKS)]
     top = ptpu.download_std(t_coeff_chunks[QUOTIENT_CHUNKS])
+    t_coeff_chunks[QUOTIENT_CHUNKS] = None  # only the zero check needs it
     if top.any():
         raise EigenError(
             "proving_error",
@@ -953,9 +971,9 @@ def prove_fast_tpu(params: KZGParams, pk: FastProvingKey,
             xp = xp * at % R
         return b * zh % R
 
-    base_evals = dp.eval_at_many(
-        wire_dev + [m_dev, z_dev, phi_dev] + dp.fixed_evals
-        + dp.sigma_evals, zeta)
+    base_evals = dp.eval_coeffs_at_many(
+        wire_coeff_dev + [m_coeff_dev, z_coeff_dev, phi_coeff_dev]
+        + dp.fixed_coeffs + dp.sigma_coeffs, zeta)
     wire_evals = [
         (base_evals[w] + blind_corr(wire_blinds[w], zeta, zh_zeta)) % R
         for w in range(NUM_WIRES)
@@ -965,7 +983,8 @@ def prove_fast_tpu(params: KZGParams, pk: FastProvingKey,
     phi_eval = (base_evals[8] + blind_corr(phi_blinds, zeta, zh_zeta)) % R
     fixed_evals = base_evals[9 : 9 + len(FIXED_NAMES)]
     sigma_zeta = base_evals[9 + len(FIXED_NAMES) :]
-    shifted_evals = dp.eval_at_many([z_dev, phi_dev], zeta_w)
+    shifted_evals = dp.eval_coeffs_at_many([z_coeff_dev, phi_coeff_dev],
+                                           zeta_w)
     z_next = (shifted_evals[0] + blind_corr(z_blinds, zeta_w, zh_zeta_w)) % R
     phi_next = (shifted_evals[1]
                 + blind_corr(phi_blinds, zeta_w, zh_zeta_w)) % R

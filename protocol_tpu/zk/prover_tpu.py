@@ -128,12 +128,15 @@ fs_roll_next = _fs_roll_next  # public alias (pure reshapes, jit-safe)
 # --- jitted kernels ---------------------------------------------------------
 
 @partial(jax.jit, static_argnames=("nblinds",))
-def _ext_chunk_impl(coeffs, coset_pows, xs_fs, zh_plane, blind_planes,
+def _ext_chunk_impl(coeffs, coset16, xs16, zh_plane, blind_planes,
                     w_a, w_b, t16, nblinds: int):
-    scaled = f2.mont_mul(coeffs, coset_pows)
+    """Static tables arrive as packed (16, n) uint16 planes (half the
+    HBM of int32 limb planes; the unpack is trivial VPU work)."""
+    scaled = f2.mont_mul(coeffs, f2.unpack16(coset16))
     chunk = ntt_tpu._ntt_impl(scaled, w_a, w_b, t16)
     if nblinds:
         n = chunk.shape[1]
+        xs_fs = f2.unpack16(xs16)
         corr = jnp.broadcast_to(blind_planes[:, 0:1], (L, n))
         xp = xs_fs
         for i in range(1, nblinds):
@@ -152,15 +155,17 @@ def _ext_chunk_impl(coeffs, coset_pows, xs_fs, zh_plane, blind_planes,
 
 @partial(jax.jit, static_argnames=("A", "B"))
 def _quotient_chunk_impl(wires, z_e, m_e, phi_e, pi_e, fixed16, sigma16,
-                         xs, l0, ch, zh_inv_plane, A: int, B: int):
+                         xs16, l016, ch, zh_inv_plane, A: int, B: int):
     """ch: (L, 10) planes of [beta, gamma, beta_lk, alpha, a2, a3, a4,
-    beta·shift_0.., ] — laid out below."""
+    beta·shift_0.., ] — laid out below. xs/l0 arrive packed uint16."""
     n = A * B
 
     def cc(idx):
         return jnp.broadcast_to(ch[:, idx : idx + 1], (L, n))
 
     one = f2._const_planes(_mont(1), n)
+    xs = f2.unpack16(xs16)
+    l0 = f2.unpack16(l016)
     fx = [f2.unpack16(fixed16[i]) for i in range(9)]
     sg = [f2.unpack16(sigma16[i]) for i in range(6)]
     w = [wires[i] for i in range(6)]
@@ -206,35 +211,35 @@ def _quotient_chunk_impl(wires, z_e, m_e, phi_e, pi_e, fixed16, sigma16,
 
 
 @jax.jit
-def _combine8_impl(hats, zc_planes, s_neg_pows, su_planes):
-    """hats: (8, L, n) twiddled per-chunk iNTTs; zc_planes: (8, 8, L, 1)
-    ζ-DFT constants (already /8); su_planes: (8, L, 1) (s^{−n})^u."""
-    n = hats.shape[2]
-    chunks = []
-    for u in range(8):
-        acc = None
-        for j in range(8):
-            term = f2.mont_mul(
-                hats[j], jnp.broadcast_to(zc_planes[u, j], (L, n)))
-            acc = term if acc is None else f2.add(acc, term)
-        acc = f2.mont_mul(acc, s_neg_pows)
-        acc = f2.mont_mul(acc, jnp.broadcast_to(su_planes[u], (L, n)))
-        chunks.append(acc)
-    return jnp.stack(chunks)
-
-
-@jax.jit
-def _twiddle_mul(x, pows):
-    return f2.mont_mul(x, pows)
-
-
-@jax.jit
-def _fold_impl(polys, scalars):
-    """polys: (m, L, n); scalars: (m, L, 1) Montgomery → Σ scalarᵢ·pᵢ."""
-    m, _, n = polys.shape
+def _combine1_impl(zc_u, s_neg16, su_u, *hats):
+    """One output chunk u of the radix-8 combine: hats are the 8
+    twiddled per-chunk iNTTs as SEPARATE (L, n) args (a (8, L, n) stack
+    is a 0.7 GB transient at k=20); zc_u: (8, L, 1) ζ-DFT constants for
+    this u (already /8); su_u: (L, 1) (s^{−n})^u; s_neg16: packed
+    (16, n) of s^{−d}."""
+    n = hats[0].shape[1]
     acc = None
-    for i in range(m):
-        term = f2.mont_mul(polys[i], jnp.broadcast_to(scalars[i], (L, n)))
+    for j in range(8):
+        term = f2.mont_mul(hats[j], jnp.broadcast_to(zc_u[j], (L, n)))
+        acc = term if acc is None else f2.add(acc, term)
+    acc = f2.mont_mul(acc, f2.unpack16(s_neg16))
+    return f2.mont_mul(acc, jnp.broadcast_to(su_u, (L, n)))
+
+
+@jax.jit
+def _twiddle_mul(x, pows16):
+    return f2.mont_mul(x, f2.unpack16(pows16))
+
+
+@jax.jit
+def _fold_impl(scalars, *polys):
+    """polys: m separate (L, n) arrays (NOT stacked — a 25-poly stack
+    is a 2.2 GB transient copy at k=20); scalars: (m, L, 1) Montgomery
+    → Σ scalarᵢ·pᵢ."""
+    n = polys[0].shape[1]
+    acc = None
+    for i, p in enumerate(polys):
+        term = f2.mont_mul(p, jnp.broadcast_to(scalars[i], (L, n)))
         acc = term if acc is None else f2.add(acc, term)
     return acc
 
@@ -271,12 +276,10 @@ def _sum_reduce_mont(prod: jnp.ndarray) -> jnp.ndarray:
 
 
 @jax.jit
-def _dots_impl(evals_stack, weights):
-    """evals_stack: (m, L, n); weights (L, n) → (m, L, 1) Σ eᵢ·w."""
-    outs = [
-        _sum_reduce_mont(f2.mont_mul(evals_stack[i], weights))
-        for i in range(evals_stack.shape[0])
-    ]
+def _dots_impl(weights, *evals):
+    """m separate (L, n) arrays (unstacked, see _fold_impl); weights
+    (L, n) → (m, L, 1) Σ eᵢ·w."""
+    outs = [_sum_reduce_mont(f2.mont_mul(e, weights)) for e in evals]
     return jnp.stack(outs)
 
 
@@ -293,9 +296,17 @@ def _xs_l0_impl(omega_pows, shift_plane, zh_plane, n_plane):
 
 
 class DeviceProver:
-    """Per-(k, shift, pk) device state: NTT plan, coset tables, and the
-    pk's fixed/sigma columns resident as evals + coeffs + packed ext
-    chunks (~4 GB at k=20)."""
+    """Per-(k, shift, pk) device state: NTT plan, coset tables (packed
+    uint16), and the pk's fixed/sigma columns resident as coeffs +
+    packed ext chunks.
+
+    HBM budget at k=20 (16 GB v5e chip): pk coeffs 1.3 GB + packed ext
+    chunks 3.8 GB + packed tables ~1.3 GB + plan 0.16 GB ≈ 6.6 GB
+    resident, leaving ~9 GB for the prove working set. Three design
+    rules keep the peak inside that: H-domain eval arrays are never
+    resident (ζ-evals run from coeffs), static tables live as (16, n)
+    uint16 packs, and fold/dot kernels take polys as separate args
+    (a 25-poly jnp.stack is a 2.2 GB transient)."""
 
     def __init__(self, k: int, shift: int, fixed_evals_u64, sigma_evals_u64):
         self.k = k
@@ -320,40 +331,50 @@ class DeviceProver:
         self.zh_planes = [_cplane(z) for z in self.zh_c]
         self.zh_inv_planes = [_cplane(z) for z in self.zh_inv_c]
 
+        pk16 = jax.jit(f2.pack16)
         self.omega_pows = powers_vector(self.omega, n)          # natural
-        self.coset_pows = [powers_vector(s, n) for s in self.shifts8]
+        self.coset_pows = [pk16(powers_vector(s, n)) for s in self.shifts8]
         n_plane = _cplane(n)
         self.xs_fs, self.l0_fs = [], []
         for j in range(8):
             xs_nat, l0 = _xs_l0_impl(self.omega_pows,
                                      _cplane(self.shifts8[j]),
                                      self.zh_planes[j], n_plane)
-            self.xs_fs.append(fs_from_natural(xs_nat, self.A, self.B))
+            self.xs_fs.append(pk16(fs_from_natural(xs_nat, self.A, self.B)))
             # l0 is produced in natural order like xs — BOTH must be
             # FS-converted (a natural-order l0 here permutes the L0 row
             # weights across the whole chunk; caught by
             # test_quotient_chunk_matches_host)
-            self.l0_fs.append(fs_from_natural(l0, self.A, self.B))
+            self.l0_fs.append(pk16(fs_from_natural(l0, self.A, self.B)))
 
-        # pk columns: natural evals, coeffs, packed ext chunks
-        self.fixed_evals = [upload_mont(a) for a in fixed_evals_u64]
-        self.sigma_evals = [upload_mont(a) for a in sigma_evals_u64]
-        self.fixed_coeffs = [self.intt_natural(e) for e in self.fixed_evals]
-        self.sigma_coeffs = [self.intt_natural(e) for e in self.sigma_evals]
-        pk16 = jax.jit(f2.pack16)
-        self.fixed_ext = [
-            [pk16(self.ext_chunk(cf, j)) for j in range(8)]
-            for cf in self.fixed_coeffs
-        ]
-        self.sigma_ext = [
-            [pk16(self.ext_chunk(cf, j)) for j in range(8)]
-            for cf in self.sigma_coeffs
-        ]
+        # pk columns: coeffs + packed ext chunks. The H-domain evals are
+        # NOT kept resident — ζ-evaluations run as coefficient dots
+        # (eval_coeffs_at_many), and dropping the 15 eval arrays saves
+        # ~1.3 GB of HBM at k=20 (the difference between fitting and
+        # RESOURCE_EXHAUSTED on a 16 GB chip).
+        self.fixed_coeffs = []
+        self.fixed_ext = []
+        for a in fixed_evals_u64:
+            ev = upload_mont(a)
+            cf = self.intt_natural(ev)
+            del ev
+            self.fixed_coeffs.append(cf)
+            self.fixed_ext.append(
+                [pk16(self.ext_chunk(cf, j)) for j in range(8)])
+        self.sigma_coeffs = []
+        self.sigma_ext = []
+        for a in sigma_evals_u64:
+            ev = upload_mont(a)
+            cf = self.intt_natural(ev)
+            del ev
+            self.sigma_coeffs.append(cf)
+            self.sigma_ext.append(
+                [pk16(self.ext_chunk(cf, j)) for j in range(8)])
 
-        # intt8 combine tables
-        self.we_neg_pows = [powers_vector(pow(omega_e, -j, P), n)
+        # intt8 combine tables (packed)
+        self.we_neg_pows = [pk16(powers_vector(pow(omega_e, -j, P), n))
                             for j in range(8)]
-        self.s_neg_pows = powers_vector(pow(shift, -1, P), n)
+        self.s_neg_pows = pk16(powers_vector(pow(shift, -1, P), n))
         zeta8 = pow(omega_e, n, P)                  # primitive 8th root
         inv8 = pow(8, -1, P)
         s_n_inv = pow(shift, -n, P)
@@ -414,24 +435,32 @@ class DeviceProver:
 
     # --- 8n inverse -------------------------------------------------------
 
-    def intt8(self, t_chunks: list) -> jnp.ndarray:
-        """FS coset chunks of t → (8, L, n) coefficient chunks
+    def intt8(self, t_chunks: list) -> list:
+        """FS coset chunks of t → list of 8 (L, n) coefficient chunks
         a[u·n:(u+1)·n] (derivation: iNTT_n folds coefficients; after the
         ωₑ^{−jd} twiddle, an 8-point inverse DFT across chunks recovers
-        b_u[d] = a_{d+un}·s^{d+un}, then the s-power unscale)."""
+        b_u[d] = a_{d+un}·s^{d+un}, then the s-power unscale).
+
+        CONSUMES ``t_chunks`` (entries are dropped as their iNTT
+        completes) and emits output chunks one at a time — the HBM peak
+        here decides whether k=20 fits the chip."""
         hats = []
         for j in range(8):
             cj = ntt_tpu.intt(t_chunks[j], self.plan)
+            t_chunks[j] = None
             hats.append(_twiddle_mul(cj, self.we_neg_pows[j]))
-        return _combine8_impl(jnp.stack(hats), self.zc_planes,
-                              self.s_neg_pows, self.su_planes)
+        return [
+            _combine1_impl(self.zc_planes[u], self.s_neg_pows,
+                           self.su_planes[u], *hats)
+            for u in range(8)
+        ]
 
     # --- round 4 ----------------------------------------------------------
 
     def fold_coeffs(self, polys: list, scalars: list) -> jnp.ndarray:
         """Σ scalarᵢ·pᵢ over same-length device coeff arrays."""
         sc = jnp.stack([_cplane(s) for s in scalars])
-        return _fold_impl(jnp.stack(polys), sc)
+        return _fold_impl(sc, *polys)
 
     def barycentric_weights(self, zeta: int) -> jnp.ndarray:
         key = zeta % P
@@ -443,20 +472,31 @@ class DeviceProver:
             self._bary = {key: w}
         return w
 
-    def eval_at_many(self, evals_list: list, zeta: int) -> list:
-        """[pᵢ(ζ)] from natural-order eval arrays (deg pᵢ < n)."""
-        w = self.barycentric_weights(zeta)
-        outs = _dots_impl(jnp.stack(evals_list), w)
-        res = []
-        # outs is (m, L, 1): move the limb-plane axis first — a raw
-        # reshape would interleave planes across polynomials
+    @staticmethod
+    def _download_scalars(outs: jnp.ndarray, count: int) -> list:
+        """(m, L, 1) dot results → host ints. The transpose moves the
+        limb-plane axis first — a raw reshape would interleave planes
+        across polynomials (regression-tested in test_fieldops2)."""
         stacked = outs.transpose(1, 0, 2).reshape(L, -1)
         ready = _to_u64_ready(stacked)
         jax.block_until_ready(ready)
         host = f2.unpack_u64(np.asarray(ready))
-        for i in range(len(evals_list)):
-            res.append(int.from_bytes(host[i].tobytes(), "little"))
-        return res
+        return [int.from_bytes(host[i].tobytes(), "little")
+                for i in range(count)]
+
+    def eval_at_many(self, evals_list: list, zeta: int) -> list:
+        """[pᵢ(ζ)] from natural-order eval arrays (deg pᵢ < n)."""
+        w = self.barycentric_weights(zeta)
+        return self._download_scalars(_dots_impl(w, *evals_list),
+                                      len(evals_list))
 
     def eval_at(self, evals_nat: jnp.ndarray, zeta: int) -> int:
         return self.eval_at_many([evals_nat], zeta)[0]
+
+    def eval_coeffs_at_many(self, coeffs_list: list, zeta: int) -> list:
+        """[pᵢ(ζ)] from device-resident COEFFICIENT arrays: a ζ-power
+        dot Σ cᵢ·ζⁱ — same exact result as the barycentric eval-form
+        path, without needing any H-domain eval array resident."""
+        zp = powers_vector(zeta, self.n)
+        return self._download_scalars(_dots_impl(zp, *coeffs_list),
+                                      len(coeffs_list))

@@ -138,7 +138,7 @@ def test_dots_impl_multi_poly_ordering():
     e0 = f2.enter_mont(jnp.asarray(f2.ints_to_planes(vals0)))
     e1 = f2.enter_mont(jnp.asarray(f2.ints_to_planes(vals1)))
     w = f2.enter_mont(jnp.asarray(f2.ints_to_planes(w_vals)))
-    outs = ptpu._dots_impl(jnp.stack([e0, e1]), w)
+    outs = ptpu._dots_impl(w, e0, e1)
     stacked = outs.transpose(1, 0, 2).reshape(f2.L, -1)
     host = f2.unpack_u64(
         __import__("numpy").asarray(ptpu._to_u64_ready(stacked)))

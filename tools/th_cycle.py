@@ -1,0 +1,110 @@
+"""Measured full Threshold proof cycle (the stack's heaviest path).
+
+Mirrors the slow ``TestApiThresholdCycle`` flow — SRS, Threshold pk
+(which keygens AND proves a dummy inner EigenTrust snark, exactly like
+the reference's ``th_circuit_setup``, lib.rs:469-534), a real Threshold
+proof over a different witness, verification incl. the deferred KZG
+decide — and prints per-phase wall-clock JSON for BASELINE.md.
+
+The in-circuit verifier now folds on the native-scalar batched MSM
+(zk/ecc_chip.py msm_native), which drops the aggregated circuit under
+2^21 rows; the cycle therefore runs on a k=21 SRS instead of r1's k=22,
+and every keygen/prove rides the eval-form + device-prover path
+(prove_auto falls back to the host prover on device faults, so the
+cycle completes either way).
+
+Usage (repo root):  python tools/th_cycle.py [--k 21]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+CACHE = os.path.join(REPO, "bench_cache", "zk")
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--k", type=int, default=21)
+    args = ap.parse_args()
+    sys.path.insert(0, REPO)
+    os.chdir(REPO)
+    os.makedirs(CACHE, exist_ok=True)
+
+    from protocol_tpu.utils.fields import Fr
+    from protocol_tpu.zk import api
+    from tests.test_api import TINY, tiny_et_setup
+
+    timings = {}
+
+    params_path = os.path.join(CACHE, f"params_th_k{args.k}.bin")
+    t0 = time.time()
+    if os.path.exists(params_path):
+        params = open(params_path, "rb").read()
+        timings["srs_s"] = f"cached ({round(time.time() - t0, 1)}s load)"
+    else:
+        params = api.generate_kzg_params(args.k, seed=b"api-th-cycle")
+        with open(params_path, "wb") as f:
+            f.write(params)
+        timings["srs_s"] = round(time.time() - t0, 1)
+    print("srs:", timings["srs_s"], flush=True)
+
+    t0 = time.time()
+    th_pk = api.generate_th_pk(params, shape=TINY)
+    timings["th_pk_s"] = round(time.time() - t0, 1)
+    print("th_pk (incl. dummy ET keygen+prove):", timings["th_pk_s"],
+          flush=True)
+
+    setup_et = tiny_et_setup()
+    from protocol_tpu.client.circuit_io import ThPublicInputs, ThSetup
+    from protocol_tpu.models.threshold import Threshold
+
+    index = 1
+    threshold = 500
+    ratio = setup_et.rational_scores[index]
+    th = Threshold(setup_et.pub_inputs.scores[index], ratio,
+                   Fr(threshold), num_limbs=TINY.num_limbs,
+                   power_of_ten=TINY.power_of_ten,
+                   num_neighbours=TINY.num_neighbours,
+                   initial_score=TINY.initial_score)
+    setup = ThSetup(
+        ThPublicInputs(
+            address=setup_et.pub_inputs.participants[index],
+            threshold=Fr(threshold),
+            threshold_check=th.check_threshold(),
+        ),
+        th.num_decomposed, th.den_decomposed,
+        et_setup=setup_et, ratio=ratio,
+    )
+    t0 = time.time()
+    proof = api.generate_th_proof(params, th_pk, setup, shape=TINY)
+    timings["th_proof_s"] = round(time.time() - t0, 1)
+    print("th_proof (incl. real inner ET keygen+prove):",
+          timings["th_proof_s"], flush=True)
+
+    pub_bytes = setup.pub_inputs.to_bytes()
+    t0 = time.time()
+    ok = api.verify_th(params, th_pk, pub_bytes, proof, shape=TINY)
+    timings["verify_s"] = round(time.time() - t0, 2)
+    if not ok:
+        print("VERIFY FAILED", file=sys.stderr)
+        return 1
+    bad = bytearray(proof)
+    bad[len(bad) // 2] ^= 1
+    if api.verify_th(params, th_pk, pub_bytes, bytes(bad), shape=TINY):
+        print("TAMPER ACCEPTED", file=sys.stderr)
+        return 1
+    timings["total_s"] = round(sum(v for v in timings.values()
+                                   if isinstance(v, (int, float))), 1)
+    timings["k"] = args.k
+    print(json.dumps(timings), flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
