@@ -48,6 +48,14 @@ class EigenFile:
     def et_proof(self) -> Path:
         return self.assets / "et-proof.bin"
 
+    def et_verifier(self) -> Path:
+        return self.assets / "et-verifier.yul"
+
+    def et_proof_meta(self) -> Path:
+        """Sidecar recording how et-proof.bin was produced (transcript
+        kind) so verify verbs can't silently replay the wrong hash."""
+        return self.assets / "et-proof.meta.json"
+
     def et_public_inputs(self) -> Path:
         return self.assets / "et-public-inputs.bin"
 

@@ -63,9 +63,42 @@ def build_parser() -> argparse.ArgumentParser:
 
     sub.add_parser("deploy", help="deploy the AttestationStation (local chain)")
 
-    sub.add_parser("et-proof", help="generate the EigenTrust proof")
-    sub.add_parser("et-proving-key", help="generate the EigenTrust proving key")
-    sub.add_parser("et-verify", help="verify the EigenTrust proof")
+    def _et_shape_args(p):
+        p.add_argument("--shape", choices=["default", "tiny"],
+                       default="default",
+                       help="circuit instantiation: default = the "
+                            "EigenTrust4 shape (k=22 params), tiny = "
+                            "the 2-peer/2-iteration dev shape (k=20)")
+
+    p = sub.add_parser("et-proof", help="generate the EigenTrust proof")
+    _et_shape_args(p)
+    p.add_argument("--transcript", choices=["poseidon", "keccak"],
+                   default="poseidon",
+                   help="keccak emits the on-chain-cheap proof the Yul "
+                        "verifier checks at ~388k gas; poseidon keeps "
+                        "recursion parity with the aggregator")
+    p = sub.add_parser("et-proving-key",
+                       help="generate the EigenTrust proving key")
+    _et_shape_args(p)
+    p = sub.add_parser("et-verify", help="verify the EigenTrust proof")
+    _et_shape_args(p)
+    p.add_argument("--transcript", choices=["auto", "poseidon", "keccak"],
+                   default="auto",
+                   help="auto reads et-proof.meta.json (falls back to "
+                        "poseidon) so a keccak proof can't be replayed "
+                        "under the wrong hash by default")
+    p = sub.add_parser(
+        "et-verifier",
+        help="emit the deployable Yul/EVM verifier (et-verifier.yul)")
+    _et_shape_args(p)
+    p.add_argument("--transcript", choices=["auto", "poseidon", "keccak"],
+                   default="auto",
+                   help="auto follows et-proof.meta.json, else keccak "
+                        "(the on-chain-cheap variant)")
+    p.add_argument("--check", action="store_true",
+                   help="replay the written et-proof against the "
+                        "generated verifier in the in-repo EVM and "
+                        "print the gas")
 
     p = sub.add_parser("kzg-params", help="generate KZG params")
     p.add_argument("--k", type=int, required=True, help="circuit degree 2^k rows")
@@ -143,7 +176,7 @@ def _save_config(files: EigenFile, config: ClientConfig) -> None:
 
 
 def _make_client(files: EigenFile, config: ClientConfig,
-                 batched_ingest: bool = False) -> Client:
+                 batched_ingest: bool = False, shape=None) -> Client:
     chain = None
     if config.node_url == "memory":
         path = files.chain_json()
@@ -151,8 +184,13 @@ def _make_client(files: EigenFile, config: ClientConfig,
             chain = LocalChain.from_json(JSONFileStorage(path).load())
         else:
             chain = LocalChain()
+    kwargs = {}
+    if shape is not None:
+        kwargs["num_neighbours"] = shape.num_neighbours
+        kwargs["num_iterations"] = shape.num_iterations
+        kwargs["initial_score"] = shape.initial_score
     return Client(config, load_mnemonic(), chain=chain,
-                  batched_ingest=batched_ingest)
+                  batched_ingest=batched_ingest, **kwargs)
 
 
 def _save_chain(files: EigenFile, client: Client) -> None:
@@ -334,11 +372,22 @@ def handle_kzg_params(args, files, config):
     print(f"wrote {path} ({len(data)} bytes)")
 
 
+def _et_shape(args):
+    """(CircuitShape, params_k) for the --shape flag; "tiny" is the
+    2-peer dev instantiation whose 790k rows fit a k=20 SRS."""
+    from ..zk.api import DEFAULT_SHAPE, TINY_SHAPE
+
+    if getattr(args, "shape", "default") == "tiny":
+        return TINY_SHAPE, 20
+    return DEFAULT_SHAPE, ET_PARAMS_K
+
+
 def handle_et_pk(args, files, config):
     from ..zk import api as zk
 
-    params = files.read(files.kzg_params(ET_PARAMS_K))
-    pk = zk.generate_et_pk(params)
+    shape, params_k = _et_shape(args)
+    params = files.read(files.kzg_params(params_k))
+    pk = zk.generate_et_pk(params, shape=shape)
     files.et_proving_key().write_bytes(pk)
     print(f"wrote {files.et_proving_key()}")
 
@@ -346,27 +395,75 @@ def handle_et_pk(args, files, config):
 def handle_et_proof(args, files, config):
     from ..zk import api as zk
 
-    client = _make_client(files, config)
+    shape, params_k = _et_shape(args)
+    client = _make_client(files, config, shape=shape)
     atts = _load_attestations(files)
     setup = client.et_circuit_setup(atts)
-    params = files.read(files.kzg_params(ET_PARAMS_K))
+    params = files.read(files.kzg_params(params_k))
     pk = files.read(files.et_proving_key())
-    proof = zk.generate_et_proof(params, pk, setup)
+    proof = zk.generate_et_proof(params, pk, setup, shape=shape,
+                                 transcript=args.transcript)
     files.et_proof().write_bytes(proof)
     files.et_public_inputs().write_bytes(setup.pub_inputs.to_bytes())
+    files.et_proof_meta().write_text(
+        json.dumps({"transcript": args.transcript}))
     print(f"wrote {files.et_proof()} and {files.et_public_inputs()}")
+
+
+def _resolve_transcript(args, files, fallback: str) -> str:
+    if args.transcript != "auto":
+        return args.transcript
+    meta = files.et_proof_meta()
+    if meta.exists():
+        try:
+            return json.loads(meta.read_text()).get("transcript", fallback)
+        except (ValueError, OSError):
+            pass
+    return fallback
 
 
 def handle_et_verify(args, files, config):
     from ..zk import api as zk
 
-    params = files.read(files.kzg_params(ET_PARAMS_K))
+    shape, params_k = _et_shape(args)
+    transcript = _resolve_transcript(args, files, "poseidon")
+    params = files.read(files.kzg_params(params_k))
     pk = files.read(files.et_proving_key())
     proof = files.read(files.et_proof())
     pub_inputs = files.read(files.et_public_inputs())
-    ok = zk.verify_et(params, pk, pub_inputs, proof)
+    ok = zk.verify_et(params, pk, pub_inputs, proof, shape=shape,
+                      transcript=transcript)
     print("EigenTrust proof: VALID" if ok else "EigenTrust proof: INVALID")
     return 0 if ok else 1
+
+
+def handle_et_verifier(args, files, config):
+    """Emit the deployable Yul verifier; --check replays the written
+    proof artifacts through the in-repo EVM (yellow-paper gas) — the
+    full on-chain flow, drivable end-to-end with shipped tools."""
+    from ..zk import api as zk
+
+    shape, params_k = _et_shape(args)
+    transcript = _resolve_transcript(args, files, "keccak")
+    params = files.read(files.kzg_params(params_k))
+    pk = files.read(files.et_proving_key())
+    code = zk.gen_et_evm_verifier(params, pk, transcript=transcript)
+    files.et_verifier().write_text(code)
+    print(f"wrote {files.et_verifier()}")
+    if args.check:
+        from ..zk.yul import VMRevert, YulVM
+
+        proof = files.read(files.et_proof())
+        pub_inputs = files.read(files.et_public_inputs())
+        calldata = zk.et_evm_calldata(pub_inputs, proof, shape=shape)
+        try:
+            out, gas = YulVM(code).run(calldata)
+            ok = int.from_bytes(out, "big") == 1
+        except VMRevert:
+            ok, gas = False, 0
+        print(f"EVM replay: {'VALID' if ok else 'INVALID'} "
+              f"({gas} gas, {transcript} transcript)")
+        return 0 if ok else 1
 
 
 def handle_th_pk(args, files, config):
@@ -588,6 +685,7 @@ HANDLERS = {
     "bandada": handle_bandada,
     "deploy": handle_deploy,
     "et-proof": handle_et_proof,
+    "et-verifier": handle_et_verifier,
     "et-proving-key": handle_et_pk,
     "et-verify": handle_et_verify,
     "kzg-params": handle_kzg_params,

@@ -44,6 +44,13 @@ class CircuitShape:
 
 DEFAULT_SHAPE = CircuitShape()
 
+# the 2-peer / 2-iteration dev instantiation: ECDSA chips dominate rows,
+# so this is the smallest REAL shape (790k rows -> k=20). Single source
+# of truth for the CLI --shape tiny flag, the measurement tools and the
+# test suite.
+TINY_SHAPE = CircuitShape(num_neighbours=2, num_iterations=2,
+                          lookup_bits=12)
+
 _DUMMY_SEED = 0xD00D
 
 
@@ -84,16 +91,16 @@ def _keygen(params, cs):
     return keygen(params, cs)
 
 
-def _prove(params, pk, cs):
+def _prove(params, pk, cs, transcript: str = "poseidon"):
     from .prover_fast import FastProvingKey, prove_auto
 
     if isinstance(pk, FastProvingKey):
         # TPU round-3/4 when a device + eval-form key are available;
         # degrades to the host path on any device fault
-        return prove_auto(params, pk, cs)
+        return prove_auto(params, pk, cs, transcript=transcript)
     from .plonk import prove
 
-    return prove(params, pk, cs)
+    return prove(params, pk, cs, transcript=transcript)
 
 
 def _load_params(params: bytes):
@@ -181,6 +188,61 @@ def _build_et_circuit(witness, shape: CircuitShape):
     return circuit.build(witness)
 
 
+def demo_et_setup(shape: CircuitShape = TINY_SHAPE, seed: int = 5000):
+    """A deterministic REAL ETSetup built directly (no chain): sparse
+    opinions over ``shape.num_neighbours`` peers — the fixture behind
+    the measurement tools and the test suite's tiny cycles. Unlike
+    ``_dummy_et_fixture`` (full opinions, keygen shape only) this
+    produces a structurally sparse witness."""
+    from ..client.circuit_io import ETPublicInputs, ETSetup
+    from ..crypto.poseidon import PoseidonSponge
+    from ..crypto.secp256k1 import EcdsaKeypair
+    from ..models.eigentrust import (
+        HASHER_WIDTH,
+        Attestation,
+        EigenTrustSet,
+        SignedAttestation,
+    )
+
+    domain = Fr(42)
+    n = shape.num_neighbours
+    kps = [EcdsaKeypair(seed + i) for i in range(n)]
+    addrs = [kp.public_key.to_address() for kp in kps]
+    native = EigenTrustSet(n, shape.num_iterations, shape.initial_score,
+                           domain)
+    for a in addrs:
+        native.add_member(a)
+    matrix = [[None] * n for _ in range(n)]
+    op_hashes = []
+    # ring of sparse opinions: peer i attests only peer (i+1) mod n
+    rows = {i: {(i + 1) % n: 400 + 200 * i} for i in range(n)}
+    for i, row in rows.items():
+        signed = []
+        for j in range(n):
+            if row.get(j):
+                att = Attestation(about=addrs[j], domain=domain,
+                                  value=Fr(row[j]), message=Fr.zero())
+                sa = SignedAttestation(att, kps[i].sign(int(att.hash())))
+                signed.append(sa)
+                matrix[i][j] = sa
+            else:
+                signed.append(None)
+        op_hashes.append(native.update_op(kps[i].public_key, signed))
+    scores = native.converge()
+    ratios = native.converge_rational()
+    sponge = PoseidonSponge(HASHER_WIDTH)
+    sponge.update(op_hashes)
+    pub_inputs = ETPublicInputs(list(addrs), scores, domain,
+                                sponge.squeeze())
+    return ETSetup(
+        address_set=[a.to_bytes_be()[12:] for a in addrs],
+        attestation_matrix=matrix,
+        pub_keys=[kp.public_key for kp in kps],
+        pub_inputs=pub_inputs,
+        rational_scores=ratios,
+    )
+
+
 def generate_et_pk(params: bytes, shape: CircuitShape = DEFAULT_SHAPE) -> bytes:
     """Proving key over the dummy-witness circuit (lib.rs:537-558); the
     circuit structure is witness-independent, so the key proves any
@@ -214,21 +276,48 @@ def _et_setup_circuit(setup, shape: CircuitShape):
 
 
 def generate_et_proof(params: bytes, pk: bytes, setup,
-                      shape: CircuitShape = DEFAULT_SHAPE) -> bytes:
+                      shape: CircuitShape = DEFAULT_SHAPE,
+                      transcript: str = "poseidon") -> bytes:
+    """``transcript="keccak"`` emits the on-chain-cheap proof (one
+    keccak256 per Fiat–Shamir challenge) that the generated Yul/EVM
+    verifier checks at ~388 k gas; "poseidon" keeps recursion parity
+    with the in-circuit aggregator (the Threshold flow requires it)."""
     p = _load_params(params)
     chips, _ = _et_setup_circuit(setup, shape)
-    return _prove(p, _load_pk(pk), chips.cs)
+    return _prove(p, _load_pk(pk), chips.cs, transcript=transcript)
 
 
 def verify_et(params: bytes, pk: bytes, pub_inputs: bytes, proof: bytes,
-              shape: CircuitShape = DEFAULT_SHAPE) -> bool:
+              shape: CircuitShape = DEFAULT_SHAPE,
+              transcript: str = "poseidon") -> bool:
     from ..client.circuit_io import ETPublicInputs
     from .plonk import verify
 
     p = _load_params_verifier(params)
     pubs = ETPublicInputs.from_bytes(pub_inputs, shape.num_neighbours)
     flat = [int(x) for x in pubs.to_flat()]
-    return verify(p, _load_vk(pk), flat, proof)
+    return verify(p, _load_vk(pk), flat, proof, transcript=transcript)
+
+
+def gen_et_evm_verifier(params: bytes, pk: bytes,
+                        transcript: str = "keccak") -> str:
+    """Yul source of the EVM verifier for the EigenTrust circuit —
+    the reference's deployable artifact (verifier/mod.rs:116-145).
+    Pairs with proofs from ``generate_et_proof(transcript=...)``."""
+    from .evm import gen_evm_verifier_code
+
+    return gen_evm_verifier_code(_load_params_verifier(params),
+                                 _load_vk(pk), transcript=transcript)
+
+
+def et_evm_calldata(pub_inputs: bytes, proof: bytes,
+                    shape: CircuitShape = DEFAULT_SHAPE) -> bytes:
+    """ABI calldata (instances ‖ proof) for the generated verifier."""
+    from ..client.circuit_io import ETPublicInputs
+    from .evm import encode_calldata
+
+    pubs = ETPublicInputs.from_bytes(pub_inputs, shape.num_neighbours)
+    return encode_calldata([int(x) for x in pubs.to_flat()], proof)
 
 
 def _aggregate_th_circuit(p, et_chips, et_pubs, target_address: Fr,

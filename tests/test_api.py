@@ -25,8 +25,10 @@ from protocol_tpu.zk.api import CircuitShape
 DOMAIN = Fr(42)
 
 # smallest real shape: 2 peers, 2 iterations (ECDSA chips dominate rows,
-# so fewer iterations only trims the tail), small range table
-TINY = CircuitShape(num_neighbours=2, num_iterations=2, lookup_bits=12)
+# so fewer iterations only trims the tail), small range table — the
+# canonical instance lives in the api module (CLI --shape tiny and the
+# measurement tools share it)
+from protocol_tpu.zk.api import TINY_SHAPE as TINY  # noqa: E402
 
 
 def tiny_et_setup(shape=TINY):

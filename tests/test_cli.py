@@ -24,9 +24,10 @@ def test_parser_accepts_all_verbs():
         ("sparse-scores", ["--edges", "e.csv", "--n", "10"]),
         ("bandada", ["--action", "add", "--identity-commitment", "1", "--address", "0xaa"]),
         ("deploy", []),
-        ("et-proof", []),
+        ("et-proof", ["--transcript", "keccak", "--shape", "tiny"]),
         ("et-proving-key", []),
         ("et-verify", []),
+        ("et-verifier", ["--check"]),
         ("kzg-params", ["--k", "10"]),
         ("local-scores", []),
         ("scores", ["--backend", "jax"]),
@@ -281,3 +282,97 @@ def test_bundled_demo_assets_score_out_of_box(tmp_path):
         assert got[addr]["score_fr"] == row["score_fr"]
         assert got[addr]["numerator"] == row["numerator"]
         assert got[addr]["denominator"] == row["denominator"]
+
+
+class TestEvmVerifierVerb:
+    """The on-chain flow with shipped tools: et-proof --transcript
+    keccak + et-verifier --check (Yul artifact + in-repo EVM replay).
+    The fast test drives the verbs over small fixture artifacts (an
+    ET-shaped k=8 snark — the artifact files don't encode k, so the
+    verbs exercise the real load/codegen/replay path); the slow test
+    runs the whole attest -> scores -> pk -> proof -> verifier flow at
+    the tiny shape."""
+
+    @staticmethod
+    def _et_shaped_fixture(tmp_path, transcript):
+        """Write kzg-params/pk/proof/public-inputs artifacts for a
+        small circuit whose publics follow the n=2 ET layout."""
+        from protocol_tpu.client.circuit_io import ETPublicInputs
+        from protocol_tpu.utils.fields import Fr
+        from protocol_tpu.zk.gadgets import Chips
+        from protocol_tpu.zk.kzg import KZGParams
+        from protocol_tpu.zk.plonk import ConstraintSystem, keygen, prove
+
+        addrs = [11, 22]
+        scores = [700, 1300]
+        pubs = addrs + scores + [42, 12345]
+        c = Chips(ConstraintSystem(lookup_bits=4))
+        x, y = c.witness(3), c.witness(4)
+        s = c.add(x, y)
+        c.lincomb([(2, x), (3, y), (1, s), (1, c.mul(x, y))], const=1)
+        c.mul_add(x, y, s)
+        c.range_check(c.witness(9), 4)
+        c.cs.add_row([0, 0, 2, 3, 0, 0], q_mul_cd=1, q_const=-6)
+        for v in pubs:
+            c.cs.public_input(v)
+        c.cs.check_satisfied()
+        params = KZGParams.setup(8, seed=b"cli-evm")
+        pk = keygen(params, c.cs)
+        proof = prove(params, pk, c.cs, transcript=transcript)
+        (tmp_path / "kzg-params-20.bin").write_bytes(params.to_bytes())
+        (tmp_path / "et-proving-key.bin").write_bytes(pk.to_bytes())
+        (tmp_path / "et-proof.bin").write_bytes(proof)
+        pub_obj = ETPublicInputs(
+            participants=[Fr(a) for a in addrs],
+            scores=[Fr(s) for s in scores],
+            domain=Fr(42), opinion_hash=Fr(12345))
+        (tmp_path / "et-public-inputs.bin").write_bytes(pub_obj.to_bytes())
+
+    def test_et_verifier_check_keccak(self, tmp_path, capsys):
+        self._et_shaped_fixture(tmp_path, "keccak")
+        assert run(tmp_path, "et-verifier", "--shape", "tiny",
+                   "--transcript", "keccak", "--check") == 0
+        out = capsys.readouterr().out
+        assert "VALID" in out and "gas" in out
+        assert (tmp_path / "et-verifier.yul").exists()
+        assert run(tmp_path, "et-verify", "--shape", "tiny",
+                   "--transcript", "keccak") == 0
+        assert "VALID" in capsys.readouterr().out
+
+    def test_et_verifier_rejects_tampered_proof(self, tmp_path, capsys):
+        self._et_shaped_fixture(tmp_path, "keccak")
+        proof = bytearray((tmp_path / "et-proof.bin").read_bytes())
+        proof[40] ^= 1
+        (tmp_path / "et-proof.bin").write_bytes(bytes(proof))
+        assert run(tmp_path, "et-verifier", "--shape", "tiny",
+                   "--transcript", "keccak", "--check") == 1
+        assert "INVALID" in capsys.readouterr().out
+
+    def test_transcript_mismatch_fails_cleanly(self, tmp_path, capsys):
+        """A poseidon proof must not pass the keccak Yul verifier."""
+        self._et_shaped_fixture(tmp_path, "poseidon")
+        assert run(tmp_path, "et-verifier", "--shape", "tiny",
+                   "--transcript", "keccak", "--check") == 1
+        assert "INVALID" in capsys.readouterr().out
+
+
+@pytest.mark.slow
+def test_cli_keccak_onchain_flow_tiny(tmp_path, capsys, monkeypatch):
+    """The judge-facing end-to-end: attest -> local-scores -> kzg-params
+    -> et-proving-key -> et-proof --transcript keccak -> et-verifier
+    --check, all through shipped CLI verbs at the tiny (2-peer, k=20)
+    shape. One real SRS + keygen + prove on the host path."""
+    monkeypatch.delenv("MNEMONIC", raising=False)
+    peer = "0x" + "22" * 20
+    assert run(tmp_path, "attest", "--to", peer, "--score", "7") == 0
+    capsys.readouterr()
+    assert run(tmp_path, "kzg-params", "--k", "20") == 0
+    assert run(tmp_path, "et-proving-key", "--shape", "tiny") == 0
+    assert run(tmp_path, "et-proof", "--shape", "tiny",
+               "--transcript", "keccak") == 0
+    assert run(tmp_path, "et-verify", "--shape", "tiny",
+               "--transcript", "keccak") == 0
+    assert run(tmp_path, "et-verifier", "--shape", "tiny",
+               "--transcript", "keccak", "--check") == 0
+    out = capsys.readouterr().out
+    assert "EVM replay: VALID" in out

@@ -69,16 +69,28 @@ def prepare(k: int) -> None:
 
 
 def _tiny_shape():
-    from protocol_tpu.zk.api import CircuitShape
-
     # the n=2 x 2-iteration shape whose 790k rows need k=20 (BASELINE.md)
-    return CircuitShape(num_neighbours=2, num_iterations=2, lookup_bits=12)
+    from protocol_tpu.zk.api import TINY_SHAPE
+
+    return TINY_SHAPE
 
 
 def child(k: int, seed: int, out_path: str, host: bool) -> None:
     """One prove attempt (fresh process = fresh device backend)."""
     sys.path.insert(0, REPO)
     os.chdir(REPO)  # the TPU platform plugin registers relative to CWD
+    if not host:
+        # persistent XLA compile cache: retries and later sessions skip
+        # the multi-minute k=20 program compiles
+        try:
+            import jax
+
+            jax.config.update("jax_compilation_cache_dir",
+                              os.path.join(CACHE, "xla_cache"))
+            jax.config.update("jax_persistent_cache_min_compile_time_secs",
+                              1.0)
+        except Exception:
+            pass
     import random
 
     from protocol_tpu.zk import api
@@ -112,15 +124,29 @@ def child(k: int, seed: int, out_path: str, host: bool) -> None:
     if not ok:
         print("VERIFY FAILED (corrupt device session?)", file=sys.stderr)
         sys.exit(3)
+    result = {"k": k, "seed": seed, "load_s": round(load_s, 1),
+              "prove_s": round(prove_s, 1),
+              "verify_s": round(verify_s, 2),
+              "path": "host" if host else "tpu"}
+    if not host:
+        # warm steady-state prove: XLA programs compiled, DeviceProver
+        # (pk cosets) resident — the per-proof cost a long-lived prover
+        # service pays, like halo2 reusing its ProvingKey
+        rng2 = random.Random(seed + 1000)
+        t0 = time.time()
+        proof2 = pf.prove_fast_tpu(params, pk, chips.cs,
+                                   randint=lambda: rng2.randrange(R))
+        result["prove_warm_s"] = round(time.time() - t0, 1)
+        if not verify(params, pk, chips.cs.public_values(), proof2):
+            print("WARM VERIFY FAILED", file=sys.stderr)
+            sys.exit(3)
     with open(out_path, "wb") as f:
         f.write(proof)
     with open(out_path + ".json", "w") as f:
-        json.dump({"k": k, "seed": seed, "load_s": round(load_s, 1),
-                   "prove_s": round(prove_s, 1),
-                   "verify_s": round(verify_s, 2),
-                   "path": "host" if host else "tpu"}, f)
+        json.dump(result, f)
     print(f"{'host' if host else 'tpu'} prove ok: load {load_s:.1f}s "
-          f"prove {prove_s:.1f}s verify {verify_s:.2f}s", flush=True)
+          f"prove {prove_s:.1f}s verify {verify_s:.2f}s "
+          f"warm {result.get('prove_warm_s', '-')}", flush=True)
 
 
 def main() -> int:

@@ -38,7 +38,9 @@ def main() -> int:
 
     from protocol_tpu.utils.fields import Fr
     from protocol_tpu.zk import api
-    from tests.test_api import TINY, tiny_et_setup
+    from protocol_tpu.zk.api import TINY_SHAPE as TINY
+
+    tiny_et_setup = api.demo_et_setup
 
     timings = {}
 
