@@ -29,6 +29,7 @@ from dataclasses import dataclass
 import numpy as np
 
 from .. import native
+from ..utils import trace
 from ..utils.errors import EigenError
 from ..utils.fields import BN254_FR_MODULUS
 from .bn254 import BN254_FQ_MODULUS, G1_GEN
@@ -567,7 +568,10 @@ def prove_auto(params: KZGParams, pk: FastProvingKey, cs: ConstraintSystem,
     host the probe below fails closed and the numpy+native host path
     runs (prove_fast_tpu does its own jax imports)."""
     use_tpu = False
-    if pk.eval_form:
+    # k ≤ 21 is the HBM feasibility line on a 16 GB chip (k=20 with
+    # resident ext chunks, k=21 streaming); beyond it the device
+    # attempt would burn minutes of uploads before RESOURCE_EXHAUSTED
+    if pk.eval_form and pk.k <= 21:
         try:
             import jax
 
@@ -857,7 +861,8 @@ def prove_fast_tpu(params: KZGParams, pk: FastProvingKey,
     if (params.g1_lagrange is None or len(params.g1_lagrange) != n):
         raise EigenError("proving_error",
                          "prove_fast_tpu needs a matching Lagrange basis")
-    dp = _device_prover(pk)
+    with trace.span("prove_tpu.device_prover_init"):
+        dp = _device_prover(pk)
     pubs = (list(public_inputs) if public_inputs is not None
             else cs.public_values())
     tr = make_transcript(transcript)
@@ -874,16 +879,18 @@ def prove_fast_tpu(params: KZGParams, pk: FastProvingKey,
     # eval-form device arrays are transient: intt to coeffs, then drop
     # (ζ-evals run from coeffs; keeping 10 eval arrays resident is what
     # pushed k=20 over the 16 GB HBM line)
-    wire_coeff_dev = []
-    for w in range(NUM_WIRES):
-        ev = ptpu.upload_mont(wire_vals[w])
-        wire_coeff_dev.append(dp.intt_natural(ev))
-        del ev
+    with trace.span("prove_tpu.r1_upload_intt"):
+        wire_coeff_dev = []
+        for w in range(NUM_WIRES):
+            ev = ptpu.upload_mont(wire_vals[w])
+            wire_coeff_dev.append(dp.intt_natural(ev))
+            del ev
     wire_blinds = [[randint() for _ in range(2)] for _ in range(NUM_WIRES)]
-    wire_commits = [
-        _commit_blinded_evals(params, wire_vals[w], wire_blinds[w])
-        for w in range(NUM_WIRES)
-    ]
+    with trace.span("prove_tpu.r1_wire_commits"):
+        wire_commits = [
+            _commit_blinded_evals(params, wire_vals[w], wire_blinds[w])
+            for w in range(NUM_WIRES)
+        ]
     for cm in wire_commits:
         tr.absorb_point(cm)
 
@@ -904,13 +911,14 @@ def prove_fast_tpu(params: KZGParams, pk: FastProvingKey,
     omegas = np.zeros((n, 4), dtype="<u8")
     omegas[:, 0] = 1
     fk.coset_scale(omegas, d.omega)
-    z_vals = fk.perm_grand_product(wire_vals, pk.sigma_eval_limbs,
-                                   pk.shifts, omegas, beta, gamma)
-    z_dev = ptpu.upload_mont(z_vals)
-    z_coeff_dev = dp.intt_natural(z_dev)
-    del z_dev
-    z_blinds = [randint() for _ in range(3)]
-    z_commit = _commit_blinded_evals(params, z_vals, z_blinds)
+    with trace.span("prove_tpu.r2_grand_products"):
+        z_vals = fk.perm_grand_product(wire_vals, pk.sigma_eval_limbs,
+                                       pk.shifts, omegas, beta, gamma)
+        z_dev = ptpu.upload_mont(z_vals)
+        z_coeff_dev = dp.intt_natural(z_dev)
+        del z_dev
+        z_blinds = [randint() for _ in range(3)]
+        z_commit = _commit_blinded_evals(params, z_vals, z_blinds)
     tr.absorb_point(z_commit)
 
     table_limbs = np.zeros((n, 4), dtype="<u8")
@@ -933,27 +941,31 @@ def prove_fast_tpu(params: KZGParams, pk: FastProvingKey,
     pi_coeff_dev = dp.intt_natural(ptpu.upload_mont(pi_vals))
 
     ch_planes = dp.challenge_planes(beta, gamma, beta_lk, alpha, pk.shifts)
-    t_chunks_fs = []
-    for j in range(8):
-        wires_e = [dp.ext_chunk(wire_coeff_dev[w], j, wire_blinds[w])
-                   for w in range(NUM_WIRES)]
-        z_e = dp.ext_chunk(z_coeff_dev, j, z_blinds)
-        m_e = dp.ext_chunk(m_coeff_dev, j, m_blinds)
-        phi_e = dp.ext_chunk(phi_coeff_dev, j, phi_blinds)
-        pi_e = dp.ext_chunk(pi_coeff_dev, j)
-        t_chunks_fs.append(dp.quotient_chunk(j, wires_e, z_e, m_e, phi_e,
-                                             pi_e, ch_planes))
-    t_coeff_chunks = dp.intt8(t_chunks_fs)
-    chunk_arrs = [ptpu.download_std(t_coeff_chunks[u])
-                  for u in range(QUOTIENT_CHUNKS)]
-    top = ptpu.download_std(t_coeff_chunks[QUOTIENT_CHUNKS])
+    with trace.span("prove_tpu.r3_quotient"):
+        t_chunks_fs = []
+        for j in range(8):
+            wires_e = [dp.ext_chunk(wire_coeff_dev[w], j, wire_blinds[w])
+                       for w in range(NUM_WIRES)]
+            z_e = dp.ext_chunk(z_coeff_dev, j, z_blinds)
+            m_e = dp.ext_chunk(m_coeff_dev, j, m_blinds)
+            phi_e = dp.ext_chunk(phi_coeff_dev, j, phi_blinds)
+            pi_e = dp.ext_chunk(pi_coeff_dev, j)
+            t_chunks_fs.append(dp.quotient_chunk(j, wires_e, z_e, m_e,
+                                                 phi_e, pi_e, ch_planes))
+    with trace.span("prove_tpu.r3_intt8"):
+        t_coeff_chunks = dp.intt8(t_chunks_fs)
+    with trace.span("prove_tpu.r3_download"):
+        chunk_arrs = [ptpu.download_std(t_coeff_chunks[u])
+                      for u in range(QUOTIENT_CHUNKS)]
+        top = ptpu.download_std(t_coeff_chunks[QUOTIENT_CHUNKS])
     t_coeff_chunks[QUOTIENT_CHUNKS] = None  # only the zero check needs it
     if top.any():
         raise EigenError(
             "proving_error",
             "quotient degree overflow — witness does not satisfy the circuit",
         )
-    t_commits = [commit_limbs(params, ch) for ch in chunk_arrs]
+    with trace.span("prove_tpu.r3_t_commits"):
+        t_commits = [commit_limbs(params, ch) for ch in chunk_arrs]
     for cm in t_commits:
         tr.absorb_point(cm)
     zeta = tr.challenge()
@@ -971,9 +983,10 @@ def prove_fast_tpu(params: KZGParams, pk: FastProvingKey,
             xp = xp * at % R
         return b * zh % R
 
-    base_evals = dp.eval_coeffs_at_many(
-        wire_coeff_dev + [m_coeff_dev, z_coeff_dev, phi_coeff_dev]
-        + dp.fixed_coeffs + dp.sigma_coeffs, zeta)
+    with trace.span("prove_tpu.r4_evals"):
+        base_evals = dp.eval_coeffs_at_many(
+            wire_coeff_dev + [m_coeff_dev, z_coeff_dev, phi_coeff_dev]
+            + dp.fixed_coeffs + dp.sigma_coeffs, zeta)
     wire_evals = [
         (base_evals[w] + blind_corr(wire_blinds[w], zeta, zh_zeta)) % R
         for w in range(NUM_WIRES)
@@ -1027,10 +1040,11 @@ def prove_fast_tpu(params: KZGParams, pk: FastProvingKey,
         quotient = fk.poly_divide_linear(folded, at)
         return commit_limbs(params, quotient)
 
-    all_idx = list(range(len(base_polys)))
-    w_x = open_group_dev(all_idx, base_polys, zeta)
-    w_wx = open_group_dev([NUM_WIRES + 1, NUM_WIRES + 2],
-                          [z_coeff_dev, phi_coeff_dev], zeta_w)
+    with trace.span("prove_tpu.r4_openings"):
+        all_idx = list(range(len(base_polys)))
+        w_x = open_group_dev(all_idx, base_polys, zeta)
+        w_wx = open_group_dev([NUM_WIRES + 1, NUM_WIRES + 2],
+                              [z_coeff_dev, phi_coeff_dev], zeta_w)
 
     proof = Proof(wire_commits, m_commit, z_commit, phi_commit, t_commits,
                   wire_evals, m_eval, z_eval, z_next, phi_eval, phi_next,

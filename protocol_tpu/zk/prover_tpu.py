@@ -210,6 +210,76 @@ def _quotient_chunk_impl(wires, z_e, m_e, phi_e, pi_e, fixed16, sigma16,
     return f2.mont_mul(total, jnp.broadcast_to(zh_inv_plane, (L, n)))
 
 
+# --- streaming quotient (k ≥ 21: the 15 packed fixed/sigma ext-chunk
+# tables would need ~7.7 GB resident, past the 16 GB chip budget with
+# the working set; instead each pk column's ext chunk is generated
+# on the fly and folded into running accumulators, so at most one
+# pk-column ext array is live at a time — trading ~15 extra n-sized
+# NTTs per chunk for ~7.7 GB of HBM) ------------------------------------
+
+@jax.jit
+def _mul_first_impl(a, b):
+    return f2.mont_mul(a, b)
+
+
+@jax.jit
+def _mul_acc_impl(acc, a, b):
+    return f2.add(acc, f2.mont_mul(a, b))
+
+
+@jax.jit
+def _add2_impl(acc, a):
+    return f2.add(acc, a)
+
+
+@jax.jit
+def _perm_step_x_impl(pn, xs16, bshift_plane, w, gamma_plane):
+    n = w.shape[1]
+    f1 = f2.mont_mul(f2.unpack16(xs16),
+                     jnp.broadcast_to(bshift_plane, (L, n)))
+    f1 = f2.add(f2.add(f1, w), jnp.broadcast_to(gamma_plane, (L, n)))
+    return f2.mont_mul(pn, f1)
+
+
+@jax.jit
+def _perm_step_sg_impl(pd, sg_e, beta_plane, w, gamma_plane):
+    n = w.shape[1]
+    g2 = f2.mont_mul(sg_e, jnp.broadcast_to(beta_plane, (L, n)))
+    g2 = f2.add(f2.add(g2, w), jnp.broadcast_to(gamma_plane, (L, n)))
+    return f2.mont_mul(pd, g2)
+
+
+@jax.jit
+def _lk_impl(w5, fx8_e, m_e, phii, phiwi, blk_plane):
+    n = w5.shape[1]
+    one = f2._const_planes(_mont(1), n)
+    blk = jnp.broadcast_to(blk_plane, (L, n))
+    ba = f2.add(w5, blk)
+    bt = f2.add(fx8_e, blk)
+    lk = f2.mont_mul(f2.sub(phiwi, phii), ba)
+    lk = f2.sub(lk, one)
+    lk = f2.mont_mul(lk, bt)
+    return f2.add(lk, f2.mont_mul(m_e, ba))
+
+
+@jax.jit
+def _qfinal_impl(gate, pn, pd, lk, z_e, phii, l016, ch, zh_inv_plane):
+    n = gate.shape[1]
+
+    def cc(idx):
+        return jnp.broadcast_to(ch[:, idx : idx + 1], (L, n))
+
+    one = f2._const_planes(_mont(1), n)
+    l0 = f2.unpack16(l016)
+    perm = f2.sub(pn, pd)
+    total = f2.add(gate, f2.mont_mul(perm, cc(3)))
+    zm1 = f2.sub(z_e, one)
+    total = f2.add(total, f2.mont_mul(f2.mont_mul(l0, zm1), cc(4)))
+    total = f2.add(total, f2.mont_mul(lk, cc(5)))
+    total = f2.add(total, f2.mont_mul(f2.mont_mul(l0, phii), cc(6)))
+    return f2.mont_mul(total, jnp.broadcast_to(zh_inv_plane, (L, n)))
+
+
 @jax.jit
 def _combine1_impl(zc_u, s_neg16, su_u, *hats):
     """One output chunk u of the radix-8 combine: hats are the 8
@@ -308,9 +378,14 @@ class DeviceProver:
     uint16 packs, and fold/dot kernels take polys as separate args
     (a 25-poly jnp.stack is a 2.2 GB transient)."""
 
-    def __init__(self, k: int, shift: int, fixed_evals_u64, sigma_evals_u64):
+    def __init__(self, k: int, shift: int, fixed_evals_u64, sigma_evals_u64,
+                 ext_resident: bool | None = None):
         self.k = k
         self.n = n = 1 << k
+        # resident packed ext chunks are a speed/HBM trade: ~3.8 GB at
+        # k=20 (fits), ~7.7 GB at k=21 (does not) — default follows k
+        self.ext_resident = (k <= 20 if ext_resident is None
+                             else ext_resident)
         # pre-compile the upload/download programs at the working shape
         # BEFORE the heavy jit battery: the remote worker has repeatedly
         # faulted when the download program compiles after dozens of
@@ -359,8 +434,9 @@ class DeviceProver:
             cf = self.intt_natural(ev)
             del ev
             self.fixed_coeffs.append(cf)
-            self.fixed_ext.append(
-                [pk16(self.ext_chunk(cf, j)) for j in range(8)])
+            if self.ext_resident:
+                self.fixed_ext.append(
+                    [pk16(self.ext_chunk(cf, j)) for j in range(8)])
         self.sigma_coeffs = []
         self.sigma_ext = []
         for a in sigma_evals_u64:
@@ -368,8 +444,9 @@ class DeviceProver:
             cf = self.intt_natural(ev)
             del ev
             self.sigma_coeffs.append(cf)
-            self.sigma_ext.append(
-                [pk16(self.ext_chunk(cf, j)) for j in range(8)])
+            if self.ext_resident:
+                self.sigma_ext.append(
+                    [pk16(self.ext_chunk(cf, j)) for j in range(8)])
 
         # intt8 combine tables (packed)
         self.we_neg_pows = [pk16(powers_vector(pow(omega_e, -j, P), n))
@@ -425,13 +502,63 @@ class DeviceProver:
     def quotient_chunk(self, j, wires_e, z_e, m_e, phi_e, pi_e,
                        ch_planes) -> jnp.ndarray:
         """Device twin of the C++ quotient_eval on coset chunk j;
-        ``ch_planes`` from :meth:`challenge_planes`."""
+        ``ch_planes`` from :meth:`challenge_planes`. Dispatches to the
+        streaming variant when the pk ext chunks are not resident."""
+        if not self.ext_resident:
+            return self._quotient_chunk_streaming(
+                j, wires_e, z_e, m_e, phi_e, pi_e, ch_planes)
         return _quotient_chunk_impl(
             jnp.stack(wires_e), z_e, m_e, phi_e, pi_e,
             jnp.stack([self.fixed_ext[i][j] for i in range(9)]),
             jnp.stack([self.sigma_ext[i][j] for i in range(6)]),
             self.xs_fs[j], self.l0_fs[j], ch_planes,
             self.zh_inv_planes[j], self.A, self.B)
+
+    def _quotient_chunk_streaming(self, j, wires_e, z_e, m_e, phi_e,
+                                  pi_e, ch_planes) -> jnp.ndarray:
+        """Same math as ``_quotient_chunk_impl``, but each pk column's
+        ext chunk is generated on the fly and folded immediately, so at
+        most one is live — see the streaming-quotient section above.
+        Bit-identical to the resident path (tested)."""
+        def cp(idx):  # (L, 1) challenge plane
+            return ch_planes[:, idx : idx + 1]
+
+        # gate: Σ fx_i·w_i + fx5·w0w1 + fx6·w2w3 + fx7 + pi
+        gate = None
+        for i in range(5):
+            fx = self.ext_chunk(self.fixed_coeffs[i], j)
+            gate = (_mul_first_impl(fx, wires_e[i]) if gate is None
+                    else _mul_acc_impl(gate, fx, wires_e[i]))
+        w01 = _mul_first_impl(wires_e[0], wires_e[1])
+        gate = _mul_acc_impl(gate, self.ext_chunk(self.fixed_coeffs[5], j),
+                             w01)
+        del w01
+        w23 = _mul_first_impl(wires_e[2], wires_e[3])
+        gate = _mul_acc_impl(gate, self.ext_chunk(self.fixed_coeffs[6], j),
+                             w23)
+        del w23
+        gate = _add2_impl(gate, self.ext_chunk(self.fixed_coeffs[7], j))
+        gate = _add2_impl(gate, pi_e)
+
+        # permutation products (sequential in k — one σ ext live)
+        zwi = fs_roll_next(z_e, self.A, self.B)
+        pn, pd = z_e, zwi
+        for kk in range(6):
+            pn = _perm_step_x_impl(pn, self.xs_fs[j], cp(7 + kk),
+                                   wires_e[kk], cp(1))
+            sg = self.ext_chunk(self.sigma_coeffs[kk], j)
+            pd = _perm_step_sg_impl(pd, sg, cp(0), wires_e[kk], cp(1))
+            del sg
+
+        # LogUp
+        phiwi = fs_roll_next(phi_e, self.A, self.B)
+        fx8 = self.ext_chunk(self.fixed_coeffs[8], j)
+        lk = _lk_impl(wires_e[5], fx8, m_e, phi_e, phiwi, cp(2))
+        del fx8
+
+        return _qfinal_impl(gate, pn, pd, lk, z_e, phi_e,
+                            self.l0_fs[j], ch_planes,
+                            self.zh_inv_planes[j])
 
     # --- 8n inverse -------------------------------------------------------
 

@@ -182,6 +182,37 @@ def test_prove_fast_tpu_bytes_equal_host():
     assert verify(params, pk, cs.public_values(), proof_tpu)
 
 
+def test_streaming_quotient_matches_resident(dp):
+    """The k≥21 streaming quotient (pk ext chunks generated on the fly)
+    must be BIT-identical to the resident-table path."""
+    dp_obj, fixed_u64, sigma_u64 = dp
+    dp_stream = ptpu.DeviceProver(K, SHIFT, fixed_u64, sigma_u64,
+                                  ext_resident=False)
+    rng = np.random.default_rng(33)
+    wires = [ptpu.upload_mont(_rand_u64(N, 500 + w)[0]) for w in range(6)]
+    z = ptpu.upload_mont(_rand_u64(N, 510)[0])
+    m = ptpu.upload_mont(_rand_u64(N, 511)[0])
+    phi = ptpu.upload_mont(_rand_u64(N, 512)[0])
+    pi = ptpu.upload_mont(_rand_u64(N, 513)[0])
+    beta, gamma, beta_lk, alpha = [int(x) % P for x in
+                                   rng.integers(1, 2**62, 4)]
+    shifts = _find_coset_shifts(N, 6)
+    ch_r = dp_obj.challenge_planes(beta, gamma, beta_lk, alpha, shifts)
+    ch_s = dp_stream.challenge_planes(beta, gamma, beta_lk, alpha, shifts)
+    for j in (0, 5):
+        we_r = [dp_obj.ext_chunk(dp_obj.intt_natural(w), j) for w in wires]
+        ze_r = dp_obj.ext_chunk(dp_obj.intt_natural(z), j)
+        me_r = dp_obj.ext_chunk(dp_obj.intt_natural(m), j)
+        pe_r = dp_obj.ext_chunk(dp_obj.intt_natural(phi), j)
+        pie_r = dp_obj.ext_chunk(dp_obj.intt_natural(pi), j)
+        t_res = dp_obj.quotient_chunk(j, we_r, ze_r, me_r, pe_r, pie_r,
+                                      ch_r)
+        t_str = dp_stream.quotient_chunk(j, we_r, ze_r, me_r, pe_r,
+                                         pie_r, ch_s)
+        assert np.array_equal(ptpu.download_std(t_res),
+                              ptpu.download_std(t_str))
+
+
 def test_quotient_chunk_matches_host(dp):
     dp_obj, fixed_u64, sigma_u64 = dp
     rng = np.random.default_rng(21)
