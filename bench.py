@@ -90,10 +90,16 @@ def main():
     rop = None
     cache_path = None
     if backend == "routed" and args.cache_dir:
+        # raw-directory cache (fast loads); migrate a legacy .npz once
         cache_path = (Path(args.cache_dir)
-                      / f"routed_ba_n{args.n}_m{args.m}_s0_v1.npz")
+                      / f"routed_ba_n{args.n}_m{args.m}_s0_v2")
+        legacy = (Path(args.cache_dir)
+                  / f"routed_ba_n{args.n}_m{args.m}_s0_v1.npz")
         if cache_path.exists():
             rop = RoutedOperator.load(cache_path)
+        elif legacy.exists():
+            rop = RoutedOperator.load(legacy)
+            rop.save(cache_path)
 
     if backend == "routed":
         if rop is None:

@@ -266,6 +266,97 @@ def save_operator_npz(op, path) -> None:
             os.unlink(tmp)
 
 
+def save_operator_dir(op, path) -> None:
+    """Directory twin of :func:`save_operator_npz`: one raw ``.npy``
+    per array plus a ``meta.json``. No zip container means no CRC32
+    pass and no chunked copies on load — at 10M peers (4 GB) the load
+    drops from ~11 s (npz) to disk-stream speed (~3.5 s). Atomic via
+    tmp-dir + rename."""
+    import dataclasses
+    import json
+    import os
+    import shutil
+
+    path = str(path)
+    tmp = f"{path}.tmp.{os.getpid()}"
+    try:
+        os.makedirs(tmp, exist_ok=True)
+        meta = {"fmt_version": 3, "ints": {}, "tups": {}, "arrays": [],
+                "lists": {}}
+        for f in dataclasses.fields(op):
+            v = getattr(op, f.name)
+            if isinstance(v, (int, np.integer)):
+                meta["ints"][f.name] = int(v)
+            elif isinstance(v, tuple):
+                meta["tups"][f.name] = [int(x) for x in v]
+            elif isinstance(v, np.ndarray):
+                np.save(os.path.join(tmp, f"arr_{f.name}.npy"), v)
+                meta["arrays"].append(f.name)
+            elif isinstance(v, list):
+                meta["lists"][f.name] = len(v)
+                for i, a in enumerate(v):
+                    np.save(os.path.join(tmp, f"lst_{f.name}_{i}.npy"),
+                            np.asarray(a))
+            else:  # pragma: no cover - new field types need a tag here
+                raise TypeError(
+                    f"unserializable field {f.name}: {type(v)}")
+        with open(os.path.join(tmp, "meta.json"), "w") as fh:
+            json.dump(meta, fh)
+        # swap the old cache out from under the final name, then swap
+        # the new one in — the only non-atomic window deletes a
+        # .old dir, never the freshly written data
+        old = f"{path}.old.{os.getpid()}"
+        if os.path.isdir(path):
+            os.rename(path, old)
+        elif os.path.exists(path):
+            os.unlink(path)
+            old = None
+        else:
+            old = None
+        os.rename(tmp, path)
+        if old is not None:
+            shutil.rmtree(old, ignore_errors=True)
+    except BaseException:
+        shutil.rmtree(tmp, ignore_errors=True)
+        raise
+
+
+def load_operator_dir(cls, path, mmap: bool = True):
+    """Inverse of :func:`save_operator_dir`.
+
+    ``mmap=True`` (default) memory-maps every array: the operator is
+    usable immediately and its ~4 GB (at 10M peers) page in exactly
+    once, on demand, during device staging — instead of a full eager
+    read (disk-bound, ~19 s cold) followed by a second pass in
+    device_put. The maps are read-only; consumers that mutate must
+    copy (none do)."""
+    import dataclasses
+    import json
+    import os
+
+    mode = "r" if mmap else None
+    with open(os.path.join(path, "meta.json")) as fh:
+        meta = json.load(fh)
+    kwargs = {}
+    for f in dataclasses.fields(cls):
+        if f.name in meta["ints"]:
+            kwargs[f.name] = meta["ints"][f.name]
+        elif f.name in meta["tups"]:
+            kwargs[f.name] = tuple(meta["tups"][f.name])
+        elif f.name in meta["arrays"]:
+            kwargs[f.name] = np.load(
+                os.path.join(path, f"arr_{f.name}.npy"), mmap_mode=mode)
+        elif f.name in meta["lists"]:
+            kwargs[f.name] = [
+                np.load(os.path.join(path, f"lst_{f.name}_{i}.npy"),
+                        mmap_mode=mode)
+                for i in range(meta["lists"][f.name])
+            ]
+        else:
+            raise ValueError(f"operator dir is missing field {f.name}")
+    return cls(**kwargs)
+
+
 def load_operator_npz(cls, z):
     """Inverse of :func:`save_operator_npz` for an open npz handle."""
     import dataclasses
@@ -322,14 +413,22 @@ class RoutedOperator:
         return _scores_for_nodes(self.state_to_node, self.n, state_scores)
 
     def save(self, path) -> None:
-        """Persist the compiled operator (uncompressed .npz, atomic) so
-        the one-time routing-plan compilation is reusable across runs.
-        Weights stay float64: the f64 converge path must round-trip
-        losslessly."""
-        save_operator_npz(self, path)
+        """Persist the compiled operator so the one-time routing-plan
+        compilation is reusable across runs. A path WITHOUT an ``.npz``
+        suffix uses the raw-directory format (3× faster loads at 10M);
+        ``.npz`` keeps the legacy container. Weights stay float64: the
+        f64 converge path must round-trip losslessly."""
+        if str(path).endswith(".npz"):
+            save_operator_npz(self, path)
+        else:
+            save_operator_dir(self, path)
 
     @classmethod
     def load(cls, path) -> "RoutedOperator":
+        import os
+
+        if os.path.isdir(path):
+            return load_operator_dir(cls, path)
         with np.load(path) as z:
             if "fmt_version" in z:
                 return load_operator_npz(cls, z)

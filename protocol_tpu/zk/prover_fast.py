@@ -879,11 +879,13 @@ def prove_fast_tpu(params: KZGParams, pk: FastProvingKey,
     # eval-form device arrays are transient: intt to coeffs, then drop
     # (ζ-evals run from coeffs; keeping 10 eval arrays resident is what
     # pushed k=20 over the 16 GB HBM line)
+    # streaming (k>=21) mode keeps every coefficient array packed
+    pack = (lambda x: x) if dp.ext_resident else ptpu._pack16_impl
     with trace.span("prove_tpu.r1_upload_intt"):
         wire_coeff_dev = []
         for w in range(NUM_WIRES):
             ev = ptpu.upload_mont(wire_vals[w])
-            wire_coeff_dev.append(dp.intt_natural(ev))
+            wire_coeff_dev.append(pack(dp.intt_natural(ev)))
             del ev
     wire_blinds = [[randint() for _ in range(2)] for _ in range(NUM_WIRES)]
     with trace.span("prove_tpu.r1_wire_commits"):
@@ -897,7 +899,7 @@ def prove_fast_tpu(params: KZGParams, pk: FastProvingKey,
     table_size = 1 << pk.lookup_bits if pk.lookup_bits else 1
     m_vals = _lookup_multiplicities(cs, n, table_size)
     m_dev = ptpu.upload_mont(m_vals)
-    m_coeff_dev = dp.intt_natural(m_dev)
+    m_coeff_dev = pack(dp.intt_natural(m_dev))
     del m_dev
     m_blinds = [randint() for _ in range(2)]
     m_commit = _commit_blinded_evals(params, m_vals, m_blinds)
@@ -915,7 +917,7 @@ def prove_fast_tpu(params: KZGParams, pk: FastProvingKey,
         z_vals = fk.perm_grand_product(wire_vals, pk.sigma_eval_limbs,
                                        pk.shifts, omegas, beta, gamma)
         z_dev = ptpu.upload_mont(z_vals)
-        z_coeff_dev = dp.intt_natural(z_dev)
+        z_coeff_dev = pack(dp.intt_natural(z_dev))
         del z_dev
         z_blinds = [randint() for _ in range(3)]
         z_commit = _commit_blinded_evals(params, z_vals, z_blinds)
@@ -926,7 +928,7 @@ def prove_fast_tpu(params: KZGParams, pk: FastProvingKey,
     phi_vals = fk.logup_running_sum(wire_vals[LOOKUP_WIRE], table_limbs,
                                     m_vals, beta_lk)
     phi_dev = ptpu.upload_mont(phi_vals)
-    phi_coeff_dev = dp.intt_natural(phi_dev)
+    phi_coeff_dev = pack(dp.intt_natural(phi_dev))
     del phi_dev
     phi_blinds = [randint() for _ in range(3)]
     phi_commit = _commit_blinded_evals(params, phi_vals, phi_blinds)
@@ -938,7 +940,7 @@ def prove_fast_tpu(params: KZGParams, pk: FastProvingKey,
     pi_vals = np.zeros((n, 4), dtype="<u8")
     for row, value in zip(pk.public_rows, pubs):
         _set_int(pi_vals, row, (-int(value)) % R)
-    pi_coeff_dev = dp.intt_natural(ptpu.upload_mont(pi_vals))
+    pi_coeff_dev = pack(dp.intt_natural(ptpu.upload_mont(pi_vals)))
 
     ch_planes = dp.challenge_planes(beta, gamma, beta_lk, alpha, pk.shifts)
     with trace.span("prove_tpu.r3_quotient"):
@@ -950,8 +952,8 @@ def prove_fast_tpu(params: KZGParams, pk: FastProvingKey,
             m_e = dp.ext_chunk(m_coeff_dev, j, m_blinds)
             phi_e = dp.ext_chunk(phi_coeff_dev, j, phi_blinds)
             pi_e = dp.ext_chunk(pi_coeff_dev, j)
-            t_chunks_fs.append(dp.quotient_chunk(j, wires_e, z_e, m_e,
-                                                 phi_e, pi_e, ch_planes))
+            t_chunks_fs.append(pack(dp.quotient_chunk(
+                j, wires_e, z_e, m_e, phi_e, pi_e, ch_planes)))
     with trace.span("prove_tpu.r3_intt8"):
         t_coeff_chunks = dp.intt8(t_chunks_fs)
     with trace.span("prove_tpu.r3_download"):

@@ -62,7 +62,19 @@ def _enter(x):
 
 @jax.jit
 def _to_u64_ready(x):
+    if x.dtype == jnp.uint16:  # packed storage (streaming mode)
+        x = f2.unpack16(x)
     return f2.canonical(f2.exit_mont(x))
+
+
+@jax.jit
+def _pack16_impl(x):
+    return f2.pack16(x)
+
+
+@jax.jit
+def _unpack16_impl(x):
+    return f2.unpack16(x)
 
 
 def upload_mont(arr_u64: np.ndarray) -> jnp.ndarray:
@@ -127,12 +139,22 @@ fs_roll_next = _fs_roll_next  # public alias (pure reshapes, jit-safe)
 
 # --- jitted kernels ---------------------------------------------------------
 
+def _as_planes(x):
+    """Trace-time dtype guard: packed (16, n) uint16 operands unpack to
+    (L, n) limb planes; already-unpacked arrays pass through. Lets every
+    kernel accept either storage form (the streaming k≥21 mode keeps
+    coefficient arrays packed to halve resident HBM)."""
+    if x.dtype == jnp.uint16:
+        return f2.unpack16(x)
+    return x
+
+
 @partial(jax.jit, static_argnames=("nblinds",))
 def _ext_chunk_impl(coeffs, coset16, xs16, zh_plane, blind_planes,
                     w_a, w_b, t16, nblinds: int):
     """Static tables arrive as packed (16, n) uint16 planes (half the
     HBM of int32 limb planes; the unpack is trivial VPU work)."""
-    scaled = f2.mont_mul(coeffs, f2.unpack16(coset16))
+    scaled = f2.mont_mul(_as_planes(coeffs), f2.unpack16(coset16))
     chunk = ntt_tpu._ntt_impl(scaled, w_a, w_b, t16)
     if nblinds:
         n = chunk.shape[1]
@@ -304,13 +326,25 @@ def _twiddle_mul(x, pows16):
 @jax.jit
 def _fold_impl(scalars, *polys):
     """polys: m separate (L, n) arrays (NOT stacked — a 25-poly stack
-    is a 2.2 GB transient copy at k=20); scalars: (m, L, 1) Montgomery
-    → Σ scalarᵢ·pᵢ."""
+    is a 2.2 GB transient copy at k=20), packed or unpacked; scalars:
+    (m, L, 1) Montgomery → Σ scalarᵢ·pᵢ."""
     n = polys[0].shape[1]
     acc = None
     for i, p in enumerate(polys):
-        term = f2.mont_mul(p, jnp.broadcast_to(scalars[i], (L, n)))
+        term = f2.mont_mul(_as_planes(p),
+                           jnp.broadcast_to(scalars[i], (L, n)))
         acc = term if acc is None else f2.add(acc, term)
+    return acc
+
+
+@jax.jit
+def _fold_cont_impl(acc, scalars, *polys):
+    """Continuation of a chunked fold: acc + Σ scalarᵢ·pᵢ."""
+    n = polys[0].shape[1]
+    for i, p in enumerate(polys):
+        term = f2.mont_mul(_as_planes(p),
+                           jnp.broadcast_to(scalars[i], (L, n)))
+        acc = f2.add(acc, term)
     return acc
 
 
@@ -347,9 +381,10 @@ def _sum_reduce_mont(prod: jnp.ndarray) -> jnp.ndarray:
 
 @jax.jit
 def _dots_impl(weights, *evals):
-    """m separate (L, n) arrays (unstacked, see _fold_impl); weights
-    (L, n) → (m, L, 1) Σ eᵢ·w."""
-    outs = [_sum_reduce_mont(f2.mont_mul(e, weights)) for e in evals]
+    """m separate (L, n) arrays (unstacked, see _fold_impl; packed or
+    unpacked); weights (L, n) → (m, L, 1) Σ eᵢ·w."""
+    outs = [_sum_reduce_mont(f2.mont_mul(_as_planes(e), weights))
+            for e in evals]
     return jnp.stack(outs)
 
 
@@ -427,26 +462,33 @@ class DeviceProver:
         # (eval_coeffs_at_many), and dropping the 15 eval arrays saves
         # ~1.3 GB of HBM at k=20 (the difference between fitting and
         # RESOURCE_EXHAUSTED on a 16 GB chip).
+        # streaming mode additionally keeps the pk coefficient arrays
+        # PACKED (uint16, half HBM): every consumer kernel unpacks at
+        # trace time via _as_planes
         self.fixed_coeffs = []
         self.fixed_ext = []
         for a in fixed_evals_u64:
             ev = upload_mont(a)
             cf = self.intt_natural(ev)
             del ev
-            self.fixed_coeffs.append(cf)
             if self.ext_resident:
+                self.fixed_coeffs.append(cf)
                 self.fixed_ext.append(
                     [pk16(self.ext_chunk(cf, j)) for j in range(8)])
+            else:
+                self.fixed_coeffs.append(pk16(cf))
         self.sigma_coeffs = []
         self.sigma_ext = []
         for a in sigma_evals_u64:
             ev = upload_mont(a)
             cf = self.intt_natural(ev)
             del ev
-            self.sigma_coeffs.append(cf)
             if self.ext_resident:
+                self.sigma_coeffs.append(cf)
                 self.sigma_ext.append(
                     [pk16(self.ext_chunk(cf, j)) for j in range(8)])
+            else:
+                self.sigma_coeffs.append(pk16(cf))
 
         # intt8 combine tables (packed)
         self.we_neg_pows = [pk16(powers_vector(pow(omega_e, -j, P), n))
@@ -573,21 +615,40 @@ class DeviceProver:
         here decides whether k=20 fits the chip."""
         hats = []
         for j in range(8):
-            cj = ntt_tpu.intt(t_chunks[j], self.plan)
+            src = t_chunks[j]
+            if src.dtype == jnp.uint16:  # streaming mode packs t chunks
+                src = _unpack16_impl(src)
+            cj = ntt_tpu.intt(src, self.plan)
             t_chunks[j] = None
+            del src
             hats.append(_twiddle_mul(cj, self.we_neg_pows[j]))
-        return [
-            _combine1_impl(self.zc_planes[u], self.s_neg_pows,
-                           self.su_planes[u], *hats)
-            for u in range(8)
-        ]
+        out = []
+        for u in range(8):
+            chunk = _combine1_impl(self.zc_planes[u], self.s_neg_pows,
+                                   self.su_planes[u], *hats)
+            # streaming mode keeps the coefficient chunks packed too —
+            # they stay resident through round 4 (downloads + folds
+            # unpack at trace time)
+            out.append(chunk if self.ext_resident
+                       else _pack16_impl(chunk))
+        return out
 
     # --- round 4 ----------------------------------------------------------
 
     def fold_coeffs(self, polys: list, scalars: list) -> jnp.ndarray:
-        """Σ scalarᵢ·pᵢ over same-length device coeff arrays."""
-        sc = jnp.stack([_cplane(s) for s in scalars])
-        return _fold_impl(sc, *polys)
+        """Σ scalarᵢ·pᵢ over same-length device coeff arrays, folded in
+        groups of 6 so the unpacked transients of a 25-poly fold never
+        coexist (the k=21 HBM line runs through this call)."""
+        acc = None
+        for base in range(0, len(polys), 6):
+            group = polys[base : base + 6]
+            sc = jnp.stack([_cplane(s)
+                            for s in scalars[base : base + 6]])
+            if acc is None:
+                acc = _fold_impl(sc, *group)
+            else:
+                acc = _fold_cont_impl(acc, sc, *group)
+        return acc
 
     def barycentric_weights(self, zeta: int) -> jnp.ndarray:
         key = zeta % P

@@ -21,6 +21,7 @@ from protocol_tpu.ops.clos import (
 )
 from protocol_tpu.ops.converge import converge_sparse_adaptive, operator_arrays, spmv
 from protocol_tpu.ops.routed import (
+    RoutedOperator,
     build_routed_operator,
     converge_routed_adaptive,
     converge_routed_fixed,
@@ -189,6 +190,29 @@ def test_routed_operator_save_load_roundtrip(tmp_path):
                                         max_iterations=300)
     np.testing.assert_allclose(srn, np.asarray(sg), rtol=1e-4, atol=0.5)
     assert rop2.nnz == rop.nnz and rop2.n_valid == rop.n_valid
+
+
+def test_routed_operator_dir_format_roundtrip(tmp_path):
+    """The raw-directory cache format (no zip/CRC — the 10M bench load
+    path) round-trips exactly, fields and arrays."""
+    import dataclasses
+
+    n, m = 500, 3
+    src, dst, val = barabasi_albert_edges(n, m, seed=21)
+    rop = build_routed_operator(n, src, dst, val)
+    path = tmp_path / "op_v2"
+    rop.save(path)
+    rop2 = RoutedOperator.load(path)
+    for f in dataclasses.fields(rop):
+        a, b = getattr(rop, f.name), getattr(rop2, f.name)
+        if isinstance(a, np.ndarray):
+            assert np.array_equal(a, b), f.name
+        elif isinstance(a, list):
+            assert len(a) == len(b)
+            for x, y in zip(a, b):
+                assert np.array_equal(np.asarray(x), np.asarray(y)), f.name
+        else:
+            assert a == b, f.name
 
 
 def test_routed_operator_legacy_v1_format_still_loads(tmp_path):

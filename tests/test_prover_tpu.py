@@ -213,6 +213,52 @@ def test_streaming_quotient_matches_resident(dp):
                               ptpu.download_std(t_str))
 
 
+def test_prove_streaming_mode_bytes_equal_host():
+    """Full prove_fast_tpu in streaming (k≥21-style) mode — packed
+    coefficient arrays, on-the-fly pk ext chunks, packed t chunks —
+    must still emit byte-identical proofs to the host prover."""
+    import random
+
+    from protocol_tpu.utils.fields import BN254_FR_MODULUS as R
+    from protocol_tpu.zk import prover_fast as pf
+    from protocol_tpu.zk.plonk import (
+        FIXED_NAMES,
+        NUM_WIRES,
+        ConstraintSystem,
+        verify,
+    )
+
+    rng = random.Random(21)
+    cs = ConstraintSystem(lookup_bits=6)
+    for _ in range(16):
+        a, b = rng.randrange(50), rng.randrange(50)
+        cs.add_row([a, b, (a * b + a) % R], q_a=1, q_mul_ab=1, q_c=R - 1)
+    cs.public_input(31337)
+    cs.check_satisfied()
+    params = pf.setup_params_fast(6, seed=b"stream-lock")
+    pk = pf.keygen_fast(params, cs, eval_pk=True)
+    ext_n = (1 << pk.k) * 8
+    shift = _find_coset_shifts(ext_n, 2)[1]
+    dp_stream = ptpu.DeviceProver(
+        pk.k, shift,
+        [pk.fixed_limbs[i] for i in range(len(FIXED_NAMES))],
+        [pk.sigma_limbs[w] for w in range(NUM_WIRES)],
+        ext_resident=False)
+    pf._DEVICE_PROVER[0] = pk
+    pf._DEVICE_PROVER[1] = dp_stream
+    try:
+        r1, r2 = random.Random(4), random.Random(4)
+        p_stream = pf.prove_fast_tpu(params, pk, cs,
+                                     randint=lambda: r1.randrange(R))
+        p_host = pf.prove_fast(params, pk, cs,
+                               randint=lambda: r2.randrange(R))
+    finally:
+        pf._DEVICE_PROVER[0] = None
+        pf._DEVICE_PROVER[1] = None
+    assert p_stream == p_host
+    assert verify(params, pk, cs.public_values(), p_stream)
+
+
 def test_quotient_chunk_matches_host(dp):
     dp_obj, fixed_u64, sigma_u64 = dp
     rng = np.random.default_rng(21)

@@ -1,0 +1,44 @@
+"""One-shot k=21 streaming device-prove probe (HBM fit + timing)."""
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+os.chdir(REPO)
+import jax
+
+jax.config.update("jax_compilation_cache_dir",
+                  os.path.join(REPO, "bench_cache", "zk", "xla_cache"))
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+
+from protocol_tpu.utils import trace
+from protocol_tpu.zk import api
+from protocol_tpu.zk import prover_fast as pf
+from protocol_tpu.zk.kzg import KZGParams
+from protocol_tpu.zk.plonk import verify
+
+trace.enable()
+params_path = os.path.join(REPO, "bench_cache", "zk", "params_th_k21.bin")
+t0 = time.time()
+params = KZGParams.from_bytes(open(params_path, "rb").read())
+print("params load", round(time.time() - t0, 1), flush=True)
+shape = api.TINY_SHAPE
+witness, *_ = api._dummy_et_fixture(shape)
+chips, _ = api._build_et_circuit(witness, shape)
+t0 = time.time()
+pk = pf.keygen_fast(params, chips.cs, k=21, eval_pk=True)
+print("keygen k=21", round(time.time() - t0, 1), flush=True)
+t0 = time.time()
+proof = pf.prove_fast_tpu(params, pk, chips.cs)
+dt = time.time() - t0
+print("prove k=21 (cold)", round(dt, 1), flush=True)
+ok = verify(params, pk, chips.cs.public_values(), proof)
+print("verify", ok, flush=True)
+t0 = time.time()
+proof2 = pf.prove_fast_tpu(params, pk, chips.cs)
+print("prove k=21 (warm)", round(time.time() - t0, 1), flush=True)
+print("verify2", verify(params, pk, chips.cs.public_values(), proof2),
+      flush=True)
+import json as _json
+print(_json.dumps(trace.summary(), indent=1), flush=True)

@@ -35,6 +35,14 @@ def main() -> int:
     sys.path.insert(0, REPO)
     os.chdir(REPO)
     os.makedirs(CACHE, exist_ok=True)
+    try:
+        import jax
+
+        jax.config.update("jax_compilation_cache_dir",
+                          os.path.join(CACHE, "xla_cache"))
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    except Exception:
+        pass
 
     from protocol_tpu.utils.fields import Fr
     from protocol_tpu.zk import api
