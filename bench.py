@@ -100,6 +100,7 @@ def main():
         elif legacy.exists():
             rop = RoutedOperator.load(legacy)
             rop.save(cache_path)
+            legacy.unlink()  # migration complete — don't double the cache
 
     if backend == "routed":
         if rop is None:

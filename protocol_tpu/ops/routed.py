@@ -303,8 +303,8 @@ def save_operator_dir(op, path) -> None:
         with open(os.path.join(tmp, "meta.json"), "w") as fh:
             json.dump(meta, fh)
         # swap the old cache out from under the final name, then swap
-        # the new one in — the only non-atomic window deletes a
-        # .old dir, never the freshly written data
+        # the new one in; if the final rename loses a race, restore the
+        # old cache rather than leaking it
         old = f"{path}.old.{os.getpid()}"
         if os.path.isdir(path):
             os.rename(path, old)
@@ -313,7 +313,12 @@ def save_operator_dir(op, path) -> None:
             old = None
         else:
             old = None
-        os.rename(tmp, path)
+        try:
+            os.rename(tmp, path)
+        except OSError:
+            if old is not None and not os.path.exists(path):
+                os.rename(old, path)  # put the previous cache back
+            raise
         if old is not None:
             shutil.rmtree(old, ignore_errors=True)
     except BaseException:

@@ -362,9 +362,27 @@ def test_cli_keccak_onchain_flow_tiny(tmp_path, capsys, monkeypatch):
     -> et-proving-key -> et-proof --transcript keccak -> et-verifier
     --check, all through shipped CLI verbs at the tiny (2-peer, k=20)
     shape. One real SRS + keygen + prove on the host path."""
+    from protocol_tpu.cli.fs import INSECURE_MNEMONIC
+    from protocol_tpu.client.eth import ecdsa_keypairs_from_mnemonic
+
+    # two identities attesting each other (every participant must
+    # attest: the circuit hashes all opinion rows while the client
+    # hashes attesters' only — reference-parity semantics on both
+    # sides, so a silent participant is rejected loudly at setup)
+    mn_b = "legal winner thank year wave sausage worth useful legal " \
+           "winner thank yellow"
+    addr_a = ecdsa_keypairs_from_mnemonic(INSECURE_MNEMONIC, 1)[0] \
+        .public_key.to_address_bytes().hex()
+    addr_b = ecdsa_keypairs_from_mnemonic(mn_b, 1)[0] \
+        .public_key.to_address_bytes().hex()
     monkeypatch.delenv("MNEMONIC", raising=False)
-    peer = "0x" + "22" * 20
-    assert run(tmp_path, "attest", "--to", peer, "--score", "7") == 0
+    assert run(tmp_path, "attest", "--to", "0x" + addr_b,
+               "--score", "7") == 0
+    monkeypatch.setenv("MNEMONIC", mn_b)
+    assert run(tmp_path, "attest", "--to", "0x" + addr_a,
+               "--score", "9") == 0
+    monkeypatch.delenv("MNEMONIC", raising=False)
+    assert run(tmp_path, "attestations") == 0  # chain -> attestations.csv
     capsys.readouterr()
     assert run(tmp_path, "kzg-params", "--k", "20") == 0
     assert run(tmp_path, "et-proving-key", "--shape", "tiny") == 0
