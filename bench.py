@@ -100,7 +100,9 @@ def main():
         elif legacy.exists():
             rop = RoutedOperator.load(legacy)
             rop.save(cache_path)
-            legacy.unlink()  # migration complete — don't double the cache
+            # migration complete — don't double the cache (idempotent
+            # for concurrent runs)
+            legacy.unlink(missing_ok=True)
 
     if backend == "routed":
         if rop is None:

@@ -31,10 +31,13 @@ from ..utils.errors import EigenError
 from .fs import EigenFile, assets_dir, load_mnemonic
 
 # Circuit degrees for the EigenTrust4 shape (the reference pins k=20/21,
-# circuits/mod.rs:57-59; this stack's ET circuit is 2.49M rows → k=22,
-# and the Threshold circuit aggregates the ET snark in-circuit on top).
+# circuits/mod.rs:57-59; this stack's ET circuit is 2.49M rows → k=22).
+# The Threshold circuit itself fits 2^21 since the batched-MSM verifier
+# fold (r3), but the flow proves the INNER ET snark under the shared TH
+# SRS, so the TH params must cover the ET domain: k=22 (was 23 with the
+# per-point RNS fold).
 ET_PARAMS_K = 22
-TH_PARAMS_K = 23
+TH_PARAMS_K = 22
 
 
 def build_parser() -> argparse.ArgumentParser:

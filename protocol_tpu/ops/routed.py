@@ -316,8 +316,14 @@ def save_operator_dir(op, path) -> None:
         try:
             os.rename(tmp, path)
         except OSError:
-            if old is not None and not os.path.exists(path):
-                os.rename(old, path)  # put the previous cache back
+            if old is not None:
+                if not os.path.exists(path):
+                    try:
+                        os.rename(old, path)  # previous cache back
+                    except OSError:
+                        pass  # surface the original failure below
+                else:  # a concurrent writer won the race — drop ours
+                    shutil.rmtree(old, ignore_errors=True)
             raise
         if old is not None:
             shutil.rmtree(old, ignore_errors=True)
