@@ -149,22 +149,35 @@ def _plane_matmul_left(w_planes: jnp.ndarray, x6: jnp.ndarray) -> jnp.ndarray:
     return f2.reduce_mxu_planes(out.reshape(n_out, A * C)).reshape(L, A, C)
 
 
-def _plane_matmul_right(x6: jnp.ndarray, w_planes: jnp.ndarray) -> jnp.ndarray:
-    """Σ_j X[r, j]·W[i, j] over planes: x6 (L6, A, B) int8, w_planes
-    (L6, B, B) int8 (indexed W[out, in]) → (L, A, B) Montgomery
-    relaxed."""
+def _plane_accum_right(x6: jnp.ndarray, w_planes: jnp.ndarray) -> jnp.ndarray:
+    """LAZY stage of the right plane-matmul: Σ_j X[r, j]·W[i, j] as
+    (2·L6−1, A, out) int32 plane accumulations, NOT yet reduced mod p.
+    x6 (L6, A, B_in) int8; w_planes (L6, out, B_in) int8 (W[out, in]).
+    Shared by the single-chip kernel below and the sharded NTT
+    (parallel/ntt.py), whose per-device partials psum to exactly this
+    total — the exact-f32 / int32 bound analysis lives in ONE place."""
     n_out = 2 * L6 - 1
     _, A, Bd = x6.shape
+    out_dim = w_planes.shape[1]
     xf = x6.astype(jnp.float32).reshape(L6 * A, Bd)
-    out = jnp.zeros((n_out, A, Bd), dtype=jnp.int32)
+    out = jnp.zeros((n_out, A, out_dim), dtype=jnp.int32)
     for m in range(L6):
         wf = w_planes[m].astype(jnp.float32)  # (out, in)
         cm = jax.lax.dot_general(
             xf, wf, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)
-        cm = cm.astype(jnp.int32).reshape(L6, A, Bd)
+        cm = cm.astype(jnp.int32).reshape(L6, A, out_dim)
         out = out.at[m : m + L6].add(cm)
-    return f2.reduce_mxu_planes(out.reshape(n_out, A * Bd)).reshape(
+    return out
+
+
+def _plane_matmul_right(x6: jnp.ndarray, w_planes: jnp.ndarray) -> jnp.ndarray:
+    """Σ_j X[r, j]·W[i, j] over planes: x6 (L6, A, B) int8, w_planes
+    (L6, B, B) int8 (indexed W[out, in]) → (L, A, B) Montgomery
+    relaxed."""
+    _, A, Bd = x6.shape
+    out = _plane_accum_right(x6, w_planes)
+    return f2.reduce_mxu_planes(out.reshape(out.shape[0], A * Bd)).reshape(
         L, A, Bd)
 
 

@@ -1,0 +1,50 @@
+"""Multi-chip sharded NTT (parallel/ntt.py) vs the single-device kernel
+— bit-exactness over the virtual 8-device mesh, the proving stack's
+distributed seam."""
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+import jax.numpy as jnp  # noqa: E402
+
+from protocol_tpu.ops import fieldops2 as f2  # noqa: E402
+from protocol_tpu.ops import ntt_tpu  # noqa: E402
+from protocol_tpu.parallel.mesh import make_mesh  # noqa: E402
+from protocol_tpu.parallel.ntt import ntt_sharded  # noqa: E402
+from protocol_tpu.utils.fields import BN254_FR_MODULUS as P  # noqa: E402
+
+pytestmark = pytest.mark.skipif(
+    jax.device_count() < 8, reason="needs the virtual 8-device mesh"
+)
+
+
+def _rand_planes(n, seed):
+    rng = np.random.default_rng(seed)
+    vals = [int.from_bytes(rng.bytes(32), "little") % P for _ in range(n)]
+    mont = [v * f2.R_MONT % P for v in vals]
+    return jnp.asarray(f2.ints_to_planes(mont))
+
+
+@pytest.mark.parametrize("k,shards", [(10, 8), (10, 2), (8, 4)])
+def test_sharded_ntt_bit_exact(k, shards):
+    n = 1 << k
+    plan = ntt_tpu.NttPlan.get(k)
+    x = _rand_planes(n, 100 + k)
+    expect = np.asarray(ntt_tpu.ntt(x, plan))
+    mesh = make_mesh(shards)
+    got = np.asarray(ntt_sharded(x, plan, mesh))
+    assert np.array_equal(got, expect)
+
+
+def test_sharded_ntt_rejects_bad_shard_count():
+    plan = ntt_tpu.NttPlan.get(8)  # B = 16
+    mesh = make_mesh(8)
+    x = _rand_planes(1 << 8, 1)
+    # fine: 16 % 8 == 0; then check a non-dividing count via a fake
+    got = ntt_sharded(x, plan, mesh)
+    assert got.shape == (f2.L, 1 << 8)
+    plan6 = ntt_tpu.NttPlan.get(6)  # B = 8, A = 8
+    mesh3 = make_mesh(3)
+    with pytest.raises(ValueError):
+        ntt_sharded(_rand_planes(1 << 6, 2), plan6, mesh3)
