@@ -14,6 +14,8 @@
 
 #include <algorithm>
 #include <atomic>
+#include <chrono>
+#include <cstdio>
 #include <cstdlib>
 #ifdef __linux__
 #include <sched.h>
@@ -609,6 +611,461 @@ static void jac_add_small_mul(JacPoint &r, const JacPoint &p, u64 k,
     jac_add(r, r, acc, f);
 }
 
+// ===== AVX-512 IFMA 8-lane batched field engine =========================
+//
+// The batch-affine bucket accumulation below is mul-bound: ~6 Montgomery
+// muls per pair-add, half of them on serial prefix/unwind chains. With
+// vpmadd52 (8x52-bit lanes) the muls vectorize 8-wide IF the serial
+// chains are split into 8 interleaved per-lane chains whose lane totals
+// share one inversion — which is how level_pass_ifma() below is
+// structured. Guarded at compile time (-march=native on an IFMA machine)
+// and at runtime; every machine without it keeps the scalar path.
+
+#if defined(__AVX512IFMA__) && defined(__AVX512F__)
+#define PN_IFMA 1
+#include <immintrin.h>
+
+static const u64 MASK52 = (1ULL << 52) - 1;
+
+struct Fp8 {  // 8 field elements, 5x52-bit limbs, lane-parallel
+    __m512i l[5];
+};
+
+// The 5x52-limb CIOS below reduces by 2^260 per multiply, so the vector
+// subsystem lives in the R' = 2^260 Montgomery domain while the scalar
+// engine uses R = 2^256. Boundary conversions are one scalar mont_mul:
+// x_w = mont_mul(x_s, c_in) (c_in = 2^260 mod p → X·2^260) and
+// x_s = mont_mul(x_w, c_out) (c_out = 2^252 mod p → X·2^256).
+struct Ctx52 {
+    __m512i p[5];
+    __m512i n0;     // -mod^{-1} mod 2^52, broadcast
+    u64 p52[5];
+    Fp c_in;        // 2^260 mod p (plain bits)
+    Fp c_out;       // 2^252 mod p (plain bits)
+};
+
+static inline void fp_to52(const Fp &a, u64 out[5]) {
+    out[0] = a.v[0] & MASK52;
+    out[1] = ((a.v[0] >> 52) | (a.v[1] << 12)) & MASK52;
+    out[2] = ((a.v[1] >> 40) | (a.v[2] << 24)) & MASK52;
+    out[3] = ((a.v[2] >> 28) | (a.v[3] << 36)) & MASK52;
+    out[4] = a.v[3] >> 16;
+}
+
+static inline void fp_from52(const u64 in[5], Fp &a) {
+    a.v[0] = in[0] | (in[1] << 52);
+    a.v[1] = (in[1] >> 12) | (in[2] << 40);
+    a.v[2] = (in[2] >> 24) | (in[3] << 28);
+    a.v[3] = (in[3] >> 36) | (in[4] << 16);
+}
+
+static Ctx52 make_ctx52(const FieldCtx &f) {
+    Ctx52 c;
+    fp_to52(f.mod, c.p52);
+    for (int i = 0; i < 5; ++i) c.p[i] = _mm512_set1_epi64((long long)c.p52[i]);
+    // the 2-adic inverse mod 2^64 truncates to the inverse mod 2^52
+    c.n0 = _mm512_set1_epi64((long long)(f.inv & MASK52));
+    // f.one = 2^256 mod p: shift by ±4 doublings/halvings mod p
+    c.c_in = f.one;
+    for (int i = 0; i < 4; ++i) add_mod(c.c_in, c.c_in, c.c_in, f);
+    c.c_out = f.one;
+    for (int i = 0; i < 4; ++i) {
+        Fp t = c.c_out;
+        if (t.v[0] & 1) {  // odd: add p, then halve
+            u128 carry = 0;
+            for (int j = 0; j < 4; ++j) {
+                u128 s = (u128)t.v[j] + f.mod.v[j] + (u64)carry;
+                t.v[j] = (u64)s;
+                carry = s >> 64;
+            }
+            for (int j = 0; j < 3; ++j)
+                t.v[j] = (t.v[j] >> 1) | (t.v[j + 1] << 63);
+            t.v[3] = (t.v[3] >> 1) | ((u64)carry << 63);
+        } else {
+            for (int j = 0; j < 3; ++j)
+                t.v[j] = (t.v[j] >> 1) | (t.v[j + 1] << 63);
+            t.v[3] >>= 1;
+        }
+        c.c_out = t;
+    }
+    return c;
+}
+
+// boundary moves between the scalar (R = 2^256) and vector (R' = 2^260)
+// Montgomery domains
+static inline void to_w52(u64 out[5], const Fp &s, const Ctx52 &c,
+                          const FieldCtx &f) {
+    Fp w;
+    mont_mul(w, s, c.c_in, f);
+    fp_to52(w, out);
+}
+
+static inline void from_w52(Fp &out, const u64 in[5], const Ctx52 &c,
+                            const FieldCtx &f) {
+    Fp w;
+    fp_from52(in, w);
+    mont_mul(out, w, c.c_out, f);
+}
+
+static inline void v_load_lanes(Fp8 &dst, const u64 lanes[5][8]) {
+    for (int i = 0; i < 5; ++i)
+        dst.l[i] = _mm512_loadu_si512((const void *)lanes[i]);
+}
+
+// 8-wide CIOS Montgomery multiply; canonical (< p) in, canonical out.
+static inline void v_mont_mul(Fp8 &out, const Fp8 &a, const Fp8 &b,
+                              const Ctx52 &c) {
+    __m512i acc[10];
+    const __m512i zero = _mm512_setzero_si512();
+    for (int i = 0; i < 10; ++i) acc[i] = zero;
+    for (int i = 0; i < 5; ++i)
+        for (int j = 0; j < 5; ++j) {
+            acc[i + j] = _mm512_madd52lo_epu64(acc[i + j], a.l[i], b.l[j]);
+            acc[i + j + 1] =
+                _mm512_madd52hi_epu64(acc[i + j + 1], a.l[i], b.l[j]);
+        }
+    const __m512i mask = _mm512_set1_epi64((long long)MASK52);
+    for (int i = 0; i < 5; ++i) {
+        acc[i + 1] = _mm512_add_epi64(acc[i + 1], _mm512_srli_epi64(acc[i], 52));
+        __m512i lo = _mm512_and_si512(acc[i], mask);
+        __m512i m = _mm512_madd52lo_epu64(zero, lo, c.n0);
+        acc[i] = lo;
+        for (int j = 0; j < 5; ++j) {
+            acc[i + j] = _mm512_madd52lo_epu64(acc[i + j], m, c.p[j]);
+            acc[i + j + 1] =
+                _mm512_madd52hi_epu64(acc[i + j + 1], m, c.p[j]);
+        }
+        // acc[i] ≡ 0 mod 2^52 now; push its (1-bit) carry up
+        acc[i + 1] = _mm512_add_epi64(acc[i + 1], _mm512_srli_epi64(acc[i], 52));
+    }
+    __m512i r[5];
+    __m512i carry = zero;
+    for (int i = 0; i < 5; ++i) {
+        __m512i t = _mm512_add_epi64(acc[5 + i], carry);
+        r[i] = _mm512_and_si512(t, mask);
+        carry = _mm512_srli_epi64(t, 52);
+    }
+    // (< 2p; bits fit 5 limbs, so `carry` here is zero) — one
+    // conditional subtract lands canonical
+    __m512i borrow = zero;
+    __m512i d[5];
+    for (int i = 0; i < 5; ++i) {
+        __m512i t = _mm512_sub_epi64(_mm512_sub_epi64(r[i], c.p[i]), borrow);
+        d[i] = _mm512_and_si512(t, mask);
+        borrow = _mm512_srli_epi64(t, 63);
+    }
+    __mmask8 ge = _mm512_cmpeq_epi64_mask(borrow, zero);  // r >= p lanes
+    for (int i = 0; i < 5; ++i)
+        out.l[i] = _mm512_mask_blend_epi64(ge, r[i], d[i]);
+}
+
+// 8-wide modular subtract, canonical in/out.
+static inline void v_sub_mod(Fp8 &out, const Fp8 &a, const Fp8 &b,
+                             const Ctx52 &c) {
+    const __m512i zero = _mm512_setzero_si512();
+    const __m512i mask = _mm512_set1_epi64((long long)MASK52);
+    __m512i borrow = zero;
+    __m512i d[5];
+    for (int i = 0; i < 5; ++i) {
+        __m512i t = _mm512_sub_epi64(_mm512_sub_epi64(a.l[i], b.l[i]), borrow);
+        d[i] = _mm512_and_si512(t, mask);
+        borrow = _mm512_srli_epi64(t, 63);
+    }
+    __mmask8 neg = _mm512_cmpneq_epi64_mask(borrow, zero);  // a < b lanes
+    __m512i carry = zero;
+    for (int i = 0; i < 5; ++i) {
+        __m512i e = _mm512_add_epi64(_mm512_add_epi64(d[i], c.p[i]), carry);
+        carry = _mm512_srli_epi64(e, 52);
+        out.l[i] = _mm512_mask_blend_epi64(neg, d[i], _mm512_and_si512(e, mask));
+    }
+}
+
+static inline bool v_mul_selftest(const FieldCtx &f) {
+    // 8 lanes of r2·r2 through the w-domain must round-trip to the
+    // scalar product — a boot check of the 52-bit path + conversions
+    Ctx52 c = make_ctx52(f);
+    u64 lanes[5][8];
+    u64 t[5];
+    to_w52(t, f.r2, c, f);
+    for (int i = 0; i < 5; ++i)
+        for (int l = 0; l < 8; ++l) lanes[i][l] = t[i];
+    Fp8 a;
+    v_load_lanes(a, lanes);
+    Fp8 o;
+    v_mont_mul(o, a, a, c);
+    u64 got[5][8];
+    for (int i = 0; i < 5; ++i)
+        _mm512_storeu_si512((void *)got[i], o.l[i]);
+    Fp expect;
+    mont_mul(expect, f.r2, f.r2, f);
+    for (int l = 0; l < 8; ++l) {
+        u64 g[5] = {got[0][l], got[1][l], got[2][l], got[3][l], got[4][l]};
+        Fp back;
+        from_w52(back, g, c, f);
+        for (int i = 0; i < 4; ++i)
+            if (back.v[i] != expect.v[i]) return false;
+    }
+    return true;
+}
+
+static bool ifma_available() {
+    static int cached = -1;
+    if (cached < 0) {
+        __builtin_cpu_init();
+        cached = __builtin_cpu_supports("avx512ifma") ? 1 : 0;
+    }
+    return cached == 1;
+}
+
+// gather 8 elements of a 5x52-limb AoS array (40 B stride) by index
+static inline void vgather5(Fp8 &dst, const u64 *base, const __m512i idx5) {
+    for (int i = 0; i < 5; ++i)
+        dst.l[i] = _mm512_i64gather_epi64(
+            _mm512_add_epi64(idx5, _mm512_set1_epi64(i)), base, 8);
+}
+
+// reusable per-MSM scratch: a fresh allocation per level call costs
+// ~170 MB of page faults at 2^20 and swamps the vector math
+struct IfmaScratch {
+    std::vector<Fp8> prefv, denv, axv, ayv, bxv, byv;
+    std::vector<u64> pox, poy;
+    std::vector<unsigned char> kind;
+    std::vector<long> heads;
+    void ensure(long pairs) {
+        const long nblk = (pairs + 7) / 8;
+        if ((long)prefv.size() < nblk) {
+            prefv.resize(nblk);
+            denv.resize(nblk);
+            axv.resize(nblk);
+            ayv.resize(nblk);
+            bxv.resize(nblk);
+            byv.resize(nblk);
+        }
+        if ((long)pox.size() < 5 * pairs) {
+            pox.resize(5 * (size_t)pairs);
+            poy.resize(5 * (size_t)pairs);
+        }
+        if ((long)kind.size() < pairs) kind.resize(pairs);
+        if ((long)heads.size() < pairs) heads.resize(pairs);
+    }
+};
+
+// One batch-affine level, 8-wide over a 52-bit AoS working set:
+// per-lane den chains built forward, lane totals batch-inverted once,
+// chains unwound backward, the affine adds evaluated in vector lanes.
+// Mirrors the scalar level exactly — same pairing, same edge rules
+// (doubling / cancel-to-infinity), same output order. ax52/ay52 hold
+// 5x52-bit limbs per element (canonical Montgomery values); abid the
+// bucket ids. Returns the new live count.
+static long level_pass_ifma(const FieldCtx &f, const Ctx52 &c52,
+                            std::vector<u64> &ax52, std::vector<u64> &ay52,
+                            std::vector<int32_t> &abid,
+                            std::vector<u64> &nx52, std::vector<u64> &ny52,
+                            std::vector<int32_t> &nbid,
+                            const std::vector<unsigned char> &role,
+                            long m, long pairs, IfmaScratch &S) {
+    S.ensure(pairs);
+    std::vector<long> &heads = S.heads;
+    {
+        long pi = 0;
+        for (long i = 0; i < m; ++i)
+            if (role[i] == 1) heads[pi++] = i;
+    }
+    const long nblk = (pairs + 7) / 8;
+    // per-block saved state so pass 2 re-reads nothing from the source
+    std::vector<Fp8> &prefv = S.prefv, &denv = S.denv, &axv = S.axv,
+                     &ayv = S.ayv, &bxv = S.bxv, &byv = S.byv;
+    std::vector<unsigned char> &kind = S.kind;
+    std::memset(kind.data(), 0, pairs);
+    // w-domain multiplicative identity: v_mont_mul(x, e) = x needs
+    // e = 2^260 mod p — c_in's bit pattern, not f.one's
+    u64 one52[5];
+    fp_to52(c52.c_in, one52);
+
+    Fp8 run;
+    for (int i = 0; i < 5; ++i) run.l[i] = _mm512_set1_epi64((long long)one52[i]);
+    const __m512i vzero = _mm512_setzero_si512();
+
+    // pass 1: gather head/tail coords, den = xB − xA, per-lane chains
+    for (long b = 0; b < nblk; ++b) {
+        int cnt = (int)((b == nblk - 1) ? pairs - 8 * b : 8);
+        alignas(64) long long hoff[8];
+        for (int l = 0; l < 8; ++l) {
+            long h = (l < cnt) ? heads[8 * b + l] : heads[8 * b];  // dup pad
+            hoff[l] = 5 * h;
+        }
+        const __m512i hv = _mm512_load_si512((const void *)hoff);
+        const __m512i tv = _mm512_add_epi64(hv, _mm512_set1_epi64(5));
+        Fp8 Ax, Ay, Bx, By, den;
+        vgather5(Ax, ax52.data(), hv);
+        vgather5(Ay, ay52.data(), hv);
+        vgather5(Bx, ax52.data(), tv);
+        vgather5(By, ay52.data(), tv);
+        v_sub_mod(den, Bx, Ax, c52);
+        __m512i nz = den.l[0];
+        for (int i = 1; i < 5; ++i) nz = _mm512_or_si512(nz, den.l[i]);
+        __mmask8 zl = _mm512_cmpeq_epi64_mask(nz, vzero);
+        if (cnt < 8) zl = (__mmask8)(zl | (0xFF << cnt));  // pad lanes
+        if (zl) {
+            u64 dl[5][8], ayl[5][8], byl[5][8];
+            for (int i = 0; i < 5; ++i) {
+                _mm512_storeu_si512((void *)dl[i], den.l[i]);
+                _mm512_storeu_si512((void *)ayl[i], Ay.l[i]);
+                _mm512_storeu_si512((void *)byl[i], By.l[i]);
+            }
+            for (int l = 0; l < 8; ++l) {
+                if (!((zl >> l) & 1)) continue;
+                u64 t[5];
+                if (l >= cnt) {
+                    std::memcpy(t, one52, 40);  // pad: den=1, no output
+                } else {
+                    Fp aY, bY, sy;
+                    u64 a5[5] = {ayl[0][l], ayl[1][l], ayl[2][l], ayl[3][l],
+                                 ayl[4][l]};
+                    u64 b5[5] = {byl[0][l], byl[1][l], byl[2][l], byl[3][l],
+                                 byl[4][l]};
+                    fp_from52(a5, aY);
+                    fp_from52(b5, bY);
+                    add_mod(sy, aY, bY, f);
+                    if (is_zero_fp(sy)) {
+                        kind[8 * b + l] = 2;  // P + (−P): drops out
+                        std::memcpy(t, one52, 40);
+                    } else {
+                        kind[8 * b + l] = 1;  // doubling: den = 2y
+                        Fp dd;
+                        add_mod(dd, aY, aY, f);
+                        fp_to52(dd, t);
+                    }
+                }
+                for (int i = 0; i < 5; ++i) dl[i][l] = t[i];
+            }
+            v_load_lanes(den, dl);
+        }
+        prefv[b] = run;
+        denv[b] = den;
+        axv[b] = Ax;
+        ayv[b] = Ay;
+        bxv[b] = Bx;
+        byv[b] = By;
+        v_mont_mul(run, run, den, c52);
+    }
+
+    // lane totals -> one inversion -> per-lane inverse seeds
+    Fp8 inv_vec;
+    {
+        Fp lane_tot[8], pre[8], inv_lane[8];
+        u64 lanes[5][8];
+        for (int i = 0; i < 5; ++i)
+            _mm512_storeu_si512((void *)lanes[i], run.l[i]);
+        for (int l = 0; l < 8; ++l) {
+            u64 t[5] = {lanes[0][l], lanes[1][l], lanes[2][l], lanes[3][l],
+                        lanes[4][l]};
+            from_w52(lane_tot[l], t, c52, f);  // w → s domain
+        }
+        Fp acc = f.one;
+        for (int l = 0; l < 8; ++l) {
+            pre[l] = acc;
+            mont_mul(acc, acc, lane_tot[l], f);
+        }
+        Fp tinv;
+        mont_inv(tinv, acc, f);
+        for (int l = 7; l >= 0; --l) {
+            mont_mul(inv_lane[l], tinv, pre[l], f);
+            mont_mul(tinv, tinv, lane_tot[l], f);
+        }
+        u64 t[5];
+        for (int l = 0; l < 8; ++l) {
+            to_w52(t, inv_lane[l], c52, f);  // s → w domain
+            for (int i = 0; i < 5; ++i) lanes[i][l] = t[i];
+        }
+        v_load_lanes(inv_vec, lanes);
+    }
+
+    // pass 2 (backward): unwind chains, evaluate the adds into a dense
+    // 52-bit pair-output array
+    std::vector<u64> &pox = S.pox, &poy = S.poy;
+    for (long b = nblk - 1; b >= 0; --b) {
+        int cnt = (int)((b == nblk - 1) ? pairs - 8 * b : 8);
+        Fp8 dinv, num;
+        v_mont_mul(dinv, inv_vec, prefv[b], c52);
+        v_mont_mul(inv_vec, inv_vec, denv[b], c52);
+        const Fp8 &Ax = axv[b], &Ay = ayv[b], &Bx = bxv[b], &By = byv[b];
+        v_sub_mod(num, By, Ay, c52);
+        bool patch = false;
+        for (int l = 0; l < cnt; ++l)
+            if (kind[8 * b + l] == 1) patch = true;
+        if (patch) {
+            u64 lanes[5][8], axl[5][8];
+            for (int i = 0; i < 5; ++i) {
+                _mm512_storeu_si512((void *)lanes[i], num.l[i]);
+                _mm512_storeu_si512((void *)axl[i], Ax.l[i]);
+            }
+            for (int l = 0; l < cnt; ++l) {
+                if (kind[8 * b + l] != 1) continue;
+                u64 a5[5] = {axl[0][l], axl[1][l], axl[2][l], axl[3][l],
+                             axl[4][l]};
+                Fp aX, sq, n3;
+                fp_from52(a5, aX);       // raw w-form bits X·2^260
+                mont_sqr(sq, aX, f);     // X²·2^264
+                mont_mul(sq, sq, c52.c_out, f);  // X²·2^260 — back in w
+                add_mod(n3, sq, sq, f);
+                add_mod(n3, n3, sq, f);  // 3x²
+                u64 t[5];
+                fp_to52(n3, t);
+                for (int i = 0; i < 5; ++i) lanes[i][l] = t[i];
+            }
+            v_load_lanes(num, lanes);
+        }
+        Fp8 lam, x3, y3, t0;
+        v_mont_mul(lam, num, dinv, c52);
+        v_mont_mul(x3, lam, lam, c52);
+        v_sub_mod(x3, x3, Ax, c52);
+        v_sub_mod(x3, x3, Bx, c52);
+        v_sub_mod(t0, Ax, x3, c52);
+        v_mont_mul(y3, lam, t0, c52);
+        v_sub_mod(y3, y3, Ay, c52);
+        // dense stride-5 scatter of the block's outputs
+        alignas(64) long long ooff[8];
+        for (int l = 0; l < 8; ++l)
+            ooff[l] = 5 * (8 * b + ((l < cnt) ? l : cnt - 1));
+        const __m512i ov = _mm512_load_si512((const void *)ooff);
+        __mmask8 live = (__mmask8)((1u << cnt) - 1);
+        for (int i = 0; i < 5; ++i) {
+            _mm512_mask_i64scatter_epi64(
+                pox.data(), live,
+                _mm512_add_epi64(ov, _mm512_set1_epi64(i)), x3.l[i], 8);
+            _mm512_mask_i64scatter_epi64(
+                poy.data(), live,
+                _mm512_add_epi64(ov, _mm512_set1_epi64(i)), y3.l[i], 8);
+        }
+    }
+
+    // merge (forward, order-preserving — matches the scalar backward fill)
+    long write = 0, pi = 0;
+    for (long i = 0; i < m; ++i) {
+        if (role[i] == 2) continue;
+        if (role[i] == 1) {
+            if (kind[pi] != 2) {
+                std::memcpy(&nx52[5 * write], &pox[5 * pi], 40);
+                std::memcpy(&ny52[5 * write], &poy[5 * pi], 40);
+                nbid[write] = abid[i];
+                ++write;
+            }
+            ++pi;
+        } else {
+            std::memcpy(&nx52[5 * write], &ax52[5 * i], 40);
+            std::memcpy(&ny52[5 * write], &ay52[5 * i], 40);
+            nbid[write] = abid[i];
+            ++write;
+        }
+    }
+    ax52.swap(nx52);
+    ay52.swap(ny52);
+    abid.swap(nbid);
+    return write;
+}
+#endif  // PN_IFMA
+
 // Pippenger MSM: bases affine standard-form (x,y) pairs (8 limbs each,
 // zero-zero = identity), scalars standard-form 4-limb. Result affine
 // standard form written to out (8 limbs; zeros for identity).
@@ -623,10 +1080,20 @@ static void jac_add_small_mul(JacPoint &r, const JacPoint &p, u64 k,
 void g1_msm(const u64 *mod_limbs, const u64 *bases, const u64 *scalars,
             long n, u64 *out) {
     FieldCtx f = make_ctx(mod_limbs);
+#ifdef PN_IFMA
+    const bool use_ifma = !std::getenv("PN_NO_IFMA") && ifma_available() &&
+                          v_mul_selftest(f);
+    Ctx52 c52;
+    if (use_ifma) c52 = make_ctx52(f);
+#endif
     int c = 4;
     if (n > 32) c = 8;
     if (n > 1024) c = 12;
     if (n > 131072) c = 16;
+    if (const char *cenv = std::getenv("PN_MSM_C")) {
+        int cv = std::atoi(cenv);
+        if (cv >= 2 && cv <= 20) c = cv;
+    }
     const long half = 1L << (c - 1);
     const int windows = (256 + c - 1) / c + 1;  // +1 for the signed carry
 
@@ -679,12 +1146,48 @@ void g1_msm(const u64 *mod_limbs, const u64 *bases, const u64 *scalars,
     std::vector<Fp> dens, prefix;
     dens.reserve(n_finite / 2 + 1);
     prefix.reserve(n_finite / 2 + 1);
+#ifdef PN_IFMA
+    // 52-bit AoS twins for the vectorized levels (built lazily per
+    // window; the tail levels fall back to the scalar path)
+    std::vector<u64> x52, y52, nx52, ny52, p52x, p52y, p52yn;
+    IfmaScratch ifma_scratch;
+    if (use_ifma) {
+        x52.resize(5 * (size_t)n_finite);
+        y52.resize(5 * (size_t)n_finite);
+        nx52.resize(5 * (size_t)n_finite);
+        ny52.resize(5 * (size_t)n_finite);
+        // per-point w-domain coordinates (and negated y for the signed
+        // digits), converted once — window placement is then a memcpy
+        p52x.resize(5 * (size_t)n);
+        p52y.resize(5 * (size_t)n);
+        p52yn.resize(5 * (size_t)n);
+        for (long i = 0; i < n; ++i) {
+            if (!finite[i]) continue;
+            to_w52(&p52x[5 * (size_t)i], pts[i].x, c52, f);
+            to_w52(&p52y[5 * (size_t)i], pts[i].y, c52, f);
+            Fp yn;
+            neg_mod(yn, pts[i].y, f);
+            to_w52(&p52yn[5 * (size_t)i], yn, c52, f);
+        }
+    }
+#endif
+
+    // PN_MSM_DEBUG=1: phase timing to stderr (sort/levels/reduction)
+    const bool dbg = std::getenv("PN_MSM_DEBUG") != nullptr;
+    double t_sort = 0, t_levels = 0, t_reduce = 0, t_dbl = 0;
+    auto now = [] { return std::chrono::steady_clock::now(); };
+    auto secs = [](auto a, auto b) {
+        return std::chrono::duration<double>(b - a).count();
+    };
 
     JacPoint total;
     total.z = Fp{{0, 0, 0, 0}};
     for (int w = windows - 1; w >= 0; --w) {
+        auto tw0 = now();
         if (!is_zero_fp(total.z))
             for (int d = 0; d < c; ++d) jac_double(total, total, f);
+        t_dbl += secs(tw0, now());
+        auto ts0 = now();
         const int32_t *dw = &digits[(size_t)w * n];
 
         // counting sort by |digit|, sign applied to y on placement
@@ -704,16 +1207,34 @@ void g1_msm(const u64 *mod_limbs, const u64 *bases, const u64 *scalars,
             if (!d) continue;
             long b = d < 0 ? -d : d;
             long pos = counts[b]++;
+#ifdef PN_IFMA
+            if (use_ifma) {
+                std::memcpy(&x52[5 * (size_t)pos], &p52x[5 * (size_t)i], 40);
+                std::memcpy(&y52[5 * (size_t)pos],
+                            d > 0 ? &p52y[5 * (size_t)i]
+                                  : &p52yn[5 * (size_t)i], 40);
+                abid[pos] = (int32_t)b;
+                continue;
+            }
+#endif
             ax[pos] = pts[i].x;
             if (d > 0) ay[pos] = pts[i].y;
             else neg_mod(ay[pos], pts[i].y, f);
             abid[pos] = (int32_t)b;
         }
 
+        t_sort += secs(ts0, now());
+        auto tl0 = now();
         // level-by-level batch-affine segment sums. Each level pairs
         // adjacent same-bucket entries; all pair additions share one
         // batched inversion (Montgomery trick).
         std::vector<unsigned char> role(n_finite);  // 0=solo 1=pair-first
+#ifdef PN_IFMA
+        bool in52 = use_ifma;  // placement wrote the w-domain arrays
+#else
+        bool in52 = false;
+#endif
+        (void)in52;
         while (true) {
             // fix the pairing once (greedy adjacent within segments) so
             // both passes below agree for odd-length segments
@@ -730,6 +1251,20 @@ void g1_msm(const u64 *mod_limbs, const u64 *bases, const u64 *scalars,
                 }
             }
             if (!pairs) break;
+#ifdef PN_IFMA
+            if (use_ifma && in52 && pairs >= 64) {
+                m = level_pass_ifma(f, c52, x52, y52, abid, nx52, ny52,
+                                    nbid, role, m, pairs, ifma_scratch);
+                continue;
+            }
+            if (in52) {  // tail levels: back to the scalar (s) domain
+                for (long i = 0; i < m; ++i) {
+                    from_w52(ax[i], &x52[5 * (size_t)i], c52, f);
+                    from_w52(ay[i], &y52[5 * (size_t)i], c52, f);
+                }
+                in52 = false;
+            }
+#endif
             dens.clear();
             prefix.clear();
             // pass 1: denominators + running product
@@ -802,6 +1337,16 @@ void g1_msm(const u64 *mod_limbs, const u64 *bases, const u64 *scalars,
             abid.swap(nbid);
         }
 
+#ifdef PN_IFMA
+        if (in52) {  // vector levels ran last: rebuild the Fp survivors
+            for (long i = 0; i < m; ++i) {
+                from_w52(ax[i], &x52[5 * (size_t)i], c52, f);
+                from_w52(ay[i], &y52[5 * (size_t)i], c52, f);
+            }
+        }
+#endif
+        t_levels += secs(tl0, now());
+        auto tr0 = now();
         // bucket reduction: one affine point per surviving bucket id,
         // ascending. Walk descending with the running/sum scan; empty
         // gaps advance `sum` by gap·running via a small double-and-add.
@@ -821,6 +1366,16 @@ void g1_msm(const u64 *mod_limbs, const u64 *bases, const u64 *scalars,
         }
         jac_add_small_mul(sum, running, (u64)(prev_b - 1), f);
         jac_add(total, total, sum, f);
+        t_reduce += secs(tr0, now());
+    }
+    if (dbg) {
+#ifdef PN_IFMA
+        std::fprintf(stderr, "g1_msm ifma=%d\n", (int)use_ifma);
+#endif
+        std::fprintf(stderr,
+                     "g1_msm n=%ld c=%d: dbl %.2fs sort %.2fs levels %.2fs "
+                     "reduce %.2fs\n",
+                     n, c, t_dbl, t_sort, t_levels, t_reduce);
     }
 
     // to affine

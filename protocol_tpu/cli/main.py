@@ -31,13 +31,13 @@ from ..utils.errors import EigenError
 from .fs import EigenFile, assets_dir, load_mnemonic
 
 # Circuit degrees for the EigenTrust4 shape (the reference pins k=20/21,
-# circuits/mod.rs:57-59; this stack's ET circuit is 2.49M rows → k=22).
-# The Threshold circuit itself fits 2^21 since the batched-MSM verifier
-# fold (r3), but the flow proves the INNER ET snark under the shared TH
-# SRS, so the TH params must cover the ET domain: k=22 (was 23 with the
-# per-point RNS fold).
-ET_PARAMS_K = 22
-TH_PARAMS_K = 22
+# circuits/mod.rs:57-59; this stack's ET circuit is 1.85M rows → k=21
+# since the GLV shared-doubling ECDSA path, zk/ecdsa_chip.py). The
+# Threshold circuit also fits 2^21 (the batched-MSM verifier fold), and
+# the flow proves the inner ET snark under the shared TH SRS — one k=21
+# SRS now covers both domains (was k=22 with the 272-bit ladders).
+ET_PARAMS_K = 21
+TH_PARAMS_K = 21
 
 
 def build_parser() -> argparse.ArgumentParser:
@@ -70,7 +70,7 @@ def build_parser() -> argparse.ArgumentParser:
         p.add_argument("--shape", choices=["default", "tiny"],
                        default="default",
                        help="circuit instantiation: default = the "
-                            "EigenTrust4 shape (k=22 params), tiny = "
+                            "EigenTrust4 shape (k=21 params), tiny = "
                             "the 2-peer/2-iteration dev shape (k=20)")
 
     p = sub.add_parser("et-proof", help="generate the EigenTrust proof")

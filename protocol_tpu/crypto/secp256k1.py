@@ -252,3 +252,48 @@ class EcdsaVerifier:
         if r_point.is_identity():
             return False
         return r_point.x % N == sig.r
+
+
+# --- GLV endomorphism -------------------------------------------------------
+# secp256k1 has CM discriminant −3: β³ ≡ 1 (mod p) gives the curve
+# endomorphism φ(x, y) = (β·x, y) acting as scalar multiplication by λ
+# (λ³ ≡ 1 mod n). Splitting a 256-bit scalar into two ~128-bit halves
+# against the lattice {(a, b) : a + b·λ ≡ 0 (mod n)} halves the
+# doubling chain of a scalar-mul — the circuit-row lever behind the
+# EcdsaChip's shared-doubling verify (zk/ecdsa_chip.py). The constants
+# are the standard public secp256k1 GLV parameters (e.g. libsecp256k1's
+# endomorphism module); everything is re-verified below at import.
+
+GLV_LAMBDA = 0x5363AD4CC05C30E0A5261C028812645A122E22EA20816678DF02967C1B23BD72
+GLV_BETA = 0x7AE96A2B657C07106E64479EAC3434E99CF0497512F58995C1396C28719501EE
+# shortest-vector lattice basis (a1, b1), (a2, b2): a_i + b_i·λ ≡ 0 (mod n)
+_GLV_A1 = 0x3086D221A7D46BCDE86C90E49284EB15
+_GLV_B1 = -0xE4437ED6010E88286F547FA90ABFE4C3
+_GLV_A2 = 0x114CA50F7A8E2F3F657C1108D9D44CFD8
+_GLV_B2 = _GLV_A1
+
+assert (GLV_LAMBDA * GLV_LAMBDA + GLV_LAMBDA + 1) % N == 0
+assert (GLV_BETA * GLV_BETA + GLV_BETA + 1) % P == 0
+assert (_GLV_A1 + _GLV_B1 * GLV_LAMBDA) % N == 0
+assert (_GLV_A2 + _GLV_B2 * GLV_LAMBDA) % N == 0
+
+# |s_i| provable bound: max |c_i| rounding error 1/2 each against basis
+# vectors of ≤ 2^128.06 norm → |s_i| < 2^129. The circuit allots 33
+# 4-bit windows (2^132), comfortably above.
+GLV_HALF_BITS = 129
+
+
+def glv_decompose(u: int) -> tuple:
+    """u (mod n) → (s1, e1, s2, e2) with u ≡ e1·s1 + λ·e2·s2 (mod n),
+    s_i = |component| < 2^129, e_i ∈ {+1, −1} (Babai rounding against
+    the reduced lattice basis)."""
+    u %= N
+    c1 = (_GLV_B2 * u + N // 2) // N
+    c2 = (-_GLV_B1 * u + N // 2) // N
+    k1 = u - c1 * _GLV_A1 - c2 * _GLV_A2
+    k2 = -c1 * _GLV_B1 - c2 * _GLV_B2
+    s1, e1 = (k1, 1) if k1 >= 0 else (-k1, -1)
+    s2, e2 = (k2, 1) if k2 >= 0 else (-k2, -1)
+    assert s1 < 1 << GLV_HALF_BITS and s2 < 1 << GLV_HALF_BITS
+    assert (e1 * s1 + GLV_LAMBDA * e2 * s2 - u) % N == 0
+    return s1, e1, s2, e2

@@ -305,13 +305,21 @@ class EccChip:
         verifier-fold row count collapses. Aux offsets keep the
         incomplete adds away from the identity; the aggregate aux mass
         2^252·Aux + K·(Σ16ᵘ)·C leaves with one constant-point add."""
+        return self.msm_digits(items, NATIVE_WINDOWS)
+
+    def msm_digits(self, items: list, num_windows: int) -> AssignedPoint:
+        """Shared-doubling windowed MSM over 4-bit digit-cell scalars of
+        ``num_windows`` LSB-first windows (the :meth:`msm_native` core,
+        window count lifted so the EcdsaChip's GLV half-scalars — 33
+        windows for |s| < 2^129 — ride the same loop)."""
         if not items:
-            raise EigenError("circuit_error", "msm_native needs items")
+            raise EigenError("circuit_error", "msm needs items")
         tables = []
         for pt, digits in items:
-            if len(digits) != NATIVE_WINDOWS:
-                raise EigenError("circuit_error",
-                                 "expected 64 native window digits")
+            if len(digits) != num_windows:
+                raise EigenError(
+                    "circuit_error",
+                    f"expected {num_windows} window digits")
             if isinstance(pt, AssignedPoint):
                 tbl = [self.constant_point(self.aux_c)]
                 for _ in range(1, TABLE_SIZE):
@@ -323,18 +331,18 @@ class EccChip:
                     row.append(self.spec.add(row[-1], pt))
                 tables.append((False, row))
         acc = self.constant_point(self.aux_init)
-        for w in reversed(range(NATIVE_WINDOWS)):
-            if w != NATIVE_WINDOWS - 1:
+        for w in reversed(range(num_windows)):
+            if w != num_windows - 1:
                 for _ in range(WINDOW_BITS):
                     acc = self.double(acc)
             for (in_circuit, tbl), (pt, digits) in zip(tables, items):
                 sel = (self.select_point(digits[w], tbl) if in_circuit
                        else self.select_point_const(digits[w], tbl))
                 acc = self.add(acc, sel)
-        s_c = ((1 << (WINDOW_BITS * NATIVE_WINDOWS)) - 1) // (TABLE_SIZE - 1)
+        s_c = ((1 << (WINDOW_BITS * num_windows)) - 1) // (TABLE_SIZE - 1)
         mass = self.spec.add(
             self.spec.mul(self.aux_init,
-                          pow(2, WINDOW_BITS * (NATIVE_WINDOWS - 1),
+                          pow(2, WINDOW_BITS * (num_windows - 1),
                               self.spec.n)),
             self.spec.mul(self.aux_c, len(items) * s_c % self.spec.n),
         )

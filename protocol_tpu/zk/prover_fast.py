@@ -23,6 +23,7 @@ boundary (witness columns in, transcript scalars out).
 from __future__ import annotations
 
 import json
+import os
 import secrets
 from dataclasses import dataclass
 
@@ -811,6 +812,19 @@ def prove_fast(params: KZGParams, pk: FastProvingKey, cs: ConstraintSystem,
 _DEVICE_PROVER: list = [None, None]  # [pk object, DeviceProver]
 
 
+def _sync_if_tracing(x) -> None:
+    """PTPU_TRACE_SYNC=1 turns the trace spans in ``prove_fast_tpu``
+    into accurate per-stage attribution by draining the device queue at
+    span boundaries. Device dispatch is async through the tunnel, so
+    without this the round-3 compute cost all surfaces at the blocking
+    t-chunk download. Profiling aid only — it serializes stages, so the
+    total is slightly worse than the production overlap."""
+    if os.environ.get("PTPU_TRACE_SYNC") == "1":
+        import jax
+
+        jax.block_until_ready(x)
+
+
 def _device_prover(pk: FastProvingKey):
     """Cached DeviceProver for the last-used pk (the pk's fixed/sigma
     cosets are device-resident, like halo2's ProvingKey holds its
@@ -881,13 +895,39 @@ def prove_fast_tpu(params: KZGParams, pk: FastProvingKey,
     # pushed k=20 over the 16 GB HBM line)
     # streaming (k>=21) mode keeps every coefficient array packed
     pack = (lambda x: x) if dp.ext_resident else ptpu._pack16_impl
+
+    # Host/device overlap: the 8n ext-chunk NTTs of every poly whose
+    # coefficients and blinds are already fixed (wires, m, pi — and z,
+    # phi as soon as their commits seal them) are dispatched DURING the
+    # host MSM commits of rounds 1-2, so the ~30 s of device ext work
+    # hides under the ~35 s of host commit work instead of serializing
+    # after it. Chunks are packed uint16 on arrival (~2.6 GB resident
+    # for all 80 at k=20; the quotient kernel unpacks at trace time).
+    # Device dispatch is async through the tunnel — these calls queue
+    # work and return. Resident mode only: the streaming (k≥21) HBM
+    # plan has no room for pre-dispatched ext chunks.
+    pre = dp.ext_resident
+
+    def ext8(coeff_dev, blinds=None):
+        return [ptpu._pack16_impl(e)
+                for e in dp.ext_chunks(coeff_dev, blinds)]
+
     with trace.span("prove_tpu.r1_upload_intt"):
         wire_coeff_dev = []
         for w in range(NUM_WIRES):
             ev = ptpu.upload_mont(wire_vals[w])
             wire_coeff_dev.append(pack(dp.intt_natural(ev)))
             del ev
+        _sync_if_tracing(wire_coeff_dev[-1])
     wire_blinds = [[randint() for _ in range(2)] for _ in range(NUM_WIRES)]
+    pi_vals = np.zeros((n, 4), dtype="<u8")
+    for row, value in zip(pk.public_rows, pubs):
+        _set_int(pi_vals, row, (-int(value)) % R)
+    pi_coeff_dev = pack(dp.intt_natural(ptpu.upload_mont(pi_vals)))
+    if pre:
+        wire_ext = [ext8(wire_coeff_dev[w], wire_blinds[w])
+                    for w in range(NUM_WIRES)]
+        pi_ext = ext8(pi_coeff_dev)
     with trace.span("prove_tpu.r1_wire_commits"):
         wire_commits = [
             _commit_blinded_evals(params, wire_vals[w], wire_blinds[w])
@@ -902,6 +942,8 @@ def prove_fast_tpu(params: KZGParams, pk: FastProvingKey,
     m_coeff_dev = pack(dp.intt_natural(m_dev))
     del m_dev
     m_blinds = [randint() for _ in range(2)]
+    if pre:
+        m_ext = ext8(m_coeff_dev, m_blinds)
     m_commit = _commit_blinded_evals(params, m_vals, m_blinds)
     tr.absorb_point(m_commit)
 
@@ -920,6 +962,8 @@ def prove_fast_tpu(params: KZGParams, pk: FastProvingKey,
         z_coeff_dev = pack(dp.intt_natural(z_dev))
         del z_dev
         z_blinds = [randint() for _ in range(3)]
+        if pre:
+            z_ext = ext8(z_coeff_dev, z_blinds)
         z_commit = _commit_blinded_evals(params, z_vals, z_blinds)
     tr.absorb_point(z_commit)
 
@@ -931,43 +975,67 @@ def prove_fast_tpu(params: KZGParams, pk: FastProvingKey,
     phi_coeff_dev = pack(dp.intt_natural(phi_dev))
     del phi_dev
     phi_blinds = [randint() for _ in range(3)]
+    if pre:
+        phi_ext = ext8(phi_coeff_dev, phi_blinds)
     phi_commit = _commit_blinded_evals(params, phi_vals, phi_blinds)
     tr.absorb_point(phi_commit)
 
     alpha = tr.challenge()
 
     # round 3 (device): ext chunks → quotient → 8n inverse → chunks
-    pi_vals = np.zeros((n, 4), dtype="<u8")
-    for row, value in zip(pk.public_rows, pubs):
-        _set_int(pi_vals, row, (-int(value)) % R)
-    pi_coeff_dev = pack(dp.intt_natural(ptpu.upload_mont(pi_vals)))
-
     ch_planes = dp.challenge_planes(beta, gamma, beta_lk, alpha, pk.shifts)
     with trace.span("prove_tpu.r3_quotient"):
         t_chunks_fs = []
         for j in range(8):
-            wires_e = [dp.ext_chunk(wire_coeff_dev[w], j, wire_blinds[w])
-                       for w in range(NUM_WIRES)]
-            z_e = dp.ext_chunk(z_coeff_dev, j, z_blinds)
-            m_e = dp.ext_chunk(m_coeff_dev, j, m_blinds)
-            phi_e = dp.ext_chunk(phi_coeff_dev, j, phi_blinds)
-            pi_e = dp.ext_chunk(pi_coeff_dev, j)
-            t_chunks_fs.append(pack(dp.quotient_chunk(
-                j, wires_e, z_e, m_e, phi_e, pi_e, ch_planes)))
+            with trace.span("prove_tpu.r3_chunk", j=j):
+                if pre:
+                    wires_e = [wire_ext[w][j] for w in range(NUM_WIRES)]
+                    z_e, m_e = z_ext[j], m_ext[j]
+                    phi_e, pi_e = phi_ext[j], pi_ext[j]
+                else:
+                    wires_e = [dp.ext_chunk(wire_coeff_dev[w], j,
+                                            wire_blinds[w])
+                               for w in range(NUM_WIRES)]
+                    z_e = dp.ext_chunk(z_coeff_dev, j, z_blinds)
+                    m_e = dp.ext_chunk(m_coeff_dev, j, m_blinds)
+                    phi_e = dp.ext_chunk(phi_coeff_dev, j, phi_blinds)
+                    pi_e = dp.ext_chunk(pi_coeff_dev, j)
+                t_chunks_fs.append(pack(dp.quotient_chunk(
+                    j, wires_e, z_e, m_e, phi_e, pi_e, ch_planes)))
+                if pre:  # chunk consumed — release its 10 ext arrays
+                    for col in wire_ext:
+                        col[j] = None
+                    z_ext[j] = m_ext[j] = phi_ext[j] = pi_ext[j] = None
+                _sync_if_tracing(t_chunks_fs[-1])
     with trace.span("prove_tpu.r3_intt8"):
         t_coeff_chunks = dp.intt8(t_chunks_fs)
-    with trace.span("prove_tpu.r3_download"):
-        chunk_arrs = [ptpu.download_std(t_coeff_chunks[u])
-                      for u in range(QUOTIENT_CHUNKS)]
+        _sync_if_tracing(t_coeff_chunks[-1])
+    # the degree check pins the full device pipeline; the remaining
+    # chunk downloads then overlap the host t-commit MSMs (the ctypes
+    # MSM call releases the GIL, so the downloader thread streams chunk
+    # u+1 through the tunnel while chunk u commits)
+    with trace.span("prove_tpu.r3_top_check"):
         top = ptpu.download_std(t_coeff_chunks[QUOTIENT_CHUNKS])
-    t_coeff_chunks[QUOTIENT_CHUNKS] = None  # only the zero check needs it
-    if top.any():
-        raise EigenError(
-            "proving_error",
-            "quotient degree overflow — witness does not satisfy the circuit",
-        )
+        t_coeff_chunks[QUOTIENT_CHUNKS] = None
+        if top.any():
+            raise EigenError(
+                "proving_error",
+                "quotient degree overflow — witness does not satisfy "
+                "the circuit",
+            )
     with trace.span("prove_tpu.r3_t_commits"):
-        t_commits = [commit_limbs(params, ch) for ch in chunk_arrs]
+        from concurrent.futures import ThreadPoolExecutor
+
+        t_commits = []
+        with ThreadPoolExecutor(max_workers=1) as pool:
+            fut = pool.submit(ptpu.download_std, t_coeff_chunks[0])
+            for u in range(QUOTIENT_CHUNKS):
+                arr = fut.result()
+                if u + 1 < QUOTIENT_CHUNKS:
+                    fut = pool.submit(ptpu.download_std,
+                                      t_coeff_chunks[u + 1])
+                t_commits.append(commit_limbs(params, arr))
+                del arr  # ~32 MB each; t_evals run on-device now
     for cm in t_commits:
         tr.absorb_point(cm)
     zeta = tr.challenge()
@@ -1003,8 +1071,10 @@ def prove_fast_tpu(params: KZGParams, pk: FastProvingKey,
     z_next = (shifted_evals[0] + blind_corr(z_blinds, zeta_w, zh_zeta_w)) % R
     phi_next = (shifted_evals[1]
                 + blind_corr(phi_blinds, zeta_w, zh_zeta_w)) % R
-    stacked = np.stack(chunk_arrs)
-    t_evals = [int(v) for v in fk.poly_eval_many(stacked, zeta)]
+    # t chunks are device-resident coefficient arrays — ζ-power dots
+    # there instead of a 7×2^20 host Horner pass
+    t_evals = dp.eval_coeffs_at_many(
+        [t_coeff_chunks[u] for u in range(QUOTIENT_CHUNKS)], zeta)
 
     for v in (wire_evals + [m_eval, z_eval, z_next, phi_eval, phi_next]
               + t_evals + fixed_evals + sigma_zeta):
@@ -1021,15 +1091,13 @@ def prove_fast_tpu(params: KZGParams, pk: FastProvingKey,
     blind_map[NUM_WIRES + 1] = z_blinds
     blind_map[NUM_WIRES + 2] = phi_blinds
 
-    def open_group_dev(poly_idx: list, polys_dev: list, at: int):
-        g_pows = []
-        g = 1
-        for _ in poly_idx:
-            g_pows.append(g)
-            g = g * v_ch % R
-        folded_dev = dp.fold_coeffs(polys_dev, g_pows)
+    def _g_pows(poly_idx: list) -> list:
+        return [pow(v_ch, i, R) for i in range(len(poly_idx))]
+
+    def open_finish(g_pows: list, folded_np: np.ndarray, poly_idx: list,
+                    at: int):
         folded = np.zeros((n + 3, 4), dtype="<u8")
-        folded[:n] = ptpu.download_std(folded_dev)
+        folded[:n] = folded_np
         for gi, idx in zip(g_pows, poly_idx):
             blinds = blind_map.get(idx)
             if not blinds:
@@ -1039,14 +1107,29 @@ def prove_fast_tpu(params: KZGParams, pk: FastProvingKey,
                 _set_int(folded, i, (_get_int(folded, i) - corr) % R)
                 _set_int(folded, n + i,
                          (_get_int(folded, n + i) + corr) % R)
-        quotient = fk.poly_divide_linear(folded, at)
-        return commit_limbs(params, quotient)
+        with trace.span("prove_tpu.r4_divide_commit"):
+            quotient = fk.poly_divide_linear(folded, at)
+            return commit_limbs(params, quotient)
 
     with trace.span("prove_tpu.r4_openings"):
+        from concurrent.futures import ThreadPoolExecutor
+
+        # both folds dispatch up front; the ωζ fold downloads on a side
+        # thread while the ζ group divides+commits on the host (the
+        # fold itself is device work, the MSM releases the GIL)
         all_idx = list(range(len(base_polys)))
-        w_x = open_group_dev(all_idx, base_polys, zeta)
-        w_wx = open_group_dev([NUM_WIRES + 1, NUM_WIRES + 2],
-                              [z_coeff_dev, phi_coeff_dev], zeta_w)
+        g1 = _g_pows(all_idx)
+        wx_idx = [NUM_WIRES + 1, NUM_WIRES + 2]
+        g2 = _g_pows(wx_idx)
+        with trace.span("prove_tpu.r4_fold_download"):
+            fold1_dev = dp.fold_coeffs(base_polys, g1)
+            fold2_dev = dp.fold_coeffs([z_coeff_dev, phi_coeff_dev], g2)
+            with ThreadPoolExecutor(max_workers=1) as pool:
+                fut2 = pool.submit(ptpu.download_std, fold2_dev)
+                fold1_np = ptpu.download_std(fold1_dev)
+                w_x = open_finish(g1, fold1_np, all_idx, zeta)
+                fold2_np = fut2.result()
+        w_wx = open_finish(g2, fold2_np, wx_idx, zeta_w)
 
     proof = Proof(wire_commits, m_commit, z_commit, phi_commit, t_commits,
                   wire_evals, m_eval, z_eval, z_next, phi_eval, phi_next,

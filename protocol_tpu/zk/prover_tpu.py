@@ -179,7 +179,11 @@ def _ext_chunk_impl(coeffs, coset16, xs16, zh_plane, blind_planes,
 def _quotient_chunk_impl(wires, z_e, m_e, phi_e, pi_e, fixed16, sigma16,
                          xs16, l016, ch, zh_inv_plane, A: int, B: int):
     """ch: (L, 10) planes of [beta, gamma, beta_lk, alpha, a2, a3, a4,
-    beta·shift_0.., ] — laid out below. xs/l0 arrive packed uint16."""
+    beta·shift_0.., ] — laid out below. xs/l0 arrive packed uint16.
+    ``wires``/``fixed16``/``sigma16`` are TUPLES of per-poly arrays —
+    a stacked (15, 16, n) operand would copy ~1.3 GB of resident packed
+    tables through HBM on every chunk dispatch. Wire entries may arrive
+    packed uint16 (the pre-dispatched ext-chunk path)."""
     n = A * B
 
     def cc(idx):
@@ -190,7 +194,11 @@ def _quotient_chunk_impl(wires, z_e, m_e, phi_e, pi_e, fixed16, sigma16,
     l0 = f2.unpack16(l016)
     fx = [f2.unpack16(fixed16[i]) for i in range(9)]
     sg = [f2.unpack16(sigma16[i]) for i in range(6)]
-    w = [wires[i] for i in range(6)]
+    w = [_as_planes(wires[i]) for i in range(6)]
+    z_e = _as_planes(z_e)
+    m_e = _as_planes(m_e)
+    phi_e = _as_planes(phi_e)
+    pi_e = _as_planes(pi_e)
     zi, phii, mi, pii = z_e, phi_e, m_e, pi_e
     zwi = _fs_roll_next(zi, A, B)
     phiwi = _fs_roll_next(phii, A, B)
@@ -550,9 +558,9 @@ class DeviceProver:
             return self._quotient_chunk_streaming(
                 j, wires_e, z_e, m_e, phi_e, pi_e, ch_planes)
         return _quotient_chunk_impl(
-            jnp.stack(wires_e), z_e, m_e, phi_e, pi_e,
-            jnp.stack([self.fixed_ext[i][j] for i in range(9)]),
-            jnp.stack([self.sigma_ext[i][j] for i in range(6)]),
+            tuple(wires_e), z_e, m_e, phi_e, pi_e,
+            tuple(self.fixed_ext[i][j] for i in range(9)),
+            tuple(self.sigma_ext[i][j] for i in range(6)),
             self.xs_fs[j], self.l0_fs[j], ch_planes,
             self.zh_inv_planes[j], self.A, self.B)
 

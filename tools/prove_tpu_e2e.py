@@ -133,6 +133,9 @@ def child(k: int, seed: int, out_path: str, host: bool) -> None:
         # (pk cosets) resident — the per-proof cost a long-lived prover
         # service pays, like halo2 reusing its ProvingKey
         rng2 = random.Random(seed + 1000)
+        from protocol_tpu.utils import trace as _trace
+
+        _trace.TRACER.reset()  # span table should cover the warm prove only
         t0 = time.time()
         proof2 = pf.prove_fast_tpu(params, pk, chips.cs,
                                    randint=lambda: rng2.randrange(R))
@@ -140,6 +143,14 @@ def child(k: int, seed: int, out_path: str, host: bool) -> None:
         if not verify(params, pk, chips.cs.public_values(), proof2):
             print("WARM VERIFY FAILED", file=sys.stderr)
             sys.exit(3)
+    from protocol_tpu.utils import trace
+
+    if trace.TRACER.enabled:  # PROTOCOL_TPU_TRACE=1 (+ PTPU_TRACE_SYNC=1
+        # for accurate per-stage attribution) → span table in the JSON
+        result["trace"] = {
+            k: {"count": v["count"], "total_s": round(v["total_s"], 1)}
+            for k, v in sorted(trace.summary().items())
+        }
     with open(out_path, "wb") as f:
         f.write(proof)
     with open(out_path + ".json", "w") as f:
