@@ -120,10 +120,41 @@ def ripple(t: jnp.ndarray, passes: int = 2) -> jnp.ndarray:
     return t
 
 
+def _lookahead_chain(g: jnp.ndarray, p: jnp.ndarray) -> jnp.ndarray:
+    """Kogge-Stone carry/borrow lookahead over the limb axis: given
+    per-limb generate/propagate flags (MUST be 0/1 int32 — the bitwise
+    combine is wrong for values ≥ 2), returns the carry INTO each limb
+    (combined carry-out of all limbs below it) in log₂(L) steps."""
+
+    def combine(lo, hi):
+        g_lo, p_lo = lo
+        g_hi, p_hi = hi
+        return g_hi | (p_hi & g_lo), p_hi & p_lo
+
+    G, _ = lax.associative_scan(combine, (g, p), axis=0)
+    return jnp.concatenate(
+        [jnp.zeros((1,) + g.shape[1:], jnp.int32), G[:-1]], axis=0)
+
+
 def canon_limbs(x: jnp.ndarray) -> jnp.ndarray:
-    """Full carry propagation to limbs < 2^B (value untouched, may still
-    be in [0, 2p))."""
-    return ripple(x, passes=3)
+    """Full carry propagation to limbs < 2^B below the top plane (value
+    untouched — the TOP limb stays unmasked and absorbs every incoming
+    carry, exactly like ``ripple``) — exact for ANY relaxed input
+    (limbs < 2^13), including adversarial all-0xFFF runs that a fixed
+    ripple-pass count would mis-canonicalize: one ripple pass bounds
+    every limb by 2^B, then a carry-lookahead resolves the remaining
+    unit carries in log₂(L) combine steps instead of L ripple passes."""
+    x = ripple(x, passes=1)  # limbs ≤ 2^B (≤ 2^B − 1 + carry ≤ 2^B)
+    g = (x >> B).astype(jnp.int32)          # generates a carry-out
+    a = x & MASK
+    p = (a == MASK).astype(jnp.int32)       # propagates an incoming carry
+    c_in = _lookahead_chain(g, p)
+    out = a + c_in
+    # lower limbs masked canonical; the top limb keeps its own high
+    # bits (a masked top would silently drop value ≥ 2^264 — lazy NTT
+    # outputs legitimately reach there)
+    return jnp.concatenate(
+        [out[:-1] & MASK, x[-1:] + c_in[-1:]], axis=0)
 
 
 # --- core multiply ----------------------------------------------------------
@@ -136,7 +167,45 @@ def mont_mul(x: jnp.ndarray, y: jnp.ndarray) -> jnp.ndarray:
     ripple cannot push a carry off the truncated top. All intermediates
     stay below 2^31 for limbs < 2^13."""
     n = x.shape[1]
-    p_planes = _const_planes(P, None)  # (L, 1), broadcasts over lanes
+    # STATICALLY UNROLLED over per-plane (n,) arrays: a lax.fori_loop
+    # (or any formulation with concatenate/.at[] on the carry state)
+    # materializes (L+2, n) through HBM every iteration — measured
+    # ~39 ms per (L, 2^20) multiply, ~100x the fused roofline. Pure
+    # elementwise ops over plane lists fuse into a handful of kernels
+    # with register-resident intermediates. Compile time grows with the
+    # 22 inlined steps but is cached.
+    xs = [x[i] for i in range(L)]
+    ys = [y[j] for j in range(L)]
+    zero = jnp.zeros((n,), dtype=jnp.int32)
+    t = [zero] * (L + 2)
+
+    def reduce_step(t):
+        u = ((t[0] & MASK) * P_INV_NEG) & MASK
+        t = [t[j] + u * _P_LIMBS[j] if _P_LIMBS[j] else t[j]
+             for j in range(L)] + t[L:]
+        carry0 = t[0] >> B
+        t = t[1:] + [zero]
+        t[0] = t[0] + carry0
+        return t
+
+    for i in range(L):
+        t = [t[j] + xs[i] * ys[j] for j in range(L)] + t[L:]
+        t = reduce_step(t)
+    t = reduce_step(t)  # the extra division by 2^B (R = 2^{B(L+1)})
+    out = jnp.stack(t[:L], axis=0)
+    return ripple(out, passes=2)
+
+
+def mont_mul_compact(x: jnp.ndarray, y: jnp.ndarray) -> jnp.ndarray:
+    """``mont_mul`` with the (L+2, n)-state fori_loop formulation.
+
+    ~2x slower than the unrolled ``mont_mul`` on straight-line code,
+    but REQUIRED inside lax control-flow bodies (associative_scan /
+    scan / fori_loop): the unrolled per-plane version's [1, n] slices
+    pick up pathological (8, 128)-tile padding under scan batching —
+    a 128x HBM expansion per temporary that OOMs a 16 GB chip."""
+    n = x.shape[1]
+    p_planes = _const_planes(P, None)
     t = jnp.zeros((L + 2, n), dtype=jnp.int32)
 
     def reduce_step(t):
@@ -148,12 +217,12 @@ def mont_mul(x: jnp.ndarray, y: jnp.ndarray) -> jnp.ndarray:
         return t
 
     def step(i, t):
-        xi = lax.dynamic_slice_in_dim(x, i, 1, axis=0)  # (1, n)
+        xi = lax.dynamic_slice_in_dim(x, i, 1, axis=0)
         t = t.at[:L].add(xi * y)
         return reduce_step(t)
 
     t = lax.fori_loop(0, L, step, t)
-    t = reduce_step(t)  # the extra division by 2^B (R = 2^{B(L+1)})
+    t = reduce_step(t)
     return ripple(t[:L].astype(jnp.int32), passes=2)
 
 
@@ -199,7 +268,9 @@ def exit_mont(x_mont: jnp.ndarray) -> jnp.ndarray:
 
 def canonical(x: jnp.ndarray) -> jnp.ndarray:
     """Relaxed → canonical (< p): full carries + one conditional
-    subtract of p."""
+    subtract of p (borrows resolved by the same log-depth lookahead as
+    ``canon_limbs`` — the former L-pass ripple was ~0.4 s per 2^20
+    download conversion)."""
     x = canon_limbs(x)
     p_planes = _const_planes(P, None)
     p_bcast = jnp.broadcast_to(p_planes, x.shape)
@@ -210,8 +281,12 @@ def canonical(x: jnp.ndarray) -> jnp.ndarray:
         gt = gt | (eq & (x[i] > p_bcast[i]))
         eq = eq & (x[i] == p_bcast[i])
     geq = gt | eq
-    x = x - jnp.where(geq[None], p_bcast, 0)
-    return ripple(x, passes=L)
+    d = x - jnp.where(geq[None], p_bcast, 0)
+    # d limbs ∈ (−2^B, 2^B); borrow lookahead: limb borrows when
+    # negative, propagates an incoming borrow when exactly zero
+    b_in = _lookahead_chain((d < 0).astype(jnp.int32),
+                            (d == 0).astype(jnp.int32))
+    return (d - b_in) & MASK
 
 
 # --- batched inverse (Fermat) ----------------------------------------------
@@ -224,9 +299,9 @@ def mont_pow_const(x: jnp.ndarray, e: int) -> jnp.ndarray:
 
     def step(i, state):
         acc, base = state
-        hit = mont_mul(acc, base)
+        hit = mont_mul_compact(acc, base)
         acc = jnp.where(bits[i] == 1, hit, acc)
-        base = mont_mul(base, base)
+        base = mont_mul_compact(base, base)
         return acc, base
 
     acc, _ = lax.fori_loop(0, nbits, step, (one_m, x))
@@ -245,7 +320,7 @@ def batch_inv(x: jnp.ndarray) -> jnp.ndarray:
     n = x.shape[1]
 
     def combine(a, b):
-        return mont_mul(a, b)
+        return mont_mul_compact(a, b)
 
     pre = lax.associative_scan(combine, x, axis=1)          # Πx_{≤i}
     suf = lax.associative_scan(combine, x[:, ::-1], axis=1)[:, ::-1]
@@ -317,24 +392,6 @@ def reduce_mxu_planes(planes: jnp.ndarray) -> jnp.ndarray:
 
 # --- compact 16-bit storage (device-resident ext arrays) -------------------
 
-def _resolve_carries_16(t16: jnp.ndarray) -> jnp.ndarray:
-    """Exact base-2^16 carry resolution, fixed unrolled passes.
-
-    2 passes shrink any int32 excess below a unit carry; a unit carry
-    can then ripple through at most the remaining 15 planes, so 18
-    passes are provably enough for ANY int32 input. Unrolled (not
-    lax.while_loop): a dynamic-trip-count While around concat ops sends
-    the XLA CPU pipeline into minutes-long compiles, and the fixed pass
-    count keeps CPU tests and the TPU path on identical programs."""
-    t = t16
-    for _ in range(18):
-        carry = t[:-1] >> 16
-        low = t[:-1] & 0xFFFF
-        t = jnp.concatenate([low, t[-1:]], axis=0) + jnp.concatenate(
-            [jnp.zeros((1,) + t.shape[1:], jnp.int32), carry], axis=0)
-    return t
-
-
 def pack16(x: jnp.ndarray) -> jnp.ndarray:
     """(L, n) planes with value < 2^256 → (16, n) uint16 value planes.
 
@@ -344,17 +401,24 @@ def pack16(x: jnp.ndarray) -> jnp.ndarray:
     planes) can reach ~2^264 and silently loses its top bits here —
     callers must normalize first with ``mont_mul_const(x, R_MONT)``
     (value-preserving fold into [0, 2p)), as ``_ext_chunk_impl`` does.
-    Each 12-bit limb is assigned wholly to the 16-bit window containing
-    its base bit, then base-2^16 carries are resolved exactly. Halves
-    the HBM footprint of resident arrays."""
+
+    After full carry propagation the 12-bit limbs are CANONICAL, so the
+    value's binary expansion is their concatenation — each 16-bit
+    window is a pure bit-slice of at most two adjacent limbs, no carry
+    resolution at all (the former 18-pass base-2^16 ripple cost more
+    device time than the NTT feeding it). Halves the HBM footprint of
+    resident arrays."""
     x = canon_limbs(x)
-    outs = [jnp.zeros(x.shape[1:], dtype=jnp.int32) for _ in range(16)]
-    for a in range(L):
-        bit = B * a
-        t, s = bit // 16, bit % 16
-        outs[t] = outs[t] + (x[a] << s)
-    t16 = _resolve_carries_16(jnp.stack(outs, axis=0))
-    return t16.astype(jnp.uint16)
+    outs = []
+    for t in range(16):
+        bit = 16 * t
+        a, s = bit // B, bit % B  # window starts inside limb a at bit s
+        # s ∈ {0, 4, 8} for B=12, so two limbs always cover a window
+        w = x[a] >> s
+        if a + 1 < L:
+            w = w | (x[a + 1] << (B - s))
+        outs.append(w & 0xFFFF)
+    return jnp.stack(outs, axis=0).astype(jnp.uint16)
 
 
 def unpack16(x16: jnp.ndarray) -> jnp.ndarray:

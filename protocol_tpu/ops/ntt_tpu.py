@@ -94,7 +94,7 @@ class NttPlan:
         one = f2._const_planes(f2.R_MONT, rows)
 
         def step(carry, _):
-            nxt = f2.mont_mul(carry, gen_mont)
+            nxt = f2.mont_mul_compact(carry, gen_mont)
             return nxt, carry
 
         _, ys = lax.scan(step, one, None, length=cols)

@@ -25,6 +25,7 @@ from __future__ import annotations
 import json
 import os
 import secrets
+from concurrent.futures import ThreadPoolExecutor
 from dataclasses import dataclass
 
 import numpy as np
@@ -904,9 +905,11 @@ def prove_fast_tpu(params: KZGParams, pk: FastProvingKey,
     # after it. Chunks are packed uint16 on arrival (~2.6 GB resident
     # for all 80 at k=20; the quotient kernel unpacks at trace time).
     # Device dispatch is async through the tunnel — these calls queue
-    # work and return. Resident mode only: the streaming (k≥21) HBM
-    # plan has no room for pre-dispatched ext chunks.
-    pre = dp.ext_resident
+    # work and return. Default: resident mode only. In streaming mode
+    # (k=21) the packed witness ext chunks cost ~3.6 GB of HBM on top
+    # of the ~7.5 GB streaming plan — close enough to the 16 GB line
+    # that it stays opt-in (PTPU_PREDISPATCH=1) until measured safe.
+    pre = dp.ext_resident or os.environ.get("PTPU_PREDISPATCH") == "1"
 
     def ext8(coeff_dev, blinds=None):
         return [ptpu._pack16_impl(e)
@@ -1024,8 +1027,6 @@ def prove_fast_tpu(params: KZGParams, pk: FastProvingKey,
                 "the circuit",
             )
     with trace.span("prove_tpu.r3_t_commits"):
-        from concurrent.futures import ThreadPoolExecutor
-
         t_commits = []
         with ThreadPoolExecutor(max_workers=1) as pool:
             fut = pool.submit(ptpu.download_std, t_coeff_chunks[0])
@@ -1112,8 +1113,6 @@ def prove_fast_tpu(params: KZGParams, pk: FastProvingKey,
             return commit_limbs(params, quotient)
 
     with trace.span("prove_tpu.r4_openings"):
-        from concurrent.futures import ThreadPoolExecutor
-
         # both folds dispatch up front; the ωζ fold downloads on a side
         # thread while the ζ group divides+commits on the host (the
         # fold itself is device work, the MSM releases the GIL)

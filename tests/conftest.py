@@ -34,6 +34,18 @@ if not _REAL_TPU:
     jax.config.update("jax_platforms", "cpu")
     jax.config.update("jax_enable_x64", True)
 
+# persistent XLA compile cache: the prover programs inline statically
+# unrolled field kernels (fieldops2.mont_mul) whose CPU compiles run
+# minutes; repeat suite runs should pay them once, not every session
+_CACHE_DIR = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "bench_cache", "xla_cache_cpu")
+try:
+    os.makedirs(_CACHE_DIR, exist_ok=True)
+    jax.config.update("jax_compilation_cache_dir", _CACHE_DIR)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 2.0)
+except Exception:  # cache is an optimization, never a failure
+    pass
+
 
 def make_signed_attestation(kp, about: bytes, domain: bytes, value: int,
                             message: bytes = b"\x00" * 32):

@@ -146,3 +146,46 @@ def test_dots_impl_multi_poly_ordering():
     exp = [sum(a * b for a, b in zip(vs, w_vals)) % P
            for vs in (vals0, vals1)]
     assert got == exp
+
+
+def test_pack16_adversarial_carry_runs():
+    """pack16/canon_limbs must canonicalize values whose limbs ripple
+    carries through long 0xFFF runs — a fixed ripple-pass count loses
+    these (the lookahead rewrite's regression case) — and round-trip
+    exactly through unpack16 and the uint16 wire layout."""
+    import numpy as np
+
+    cases = []
+    # value with a long all-ones middle: (2^200 - 2^12) + adversarial
+    cases.append((1 << 200) - (1 << 12))
+    cases.append((1 << 253) - 1)
+    cases.append(P - 1)
+    cases.append(2 * P - 1)
+    cases.append(0)
+    # relaxed representation that carries through 15 saturated limbs
+    relaxed = f2.ints_to_planes(cases).astype("int32")
+    # add a synthetic relaxed row: limb pattern [2^12, 0xFFF x 15, ...]
+    adv = np.zeros((f2.L, 1), dtype="int32")
+    adv[0, 0] = 1 << f2.B  # carry generator
+    for i in range(1, 16):
+        adv[i, 0] = f2.MASK  # propagating run
+    planes = np.concatenate([relaxed, adv], axis=1)
+    vals = cases + [f2.planes_to_ints(adv)[0]]
+    # top-limb bits >= 2^12 must survive canon_limbs exactly (a masked
+    # top plane silently drops 2^264 multiples — review regression)
+    top = np.zeros((f2.L, 1), dtype="int32")
+    top[f2.L - 1, 0] = 0x1005
+    top[0, 0] = 7
+    got_top = f2.planes_to_ints(
+        np.asarray(jnp.asarray(f2.canon_limbs(jnp.asarray(top)))))[0]
+    assert got_top == (0x1005 << (f2.B * (f2.L - 1))) + 7
+    packed = jnp.asarray(f2.pack16(jnp.asarray(planes)))
+    # uint16 planes ARE the base-2^16 digits of the value
+    got_vals = []
+    arr = np.asarray(packed)
+    for j in range(arr.shape[1]):
+        got_vals.append(sum(int(arr[t, j]) << (16 * t) for t in range(16)))
+    assert got_vals == [v % (1 << 256) for v in vals]
+    # unpack16 inverts
+    back = f2.planes_to_ints(np.asarray(jnp.asarray(f2.unpack16(packed))))
+    assert back == [v % (1 << 256) for v in vals]

@@ -1,0 +1,72 @@
+"""Tunnel transfer probe: device→host bandwidth, single vs parallel.
+
+The k=20 warm prove's t-chunk downloads (7 × 32 MB) measured ~7.5 MB/s
+through the remote-device tunnel — a dominant cost. This probe answers
+whether concurrent transfer streams aggregate bandwidth (then the
+prover's downloader pool should widen) or the tunnel serializes.
+
+Usage: python tools/probe_tunnel_bw.py
+"""
+
+import os
+import sys
+import time
+from concurrent.futures import ThreadPoolExecutor
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+os.chdir(REPO)
+
+import numpy as np  # noqa: E402
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+
+MB = 1 << 20
+
+
+def main() -> int:
+    dev = jax.devices()[0]
+    print(f"device: {dev}", flush=True)
+
+    def fresh(count):
+        # a host->device copy may be lazily aliased AND a device->host
+        # np.asarray caches on the array object — defeat both by
+        # producing DERIVED buffers on device, fresh per configuration
+        seed = jax.device_put(np.random.randint(
+            0, 1 << 16, size=(16, 1 << 20), dtype=np.uint16))
+        outs = [jnp.bitwise_xor(seed, np.uint16(i + 1)) for i in range(count)]
+        jax.block_until_ready(outs)
+        return outs
+
+    warm = fresh(1)
+    t0 = time.time()
+    _ = np.asarray(warm[0])
+    dt = time.time() - t0
+    size_mb = warm[0].nbytes / MB
+    print(f"single {size_mb:.0f} MB (warmup): {dt:.2f}s "
+          f"({size_mb/dt:.1f} MB/s)", flush=True)
+
+    for streams in (1, 2, 4):
+        bufs = fresh(8)
+        t0 = time.time()
+        with ThreadPoolExecutor(max_workers=streams) as pool:
+            list(pool.map(np.asarray, bufs))
+        dt = time.time() - t0
+        print(f"8 x {size_mb:.0f} MB, {streams} stream(s): {dt:.2f}s "
+              f"({8*size_mb/dt:.1f} MB/s aggregate)", flush=True)
+
+    # upload direction
+    host = [np.random.randint(0, 1 << 16, size=(16, 1 << 20),
+                              dtype=np.uint16) for _ in range(4)]
+    t0 = time.time()
+    up = [jax.device_put(h) for h in host]
+    jax.block_until_ready(up)
+    dt = time.time() - t0
+    print(f"upload 4 x {size_mb:.0f} MB sequential: {dt:.2f}s "
+          f"({4*size_mb/dt:.1f} MB/s)", flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,119 @@
+"""Flagship ET prove on the real chip: the n=4 × 20-iteration shape.
+
+Since the GLV shared-doubling ECDSA path (zk/ecdsa_chip.py) the
+flagship circuit is 1,843,176 rows → k=21, half the k=22 domain the
+round-2 measurement paid (BASELINE.md). This is the committed entry
+point for the flagship rows: SRS + witness + eval-form keygen cached
+on disk, one cold and one warm `prove_fast_tpu` on the k=21 streaming
+device path, verification gating every proof.
+
+Usage (repo root, real TPU visible):
+    python tools/prove_flagship.py [--skip-cold]
+Writes bench_cache/zk/flagship_k21.json.
+
+Reference anchor: the run the reference permanently `#[ignore]`s as
+"takes too long" (eigentrust-zk/src/circuits/dynamic_sets/mod.rs:870).
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+os.chdir(REPO)
+CACHE = os.path.join(REPO, "bench_cache", "zk")
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--skip-cold", action="store_true",
+                    help="one prove only (programs may still compile)")
+    ap.add_argument("--trace", action="store_true")
+    args = ap.parse_args()
+
+    os.makedirs(CACHE, exist_ok=True)
+    import jax
+
+    jax.config.update("jax_compilation_cache_dir",
+                      os.path.join(CACHE, "xla_cache"))
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+
+    from protocol_tpu.utils import trace
+    from protocol_tpu.zk import api
+    from protocol_tpu.zk import prover_fast as pf
+    from protocol_tpu.zk.kzg import KZGParams
+    from protocol_tpu.zk.plonk import verify
+
+    if args.trace:
+        trace.enable()
+    result = {}
+
+    params_path = os.path.join(CACHE, "params_k21.bin")
+    if not os.path.exists(params_path):
+        t0 = time.time()
+        data = api.generate_kzg_params(21, seed=b"flagship")
+        with open(params_path, "wb") as f:
+            f.write(data)
+        result["srs_s"] = round(time.time() - t0, 1)
+        print(f"SRS k=21: {result['srs_s']}s", flush=True)
+    t0 = time.time()
+    params = KZGParams.from_bytes(open(params_path, "rb").read())
+    print(f"params load {time.time()-t0:.1f}s", flush=True)
+
+    shape = api.DEFAULT_SHAPE  # n=4 x 20 iters — the EigenTrust4 shape
+    t0 = time.time()
+    witness, *_ = api._dummy_et_fixture(shape)
+    chips, _ = api._build_et_circuit(witness, shape)
+    result["rows"] = chips.cs.num_rows
+    result["build_s"] = round(time.time() - t0, 1)
+    print(f"flagship circuit: {result['rows']} rows "
+          f"({result['build_s']}s)", flush=True)
+
+    pk_path = os.path.join(CACHE, "pk_et_flagship_k21.fpk2")
+    if os.path.exists(pk_path):
+        t0 = time.time()
+        pk = pf.FastProvingKey.from_bytes(open(pk_path, "rb").read())
+        print(f"pk load {time.time()-t0:.1f}s", flush=True)
+    else:
+        t0 = time.time()
+        pk = pf.keygen_fast(params, chips.cs, k=21, eval_pk=True)
+        result["keygen_s"] = round(time.time() - t0, 1)
+        print(f"keygen k=21: {result['keygen_s']}s", flush=True)
+        with open(pk_path, "wb") as f:
+            f.write(pk.to_bytes())
+
+    pubs = chips.cs.public_values()
+    if not args.skip_cold:
+        t0 = time.time()
+        proof = pf.prove_fast_tpu(params, pk, chips.cs)
+        result["prove_cold_s"] = round(time.time() - t0, 1)
+        t0 = time.time()
+        ok = verify(params, pk, pubs, proof)
+        result["verify_s"] = round(time.time() - t0, 2)
+        print(f"prove cold {result['prove_cold_s']}s verify {ok} "
+              f"({result['verify_s']}s)", flush=True)
+        if not ok:
+            return 3
+    t0 = time.time()
+    proof2 = pf.prove_fast_tpu(params, pk, chips.cs)
+    result["prove_warm_s"] = round(time.time() - t0, 1)
+    ok2 = verify(params, pk, pubs, proof2)
+    print(f"prove warm {result['prove_warm_s']}s verify {ok2}", flush=True)
+    if not ok2:
+        return 3
+    if args.trace:
+        result["trace"] = {
+            k: {"count": v["count"], "total_s": round(v["total_s"], 1)}
+            for k, v in sorted(trace.summary().items())
+        }
+    with open(os.path.join(CACHE, "flagship_k21.json"), "w") as f:
+        json.dump(result, f, indent=1)
+    print(json.dumps(result), flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
