@@ -166,6 +166,26 @@ def mont_mul(x: jnp.ndarray, y: jnp.ndarray) -> jnp.ndarray:
     lazy limbs are non-negative, so t[L−1] ≤ value/2^252) and the 2-pass
     ripple cannot push a carry off the truncated top. All intermediates
     stay below 2^31 for limbs < 2^13."""
+    # Backend fork, decided at TRACE time: the XLA *CPU* pipeline can
+    # spend hours on programs that inline dozens of the unrolled chains
+    # below (the quotient kernel inlines ~45 of them), so the CPU
+    # backend — the test harness and any jax-on-host fallback — takes
+    # the compact fori_loop twin instead. The value semantics are
+    # identical (both tested against Python ints); only the TPU path
+    # needs the unrolled form's fusion behavior.
+    if _unrolled_backend():
+        return _mont_mul_unrolled(x, y)
+    return mont_mul_compact(x, y)
+
+
+def _unrolled_backend() -> bool:
+    try:
+        return jax.default_backend() != "cpu"
+    except Exception:  # pragma: no cover - uninitialized backend
+        return False
+
+
+def _mont_mul_unrolled(x: jnp.ndarray, y: jnp.ndarray) -> jnp.ndarray:
     n = x.shape[1]
     # STATICALLY UNROLLED over per-plane (n,) arrays: a lax.fori_loop
     # (or any formulation with concatenate/.at[] on the carry state)
@@ -173,7 +193,8 @@ def mont_mul(x: jnp.ndarray, y: jnp.ndarray) -> jnp.ndarray:
     # ~39 ms per (L, 2^20) multiply, ~100x the fused roofline. Pure
     # elementwise ops over plane lists fuse into a handful of kernels
     # with register-resident intermediates. Compile time grows with the
-    # 22 inlined steps but is cached.
+    # 22 inlined steps but is cached (and is a TPU-only cost — see
+    # ``mont_mul``).
     xs = [x[i] for i in range(L)]
     ys = [y[j] for j in range(L)]
     zero = jnp.zeros((n,), dtype=jnp.int32)
@@ -401,6 +422,10 @@ def pack16(x: jnp.ndarray) -> jnp.ndarray:
     planes) can reach ~2^264 and silently loses its top bits here —
     callers must normalize first with ``mont_mul_const(x, R_MONT)``
     (value-preserving fold into [0, 2p)), as ``_ext_chunk_impl`` does.
+    Limbs must additionally be RELAXED (< 2^13 — every mont_mul/ripple
+    output is): ``canon_limbs``'s lookahead assumes unit carries, so an
+    arbitrary int32 plane would pack garbage where the old 18-pass
+    resolver merely truncated.
 
     After full carry propagation the 12-bit limbs are CANONICAL, so the
     value's binary expansion is their concatenation — each 16-bit
@@ -408,7 +433,14 @@ def pack16(x: jnp.ndarray) -> jnp.ndarray:
     resolution at all (the former 18-pass base-2^16 ripple cost more
     device time than the NTT feeding it). Halves the HBM footprint of
     resident arrays."""
-    x = canon_limbs(x)
+    return _pack16_slices(canon_limbs(x))
+
+
+def _pack16_slices(x: jnp.ndarray) -> jnp.ndarray:
+    """(L, n) CANONICAL limbs → (16, n) uint16 bit-slices — the pack16
+    core, callable directly on already-canonical data (the download
+    wire path slices ``canonical()`` output without a redundant second
+    canonicalization)."""
     outs = []
     for t in range(16):
         bit = 16 * t

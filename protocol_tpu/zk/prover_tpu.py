@@ -56,11 +56,6 @@ def _cplane(v: int) -> jnp.ndarray:
 
 
 @jax.jit
-def _enter(x):
-    return f2.enter_mont(x)
-
-
-@jax.jit
 def _to_u64_ready(x):
     if x.dtype == jnp.uint16:  # packed storage (streaming mode)
         x = f2.unpack16(x)
@@ -75,7 +70,8 @@ def _to_u16_wire(x):
     (the tunnel serializes at ~16 MB/s, so wire bytes are wall-clock)."""
     if x.dtype == jnp.uint16:
         x = f2.unpack16(x)
-    return f2.pack16(f2.canonical(f2.exit_mont(x)))
+    # canonical() output needs no second canonicalization — slice it
+    return f2._pack16_slices(f2.canonical(f2.exit_mont(x)))
 
 
 @jax.jit

@@ -189,3 +189,24 @@ def test_pack16_adversarial_carry_runs():
     # unpack16 inverts
     back = f2.planes_to_ints(np.asarray(jnp.asarray(f2.unpack16(packed))))
     assert back == [v % (1 << 256) for v in vals]
+
+
+def test_mont_mul_unrolled_matches_compact_on_cpu():
+    """The TPU-only unrolled CIOS must stay value-identical to the
+    compact twin the CPU backend runs (mont_mul forks on backend at
+    trace time; one small program compiles fine even on CPU)."""
+    import random
+
+    rng = random.Random(17)
+    vals_x = [rng.randrange(P) for _ in range(64)]
+    vals_y = [rng.randrange(P) for _ in range(64)]
+    x = jnp.asarray(f2.ints_to_planes(vals_x))
+    y = jnp.asarray(f2.ints_to_planes(vals_y))
+    a = jax.jit(f2._mont_mul_unrolled)(x, y)
+    b = jax.jit(f2.mont_mul_compact)(x, y)
+    va = [v % P for v in f2.planes_to_ints(np.asarray(a))]
+    vb = [v % P for v in f2.planes_to_ints(np.asarray(b))]
+    assert va == vb
+    Rinv = pow(1 << f2.R_EXP, -1, P)
+    expect = [vx * vy * Rinv % P for vx, vy in zip(vals_x, vals_y)]
+    assert va == expect
