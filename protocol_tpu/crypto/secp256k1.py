@@ -22,6 +22,7 @@ import hashlib
 import secrets
 from dataclasses import dataclass, field as dc_field
 
+from ..utils.errors import EigenError
 from ..utils.fields import Fr, SECP256K1_P, SECP256K1_N
 from ..utils.keccak import keccak256
 
@@ -294,6 +295,13 @@ def glv_decompose(u: int) -> tuple:
     k2 = -c1 * _GLV_B1 - c2 * _GLV_B2
     s1, e1 = (k1, 1) if k1 >= 0 else (-k1, -1)
     s2, e2 = (k2, 1) if k2 >= 0 else (-k2, -1)
-    assert s1 < 1 << GLV_HALF_BITS and s2 < 1 << GLV_HALF_BITS
-    assert (e1 * s1 + GLV_LAMBDA * e2 * s2 - u) % N == 0
+    # EigenError (not assert): under python -O an oversized half-scalar
+    # would otherwise be truncated by _assign_half_scalar and surface
+    # much later as an unsatisfiable congruence with no root cause
+    if s1 >= 1 << GLV_HALF_BITS or s2 >= 1 << GLV_HALF_BITS:
+        raise EigenError("proving_error",
+                         f"GLV half-scalar exceeds 2^{GLV_HALF_BITS}")
+    if (e1 * s1 + GLV_LAMBDA * e2 * s2 - u) % N != 0:
+        raise EigenError("proving_error",
+                         "GLV decomposition congruence failed")
     return s1, e1, s2, e2

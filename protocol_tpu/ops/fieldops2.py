@@ -27,6 +27,7 @@ layout choices are TPU-tiling-driven, not a translation.
 
 from __future__ import annotations
 
+import os
 from functools import partial
 
 import numpy as np
@@ -136,6 +137,15 @@ def _lookahead_chain(g: jnp.ndarray, p: jnp.ndarray) -> jnp.ndarray:
         [jnp.zeros((1,) + g.shape[1:], jnp.int32), G[:-1]], axis=0)
 
 
+def _assert_relaxed(m) -> None:
+    """PTPU_DEBUG_BOUNDS=1 guard: canon_limbs' lookahead is exact only
+    for relaxed limbs (< 2^13); fail loudly at the violating call."""
+    if int(m) >= (1 << 13):
+        raise AssertionError(
+            f"canon_limbs input limb {int(m)} ≥ 2^13 — outside the "
+            "single-ripple + unit-carry lookahead exactness bound")
+
+
 def canon_limbs(x: jnp.ndarray) -> jnp.ndarray:
     """Full carry propagation to limbs < 2^B below the top plane (value
     untouched — the TOP limb stays unmasked and absorbs every incoming
@@ -143,7 +153,19 @@ def canon_limbs(x: jnp.ndarray) -> jnp.ndarray:
     (limbs < 2^13), including adversarial all-0xFFF runs that a fixed
     ripple-pass count would mis-canonicalize: one ripple pass bounds
     every limb by 2^B, then a carry-lookahead resolves the remaining
-    unit carries in log₂(L) combine steps instead of L ripple passes."""
+    unit carries in log₂(L) combine steps instead of L ripple passes.
+
+    EXACTNESS BOUND: limbs up to ~2^24 per plane, NOT arbitrary int32.
+    After the single ripple pass a limb of value v leaves carry v>>B
+    for its neighbor; the lookahead then resolves only UNIT carries
+    (generate/propagate are 0/1 flags), so it is exact iff post-ripple
+    limbs are ≤ 2^B (i.e. input limbs < 2^B·(2^B−1)+2^B ≈ 2^24 and no
+    limb both generates ≥2 carries and propagates). Every in-repo
+    caller feeds relaxed (< 2^13) planes; a future caller with raw
+    accumulated planes would pack garbage silently — hence the debug
+    check below (enable with PTPU_DEBUG_BOUNDS=1)."""
+    if os.environ.get("PTPU_DEBUG_BOUNDS") == "1":
+        jax.debug.callback(_assert_relaxed, jnp.max(x))
     x = ripple(x, passes=1)  # limbs ≤ 2^B (≤ 2^B − 1 + carry ≤ 2^B)
     g = (x >> B).astype(jnp.int32)          # generates a carry-out
     a = x & MASK
@@ -179,6 +201,19 @@ def mont_mul(x: jnp.ndarray, y: jnp.ndarray) -> jnp.ndarray:
 
 
 def _unrolled_backend() -> bool:
+    """True when the trace should take the unrolled twin.
+
+    CONTRACT: ``mont_mul`` must only be traced for the process-default
+    backend. The choice consults ``jax.default_backend()`` at TRACE
+    time, so tracing for a non-default device (``jax.default_device``
+    pinning a CPU while a TPU is default) would pick the unrolled form
+    on the XLA CPU pipeline — the hours-long-compile hazard this fork
+    exists to avoid. No in-repo caller does that (the prover pins the
+    whole process to one backend); results would still be correct,
+    only compile time is at risk. PTPU_FORCE_COMPACT=1 forces the
+    compact twin for such a session."""
+    if os.environ.get("PTPU_FORCE_COMPACT") == "1":
+        return False
     try:
         return jax.default_backend() != "cpu"
     except Exception:  # pragma: no cover - uninitialized backend

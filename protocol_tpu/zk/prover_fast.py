@@ -1123,9 +1123,17 @@ def prove_fast_tpu(params: KZGParams, pk: FastProvingKey,
         with trace.span("prove_tpu.r4_fold_download"):
             fold1_dev = dp.fold_coeffs(base_polys, g1)
             fold2_dev = dp.fold_coeffs([z_coeff_dev, phi_coeff_dev], g2)
+            # fold1 downloads on the MAIN thread first: the tunnel
+            # serializes transfers (parallel streams don't aggregate),
+            # so a concurrent fold2 download buys nothing — and doing
+            # it on a worker would put two threads inside JAX dispatch
+            # at once. After fold1 lands, _to_u16_wire is compiled and
+            # warm for the (L, n) fold shape, so the worker's fold2
+            # download overlaps only the GIL-releasing host
+            # divide+commit below.
+            fold1_np = ptpu.download_std(fold1_dev)
             with ThreadPoolExecutor(max_workers=1) as pool:
                 fut2 = pool.submit(ptpu.download_std, fold2_dev)
-                fold1_np = ptpu.download_std(fold1_dev)
                 w_x = open_finish(g1, fold1_np, all_idx, zeta)
                 fold2_np = fut2.result()
         w_wx = open_finish(g2, fold2_np, wx_idx, zeta_w)

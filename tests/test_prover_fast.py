@@ -234,3 +234,54 @@ def test_msm_c16_window_branch():
     out = native.g1_msm(Q_, pts, native.ints_to_limbs(scal))
     tot = sum(s * b for s, b in zip(scal, bases)) % R_
     assert out == g1_mul(G1_GEN, tot)
+
+
+def test_msm_ifma_scalar_vector_equivalence(monkeypatch):
+    """ADVICE r3: the AVX-512 IFMA level_pass (8-lane batch-affine
+    levels with doubling/cancel edge patches) only executes on IFMA
+    hardware, so CI without IFMA never compared it to the scalar path.
+    Engineer inputs that force the edge lanes — equal-point pairs
+    (doubling), P/−P pairs (cancel to infinity) inside one bucket — and
+    assert the default path, the PN_NO_IFMA=1 scalar path and a Python
+    ground truth all agree. On a non-IFMA box both native runs take the
+    scalar path and this reduces to a (still useful) oracle check."""
+    from protocol_tpu import native
+    from protocol_tpu.zk.bn254 import (BN254_FQ_MODULUS as Q_, G1_GEN,
+                                       g1_add, g1_mul)
+    from protocol_tpu.utils.fields import BN254_FR_MODULUS as R_
+
+    if not native.available():
+        pytest.skip("native library unavailable")
+    rng = np.random.default_rng(11)
+    # every point gets the same full-width scalar -> per window all n
+    # points share ONE bucket, maximizing level-chain pairings
+    s = int(rng.integers(1, 2**62)) * pow(2, 192, R_) % R_
+    A = g1_mul(G1_GEN, 7)
+    B = g1_mul(G1_GEN, 9)
+    B_neg = (B[0], Q_ - B[1])
+    pts = []
+    agg = None  # Python-side Σ points
+    for _ in range(1024):           # doubling chains: identical points
+        pts.append(A)
+    agg = g1_mul(A, 1024)
+    for _ in range(512):            # cancel-to-infinity: P then −P
+        pts.append(B)
+        pts.append(B_neg)
+    rand_scal = [int(x) for x in rng.integers(1, 2**62, 64)]
+    for v in rand_scal:             # a tail of distinct points
+        p = g1_mul(G1_GEN, v)
+        pts.append(p)
+        agg = g1_add(agg, p)
+    scal = [s] * len(pts)
+    bases = native.points_to_limbs(pts)
+    sc_limbs = native.ints_to_limbs(scal)
+
+    monkeypatch.delenv("PN_NO_IFMA", raising=False)
+    out_default = native.g1_msm(Q_, bases, sc_limbs)
+    monkeypatch.setenv("PN_NO_IFMA", "1")
+    out_scalar = native.g1_msm(Q_, bases, sc_limbs)
+    monkeypatch.delenv("PN_NO_IFMA", raising=False)
+
+    expect = g1_mul(agg, s)
+    assert out_default == out_scalar
+    assert out_default == expect

@@ -1,0 +1,196 @@
+"""Chip probe for the device-MSM design (round 4).
+
+Measures on the real TPU, through the tunnel:
+  1. upload / download bandwidth (the 16 MB/s figure, per direction)
+  2. lax.sort of (u32 key, u32 payload) at MSM sizes
+  3. row-gather throughput for point-table layouts
+  4. mont_mul_compact fold throughput inside a lax.scan (the prefix-fold
+     building block)
+  5. small-dispatch round-trip latency
+
+Sync rule for this box: jax.block_until_ready does NOT reliably drain
+the tunnel — every timed region ends with a tiny reduction downloaded
+via np.asarray (see memory/BASELINE notes).
+"""
+import time
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+import sys
+sys.path.insert(0, "/root/repo")
+from protocol_tpu.ops import fieldops2 as f2  # noqa: E402
+
+L = f2.L
+
+
+def sync_scalar(x):
+    """Force full materialization: reduce to a scalar and download it."""
+    if isinstance(x, (list, tuple)):
+        for e in x:
+            sync_scalar(e)
+        return
+    s = jnp.sum(x.astype(jnp.int32) if x.dtype != jnp.int32 else x)
+    return float(np.asarray(s))
+
+
+def timeit(label, fn, warm=1, reps=3):
+    for _ in range(warm):
+        fn()
+    ts = []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        fn()
+        ts.append(time.perf_counter() - t0)
+    best = min(ts)
+    print(f"{label:55s} {best*1e3:10.1f} ms   (all: "
+          + ", ".join(f"{t*1e3:.1f}" for t in ts) + ")")
+    return best
+
+
+def main():
+    print("devices:", jax.devices())
+    dev = jax.devices()[0]
+
+    # --- 1. transfer bandwidth ---------------------------------------------
+    for mb in (32,):
+        nbytes = mb << 20
+        host = np.random.randint(0, 2**16, size=(16, nbytes // 32),
+                                 dtype=np.uint16)
+
+        def up():
+            d = jax.device_put(host, dev)
+            sync_scalar(d)
+
+        t = timeit(f"upload {mb} MB (device_put u16)", up)
+        print(f"    -> upload bw ~ {mb / t:.1f} MB/s")
+
+        darr = jax.device_put(host, dev)
+        sync_scalar(darr)
+
+        def down():
+            np.asarray(darr)
+
+        t = timeit(f"download {mb} MB (np.asarray)", down)
+        print(f"    -> download bw ~ {mb / t:.1f} MB/s")
+
+    # --- 5. dispatch latency ------------------------------------------------
+    small = jax.device_put(np.ones((8, 128), np.int32), dev)
+
+    @jax.jit
+    def bump(x):
+        return x + 1
+
+    def tiny():
+        sync_scalar(bump(small))
+
+    timeit("tiny jit dispatch + scalar download round-trip", tiny, warm=2,
+           reps=5)
+
+    # --- 2. sort ------------------------------------------------------------
+    for logn in (20, 22):
+        n = 1 << logn
+        keys = jax.device_put(
+            np.random.randint(0, 2**15, size=n, dtype=np.uint32), dev)
+        vals = jax.device_put(np.arange(n, dtype=np.uint32), dev)
+
+        @jax.jit
+        def do_sort(k, v):
+            return lax.sort((k, v), num_keys=1)
+
+        def run():
+            out = do_sort(keys, vals)
+            sync_scalar(out[1])
+
+        timeit(f"lax.sort (u32 key + u32 payload) n=2^{logn}", run)
+
+    # --- 3. gather ----------------------------------------------------------
+    n = 1 << 20
+    idx = jax.device_put(
+        np.random.permutation(n).astype(np.int32), dev)
+    for desc, table in (
+        ("(n, 16) u32 rows", np.random.randint(0, 2**31, (n, 16),
+                                               dtype=np.int32)),
+        ("(n, 32) u16 rows", np.random.randint(0, 2**16, (n, 32)).astype(
+            np.uint16)),
+        ("(n, 64) u16 rows", np.random.randint(0, 2**16, (n, 64)).astype(
+            np.uint16)),
+        ("(n, 128) i8 rows", np.random.randint(0, 127, (n, 128)).astype(
+            np.int8)),
+    ):
+        tbl = jax.device_put(table, dev)
+
+        @jax.jit
+        def g(t, i):
+            return jnp.take(t, i, axis=0)
+
+        def run(t=tbl):
+            out = g(t, idx)
+            sync_scalar(out)
+
+        bytes_mb = table.nbytes / 2**20
+        t = timeit(f"row gather n=2^20 {desc} ({bytes_mb:.0f} MB)", run)
+        print(f"    -> {bytes_mb / t:.0f} MB/s, {t / n * 1e9:.1f} ns/row")
+
+    # plane-layout gather for comparison: (K, n) take along axis 1
+    tbl_pl = jax.device_put(
+        np.random.randint(0, 2**16, (32, n)).astype(np.uint16), dev)
+
+    @jax.jit
+    def g_pl(t, i):
+        return jnp.take(t, i, axis=1)
+
+    def run_pl():
+        sync_scalar(g_pl(tbl_pl, idx))
+
+    t = timeit("plane gather (32, n) u16 take axis=1", run_pl)
+    print(f"    -> {tbl_pl.nbytes / 2**20 / t:.0f} MB/s")
+
+    # --- 4. mont_mul fold in scan ------------------------------------------
+    # prefix fold shape: (r rows, L, m lanes) scanned over rows with a
+    # body of ~14 compact mont_muls (one complete mixed EC add)
+    for (r, m) in ((64, 1 << 16), (256, 1 << 14)):
+        rows = jax.device_put(
+            np.random.randint(0, 1 << 12, (r, L, m), dtype=np.int32), dev)
+        init = jax.device_put(
+            np.random.randint(0, 1 << 12, (L, m), dtype=np.int32), dev)
+
+        @jax.jit
+        def fold(init, rows):
+            def step(acc, row):
+                # stand-in for an EC mixed add: 12 dependent muls
+                x = acc
+                for _ in range(12):
+                    x = f2.mont_mul_compact(x, row)
+                return x, x[:, :1]
+
+            out, _ = lax.scan(step, init, rows)
+            return out
+
+        def run():
+            sync_scalar(fold(init, rows))
+
+        tot_muls = r * m * 12
+        t = timeit(f"scan fold r={r} m=2^{int(np.log2(m))} 12 muls/step",
+                   run)
+        print(f"    -> {tot_muls / t / 1e9:.2f} G muls/s")
+
+    # searchsorted cost
+    keys_s = jnp.sort(jax.device_put(
+        np.random.randint(0, 2**15, size=1 << 22, dtype=np.int32), dev))
+
+    @jax.jit
+    def ss(k):
+        return jnp.searchsorted(k, jnp.arange(1 << 15, dtype=np.int32),
+                                side="right")
+
+    def run_ss():
+        sync_scalar(ss(keys_s))
+
+    timeit("searchsorted 2^15 queries into 2^22 keys", run_ss)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,163 @@
+"""Chip probe round 2: download bw (fresh arrays), lax.sort with wide
+payloads (the fused sort+gather candidate), straight-line unrolled
+mont_mul throughput, and compile time for EC-add-sized programs."""
+import time
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+import sys
+sys.path.insert(0, "/root/repo")
+from protocol_tpu.ops import fieldops2 as f2  # noqa: E402
+
+L = f2.L
+
+
+def sync_scalar(x):
+    s = jnp.sum(x.astype(jnp.int32) if x.dtype != jnp.int32 else x)
+    return float(np.asarray(s))
+
+
+def timeit(label, fn, warm=1, reps=3):
+    for _ in range(warm):
+        fn()
+    ts = []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        fn()
+        ts.append(time.perf_counter() - t0)
+    best = min(ts)
+    print(f"{label:58s} {best*1e3:10.1f} ms   (all: "
+          + ", ".join(f"{t*1e3:.1f}" for t in ts) + ")")
+    return best
+
+
+def main():
+    dev = jax.devices()[0]
+    print("devices:", jax.devices())
+
+    # --- true download bw: FRESH device array each rep ---------------------
+    base = jax.device_put(
+        np.random.randint(0, 2**16, (16, 2**20), dtype=np.uint16), dev)
+    sync_scalar(base)
+    ctr = [0]
+
+    @jax.jit
+    def fresh(x, c):
+        return x + c
+
+    def down():
+        ctr[0] += 1
+        d = fresh(base, np.uint16(ctr[0]))
+        arr = np.asarray(d)  # 32 MB download, uncached
+        return arr[0, 0]
+
+    t = timeit("download 32 MB (fresh array each rep)", down)
+    print(f"    -> true download bw ~ {32 / t:.1f} MB/s")
+
+    # --- lax.sort with variadic u32 payload --------------------------------
+    n = 1 << 22
+    keys = jax.device_put(
+        np.random.randint(0, 2**15, size=n, dtype=np.uint32), dev)
+    for nops in (2, 9, 17, 33):
+        ops = [keys] + [
+            jax.device_put(np.arange(n, dtype=np.uint32), dev)
+            for _ in range(nops - 1)
+        ]
+
+        @jax.jit
+        def do_sort(*ops):
+            return lax.sort(ops, num_keys=1)
+
+        def run(ops=ops):
+            out = do_sort(*ops)
+            sync_scalar(out[-1])
+
+        payload_mb = (nops - 1) * n * 4 / 2**20
+        t = timeit(f"lax.sort n=2^22 key + {nops-1} u32 payload "
+                   f"({payload_mb:.0f} MB)", run)
+
+    # sort+payload at 2^20 as well (single-window sizes)
+    n1 = 1 << 20
+    keys1 = jax.device_put(
+        np.random.randint(0, 2**15, size=n1, dtype=np.uint32), dev)
+    ops1 = [keys1] + [
+        jax.device_put(np.arange(n1, dtype=np.uint32), dev)
+        for _ in range(16)
+    ]
+
+    @jax.jit
+    def do_sort1(*ops):
+        return lax.sort(ops, num_keys=1)
+
+    def run1():
+        sync_scalar(do_sort1(*ops1)[-1])
+
+    timeit("lax.sort n=2^20 key + 16 u32 payload (64 MB)", run1)
+
+    # --- straight-line unrolled mont_mul chain -----------------------------
+    for logm in (20, 22):
+        m = 1 << logm
+        x = jax.device_put(
+            np.random.randint(0, 1 << 12, (L, m), dtype=np.int32), dev)
+        y = jax.device_put(
+            np.random.randint(0, 1 << 12, (L, m), dtype=np.int32), dev)
+
+        @jax.jit
+        def chain12(x, y):
+            a = x
+            for _ in range(12):
+                a = f2.mont_mul(a, y)
+            return a
+
+        t0 = time.perf_counter()
+        out = chain12(x, y)
+        sync_scalar(out)
+        print(f"    [compile+run chain12 m=2^{logm}: "
+              f"{time.perf_counter()-t0:.1f} s]")
+
+        def run(x=x, y=y):
+            sync_scalar(chain12(x, y))
+
+        t = timeit(f"unrolled 12-mul chain (L, 2^{logm})", run)
+        print(f"    -> {12 * m / t / 1e9:.2f} G muls/s")
+
+    # --- 44-level-ish halving chain: emulate Brent-Kung up-sweep -----------
+    m = 1 << 22
+    x = jax.device_put(
+        np.random.randint(0, 1 << 12, (L, m), dtype=np.int32), dev)
+
+    @jax.jit
+    def upsweep(x):
+        levels = []
+        cur = x
+        while cur.shape[1] > 1024:
+            h = cur.shape[1] // 2
+            a = cur[:, 0::2]
+            b = cur[:, 1::2]
+            nxt = a
+            for _ in range(12):  # stand-in for one complete add
+                nxt = f2.mont_mul(nxt, b)
+            levels.append(nxt[:, :1])
+            cur = nxt
+        return cur
+
+    t0 = time.perf_counter()
+    out = upsweep(x)
+    sync_scalar(out)
+    print(f"    [compile+run upsweep-12 (12 levels, 144 inlined muls): "
+          f"{time.perf_counter()-t0:.1f} s]")
+
+    def run_up():
+        sync_scalar(upsweep(x))
+
+    t = timeit("upsweep 2^22 -> 1024, 12 muls/level (strided halving)",
+               run_up)
+    total = 12 * (m - 1024)
+    print(f"    -> {total / t / 1e9:.2f} G muls/s equivalent")
+
+
+if __name__ == "__main__":
+    main()
