@@ -470,8 +470,14 @@ def handle_et_verifier(args, files, config):
 
 
 def handle_th_pk(args, files, config):
+    import os
+
     from ..zk import api as zk
 
+    # persist the dummy inner-ET snark next to the other artifacts so a
+    # re-run of th-pk (or a th-proof after it) skips the duplicate
+    # inner keygen/prove (zk/api.py inner-ET caches)
+    os.environ.setdefault("PTPU_TH_CACHE_DIR", str(files.assets))
     params = files.read(files.kzg_params(TH_PARAMS_K))
     pk = zk.generate_th_pk(params)
     files.th_proving_key().write_bytes(pk)

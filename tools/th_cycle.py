@@ -35,6 +35,9 @@ def main() -> int:
     sys.path.insert(0, REPO)
     os.chdir(REPO)
     os.makedirs(CACHE, exist_ok=True)
+    # persist the dummy inner-ET snark per (SRS, shape): a warm th-pk
+    # pays only the Threshold keygen (see zk/api.py inner-ET caches)
+    os.environ.setdefault("PTPU_TH_CACHE_DIR", CACHE)
     try:
         import jax
 
