@@ -102,6 +102,10 @@ def build_parser() -> argparse.ArgumentParser:
                    help="replay the written et-proof against the "
                         "generated verifier in the in-repo EVM and "
                         "print the gas")
+    p.add_argument("--rpc", metavar="URL",
+                   help="deploy the verifier to this JSON-RPC node and "
+                        "verify the written et-proof ON-CHAIN via "
+                        "eth_call (devnet: client.mocknode)")
 
     p = sub.add_parser("kzg-params", help="generate KZG params")
     p.add_argument("--k", type=int, required=True, help="circuit degree 2^k rows")
@@ -453,6 +457,25 @@ def handle_et_verifier(args, files, config):
     code = zk.gen_et_evm_verifier(params, pk, transcript=transcript)
     files.et_verifier().write_text(code)
     print(f"wrote {files.et_verifier()}")
+    if getattr(args, "rpc", None):
+        # deploy to the node and verify ON-CHAIN over JSON-RPC: the
+        # devnet executes the Yul through its EVM (mocknode), so this
+        # is the reference's Anvil loop, not a local library replay
+        from ..client.chain import VerifierContract
+        from ..client.eth import ecdsa_keypairs_from_mnemonic
+        from .fs import load_mnemonic
+
+        proof = files.read(files.et_proof())
+        pub_inputs = files.read(files.et_public_inputs())
+        calldata = zk.et_evm_calldata(pub_inputs, proof, shape=shape)
+        kp = ecdsa_keypairs_from_mnemonic(load_mnemonic(), 1)[0]
+        contract = VerifierContract.deploy_signed(args.rpc, kp, code)
+        ok = contract.verify(calldata)
+        gas = contract.estimate_gas(calldata) if ok else 0
+        print(f"on-chain verify at 0x{contract.address.hex()}: "
+              f"{'VALID' if ok else 'INVALID'} ({gas} gas incl. tx, "
+              f"{transcript} transcript)")
+        return 0 if ok else 1
     if args.check:
         from ..zk.yul import VMRevert, YulVM
 

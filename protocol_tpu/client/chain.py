@@ -280,3 +280,60 @@ class RpcChain(AttestationStation):
                 )
             )
         return out
+
+
+class VerifierContract:
+    """A deployed generated PLONK verifier, driven over JSON-RPC.
+
+    Twin of the reference's on-chain verifier flow: the Yul artifact
+    from ``zk/evm.py`` is deployed as a contract-creation transaction
+    and proofs are checked with ``eth_call`` (gas via
+    ``eth_estimateGas``) — the devnet executes the code in the in-repo
+    EVM (``client/mocknode.py``), so a codegen or calldata-layout bug
+    surfaces as an on-chain revert, not a Python library disagreement.
+    Reference anchor: eigentrust-zk/src/verifier/mod.rs:148-168 (deploy
+    + call against an in-memory EVM)."""
+
+    def __init__(self, node_url: str, address: bytes, chain_id: int = 31337):
+        self.node_url = node_url
+        self.address = address
+        self.chain_id = chain_id
+        self._id = 0
+
+    rpc = RpcChain.rpc  # same JSON-RPC plumbing
+
+    @classmethod
+    def deploy_signed(cls, node_url: str, keypair, yul_source: str,
+                      chain_id: int = 31337,
+                      gas: int = 10_000_000) -> "VerifierContract":
+        from .eth import address_from_public_key, rlp_encode, sign_legacy_tx
+
+        probe = cls(node_url, b"\x00" * 20, chain_id)
+        sender_b = address_from_public_key(keypair.public_key)
+        nonce = int(probe.rpc("eth_getTransactionCount",
+                              ["0x" + sender_b.hex(), "pending"]), 16)
+        gas_price = int(probe.rpc("eth_gasPrice", []), 16)
+        raw = sign_legacy_tx(
+            keypair, nonce=nonce, gas_price=gas_price, gas=gas,
+            to=b"", value=0, data=yul_source.encode("utf-8"),
+            chain_id=chain_id,
+        )
+        probe.rpc("eth_sendRawTransaction", ["0x" + raw.hex()])
+        created = keccak256(rlp_encode([sender_b, nonce]))[12:]
+        return cls(node_url, created, chain_id)
+
+    def verify(self, calldata: bytes) -> bool:
+        """eth_call the verifier; reverts (RPC errors) read as reject."""
+        try:
+            result = self.rpc("eth_call", [
+                {"to": "0x" + self.address.hex(),
+                 "data": "0x" + calldata.hex()}, "latest"])
+        except EigenError:
+            return False
+        out = bytes.fromhex(result.removeprefix("0x"))
+        return len(out) == 32 and int.from_bytes(out, "big") == 1
+
+    def estimate_gas(self, calldata: bytes) -> int:
+        return int(self.rpc("eth_estimateGas", [
+            {"to": "0x" + self.address.hex(),
+             "data": "0x" + calldata.hex()}]), 16)

@@ -20,6 +20,15 @@ round trip:
 Contract semantics are implemented natively via ``LocalChain`` (this is
 a protocol mock, not a bytecode interpreter — the vendored creation
 bytecode is accepted and its deployed semantics modeled exactly).
+
+The one contract that IS executed rather than modeled is the generated
+PLONK verifier: a creation transaction whose data is Yul source (the
+``object "PlonkVerifier"`` artifact from ``zk/evm.py``) registers a
+contract whose ``eth_call``/``eth_estimateGas`` run the code through
+the in-repo EVM (``zk/yul.py``, yellow-paper gas schedule) — closing
+the loop the reference gets from Anvil: the proof artifact is verified
+*on-chain over JSON-RPC*, not by a library call
+(``eigentrust-zk/src/verifier/mod.rs:148-168``).
 """
 
 from __future__ import annotations
@@ -34,6 +43,27 @@ from .chain import ATTEST_SELECTOR, EVENT_TOPIC, LocalChain
 from .eth import address_from_public_key, rlp_encode
 
 ATTESTATIONS_SELECTOR = keccak256(b"attestations(address,address,bytes32)")[:4]
+
+YUL_CREATION_MARKER = b'object "PlonkVerifier"'
+
+
+class YulContract:
+    """A deployed generated verifier: calls execute in the in-repo EVM."""
+
+    def __init__(self, source: str):
+        self.source = source
+
+    def call(self, calldata: bytes) -> bytes:
+        from ..zk.yul import YulVM  # VMRevert propagates to the RPC error
+
+        out, _gas = YulVM(self.source).run(calldata)
+        return out
+
+    def estimate_gas(self, calldata: bytes) -> int:
+        from ..zk.yul import YulVM
+
+        _out, gas = YulVM(self.source).run_tx(calldata)
+        return gas
 
 
 def _rlp_decode(data: bytes):
@@ -124,9 +154,15 @@ class MockNode:
             self.block += 1
             txh = keccak256(raw)
             if len(to) == 0:
-                # contract creation at keccak(rlp([sender, nonce]))[12:]
+                # contract creation at keccak(rlp([sender, nonce]))[12:];
+                # Yul-source data deploys an executed verifier contract,
+                # anything else the modeled AttestationStation
                 addr = keccak256(rlp_encode([sender, nonce_i]))[12:]
-                self.contracts[addr] = LocalChain()
+                if YUL_CREATION_MARKER in bytes(data):
+                    self.contracts[addr] = YulContract(
+                        bytes(data).decode("utf-8"))
+                else:
+                    self.contracts[addr] = LocalChain()
                 self.receipts[txh] = {"contractAddress": "0x" + addr.hex(),
                                       "status": "0x1",
                                       "blockNumber": hex(self.block)}
@@ -134,6 +170,9 @@ class MockNode:
                 chain = self.contracts.get(bytes(to))
                 if chain is None:
                     raise ValueError("no contract at target address")
+                if isinstance(chain, YulContract):
+                    raise ValueError(
+                        "verifier contract is view-only; use eth_call")
                 entries = _decode_attest_calldata(bytes(data))
                 chain.attest(sender, entries)
                 self.receipts[txh] = {"contractAddress": None,
@@ -192,6 +231,13 @@ class MockNode:
             if chain is None:
                 return "0x"
             data = bytes.fromhex(call["data"].removeprefix("0x"))
+            if isinstance(chain, YulContract):
+                from ..zk.yul import VMRevert
+
+                try:
+                    return "0x" + chain.call(data).hex()
+                except VMRevert as e:
+                    raise ValueError(f"execution reverted: {e}") from e
             if data[:4] != ATTESTATIONS_SELECTOR:
                 raise ValueError("unsupported call selector")
             creator = data[16:36]
@@ -202,6 +248,19 @@ class MockNode:
                    + len(val).to_bytes(32, "big")
                    + val + b"\x00" * (-len(val) % 32))
             return "0x" + enc.hex()
+        if method == "eth_estimateGas":
+            call = params[0]
+            addr = bytes.fromhex(call["to"].removeprefix("0x"))
+            chain = self.contracts.get(addr)
+            data = bytes.fromhex(call.get("data", "0x").removeprefix("0x"))
+            if isinstance(chain, YulContract):
+                from ..zk.yul import VMRevert
+
+                try:
+                    return hex(chain.estimate_gas(data))
+                except VMRevert as e:
+                    raise ValueError(f"execution reverted: {e}") from e
+            return hex(100_000)
         raise ValueError(f"unsupported method {method}")
 
     # -- http --------------------------------------------------------------

@@ -328,6 +328,31 @@ class TestEvmVerifierVerb:
             domain=Fr(42), opinion_hash=Fr(12345))
         (tmp_path / "et-public-inputs.bin").write_bytes(pub_obj.to_bytes())
 
+    def test_et_verifier_onchain_rpc(self, tmp_path, capsys, monkeypatch):
+        """--rpc: deploy the generated verifier to a mock devnet and
+        verify the written proof ON-CHAIN through eth_call — the CLI
+        leg of the reference's Anvil loop (verifier/mod.rs:148-168)."""
+        from protocol_tpu.client.mocknode import MockNode
+
+        monkeypatch.delenv("MNEMONIC", raising=False)
+        self._et_shaped_fixture(tmp_path, "keccak")
+        node = MockNode()
+        url = node.start()
+        try:
+            assert run(tmp_path, "et-verifier", "--shape", "tiny",
+                       "--transcript", "keccak", "--rpc", url) == 0
+            out = capsys.readouterr().out
+            assert "on-chain verify" in out and "VALID" in out
+            # tamper the proof artifact: the chain must reject it
+            proof = bytearray((tmp_path / "et-proof.bin").read_bytes())
+            proof[100] ^= 1
+            (tmp_path / "et-proof.bin").write_bytes(bytes(proof))
+            assert run(tmp_path, "et-verifier", "--shape", "tiny",
+                       "--transcript", "keccak", "--rpc", url) == 1
+            assert "INVALID" in capsys.readouterr().out
+        finally:
+            node.stop()
+
     def test_et_verifier_check_keccak(self, tmp_path, capsys):
         self._et_shaped_fixture(tmp_path, "keccak")
         assert run(tmp_path, "et-verifier", "--shape", "tiny",

@@ -1,0 +1,175 @@
+"""Vendored EXTERNAL anchors for the EVM layer (VERDICT r3 ask #5a).
+
+Round 3's yellow-paper gas fixtures were hand-derived in this repo —
+schedule, interpreter and fixtures shared one author, so a transposed
+constant would have been invisibly self-consistent. This file pins the
+layer against constants that exist OUTSIDE this repository:
+
+1. Canonical Keccak-256 digests and the Ethereum ecosystem's most
+   widely published selector/topic constants. The ERC-20 selectors
+   (``a9059cbb`` for ``transfer(address,uint256)``, ``70a08231`` for
+   ``balanceOf(address)``, …) and the Transfer/Approval event topics
+   appear verbatim in the Solidity documentation, EIP-20 tooling, and
+   every chain explorer — they are external ground truth for the
+   keccak256 implementation the gas schedule and the Fiat-Shamir
+   transcript both ride on.
+2. The gas schedule's constants against the EIP texts that define
+   them (EIP-150/160/1108/2028/2565/2929 and Yellow Paper Appendix G),
+   table-to-table: the test re-states each EIP value literally, so a
+   transposed constant in ``zk/yul.py`` disagrees with the quoted spec
+   value here, not with a derivation that copied the same mistake.
+3. Executed programs whose expected totals use ONLY those quoted
+   constants.
+
+Environment note: full GeneralStateTests JSONs are not vendorable here
+(zero-egress container); these constants are the strongest offline
+anchors — every value below is checkable against the public record.
+"""
+
+import pytest
+
+from protocol_tpu.utils.keccak import keccak256
+from protocol_tpu.zk import yul
+from protocol_tpu.zk.yul import YulVM
+
+
+# --- 1. canonical keccak-256 vectors ---------------------------------------
+# Digests of the empty string and "abc" are the Keccak reference
+# vectors (pre-NIST-padding Keccak-256, the variant Ethereum uses);
+# selectors/topics are the ERC-20 constants published in EIP-20-era
+# tooling and the Solidity ABI documentation.
+KECCAK_VECTORS = {
+    b"": "c5d2460186f7233c927e7db2dcc703c0e500b653ca82273b7bfad8045d85a470",
+    b"abc": "4e03657aea45a94fc7d47ba826c8d667c0d1e6e33a64a036ec44f58fa12d6c45",
+}
+
+SELECTOR_VECTORS = {
+    b"transfer(address,uint256)": "a9059cbb",
+    b"balanceOf(address)": "70a08231",
+    b"approve(address,uint256)": "095ea7b3",
+    b"totalSupply()": "18160ddd",
+}
+
+TOPIC_VECTORS = {
+    b"Transfer(address,address,uint256)":
+        "ddf252ad1be2c89b69c2b068fc378daa952ba7f163c4a11628f55a4df523b3ef",
+    b"Approval(address,address,uint256)":
+        "8c5be1e5ebec7d5bd14f71427d1e84f3dd0314c0f7b2291e5b200ac8c7c3b925",
+}
+
+
+class TestCanonicalKeccak:
+    @pytest.mark.parametrize("msg,digest", sorted(KECCAK_VECTORS.items()))
+    def test_reference_digests(self, msg, digest):
+        assert keccak256(msg).hex() == digest
+
+    @pytest.mark.parametrize("sig,sel", sorted(SELECTOR_VECTORS.items()))
+    def test_erc20_selectors(self, sig, sel):
+        assert keccak256(sig)[:4].hex() == sel
+
+    @pytest.mark.parametrize("sig,topic", sorted(TOPIC_VECTORS.items()))
+    def test_erc20_event_topics(self, sig, topic):
+        assert keccak256(sig).hex() == topic
+
+    def test_vm_keccak_matches_reference_vector(self):
+        """The interpreter's keccak256 builtin against the canonical
+        "abc" digest — anchors hashing as executed, not just the
+        library function."""
+        out, _ = YulVM(
+            "{ mstore(0, shl(232, 0x616263)) "
+            "mstore(32, keccak256(0, 3)) return(32, 32) }").run(b"")
+        assert out.hex() == KECCAK_VECTORS[b"abc"]
+
+
+# --- 2. gas constants vs the EIP texts -------------------------------------
+
+class TestScheduleAgainstEips:
+    """Each assertion restates the EIP/Appendix-G value literally."""
+
+    def test_appendix_g_tiers(self):
+        # W_verylow = 3: ADD SUB AND OR XOR NOT LT GT EQ ISZERO SHL SHR
+        # MLOAD MSTORE CALLDATALOAD PUSH* DUP* SWAP*
+        for op in ("add", "sub", "and", "or", "xor", "not", "lt", "gt",
+                   "eq", "iszero", "shl", "shr", "mload", "mstore",
+                   "calldataload"):
+            assert yul.GAS[op] == 3, op
+        assert yul.GAS_PUSH == 3 and yul.GAS_SWAP == 3
+        # W_low = 5: MUL DIV MOD;  W_mid = 8: ADDMOD MULMOD
+        for op in ("mul", "div", "mod"):
+            assert yul.GAS[op] == 5, op
+        for op in ("addmod", "mulmod"):
+            assert yul.GAS[op] == 8, op
+        # W_base = 2: POP GAS CALLDATASIZE;  W_zero = 0: STOP RETURN REVERT
+        for op in ("pop", "gas", "calldatasize"):
+            assert yul.GAS[op] == 2, op
+        for op in ("stop", "return", "revert"):
+            assert yul.GAS[op] == 0, op
+        # EXP = 10 base; KECCAK256 = 30 base + 6/word
+        assert yul.GAS["exp"] == 10
+        assert yul.GAS["keccak256"] == 30
+
+    def test_eip_160_exp_byte(self):
+        assert yul.GAS_EXP_BYTE == 50  # EIP-160 (was 10 pre-Spurious)
+
+    def test_eip_2028_calldata(self):
+        assert yul.GAS_TX == 21000
+        assert yul.GAS_CALLDATA_ZERO == 4
+        assert yul.GAS_CALLDATA_NONZERO == 16  # EIP-2028 (was 68)
+
+    def test_eip_1108_curve_precompiles(self):
+        assert yul.GAS_PRECOMPILE[6] == 150      # ecAdd (was 500)
+        assert yul.GAS_PRECOMPILE[7] == 6000     # ecMul (was 40000)
+        assert yul.GAS_PAIRING_BASE == 45000     # (was 100000)
+        assert yul.GAS_PAIRING_PER_PAIR == 34000  # (was 80000)
+
+    def test_eip_2929_warm_staticcall(self):
+        # precompiles are always-warm addresses: 100, not 2600
+        assert yul.GAS["staticcall"] == 100
+
+    def test_eip_2565_modexp(self):
+        # floor 200; words = ceil(max_len/8); complexity = words^2;
+        # gas = max(200, complexity * iterations / 3)
+        assert yul._modexp_gas(32, 32, 32, 1) == 200
+        assert yul._modexp_gas(32, 32, 32, 3) == 200  # 16*1/3 = 5 -> floor
+        # 255 iterations for a full 256-bit exponent: 16*255//3 = 1360
+        assert yul._modexp_gas(32, 32, 32, (1 << 256) - 1) == 1360
+
+    def test_yellow_paper_memory_formula(self):
+        # C_mem(a) = 3a + floor(a^2/512), YP eq. (326)
+        for a in (1, 32, 724, 2048):
+            assert yul._mem_cost(a) == 3 * a + a * a // 512
+
+
+# --- 3. executed programs priced only by quoted constants ------------------
+
+class TestExecutedVectors:
+    def test_exp_charges_per_exponent_byte(self):
+        # EXP with a 3-byte exponent: 10 + 3*50 over the operand loads
+        _, g_small = YulVM("{ pop(exp(2, 0xffffff)) }").run(b"")
+        _, g_one = YulVM("{ pop(exp(2, 0xff)) }").run(b"")
+        assert g_small - g_one == 2 * yul.GAS_EXP_BYTE
+
+    def test_keccak_word_pricing(self):
+        # hashing 64 vs 32 bytes differs by exactly one word: 6
+        _, g2 = YulVM("{ pop(keccak256(0, 64)) }").run(b"")
+        _, g1 = YulVM("{ pop(keccak256(0, 32)) }").run(b"")
+        # isolate the hash cost from the extra memory expansion word
+        assert (g2 - g1) == 6 + (yul._mem_cost(2) - yul._mem_cost(1))
+
+    def test_pairing_call_priced_by_pair_count(self):
+        # EIP-1108: k-pair pairing costs 45000 + 34000k. All-zero
+        # input = point-at-infinity pairs -> pairing trivially accepts,
+        # so the 2-pair (384 B) vs 1-pair (192 B) difference isolates
+        # exactly one per-pair price plus the extra memory expansion.
+        def run_pairs(nbytes):
+            src = ("{ if iszero(staticcall(gas(), 8, 0, %d, 0, 32)) "
+                   "{ revert(0, 0) } return(0, 32) }" % nbytes)
+            out, gas = YulVM(src).run(b"")
+            assert int.from_bytes(out, "big") == 1
+            return gas
+
+        g2, g1 = run_pairs(384), run_pairs(192)
+        mem_diff = yul._mem_cost(12) - yul._mem_cost(6)
+        assert g2 - g1 == yul.GAS_PAIRING_PER_PAIR + mem_diff
+        # and the absolute level clears the EIP-1108 base price
+        assert g1 > yul.GAS_PAIRING_BASE + yul.GAS_PAIRING_PER_PAIR
