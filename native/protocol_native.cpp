@@ -1636,14 +1636,20 @@ int logup_running_sum(const u64 *mod_limbs, const u64 *a_col,
 //   sigma_e[6], pi_e, xs (coset points), zh_inv, l0 (zh*l0_den)
 // scalars: beta, gamma, beta_lk, alpha, shifts[6]
 // fixed order: q_a q_b q_c q_d q_e q_mul_ab q_mul_cd q_const t_lookup
-void quotient_eval(const u64 *mod_limbs, const u64 *wires_e, const u64 *z_e,
-                   const u64 *zw_e, const u64 *m_e, const u64 *phi_e,
-                   const u64 *phiw_e, const u64 *fixed_e, const u64 *sigma_e,
-                   const u64 *pi_e, const u64 *xs, const u64 *zh_inv_a,
-                   const u64 *l0_a, const u64 *beta_l, const u64 *gamma_l,
-                   const u64 *beta_lk_l, const u64 *alpha_l,
-                   const u64 *shifts_l, long ext_n,
-                   u64 *t_out) {
+// z-split quotient identity (r4): the degree-7 permutation constraint
+// is decomposed through four partial-product advice columns
+// u1 = z·f0·f1, u2 = u1·f2·f3, v1 = z(ωX)·g0·g1, v2 = v1·g2·g3 and the
+// link u2·f4·f5 − v2·g4·g5, capping every term at 3 polynomial factors
+// so the extension coset is 4n (see zk/plonk.py prove()).
+// uv_e: 4 stacked ext arrays in [u1, u2, v1, v2] order.
+void quotient_eval2(const u64 *mod_limbs, const u64 *wires_e, const u64 *z_e,
+                    const u64 *zw_e, const u64 *m_e, const u64 *phi_e,
+                    const u64 *phiw_e, const u64 *uv_e, const u64 *fixed_e,
+                    const u64 *sigma_e, const u64 *pi_e, const u64 *xs,
+                    const u64 *zh_inv_a, const u64 *l0_a, const u64 *beta_l,
+                    const u64 *gamma_l, const u64 *beta_lk_l,
+                    const u64 *alpha_l, const u64 *shifts_l, long ext_n,
+                    u64 *t_out) {
     FieldCtx f = make_ctx(mod_limbs);
     Fp beta, gamma, beta_lk, alpha, shifts[6];
     std::memcpy(beta.v, beta_l, 32); to_mont(beta, beta, f);
@@ -1654,10 +1660,10 @@ void quotient_eval(const u64 *mod_limbs, const u64 *wires_e, const u64 *z_e,
         std::memcpy(shifts[w].v, shifts_l + 4 * w, 32);
         to_mont(shifts[w], shifts[w], f);
     }
-    Fp a2, a3, a4;
-    mont_mul(a2, alpha, alpha, f);
-    mont_mul(a3, a2, alpha, f);
-    mont_mul(a4, a3, alpha, f);
+    Fp ap[9];  // ap[k] = alpha^k
+    ap[0] = f.one;
+    ap[1] = alpha;
+    for (int k = 2; k <= 8; ++k) mont_mul(ap[k], ap[k - 1], alpha, f);
 
     auto load = [&](const u64 *arr, long i, Fp &out_fp) {
         std::memcpy(out_fp.v, arr + 4 * i, 32);
@@ -1671,6 +1677,8 @@ void quotient_eval(const u64 *mod_limbs, const u64 *wires_e, const u64 *z_e,
         for (int k = 0; k < 9; ++k) load(fixed_e + (size_t)k * 4 * ext_n, i, fx[k]);
         Fp sg[6];
         for (int k = 0; k < 6; ++k) load(sigma_e + (size_t)k * 4 * ext_n, i, sg[k]);
+        Fp uv[4];
+        for (int k = 0; k < 4; ++k) load(uv_e + (size_t)k * 4 * ext_n, i, uv[k]);
         Fp zi, zwi, mi, phii, phiwi, pii, xi, zhi, l0i;
         load(z_e, i, zi); load(zw_e, i, zwi); load(m_e, i, mi);
         load(phi_e, i, phii); load(phiw_e, i, phiwi); load(pi_e, i, pii);
@@ -1692,22 +1700,38 @@ void quotient_eval(const u64 *mod_limbs, const u64 *wires_e, const u64 *z_e,
         add_mod(gate, gate, fx[7], f);
         add_mod(gate, gate, pii, f);
 
-        // permutation
-        Fp pn = zi, pd = zwi;
+        // permutation wire factors fv/gv
+        Fp fv[6], gv[6];
         for (int k = 0; k < 6; ++k) {
-            Fp f1, f2;
-            mont_mul(f1, beta, shifts[k], f);
-            mont_mul(f1, f1, xi, f);
-            add_mod(f1, f1, w[k], f);
-            add_mod(f1, f1, gamma, f);
-            mont_mul(pn, pn, f1, f);
-            mont_mul(f2, beta, sg[k], f);
-            add_mod(f2, f2, w[k], f);
-            add_mod(f2, f2, gamma, f);
-            mont_mul(pd, pd, f2, f);
+            mont_mul(fv[k], beta, shifts[k], f);
+            mont_mul(fv[k], fv[k], xi, f);
+            add_mod(fv[k], fv[k], w[k], f);
+            add_mod(fv[k], fv[k], gamma, f);
+            mont_mul(gv[k], beta, sg[k], f);
+            add_mod(gv[k], gv[k], w[k], f);
+            add_mod(gv[k], gv[k], gamma, f);
         }
-        Fp perm;
-        sub_mod(perm, pn, pd, f);
+        // link: u2·f4·f5 − v2·g4·g5
+        Fp link, rhs;
+        mont_mul(link, uv[1], fv[4], f);
+        mont_mul(link, link, fv[5], f);
+        mont_mul(rhs, uv[3], gv[4], f);
+        mont_mul(rhs, rhs, gv[5], f);
+        sub_mod(link, link, rhs, f);
+        // partial-product definition constraints
+        Fp c_u1, c_u2, c_v1, c_v2;
+        mont_mul(t, zi, fv[0], f);
+        mont_mul(t, t, fv[1], f);
+        sub_mod(c_u1, uv[0], t, f);
+        mont_mul(t, uv[0], fv[2], f);
+        mont_mul(t, t, fv[3], f);
+        sub_mod(c_u2, uv[1], t, f);
+        mont_mul(t, zwi, gv[0], f);
+        mont_mul(t, t, gv[1], f);
+        sub_mod(c_v1, uv[2], t, f);
+        mont_mul(t, uv[2], gv[2], f);
+        mont_mul(t, t, gv[3], f);
+        sub_mod(c_v2, uv[3], t, f);
 
         // lookup (LogUp)
         Fp ba, bt, dphi, lk;
@@ -1721,19 +1745,28 @@ void quotient_eval(const u64 *mod_limbs, const u64 *wires_e, const u64 *z_e,
         mont_mul(mba, mi, ba, f);
         add_mod(lk, lk, mba, f);
 
-        // total = gate + alpha*perm + a2*l0*(z-1) + a3*lk + a4*l0*phi
+        // total = gate + α·link + α²·l0·(z−1) + α³·lk + α⁴·l0·φ
+        //       + α⁵·c_u1 + α⁶·c_u2 + α⁷·c_v1 + α⁸·c_v2
         Fp total = gate;
-        mont_mul(t, alpha, perm, f);
+        mont_mul(t, ap[1], link, f);
         add_mod(total, total, t, f);
         Fp zm1;
         sub_mod(zm1, zi, f.one, f);
-        mont_mul(t, a2, l0i, f);
+        mont_mul(t, ap[2], l0i, f);
         mont_mul(t, t, zm1, f);
         add_mod(total, total, t, f);
-        mont_mul(t, a3, lk, f);
+        mont_mul(t, ap[3], lk, f);
         add_mod(total, total, t, f);
-        mont_mul(t, a4, l0i, f);
+        mont_mul(t, ap[4], l0i, f);
         mont_mul(t, t, phii, f);
+        add_mod(total, total, t, f);
+        mont_mul(t, ap[5], c_u1, f);
+        add_mod(total, total, t, f);
+        mont_mul(t, ap[6], c_u2, f);
+        add_mod(total, total, t, f);
+        mont_mul(t, ap[7], c_v1, f);
+        add_mod(total, total, t, f);
+        mont_mul(t, ap[8], c_v2, f);
         add_mod(total, total, t, f);
 
         mont_mul(total, total, zhi, f);

@@ -99,7 +99,7 @@ def _bind(lib, u64p) -> None:
     lib.logup_running_sum.argtypes = [u64p, u64p, u64p, u64p, u64p,
                                       ctypes.c_long, u64p]
     lib.logup_running_sum.restype = ctypes.c_int
-    lib.quotient_eval.argtypes = [u64p] + [u64p] * 12 + [u64p] * 5 \
+    lib.quotient_eval2.argtypes = [u64p] + [u64p] * 13 + [u64p] * 5 \
         + [ctypes.c_long, u64p]
     lib.fr_vec_scalar_op.argtypes = [u64p, ctypes.c_int, u64p, u64p,
                                      u64p, ctypes.c_long]
@@ -347,15 +347,17 @@ class FieldKernel:
             raise ValueError("lookup running sum does not wrap")
         return phi
 
-    def quotient_eval(self, wires_e, z_e, zw_e, m_e, phi_e, phiw_e,
+    def quotient_eval(self, wires_e, z_e, zw_e, m_e, phi_e, phiw_e, uv_e,
                       fixed_e, sigma_e, pi_e, xs, zh_inv, l0, beta, gamma,
                       beta_lk, alpha, shifts) -> np.ndarray:
+        """z-split quotient identity on the 4n coset; ``uv_e`` is the
+        (4, ext_n, 4) stack of [u1, u2, v1, v2] extension values."""
         ext_n = len(z_e)
         out = np.empty((ext_n, 4), dtype="<u8")
         args = [np.ascontiguousarray(a) for a in
-                (wires_e, z_e, zw_e, m_e, phi_e, phiw_e, fixed_e, sigma_e,
-                 pi_e, xs, zh_inv, l0)]
-        self.lib.quotient_eval(
+                (wires_e, z_e, zw_e, m_e, phi_e, phiw_e, uv_e, fixed_e,
+                 sigma_e, pi_e, xs, zh_inv, l0)]
+        self.lib.quotient_eval2(
             _ptr(self.mod_arr), *[_ptr(a) for a in args],
             _ptr(_scalar(beta)), _ptr(_scalar(gamma)),
             _ptr(_scalar(beta_lk)), _ptr(_scalar(alpha)),

@@ -27,7 +27,8 @@ from .bn254 import BN254_FQ_MODULUS as Q
 from .bn254 import G2_GEN
 from .domain import EvaluationDomain
 from .kzg import KZGParams
-from .plonk import FIXED_NAMES, NUM_WIRES, QUOTIENT_CHUNKS
+from .plonk import (FIXED_NAMES, NUM_PERM_PARTIALS, NUM_WIRES,
+                    QUOTIENT_CHUNKS)
 from .yul import VMRevert, YulVM
 
 from .transcript import TRANSCRIPT_LABEL
@@ -35,8 +36,10 @@ from .transcript import TRANSCRIPT_LABEL
 # transcript label seed (PoseidonTranscript's default label)
 _LABEL_SEED = int.from_bytes(TRANSCRIPT_LABEL, "little") % R
 
-_NPTS = NUM_WIRES + 3 + QUOTIENT_CHUNKS  # wires, m, z, phi, t chunks
-_NEVALS = NUM_WIRES + 5 + QUOTIENT_CHUNKS + len(FIXED_NAMES) + NUM_WIRES
+# wires, m, z, phi, z-split partials (u1 u2 v1 v2), t chunks
+_NPTS = NUM_WIRES + 3 + NUM_PERM_PARTIALS + QUOTIENT_CHUNKS
+_NEVALS = (NUM_WIRES + 5 + NUM_PERM_PARTIALS + QUOTIENT_CHUNKS
+           + len(FIXED_NAMES) + NUM_WIRES)
 
 # memory map (bytes)
 _RC = 0x2000  # poseidon round constants
@@ -44,6 +47,8 @@ _MDS = 0x5000
 _WTAB = 0x5400  # omega^row per public row
 _VKTAB = 0x5800  # vk commitments (x, y pairs)
 _SHIFTS = 0x6000  # permutation coset shifts
+_FVTAB = 0x7000  # z-split X-side wire factors fv[w] (6 words)
+_GVTAB = 0x7100  # z-split σ-side wire factors gv[w] (6 words)
 _STATE = 0x200  # sponge state (5 words)
 _SPCOUNT = 0x2A0
 _ROUNDS = 0x2C0
@@ -55,9 +60,13 @@ _EV_Z = NUM_WIRES + 1
 _EV_ZN = NUM_WIRES + 2
 _EV_PHI = NUM_WIRES + 3
 _EV_PHIN = NUM_WIRES + 4
-_EV_T = NUM_WIRES + 5
+_EV_UV = NUM_WIRES + 5  # u1, u2, v1, v2
+_EV_T = _EV_UV + NUM_PERM_PARTIALS
 _EV_FIXED = _EV_T + QUOTIENT_CHUNKS
 _EV_SIGMA = _EV_FIXED + len(FIXED_NAMES)
+# proof-point index of the first z-split partial / first t chunk
+_PT_UV = NUM_WIRES + 3
+_PT_T = _PT_UV + NUM_PERM_PARTIALS
 
 
 def proof_layout(num_instances: int) -> dict:
@@ -168,8 +177,11 @@ def gen_evm_verifier_code(params: KZGParams, vk,
     fold_items.append((pt_x(NUM_WIRES), pt_y(NUM_WIRES), ev(_EV_M)))
     fold_items.append((pt_x(NUM_WIRES + 1), pt_y(NUM_WIRES + 1), ev(_EV_Z)))
     fold_items.append((pt_x(NUM_WIRES + 2), pt_y(NUM_WIRES + 2), ev(_EV_PHI)))
+    for i in range(NUM_PERM_PARTIALS):
+        fold_items.append((pt_x(_PT_UV + i), pt_y(_PT_UV + i),
+                           ev(_EV_UV + i)))
     for c in range(QUOTIENT_CHUNKS):
-        fold_items.append((pt_x(NUM_WIRES + 3 + c), pt_y(NUM_WIRES + 3 + c),
+        fold_items.append((pt_x(_PT_T + c), pt_y(_PT_T + c),
                            ev(_EV_T + c)))
     for i in range(len(commits)):
         fold_items.append((f"mload({_hx(_VKTAB + 64 * i)})",
@@ -361,10 +373,12 @@ object "PlonkVerifier" {{
       let beta := challenge()
       let gamma := challenge()
       let beta_lk := challenge()
-      absorb_pt({pt_x(NUM_WIRES + 1)}, {pt_y(NUM_WIRES + 1)})
-      absorb_pt({pt_x(NUM_WIRES + 2)}, {pt_y(NUM_WIRES + 2)})
+      for {{ let i := {NUM_WIRES + 1} }} lt(i, {_PT_T}) {{ i := add(i, 1) }} {{
+        let po := add({off(layout['pts'])}, mul(i, 64))
+        absorb_pt(calldataload(po), calldataload(add(po, 32)))
+      }}
       let alpha := challenge()
-      for {{ let i := {NUM_WIRES + 3} }} lt(i, {_NPTS}) {{ i := add(i, 1) }} {{
+      for {{ let i := {_PT_T} }} lt(i, {_NPTS}) {{ i := add(i, 1) }} {{
         let po := add({off(layout['pts'])}, mul(i, 64))
         absorb_pt(calldataload(po), calldataload(add(po, 32)))
       }}
@@ -397,17 +411,27 @@ object "PlonkVerifier" {{
       gate := addmod(gate, mulmod({q['q_mul_ab']}, mulmod({a}, {b}, RMOD), RMOD), RMOD)
       gate := addmod(gate, mulmod({q['q_mul_cd']}, mulmod({c_}, {dd}, RMOD), RMOD), RMOD)
 
-      // ---- permutation identity ----
-      let pn := {ev(_EV_Z)}
-      let pd := {ev(_EV_ZN)}
+      // ---- z-split permutation constraints ----
+      // wire factors fv[w] = w + β·k_w·ζ + γ, gv[w] = w + β·σ_w + γ
+      // stored at scratch 0x7000 (fv) / 0x7100 (gv)
       for {{ let w := 0 }} lt(w, {NUM_WIRES}) {{ w := add(w, 1) }} {{
         let wv := calldataload(add({off(layout['evals'])}, mul(w, 32)))
         let shift := mload(add({_hx(_SHIFTS)}, mul(w, 32)))
         let sg := calldataload(add({off(layout['evals'] + _EV_SIGMA)}, mul(w, 32)))
-        pn := mulmod(pn, addmod(wv, addmod(mulmod(beta, mulmod(shift, zeta, RMOD), RMOD), gamma, RMOD), RMOD), RMOD)
-        pd := mulmod(pd, addmod(wv, addmod(mulmod(beta, sg, RMOD), gamma, RMOD), RMOD), RMOD)
+        mstore(add({_hx(_FVTAB)}, mul(w, 32)), addmod(wv, addmod(mulmod(beta, mulmod(shift, zeta, RMOD), RMOD), gamma, RMOD), RMOD))
+        mstore(add({_hx(_GVTAB)}, mul(w, 32)), addmod(wv, addmod(mulmod(beta, sg, RMOD), gamma, RMOD), RMOD))
       }}
-      let perm := submod(pn, pd)
+      let u1 := {ev(_EV_UV)}
+      let u2 := {ev(_EV_UV + 1)}
+      let vv1 := {ev(_EV_UV + 2)}
+      let vv2 := {ev(_EV_UV + 3)}
+      let link := submod(
+        mulmod(mulmod(u2, mload({_hx(_FVTAB + 128)}), RMOD), mload({_hx(_FVTAB + 160)}), RMOD),
+        mulmod(mulmod(vv2, mload({_hx(_GVTAB + 128)}), RMOD), mload({_hx(_GVTAB + 160)}), RMOD))
+      let c_u1 := submod(u1, mulmod(mulmod({ev(_EV_Z)}, mload({_hx(_FVTAB)}), RMOD), mload({_hx(_FVTAB + 32)}), RMOD))
+      let c_u2 := submod(u2, mulmod(mulmod(u1, mload({_hx(_FVTAB + 64)}), RMOD), mload({_hx(_FVTAB + 96)}), RMOD))
+      let c_v1 := submod(vv1, mulmod(mulmod({ev(_EV_ZN)}, mload({_hx(_GVTAB)}), RMOD), mload({_hx(_GVTAB + 32)}), RMOD))
+      let c_v2 := submod(vv2, mulmod(mulmod(vv1, mload({_hx(_GVTAB + 64)}), RMOD), mload({_hx(_GVTAB + 96)}), RMOD))
       let l0 := mulmod(zh, f_inv(mulmod(NDOM, submod(zeta, 1), RMOD)), RMOD)
 
       // ---- LogUp lookup identity ----
@@ -418,10 +442,15 @@ object "PlonkVerifier" {{
 
       // ---- total vs quotient ----
       let a2 := mulmod(alpha, alpha, RMOD)
-      let total := addmod(gate, mulmod(alpha, perm, RMOD), RMOD)
+      let a4 := mulmod(a2, a2, RMOD)
+      let total := addmod(gate, mulmod(alpha, link, RMOD), RMOD)
       total := addmod(total, mulmod(a2, mulmod(l0, submod({ev(_EV_Z)}, 1), RMOD), RMOD), RMOD)
       total := addmod(total, mulmod(mulmod(a2, alpha, RMOD), lk, RMOD), RMOD)
-      total := addmod(total, mulmod(mulmod(a2, a2, RMOD), mulmod(l0, {ev(_EV_PHI)}, RMOD), RMOD), RMOD)
+      total := addmod(total, mulmod(a4, mulmod(l0, {ev(_EV_PHI)}, RMOD), RMOD), RMOD)
+      total := addmod(total, mulmod(mulmod(a4, alpha, RMOD), c_u1, RMOD), RMOD)
+      total := addmod(total, mulmod(mulmod(a4, a2, RMOD), c_u2, RMOD), RMOD)
+      total := addmod(total, mulmod(mulmod(a4, mulmod(a2, alpha, RMOD), RMOD), c_v1, RMOD), RMOD)
+      total := addmod(total, mulmod(mulmod(a4, a4, RMOD), c_v2, RMOD), RMOD)
       let zn := expmod(zeta, NDOM)
       let tz := 0
       let zacc := 1

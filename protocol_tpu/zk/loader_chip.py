@@ -120,6 +120,7 @@ class PlonkVerifierChip:
             "m": ec.assign_point(proof.m_commit),
             "z": ec.assign_point(proof.z_commit),
             "phi": ec.assign_point(proof.phi_commit),
+            "uv": [ec.assign_point(pt) for pt in proof.uv_commits],
             "t": [ec.assign_point(pt) for pt in proof.t_commits],
             "w_x": ec.assign_point(proof.w_x),
             "w_wx": ec.assign_point(proof.w_wx),
@@ -132,6 +133,7 @@ class PlonkVerifierChip:
             "z_next": c.witness(proof.z_next_eval),
             "phi": c.witness(proof.phi_eval),
             "phi_next": c.witness(proof.phi_next_eval),
+            "uv": [c.witness(v) for v in proof.uv_evals],
             "t": [c.witness(v) for v in proof.t_evals],
             "fixed": [c.witness(v) for v in proof.fixed_evals],
             "sigma": [c.witness(v) for v in proof.sigma_zeta],
@@ -169,6 +171,8 @@ class PlonkVerifierChip:
         beta_lk = tr.challenge()
         tr.absorb_point(commits["z"])
         tr.absorb_point(commits["phi"])
+        for pt in commits["uv"]:
+            tr.absorb_point(pt)
         alpha = tr.challenge()
         for pt in commits["t"]:
             tr.absorb_point(pt)
@@ -176,7 +180,8 @@ class PlonkVerifierChip:
         for cell in (evals["wires"]
                      + [evals["m"], evals["z"], evals["z_next"],
                         evals["phi"], evals["phi_next"]]
-                     + evals["t"] + evals["fixed"] + evals["sigma"]):
+                     + evals["uv"] + evals["t"] + evals["fixed"]
+                     + evals["sigma"]):
             tr.absorb_fr(cell)
         v_ch = tr.challenge()
         u_ch = tr.challenge()
@@ -207,14 +212,20 @@ class PlonkVerifierChip:
         ]
         gate = c.lincomb([(1, t) for t in gate_terms])
 
-        pn = evals["z"]
-        pd = evals["z_next"]
+        # z-split wire factors and constraints (plonk.py round 2c/3)
+        fv, gv = [], []
         for w in range(NUM_WIRES):
             wv = evals["wires"][w]
             shift_zeta = c.mul_const(zeta, pk.shifts[w])
-            pn = c.mul(pn, c.add(wv, c.mul_add(beta, shift_zeta, gamma)))
-            pd = c.mul(pd, c.add(wv, c.mul_add(beta, evals["sigma"][w], gamma)))
-        perm = c.sub(pn, pd)
+            fv.append(c.add(wv, c.mul_add(beta, shift_zeta, gamma)))
+            gv.append(c.add(wv, c.mul_add(beta, evals["sigma"][w], gamma)))
+        u1, u2, v1, v2 = evals["uv"]
+        link = c.sub(c.mul(c.mul(u2, fv[4]), fv[5]),
+                     c.mul(c.mul(v2, gv[4]), gv[5]))
+        c_u1 = c.sub(u1, c.mul(c.mul(evals["z"], fv[0]), fv[1]))
+        c_u2 = c.sub(u2, c.mul(c.mul(u1, fv[2]), fv[3]))
+        c_v1 = c.sub(v1, c.mul(c.mul(evals["z_next"], gv[0]), gv[1]))
+        c_v2 = c.sub(v2, c.mul(c.mul(v1, gv[2]), gv[3]))
 
         l0 = c.mul(zh, c.inverse(c.mul_const(c.add_const(zeta, -1), n)))
         ba = c.add(beta_lk, evals["wires"][LOOKUP_WIRE])
@@ -227,12 +238,20 @@ class PlonkVerifierChip:
         a2 = c.mul(alpha, alpha)
         a3 = c.mul(a2, alpha)
         a4 = c.mul(a3, alpha)
+        a5 = c.mul(a4, alpha)
+        a6 = c.mul(a5, alpha)
+        a7 = c.mul(a6, alpha)
+        a8 = c.mul(a7, alpha)
         total = c.lincomb([
             (1, gate),
-            (1, c.mul(alpha, perm)),
+            (1, c.mul(alpha, link)),
             (1, c.mul(a2, c.mul(l0, c.add_const(evals["z"], -1)))),
             (1, c.mul(a3, lk)),
             (1, c.mul(a4, c.mul(l0, evals["phi"]))),
+            (1, c.mul(a5, c_u1)),
+            (1, c.mul(a6, c_u2)),
+            (1, c.mul(a7, c_v1)),
+            (1, c.mul(a8, c_v2)),
         ])
         t_at_zeta = evals["t"][0]
         acc_pow = zeta_n
@@ -258,6 +277,8 @@ class PlonkVerifierChip:
             + [(commits["m"], evals["m"], None),
                (commits["z"], evals["z"], None),
                (commits["phi"], evals["phi"], None)]
+            + [(commits["uv"][i], evals["uv"][i], None)
+               for i in range(len(commits["uv"]))]
             + [(commits["t"][i], evals["t"][i], None)
                for i in range(QUOTIENT_CHUNKS)]
             + [(None, ev, vk_pts[i]) for i, ev in

@@ -59,8 +59,17 @@ SELECTORS = ("q_a", "q_b", "q_c", "q_d", "q_e", "q_mul_ab", "q_mul_cd", "q_const
 FIXED_NAMES = SELECTORS + ("t_lookup",)
 NUM_WIRES = 6  # 5 gate wires + 1 lookup input column
 LOOKUP_WIRE = 5
-QUOTIENT_CHUNKS = 7  # permutation term degree: z · 6 wire factors ≈ 7n
-MIN_K = 4  # t degree ≈ 6n+9 must stay under 7n
+# z-split permutation argument (r4): the degree-7 grand-product
+# constraint z(ωX)·Πg_w = z(X)·Πf_w is split through four committed
+# partial-product columns u1 = z·f0·f1, u2 = u1·f2·f3, v1 = z(ωX)·g0·g1,
+# v2 = v1·g2·g3 plus the link u2·f4·f5 = v2·g4·g5 — every quotient term
+# has ≤ 3 polynomial factors (max total degree 3n+5 with blinding), so
+# the extension coset shrinks from 8n to 4n and t from 7 chunks to 3.
+# No new opening rotations: u/v open at ζ only; z(ωζ) was already open.
+NUM_PERM_PARTIALS = 4
+EXT_FACTOR_LOG = 2  # quotient runs on a 4n coset (was 8n pre-split)
+QUOTIENT_CHUNKS = 3  # t degree ≤ 2n+5 after the z-split
+MIN_K = 4  # max identity degree 3n+5 must stay under 4n
 
 
 class ConstraintSystem:
@@ -375,6 +384,7 @@ class Proof:
     m_commit: tuple  # lookup multiplicities
     z_commit: tuple
     phi_commit: tuple  # lookup running sum
+    uv_commits: list  # 4 G1: z-split partials [u1, u2, v1, v2]
     t_commits: list  # QUOTIENT_CHUNKS G1
     wire_evals: list  # 6 at x
     m_eval: int
@@ -382,6 +392,7 @@ class Proof:
     z_next_eval: int
     phi_eval: int
     phi_next_eval: int
+    uv_evals: list  # [u1, u2, v1, v2] at x
     t_evals: list  # chunks at x
     fixed_evals: list  # FIXED_NAMES at x (9)
     sigma_zeta: list  # σ_w at x (6)
@@ -391,12 +402,14 @@ class Proof:
     def to_bytes(self) -> bytes:
         out = []
         for pt in (self.wire_commits + [self.m_commit, self.z_commit,
-                                        self.phi_commit] + self.t_commits):
+                                        self.phi_commit] + self.uv_commits
+                   + self.t_commits):
             out.append(g1_to_bytes(pt))
         for v in (self.wire_evals
                   + [self.m_eval, self.z_eval, self.z_next_eval,
                      self.phi_eval, self.phi_next_eval]
-                  + self.t_evals + self.fixed_evals + self.sigma_zeta):
+                  + self.uv_evals + self.t_evals + self.fixed_evals
+                  + self.sigma_zeta):
             out.append(int(v).to_bytes(32, "little"))
         out.append(g1_to_bytes(self.w_x))
         out.append(g1_to_bytes(self.w_wx))
@@ -404,11 +417,12 @@ class Proof:
 
     @classmethod
     def from_bytes(cls, data: bytes) -> "Proof":
-        npts = NUM_WIRES + 3 + QUOTIENT_CHUNKS
+        npts = NUM_WIRES + 3 + NUM_PERM_PARTIALS + QUOTIENT_CHUNKS
         pts = [g1_from_bytes(data[i * 64 : (i + 1) * 64]) for i in range(npts)]
         off = npts * 64
         nf = len(FIXED_NAMES)
-        nevals = NUM_WIRES + 5 + QUOTIENT_CHUNKS + nf + NUM_WIRES
+        nevals = (NUM_WIRES + 5 + NUM_PERM_PARTIALS + QUOTIENT_CHUNKS
+                  + nf + NUM_WIRES)
         evals = [
             int.from_bytes(data[off + i * 32 : off + (i + 1) * 32], "little")
             for i in range(nevals)
@@ -417,12 +431,15 @@ class Proof:
         w_x = g1_from_bytes(data[off : off + 64])
         w_wx = g1_from_bytes(data[off + 64 : off + 128])
         w = NUM_WIRES
-        qe = w + 5 + QUOTIENT_CHUNKS
+        np_ = NUM_PERM_PARTIALS
+        uv_end = w + 5 + np_
+        qe = uv_end + QUOTIENT_CHUNKS
         return cls(
-            pts[:w], pts[w], pts[w + 1], pts[w + 2], pts[w + 3 :],
+            pts[:w], pts[w], pts[w + 1], pts[w + 2],
+            pts[w + 3 : w + 3 + np_], pts[w + 3 + np_ :],
             evals[:w], evals[w], evals[w + 1], evals[w + 2], evals[w + 3],
-            evals[w + 4], evals[w + 5 : qe], evals[qe : qe + nf],
-            evals[qe + nf :], w_x, w_wx,
+            evals[w + 4], evals[w + 5 : uv_end], evals[uv_end : qe],
+            evals[qe : qe + nf], evals[qe + nf :], w_x, w_wx,
         )
 
 
@@ -478,17 +495,26 @@ def prove(params: KZGParams, pk: ProvingKey, cs: ConstraintSystem,
     gamma = tr.challenge()
     beta_lk = tr.challenge()
 
-    # round 2a: permutation grand product
+    # round 2a: permutation grand product (individual wire factors kept
+    # for the z-split partial products below)
     omegas = d.elements()
-    numer = [1] * n
-    denom = [1] * n
+    f_factors = []  # f_w[i] = w_w + β·k_w·ωⁱ + γ
+    g_factors = []  # g_w[i] = w_w + β·σ_w(ωⁱ) + γ
     for w in range(NUM_WIRES):
         kw = pk.shifts[w]
         sw = pk.sigma_evals[w]
         col = wire_vals[w]
+        f_factors.append([(col[i] + beta * kw * omegas[i] + gamma) % R
+                          for i in range(n)])
+        g_factors.append([(col[i] + beta * sw[i] + gamma) % R
+                          for i in range(n)])
+    numer = [1] * n
+    denom = [1] * n
+    for w in range(NUM_WIRES):
+        fw, gw = f_factors[w], g_factors[w]
         for i in range(n):
-            numer[i] = numer[i] * ((col[i] + beta * kw * omegas[i] + gamma) % R) % R
-            denom[i] = denom[i] * ((col[i] + beta * sw[i] + gamma) % R) % R
+            numer[i] = numer[i] * fw[i] % R
+            denom[i] = denom[i] * gw[i] % R
     denom_inv = _batch_inv(denom)
     z_vals = [1] * n
     for i in range(n - 1):
@@ -513,10 +539,27 @@ def prove(params: KZGParams, pk: ProvingKey, cs: ConstraintSystem,
     phi_commit = params.commit(phi_coeffs)
     tr.absorb_point(phi_commit)
 
+    # round 2c: z-split partial products on H (u1, u2, v1, v2); note
+    # z(ω·ωⁱ) on H is a cyclic roll of z_vals
+    u1_vals = [z_vals[i] * f_factors[0][i] % R * f_factors[1][i] % R
+               for i in range(n)]
+    u2_vals = [u1_vals[i] * f_factors[2][i] % R * f_factors[3][i] % R
+               for i in range(n)]
+    v1_vals = [z_vals[(i + 1) % n] * g_factors[0][i] % R
+               * g_factors[1][i] % R for i in range(n)]
+    v2_vals = [v1_vals[i] * g_factors[2][i] % R * g_factors[3][i] % R
+               for i in range(n)]
+    uv_coeffs = [_blind(d.ifft(vals), n, 2)
+                 for vals in (u1_vals, u2_vals, v1_vals, v2_vals)]
+    uv_commits = [params.commit(c) for c in uv_coeffs]
+    for cm in uv_commits:
+        tr.absorb_point(cm)
+
     alpha = tr.challenge()
 
-    # round 3: quotient on an 8n coset
-    de = EvaluationDomain(pk.k + 3)
+    # round 3: quotient on a 4n coset (the z-split caps every term at 3
+    # polynomial factors)
+    de = EvaluationDomain(pk.k + EXT_FACTOR_LOG)
     shift = _find_coset_shifts(de.n, 2)[1]
 
     def ext(coeffs):
@@ -530,6 +573,7 @@ def prove(params: KZGParams, pk: ProvingKey, cs: ConstraintSystem,
     phi_e = ext(phi_coeffs)
     phiw_coeffs = [c * pow(d.omega, i, R) % R for i, c in enumerate(phi_coeffs)]
     phiw_e = ext(phiw_coeffs)
+    uv_e = [ext(c) for c in uv_coeffs]
     fixed_e = {name: ext(c) for name, c in pk.fixed_coeffs.items()}
     sigma_e = [ext(c) for c in pk.sigma_coeffs]
     pi_e = ext(d.ifft(_pi_evals(pk.public_rows, pubs, n)))
@@ -552,13 +596,17 @@ def prove(params: KZGParams, pk: ProvingKey, cs: ConstraintSystem,
             + fixed_e["q_mul_ab"][i] * a * b + fixed_e["q_mul_cd"][i] * c * dd
             + fixed_e["q_const"][i] + pi_e[i]
         ) % R
-        pn = z_e[i]
-        pd = zw_e[i]
-        for w in range(NUM_WIRES):
-            wv = wires_e[w][i]
-            pn = pn * ((wv + beta * pk.shifts[w] * xs[i] + gamma) % R) % R
-            pd = pd * ((wv + beta * sigma_e[w][i] + gamma) % R) % R
-        perm = (pn - pd) % R
+        # z-split: wire factors at this point
+        fv = [(wires_e[w][i] + beta * pk.shifts[w] * xs[i] + gamma) % R
+              for w in range(NUM_WIRES)]
+        gv = [(wires_e[w][i] + beta * sigma_e[w][i] + gamma) % R
+              for w in range(NUM_WIRES)]
+        u1, u2, v1, v2 = (uv_e[j][i] for j in range(4))
+        link = (u2 * fv[4] % R * fv[5] - v2 * gv[4] % R * gv[5]) % R
+        c_u1 = (u1 - z_e[i] * fv[0] % R * fv[1]) % R
+        c_u2 = (u2 - u1 * fv[2] % R * fv[3]) % R
+        c_v1 = (v1 - zw_e[i] * gv[0] % R * gv[1]) % R
+        c_v2 = (v2 - v1 * gv[2] % R * gv[3]) % R
         l0 = zh[i] * l0_den[i] % R
         # LogUp: (φω − φ)(β+a)(β+t) − (β+t) + m(β+a) = 0 on H
         ba = (beta_lk + wires_e[LOOKUP_WIRE][i]) % R
@@ -566,10 +614,14 @@ def prove(params: KZGParams, pk: ProvingKey, cs: ConstraintSystem,
         lk = ((phiw_e[i] - phi_e[i]) * ba % R * bt - bt + m_e[i] * ba) % R
         total = (
             gate
-            + alpha * perm
+            + alpha * link
             + alpha * alpha % R * l0 * ((z_e[i] - 1) % R)
             + pow(alpha, 3, R) * lk
             + pow(alpha, 4, R) * l0 * phi_e[i]
+            + pow(alpha, 5, R) * c_u1
+            + pow(alpha, 6, R) * c_u2
+            + pow(alpha, 7, R) * c_v1
+            + pow(alpha, 8, R) * c_v2
         ) % R
         t_evals_ext.append(total * zh_inv[i] % R)
 
@@ -594,12 +646,13 @@ def prove(params: KZGParams, pk: ProvingKey, cs: ConstraintSystem,
     z_next = poly_eval(z_coeffs, zeta * d.omega % R)
     phi_eval = poly_eval(phi_coeffs, zeta)
     phi_next = poly_eval(phi_coeffs, zeta * d.omega % R)
+    uv_evals = [poly_eval(c, zeta) for c in uv_coeffs]
     t_evals = [poly_eval(ch, zeta) for ch in chunks]
     fixed_evals = [poly_eval(pk.fixed_coeffs[name], zeta)
                    for name in FIXED_NAMES]
     sigma_zeta = [poly_eval(c, zeta) for c in pk.sigma_coeffs]
     for v in (wire_evals + [m_eval, z_eval, z_next, phi_eval, phi_next]
-              + t_evals + fixed_evals + sigma_zeta):
+              + uv_evals + t_evals + fixed_evals + sigma_zeta):
         tr.absorb_fr(v)
     v_ch = tr.challenge()
     tr.challenge()  # u: verifier-side cross-point fold; squeezed here only
@@ -607,15 +660,16 @@ def prove(params: KZGParams, pk: ProvingKey, cs: ConstraintSystem,
 
     openings = open_batch(
         params,
-        [(zeta, wire_coeffs + [m_coeffs, z_coeffs, phi_coeffs] + chunks
+        [(zeta, wire_coeffs + [m_coeffs, z_coeffs, phi_coeffs] + uv_coeffs
+          + chunks
           + [pk.fixed_coeffs[name] for name in FIXED_NAMES]
           + list(pk.sigma_coeffs)),
          (zeta * d.omega % R, [z_coeffs, phi_coeffs])],
         v_ch,
     )
-    proof = Proof(wire_commits, m_commit, z_commit, phi_commit, t_commits,
-                  wire_evals, m_eval, z_eval, z_next, phi_eval, phi_next,
-                  t_evals, fixed_evals, sigma_zeta,
+    proof = Proof(wire_commits, m_commit, z_commit, phi_commit, uv_commits,
+                  t_commits, wire_evals, m_eval, z_eval, z_next, phi_eval,
+                  phi_next, uv_evals, t_evals, fixed_evals, sigma_zeta,
                   openings[0].witness, openings[1].witness)
     return proof.to_bytes()
 
@@ -649,6 +703,8 @@ def succinct_verify(pk: ProvingKey, public_inputs, proof_bytes: bytes,
     beta_lk = tr.challenge()
     tr.absorb_point(proof.z_commit)
     tr.absorb_point(proof.phi_commit)
+    for cm in proof.uv_commits:
+        tr.absorb_point(cm)
     alpha = tr.challenge()
     for cm in proof.t_commits:
         tr.absorb_point(cm)
@@ -656,7 +712,8 @@ def succinct_verify(pk: ProvingKey, public_inputs, proof_bytes: bytes,
     for v in (proof.wire_evals
               + [proof.m_eval, proof.z_eval, proof.z_next_eval,
                  proof.phi_eval, proof.phi_next_eval]
-              + proof.t_evals + proof.fixed_evals + proof.sigma_zeta):
+              + proof.uv_evals + proof.t_evals + proof.fixed_evals
+              + proof.sigma_zeta):
         tr.absorb_fr(v)
     v_ch = tr.challenge()
     u_ch = tr.challenge()
@@ -680,13 +737,16 @@ def succinct_verify(pk: ProvingKey, public_inputs, proof_bytes: bytes,
         + fixed["q_mul_ab"] * a * b + fixed["q_mul_cd"] * c * dd
         + fixed["q_const"] + pi
     ) % R
-    pn = proof.z_eval
-    pd = proof.z_next_eval
-    for w in range(NUM_WIRES):
-        wv = proof.wire_evals[w]
-        pn = pn * ((wv + beta * pk.shifts[w] * zeta + gamma) % R) % R
-        pd = pd * ((wv + beta * sigma[w] + gamma) % R) % R
-    perm = (pn - pd) % R
+    fv = [(proof.wire_evals[w] + beta * pk.shifts[w] * zeta + gamma) % R
+          for w in range(NUM_WIRES)]
+    gv = [(proof.wire_evals[w] + beta * sigma[w] + gamma) % R
+          for w in range(NUM_WIRES)]
+    u1, u2, v1, v2 = proof.uv_evals
+    link = (u2 * fv[4] % R * fv[5] - v2 * gv[4] % R * gv[5]) % R
+    c_u1 = (u1 - proof.z_eval * fv[0] % R * fv[1]) % R
+    c_u2 = (u2 - u1 * fv[2] % R * fv[3]) % R
+    c_v1 = (v1 - proof.z_next_eval * gv[0] % R * gv[1]) % R
+    c_v2 = (v2 - v1 * gv[2] % R * gv[3]) % R
     l0 = zh * pow(n * (zeta - 1) % R, -1, R) % R
     ba = (beta_lk + proof.wire_evals[LOOKUP_WIRE]) % R
     bt = (beta_lk + fixed["t_lookup"]) % R
@@ -694,10 +754,14 @@ def succinct_verify(pk: ProvingKey, public_inputs, proof_bytes: bytes,
           - bt + proof.m_eval * ba) % R
     total = (
         gate
-        + alpha * perm
+        + alpha * link
         + alpha * alpha % R * l0 * ((proof.z_eval - 1) % R)
         + pow(alpha, 3, R) * lk
         + pow(alpha, 4, R) * l0 * proof.phi_eval
+        + pow(alpha, 5, R) * c_u1
+        + pow(alpha, 6, R) * c_u2
+        + pow(alpha, 7, R) * c_v1
+        + pow(alpha, 8, R) * c_v2
     ) % R
 
     t_at_zeta = 0
@@ -715,6 +779,7 @@ def succinct_verify(pk: ProvingKey, public_inputs, proof_bytes: bytes,
          + [(proof.m_commit, proof.m_eval),
             (proof.z_commit, proof.z_eval),
             (proof.phi_commit, proof.phi_eval)]
+         + [(cm, ev) for cm, ev in zip(proof.uv_commits, proof.uv_evals)]
          + [(cm, ev) for cm, ev in zip(proof.t_commits, proof.t_evals)]
          + list(zip(pk.commit_list(),
                     proof.fixed_evals + proof.sigma_zeta))),

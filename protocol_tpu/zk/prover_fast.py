@@ -41,6 +41,7 @@ from .plonk import (
     FIXED_NAMES,
     LOOKUP_WIRE,
     MIN_K,
+    NUM_PERM_PARTIALS,
     NUM_WIRES,
     QUOTIENT_CHUNKS,
     SELECTORS,
@@ -541,6 +542,29 @@ def _commit_blinded_evals(params: KZGParams, evals: np.ndarray, blinds: list):
 
 
 
+def _perm_partial_vals(fk, wire_vals, sigma_eval_limbs, shifts, omegas,
+                       z_vals, beta, gamma) -> list:
+    """[u1, u2, v1, v2] H-evaluations of the z-split partial products
+    (zk/plonk.py round 2c) on native kernels — shared by the host and
+    TPU prove paths, which must stay transcript-lockstep."""
+    def f_factor(w):
+        t = fk.scalar_mul(omegas, beta * shifts[w] % R)
+        t = fk.vec_add(t, wire_vals[w])
+        return fk.scalar_add(t, gamma)
+
+    def g_factor(w):
+        t = fk.scalar_mul(np.ascontiguousarray(sigma_eval_limbs[w]), beta)
+        t = fk.vec_add(t, wire_vals[w])
+        return fk.scalar_add(t, gamma)
+
+    zw = np.ascontiguousarray(np.roll(z_vals, -1, axis=0))  # z(ω·X) on H
+    u1 = fk.vec_mul(fk.vec_mul(z_vals, f_factor(0)), f_factor(1))
+    u2 = fk.vec_mul(fk.vec_mul(u1, f_factor(2)), f_factor(3))
+    v1 = fk.vec_mul(fk.vec_mul(zw, g_factor(0)), g_factor(1))
+    v2 = fk.vec_mul(fk.vec_mul(v1, g_factor(2)), g_factor(3))
+    return [u1, u2, v1, v2]
+
+
 def _lookup_multiplicities(cs: ConstraintSystem, n: int,
                            table_size: int) -> np.ndarray:
     """(n, 4) limb array of the LogUp multiplicity column — shared by
@@ -680,10 +704,27 @@ def prove_fast(params: KZGParams, pk: FastProvingKey, cs: ConstraintSystem,
                   if use_lagrange else commit_limbs(params, phi_coeffs))
     tr.absorb_point(phi_commit)
 
+    # round 2c: z-split partial products (u1, u2, v1, v2)
+    uv_vals = _perm_partial_vals(fk, wire_vals, pk.sigma_eval_limbs,
+                                 pk.shifts, omegas, z_vals, beta, gamma)
+    uv_coeffs = []
+    uv_blinds = []
+    uv_commits = []
+    for vals in uv_vals:
+        base = vals.copy()
+        fk.ntt(base, d.omega, inverse=True)
+        c, blinds = _blind_arr(base, n, 2, randint)
+        uv_coeffs.append(c)
+        uv_blinds.append(blinds)
+        uv_commits.append(_commit_blinded_evals(params, vals, blinds)
+                          if use_lagrange else commit_limbs(params, c))
+    for cm in uv_commits:
+        tr.absorb_point(cm)
+
     alpha = tr.challenge()
 
-    # round 3: quotient over the 8n extension coset
-    de = EvaluationDomain(pk.k + 3)
+    # round 3: quotient over the 4n extension coset (z-split)
+    de = EvaluationDomain(pk.k + 2)
     ext_n = de.n
     shift = _find_coset_shifts(ext_n, 2)[1]
 
@@ -706,6 +747,9 @@ def prove_fast(params: KZGParams, pk: FastProvingKey, cs: ConstraintSystem,
     phiw_coeffs = phi_coeffs.copy()
     fk.coset_scale(phiw_coeffs, d.omega)
     phiw_e = ext(phiw_coeffs)
+    uv_e = np.empty((NUM_PERM_PARTIALS, ext_n, 4), dtype="<u8")
+    for j in range(NUM_PERM_PARTIALS):
+        uv_e[j] = ext(uv_coeffs[j])
     pk_fixed_c, pk_sigma_c = pk.coeff_forms()
     fixed_e = np.empty((len(FIXED_NAMES), ext_n, 4), dtype="<u8")
     for idx in range(len(FIXED_NAMES)):
@@ -726,22 +770,23 @@ def prove_fast(params: KZGParams, pk: FastProvingKey, cs: ConstraintSystem,
                                 dtype="<u8")
     xs[:] = _shift_limb
     fk.coset_scale(xs, de.omega)
-    w8 = pow(de.omega, n, R)
+    # Z_H on the 4n coset has period 4: xsⁿ = shiftⁿ·(ω_eⁿ)ⁱ, ω_e order 4n
+    w4 = pow(de.omega, n, R)
     shift_n = pow(shift, n, R)
-    zh8 = [(shift_n * pow(w8, i, R) - 1) % R for i in range(8)]
-    zh8_inv = [pow(v, -1, R) for v in zh8]
-    reps = ext_n // 8
-    zh_inv = np.tile(native.ints_to_limbs(zh8_inv), (reps, 1))
-    zh_tiled = np.tile(native.ints_to_limbs(zh8), (reps, 1))
+    zh4 = [(shift_n * pow(w4, i, R) - 1) % R for i in range(4)]
+    zh4_inv = [pow(v, -1, R) for v in zh4]
+    reps = ext_n // 4
+    zh_inv = np.tile(native.ints_to_limbs(zh4_inv), (reps, 1))
+    zh_tiled = np.tile(native.ints_to_limbs(zh4), (reps, 1))
     # l0 = Z_H(x) / (n·(x−1))
     l0_den = fk.scalar_mul(fk.scalar_sub(xs, 1), n % R)
     fk.batch_inverse(l0_den)
     l0 = fk.vec_mul(zh_tiled, l0_den)
 
-    t_ext = fk.quotient_eval(wires_e, z_e, zw_e, m_e, phi_e, phiw_e,
+    t_ext = fk.quotient_eval(wires_e, z_e, zw_e, m_e, phi_e, phiw_e, uv_e,
                              fixed_e, sigma_e, pi_e, xs, zh_inv, l0,
                              beta, gamma, beta_lk, alpha, pk.shifts)
-    del wires_e, zw_e, m_e, phiw_e, fixed_e, sigma_e, pi_e, xs, zh_inv
+    del wires_e, zw_e, m_e, phiw_e, uv_e, fixed_e, sigma_e, pi_e, xs, zh_inv
     del zh_tiled, l0_den, l0, z_e, phi_e
 
     fk.ntt(t_ext, de.omega, inverse=True)
@@ -759,7 +804,9 @@ def prove_fast(params: KZGParams, pk: FastProvingKey, cs: ConstraintSystem,
     zeta = tr.challenge()
 
     # round 4: evaluations via one stacked Horner pass per point
-    all_polys = (wire_coeffs + [m_coeffs, z_coeffs, phi_coeffs] + chunks
+    npp = NUM_PERM_PARTIALS
+    all_polys = (wire_coeffs + [m_coeffs, z_coeffs, phi_coeffs] + uv_coeffs
+                 + chunks
                  + [pk_fixed_c[i] for i in range(len(FIXED_NAMES))]
                  + [pk_sigma_c[w] for w in range(NUM_WIRES)])
     max_len = max(len(p) for p in all_polys)
@@ -772,17 +819,19 @@ def prove_fast(params: KZGParams, pk: FastProvingKey, cs: ConstraintSystem,
     m_eval = evals[nw]
     z_eval = evals[nw + 1]
     phi_eval = evals[nw + 2]
-    t_evals = evals[nw + 3 : nw + 3 + QUOTIENT_CHUNKS]
-    fixed_evals = evals[nw + 3 + QUOTIENT_CHUNKS :
-                        nw + 3 + QUOTIENT_CHUNKS + len(FIXED_NAMES)]
-    sigma_zeta = evals[nw + 3 + QUOTIENT_CHUNKS + len(FIXED_NAMES) :]
+    uv_evals = evals[nw + 3 : nw + 3 + npp]
+    qb = nw + 3 + npp
+    t_evals = evals[qb : qb + QUOTIENT_CHUNKS]
+    fixed_evals = evals[qb + QUOTIENT_CHUNKS :
+                        qb + QUOTIENT_CHUNKS + len(FIXED_NAMES)]
+    sigma_zeta = evals[qb + QUOTIENT_CHUNKS + len(FIXED_NAMES) :]
     zeta_w = zeta * d.omega % R
     shifted_pair = np.zeros((2, n + 3, 4), dtype="<u8")
     shifted_pair[0, : len(z_coeffs)] = z_coeffs
     shifted_pair[1, : len(phi_coeffs)] = phi_coeffs
     z_next, phi_next = fk.poly_eval_many(shifted_pair, zeta_w)
     for v in (wire_evals + [m_eval, z_eval, z_next, phi_eval, phi_next]
-              + t_evals + fixed_evals + sigma_zeta):
+              + uv_evals + t_evals + fixed_evals + sigma_zeta):
         tr.absorb_fr(v)
     v_ch = tr.challenge()
     tr.challenge()  # u — verifier-side fold; keep transcripts in lockstep
@@ -802,9 +851,10 @@ def prove_fast(params: KZGParams, pk: FastProvingKey, cs: ConstraintSystem,
     w_x = open_group(all_polys, zeta)
     w_wx = open_group([z_coeffs, phi_coeffs], zeta_w)
 
-    proof = Proof(wire_commits, m_commit, z_commit, phi_commit, t_commits,
-                  wire_evals, m_eval, z_eval, z_next, phi_eval, phi_next,
-                  t_evals, fixed_evals, sigma_zeta, w_x, w_wx)
+    proof = Proof(wire_commits, m_commit, z_commit, phi_commit, uv_commits,
+                  t_commits, wire_evals, m_eval, z_eval, z_next, phi_eval,
+                  phi_next, uv_evals, t_evals, fixed_evals, sigma_zeta,
+                  w_x, w_wx)
     return proof.to_bytes()
 
 
@@ -836,7 +886,7 @@ def _device_prover(pk: FastProvingKey):
 
     if _DEVICE_PROVER[0] is pk:
         return _DEVICE_PROVER[1]
-    ext_n = (1 << pk.k) * 8
+    ext_n = (1 << pk.k) * 4
     shift = _find_coset_shifts(ext_n, 2)[1]
     dp = prover_tpu.DeviceProver(
         pk.k, shift,
@@ -983,18 +1033,43 @@ def prove_fast_tpu(params: KZGParams, pk: FastProvingKey,
     phi_commit = _commit_blinded_evals(params, phi_vals, phi_blinds)
     tr.absorb_point(phi_commit)
 
+    # round 2c: z-split partial products — values on host kernels (the
+    # lockstep twin of prove_fast's round 2c), ext chunks on device
+    with trace.span("prove_tpu.r2c_partials"):
+        uv_vals = _perm_partial_vals(fk, wire_vals, pk.sigma_eval_limbs,
+                                     pk.shifts, omegas, z_vals, beta,
+                                     gamma)
+        uv_coeff_dev = []
+        uv_blinds = []
+        for vals in uv_vals:
+            dev = ptpu.upload_mont(vals)
+            uv_coeff_dev.append(pack(dp.intt_natural(dev)))
+            del dev
+            uv_blinds.append([randint() for _ in range(2)])
+        if pre:
+            uv_ext = [ext8(uv_coeff_dev[i], uv_blinds[i])
+                      for i in range(NUM_PERM_PARTIALS)]
+        uv_commits = [
+            _commit_blinded_evals(params, uv_vals[i], uv_blinds[i])
+            for i in range(NUM_PERM_PARTIALS)
+        ]
+    for cm in uv_commits:
+        tr.absorb_point(cm)
+
     alpha = tr.challenge()
 
-    # round 3 (device): ext chunks → quotient → 8n inverse → chunks
+    # round 3 (device): ext chunks → quotient → 4n inverse → chunks
     ch_planes = dp.challenge_planes(beta, gamma, beta_lk, alpha, pk.shifts)
     with trace.span("prove_tpu.r3_quotient"):
         t_chunks_fs = []
-        for j in range(8):
+        for j in range(ptpu.EXT_COSETS):
             with trace.span("prove_tpu.r3_chunk", j=j):
                 if pre:
                     wires_e = [wire_ext[w][j] for w in range(NUM_WIRES)]
                     z_e, m_e = z_ext[j], m_ext[j]
                     phi_e, pi_e = phi_ext[j], pi_ext[j]
+                    uv_e = [uv_ext[i][j]
+                            for i in range(NUM_PERM_PARTIALS)]
                 else:
                     wires_e = [dp.ext_chunk(wire_coeff_dev[w], j,
                                             wire_blinds[w])
@@ -1003,15 +1078,20 @@ def prove_fast_tpu(params: KZGParams, pk: FastProvingKey,
                     m_e = dp.ext_chunk(m_coeff_dev, j, m_blinds)
                     phi_e = dp.ext_chunk(phi_coeff_dev, j, phi_blinds)
                     pi_e = dp.ext_chunk(pi_coeff_dev, j)
+                    uv_e = [dp.ext_chunk(uv_coeff_dev[i], j,
+                                         uv_blinds[i])
+                            for i in range(NUM_PERM_PARTIALS)]
                 t_chunks_fs.append(pack(dp.quotient_chunk(
-                    j, wires_e, z_e, m_e, phi_e, pi_e, ch_planes)))
-                if pre:  # chunk consumed — release its 10 ext arrays
+                    j, wires_e, z_e, m_e, phi_e, pi_e, uv_e, ch_planes)))
+                if pre:  # chunk consumed — release its 14 ext arrays
                     for col in wire_ext:
+                        col[j] = None
+                    for col in uv_ext:
                         col[j] = None
                     z_ext[j] = m_ext[j] = phi_ext[j] = pi_ext[j] = None
                 _sync_if_tracing(t_chunks_fs[-1])
-    with trace.span("prove_tpu.r3_intt8"):
-        t_coeff_chunks = dp.intt8(t_chunks_fs)
+    with trace.span("prove_tpu.r3_intt_ext"):
+        t_coeff_chunks = dp.intt_ext(t_chunks_fs)
         _sync_if_tracing(t_coeff_chunks[-1])
     # the degree check pins the full device pipeline; the remaining
     # chunk downloads then overlap the host t-commit MSMs (the ctypes
@@ -1054,10 +1134,11 @@ def prove_fast_tpu(params: KZGParams, pk: FastProvingKey,
             xp = xp * at % R
         return b * zh % R
 
+    npp = NUM_PERM_PARTIALS
     with trace.span("prove_tpu.r4_evals"):
         base_evals = dp.eval_coeffs_at_many(
             wire_coeff_dev + [m_coeff_dev, z_coeff_dev, phi_coeff_dev]
-            + dp.fixed_coeffs + dp.sigma_coeffs, zeta)
+            + uv_coeff_dev + dp.fixed_coeffs + dp.sigma_coeffs, zeta)
     wire_evals = [
         (base_evals[w] + blind_corr(wire_blinds[w], zeta, zh_zeta)) % R
         for w in range(NUM_WIRES)
@@ -1065,32 +1146,39 @@ def prove_fast_tpu(params: KZGParams, pk: FastProvingKey,
     m_eval = (base_evals[6] + blind_corr(m_blinds, zeta, zh_zeta)) % R
     z_eval = (base_evals[7] + blind_corr(z_blinds, zeta, zh_zeta)) % R
     phi_eval = (base_evals[8] + blind_corr(phi_blinds, zeta, zh_zeta)) % R
-    fixed_evals = base_evals[9 : 9 + len(FIXED_NAMES)]
-    sigma_zeta = base_evals[9 + len(FIXED_NAMES) :]
+    uv_evals = [
+        (base_evals[9 + i] + blind_corr(uv_blinds[i], zeta, zh_zeta)) % R
+        for i in range(npp)
+    ]
+    fixed_evals = base_evals[9 + npp : 9 + npp + len(FIXED_NAMES)]
+    sigma_zeta = base_evals[9 + npp + len(FIXED_NAMES) :]
     shifted_evals = dp.eval_coeffs_at_many([z_coeff_dev, phi_coeff_dev],
                                            zeta_w)
     z_next = (shifted_evals[0] + blind_corr(z_blinds, zeta_w, zh_zeta_w)) % R
     phi_next = (shifted_evals[1]
                 + blind_corr(phi_blinds, zeta_w, zh_zeta_w)) % R
     # t chunks are device-resident coefficient arrays — ζ-power dots
-    # there instead of a 7×2^20 host Horner pass
+    # there instead of a 3×2^20 host Horner pass
     t_evals = dp.eval_coeffs_at_many(
         [t_coeff_chunks[u] for u in range(QUOTIENT_CHUNKS)], zeta)
 
     for v in (wire_evals + [m_eval, z_eval, z_next, phi_eval, phi_next]
-              + t_evals + fixed_evals + sigma_zeta):
+              + uv_evals + t_evals + fixed_evals + sigma_zeta):
         tr.absorb_fr(v)
     v_ch = tr.challenge()
     tr.challenge()  # u — verifier-side fold
 
     # batched openings: fold base coeffs on device, patch blinds on host
     base_polys = (wire_coeff_dev + [m_coeff_dev, z_coeff_dev, phi_coeff_dev]
+                  + uv_coeff_dev
                   + [t_coeff_chunks[u] for u in range(QUOTIENT_CHUNKS)]
                   + dp.fixed_coeffs + dp.sigma_coeffs)
     blind_map = {w: wire_blinds[w] for w in range(NUM_WIRES)}
     blind_map[NUM_WIRES] = m_blinds
     blind_map[NUM_WIRES + 1] = z_blinds
     blind_map[NUM_WIRES + 2] = phi_blinds
+    for i in range(npp):
+        blind_map[NUM_WIRES + 3 + i] = uv_blinds[i]
 
     def _g_pows(poly_idx: list) -> list:
         return [pow(v_ch, i, R) for i in range(len(poly_idx))]
@@ -1138,7 +1226,8 @@ def prove_fast_tpu(params: KZGParams, pk: FastProvingKey,
                 fold2_np = fut2.result()
         w_wx = open_finish(g2, fold2_np, wx_idx, zeta_w)
 
-    proof = Proof(wire_commits, m_commit, z_commit, phi_commit, t_commits,
-                  wire_evals, m_eval, z_eval, z_next, phi_eval, phi_next,
-                  t_evals, fixed_evals, sigma_zeta, w_x, w_wx)
+    proof = Proof(wire_commits, m_commit, z_commit, phi_commit, uv_commits,
+                  t_commits, wire_evals, m_eval, z_eval, z_next, phi_eval,
+                  phi_next, uv_evals, t_evals, fixed_evals, sigma_zeta,
+                  w_x, w_wx)
     return proof.to_bytes()

@@ -3,21 +3,21 @@
 Replaces the host C++ extension-domain work inside ``prove_fast`` when
 the proving key is eval-form (FPK2) and a JAX device is available:
 
-- extension evaluation: the 8n coset splits into 8 size-n cosets
-  shift·ωₑʲ·H; each poly's ext chunk is ``ntt_tpu.ntt`` of its
-  coset-scaled coefficients (all chunks share one n-sized plan). A
-  blinded poly p + b·Z_H needs only the closed-form correction
-  zh_c·b(x) per chunk, because Z_H is the CONSTANT shift_jⁿ−1 on a
-  coset.
+- extension evaluation: the 4n coset (z-split protocol, zk/plonk.py)
+  splits into 4 size-n cosets shift·ωₑʲ·H; each poly's ext chunk is
+  ``ntt_tpu.ntt`` of its coset-scaled coefficients (all chunks share
+  one n-sized plan). A blinded poly p + b·Z_H needs only the
+  closed-form correction zh_c·b(x) per chunk, because Z_H is the
+  CONSTANT shift_jⁿ−1 on a coset.
 - z(ωX), φ(ωX): multiplying the argument by ω_n stays inside a coset,
   so the shifted polys are a static index roll of the unshifted chunk —
   no extra NTTs.
-- the quotient identity (an exact twin of the C++ ``quotient_eval``)
+- the quotient identity (an exact twin of the C++ ``quotient_eval2``)
   runs pointwise per chunk in the limb-plane engine; Z_H and its
   inverse are per-chunk scalars.
-- the 8n inverse NTT is 8 per-chunk iNTTs plus a radix-8 cross-chunk
-  combine (derivation at ``intt8``), emitting the quotient coefficient
-  chunks a[u·n:(u+1)·n] directly.
+- the 4n inverse NTT is 4 per-chunk iNTTs plus a radix-4 cross-chunk
+  combine (derivation at ``intt_ext``), emitting the quotient
+  coefficient chunks a[u·n:(u+1)·n] directly.
 - round 4: γ-power folds of the device-resident coefficient arrays
   (host divides and commits) and barycentric ζ-evaluations from the
   resident evals (host applies the blinding corrections).
@@ -44,6 +44,7 @@ from ..ops import ntt_tpu
 from ..utils.fields import BN254_FR_MODULUS as P
 
 L, L6 = f2.L, f2.L6
+EXT_COSETS = 4  # the z-split quotient runs on a 4n coset (was 8n)
 
 
 def _mont(v: int) -> int:
@@ -194,15 +195,23 @@ def _ext_chunk_impl(coeffs, coset16, xs16, zh_plane, blind_planes,
     return f2.mont_mul_const(chunk, f2.R_MONT)
 
 
+# challenge-plane layout shared by both quotient variants:
+# 0 beta, 1 gamma, 2 beta_lk, 3..10 alpha^1..alpha^8,
+# 11..16 beta·shift_k
+_CH_ALPHA = 3
+_CH_BSHIFT = 11
+
+
 @partial(jax.jit, static_argnames=("A", "B"))
-def _quotient_chunk_impl(wires, z_e, m_e, phi_e, pi_e, fixed16, sigma16,
-                         xs16, l016, ch, zh_inv_plane, A: int, B: int):
-    """ch: (L, 10) planes of [beta, gamma, beta_lk, alpha, a2, a3, a4,
-    beta·shift_0.., ] — laid out below. xs/l0 arrive packed uint16.
-    ``wires``/``fixed16``/``sigma16`` are TUPLES of per-poly arrays —
-    a stacked (15, 16, n) operand would copy ~1.3 GB of resident packed
-    tables through HBM on every chunk dispatch. Wire entries may arrive
-    packed uint16 (the pre-dispatched ext-chunk path)."""
+def _quotient_chunk_impl(wires, z_e, m_e, phi_e, pi_e, uv_e, fixed16,
+                         sigma16, xs16, l016, ch, zh_inv_plane,
+                         A: int, B: int):
+    """z-split quotient identity on coset chunk j (zk/plonk.py round 3;
+    exact twin of the C++ ``quotient_eval2``). xs/l0 arrive packed
+    uint16. ``wires``/``uv_e``/``fixed16``/``sigma16`` are TUPLES of
+    per-poly arrays — a stacked operand would copy ~GBs of resident
+    packed tables through HBM on every chunk dispatch. Witness entries
+    may arrive packed uint16 (the pre-dispatched ext-chunk path)."""
     n = A * B
 
     def cc(idx):
@@ -214,6 +223,7 @@ def _quotient_chunk_impl(wires, z_e, m_e, phi_e, pi_e, fixed16, sigma16,
     fx = [f2.unpack16(fixed16[i]) for i in range(9)]
     sg = [f2.unpack16(sigma16[i]) for i in range(6)]
     w = [_as_planes(wires[i]) for i in range(6)]
+    uv = [_as_planes(uv_e[i]) for i in range(4)]
     z_e = _as_planes(z_e)
     m_e = _as_planes(m_e)
     phi_e = _as_planes(phi_e)
@@ -230,17 +240,19 @@ def _quotient_chunk_impl(wires, z_e, m_e, phi_e, pi_e, fixed16, sigma16,
     gate = f2.add(gate, fx[7])
     gate = f2.add(gate, pii)
 
-    # ch layout: 0 beta, 1 gamma, 2 beta_lk, 3 alpha, 4 a2, 5 a3, 6 a4,
-    # 7..12 beta·shift_k
-    pn, pd = zi, zwi
+    # permutation wire factors
+    fv, gv = [], []
     for kk in range(6):
-        f1 = f2.mont_mul(xs, cc(7 + kk))
-        f1 = f2.add(f2.add(f1, w[kk]), cc(1))
-        pn = f2.mont_mul(pn, f1)
+        f1 = f2.mont_mul(xs, cc(_CH_BSHIFT + kk))
+        fv.append(f2.add(f2.add(f1, w[kk]), cc(1)))
         g2 = f2.mont_mul(sg[kk], cc(0))
-        g2 = f2.add(f2.add(g2, w[kk]), cc(1))
-        pd = f2.mont_mul(pd, g2)
-    perm = f2.sub(pn, pd)
+        gv.append(f2.add(f2.add(g2, w[kk]), cc(1)))
+    link = f2.sub(f2.mont_mul(f2.mont_mul(uv[1], fv[4]), fv[5]),
+                  f2.mont_mul(f2.mont_mul(uv[3], gv[4]), gv[5]))
+    c_u1 = f2.sub(uv[0], f2.mont_mul(f2.mont_mul(zi, fv[0]), fv[1]))
+    c_u2 = f2.sub(uv[1], f2.mont_mul(f2.mont_mul(uv[0], fv[2]), fv[3]))
+    c_v1 = f2.sub(uv[2], f2.mont_mul(f2.mont_mul(zwi, gv[0]), gv[1]))
+    c_v2 = f2.sub(uv[3], f2.mont_mul(f2.mont_mul(uv[2], gv[2]), gv[3]))
 
     # LogUp: lk = (dphi·ba − 1)·bt + m·ba
     ba = f2.add(w[5], cc(2))
@@ -251,20 +263,25 @@ def _quotient_chunk_impl(wires, z_e, m_e, phi_e, pi_e, fixed16, sigma16,
     lk = f2.mont_mul(lk, bt)
     lk = f2.add(lk, f2.mont_mul(mi, ba))
 
-    total = f2.add(gate, f2.mont_mul(perm, cc(3)))
+    a = _CH_ALPHA
+    total = f2.add(gate, f2.mont_mul(link, cc(a)))
     zm1 = f2.sub(zi, one)
-    total = f2.add(total, f2.mont_mul(f2.mont_mul(l0, zm1), cc(4)))
-    total = f2.add(total, f2.mont_mul(lk, cc(5)))
-    total = f2.add(total, f2.mont_mul(f2.mont_mul(l0, phii), cc(6)))
+    total = f2.add(total, f2.mont_mul(f2.mont_mul(l0, zm1), cc(a + 1)))
+    total = f2.add(total, f2.mont_mul(lk, cc(a + 2)))
+    total = f2.add(total, f2.mont_mul(f2.mont_mul(l0, phii), cc(a + 3)))
+    total = f2.add(total, f2.mont_mul(c_u1, cc(a + 4)))
+    total = f2.add(total, f2.mont_mul(c_u2, cc(a + 5)))
+    total = f2.add(total, f2.mont_mul(c_v1, cc(a + 6)))
+    total = f2.add(total, f2.mont_mul(c_v2, cc(a + 7)))
     return f2.mont_mul(total, jnp.broadcast_to(zh_inv_plane, (L, n)))
 
 
-# --- streaming quotient (k ≥ 21: the 15 packed fixed/sigma ext-chunk
-# tables would need ~7.7 GB resident, past the 16 GB chip budget with
-# the working set; instead each pk column's ext chunk is generated
-# on the fly and folded into running accumulators, so at most one
-# pk-column ext array is live at a time — trading ~15 extra n-sized
-# NTTs per chunk for ~7.7 GB of HBM) ------------------------------------
+# --- streaming quotient (large k: the 15 packed fixed/sigma ext-chunk
+# tables would need ~3.9 GB resident at k=21 post-z-split; when that
+# plus the working set is past the 16 GB chip budget, each pk column's
+# ext chunk is generated on the fly and folded into running
+# accumulators, so at most one pk-column ext array is live at a time —
+# trading ~15 extra n-sized NTTs per chunk for the resident tables) ----
 
 @jax.jit
 def _mul_first_impl(a, b):
@@ -283,6 +300,8 @@ def _add2_impl(acc, a):
 
 @jax.jit
 def _perm_step_x_impl(pn, xs16, bshift_plane, w, gamma_plane):
+    """pn · (w + β·shift·x + γ) — one X-side permutation factor."""
+    pn = _as_planes(pn)
     w = _as_planes(w)
     n = w.shape[1]
     f1 = f2.mont_mul(f2.unpack16(xs16),
@@ -293,6 +312,8 @@ def _perm_step_x_impl(pn, xs16, bshift_plane, w, gamma_plane):
 
 @jax.jit
 def _perm_step_sg_impl(pd, sg_e, beta_plane, w, gamma_plane):
+    """pd · (w + β·σ + γ) — one σ-side permutation factor."""
+    pd = _as_planes(pd)
     w = _as_planes(w)
     n = w.shape[1]
     g2 = f2.mont_mul(sg_e, jnp.broadcast_to(beta_plane, (L, n)))
@@ -316,7 +337,9 @@ def _lk_impl(w5, fx8_e, m_e, phii, phiwi, blk_plane):
 
 
 @jax.jit
-def _qfinal_impl(gate, pn, pd, lk, z_e, phii, l016, ch, zh_inv_plane):
+def _qfinal_impl(gate, link_f, link_g, t_u1, t_u2, t_v1, t_v2, uv0, uv1,
+                 uv2, uv3, lk, z_e, phii, l016, ch, zh_inv_plane):
+    """Streaming-path final combine of the z-split identity terms."""
     n = gate.shape[1]
 
     def cc(idx):
@@ -324,12 +347,17 @@ def _qfinal_impl(gate, pn, pd, lk, z_e, phii, l016, ch, zh_inv_plane):
 
     one = f2._const_planes(_mont(1), n)
     l0 = f2.unpack16(l016)
-    perm = f2.sub(pn, pd)
-    total = f2.add(gate, f2.mont_mul(perm, cc(3)))
+    uv = [_as_planes(u) for u in (uv0, uv1, uv2, uv3)]
+    a = _CH_ALPHA
+    total = f2.add(gate, f2.mont_mul(f2.sub(link_f, link_g), cc(a)))
     zm1 = f2.sub(z_e, one)
-    total = f2.add(total, f2.mont_mul(f2.mont_mul(l0, zm1), cc(4)))
-    total = f2.add(total, f2.mont_mul(lk, cc(5)))
-    total = f2.add(total, f2.mont_mul(f2.mont_mul(l0, phii), cc(6)))
+    total = f2.add(total, f2.mont_mul(f2.mont_mul(l0, zm1), cc(a + 1)))
+    total = f2.add(total, f2.mont_mul(lk, cc(a + 2)))
+    total = f2.add(total, f2.mont_mul(f2.mont_mul(l0, phii), cc(a + 3)))
+    total = f2.add(total, f2.mont_mul(f2.sub(uv[0], t_u1), cc(a + 4)))
+    total = f2.add(total, f2.mont_mul(f2.sub(uv[1], t_u2), cc(a + 5)))
+    total = f2.add(total, f2.mont_mul(f2.sub(uv[2], t_v1), cc(a + 6)))
+    total = f2.add(total, f2.mont_mul(f2.sub(uv[3], t_v2), cc(a + 7)))
     return f2.mont_mul(total, jnp.broadcast_to(zh_inv_plane, (L, n)))
 
 
@@ -342,7 +370,7 @@ def _combine1_impl(zc_u, s_neg16, su_u, *hats):
     (16, n) of s^{−d}."""
     n = hats[0].shape[1]
     acc = None
-    for j in range(8):
+    for j in range(len(hats)):
         term = f2.mont_mul(hats[j], jnp.broadcast_to(zc_u[j], (L, n)))
         acc = term if acc is None else f2.add(acc, term)
     acc = f2.mont_mul(acc, f2.unpack16(s_neg16))
@@ -436,20 +464,22 @@ class DeviceProver:
     uint16), and the pk's fixed/sigma columns resident as coeffs +
     packed ext chunks.
 
-    HBM budget at k=20 (16 GB v5e chip): pk coeffs 1.3 GB + packed ext
-    chunks 3.8 GB + packed tables ~1.3 GB + plan 0.16 GB ≈ 6.6 GB
-    resident, leaving ~9 GB for the prove working set. Three design
-    rules keep the peak inside that: H-domain eval arrays are never
-    resident (ζ-evals run from coeffs), static tables live as (16, n)
-    uint16 packs, and fold/dot kernels take polys as separate args
-    (a 25-poly jnp.stack is a 2.2 GB transient)."""
+    HBM budget at k=20 (16 GB v5e chip), post-z-split (4 cosets): pk
+    coeffs 1.3 GB + packed ext chunks 1.9 GB + packed tables ~0.7 GB +
+    plan 0.16 GB ≈ 4 GB resident, leaving ~12 GB for the prove working
+    set. Three design rules keep the peak inside that: H-domain eval
+    arrays are never resident (ζ-evals run from coeffs), static tables
+    live as (16, n) uint16 packs, and fold/dot kernels take polys as
+    separate args (a 29-poly jnp.stack is a multi-GB transient)."""
 
     def __init__(self, k: int, shift: int, fixed_evals_u64, sigma_evals_u64,
                  ext_resident: bool | None = None):
         self.k = k
         self.n = n = 1 << k
-        # resident packed ext chunks are a speed/HBM trade: ~3.8 GB at
-        # k=20 (fits), ~7.7 GB at k=21 (does not) — default follows k
+        # resident packed ext chunks are a speed/HBM trade: ~1.9 GB at
+        # k=20, ~3.9 GB at k=21 post-z-split. k=21 resident is now
+        # plausible on a 16 GB chip but unmeasured — default stays
+        # k ≤ 20 until the flagship HBM headroom is confirmed
         self.ext_resident = (k <= 20 if ext_resident is None
                              else ext_resident)
         # pre-compile the upload/download programs at the working shape
@@ -462,24 +492,25 @@ class DeviceProver:
         download_std(upload_mont(warm))
         self.plan = ntt_tpu.NttPlan.get(k)
         self.A, self.B = self.plan.A, self.plan.B
-        omega_e = ntt_tpu._root_of_unity(k + 3)     # order 8n
+        omega_e = ntt_tpu._root_of_unity(k + 2)     # order 4n
         self.omega = self.plan.omega                # order n
         self.omega_e = omega_e
         self.shift = shift
-        self.shifts8 = [shift * pow(omega_e, j, P) % P for j in range(8)]
-        self.zh_c = [(pow(s, n, P) - 1) % P for s in self.shifts8]
+        self.shifts_c = [shift * pow(omega_e, j, P) % P
+                         for j in range(EXT_COSETS)]
+        self.zh_c = [(pow(s, n, P) - 1) % P for s in self.shifts_c]
         self.zh_inv_c = [pow(z, -1, P) for z in self.zh_c]
         self.zh_planes = [_cplane(z) for z in self.zh_c]
         self.zh_inv_planes = [_cplane(z) for z in self.zh_inv_c]
 
         pk16 = jax.jit(f2.pack16)
         self.omega_pows = powers_vector(self.omega, n)          # natural
-        self.coset_pows = [pk16(powers_vector(s, n)) for s in self.shifts8]
+        self.coset_pows = [pk16(powers_vector(s, n)) for s in self.shifts_c]
         n_plane = _cplane(n)
         self.xs_fs, self.l0_fs = [], []
-        for j in range(8):
+        for j in range(EXT_COSETS):
             xs_nat, l0 = _xs_l0_impl(self.omega_pows,
-                                     _cplane(self.shifts8[j]),
+                                     _cplane(self.shifts_c[j]),
                                      self.zh_planes[j], n_plane)
             self.xs_fs.append(pk16(fs_from_natural(xs_nat, self.A, self.B)))
             # l0 is produced in natural order like xs — BOTH must be
@@ -505,7 +536,7 @@ class DeviceProver:
             if self.ext_resident:
                 self.fixed_coeffs.append(cf)
                 self.fixed_ext.append(
-                    [pk16(self.ext_chunk(cf, j)) for j in range(8)])
+                    [pk16(self.ext_chunk(cf, j)) for j in range(EXT_COSETS)])
             else:
                 self.fixed_coeffs.append(pk16(cf))
         self.sigma_coeffs = []
@@ -517,24 +548,25 @@ class DeviceProver:
             if self.ext_resident:
                 self.sigma_coeffs.append(cf)
                 self.sigma_ext.append(
-                    [pk16(self.ext_chunk(cf, j)) for j in range(8)])
+                    [pk16(self.ext_chunk(cf, j)) for j in range(EXT_COSETS)])
             else:
                 self.sigma_coeffs.append(pk16(cf))
 
-        # intt8 combine tables (packed)
+        # intt_ext combine tables (packed)
         self.we_neg_pows = [pk16(powers_vector(pow(omega_e, -j, P), n))
-                            for j in range(8)]
+                            for j in range(EXT_COSETS)]
         self.s_neg_pows = pk16(powers_vector(pow(shift, -1, P), n))
-        zeta8 = pow(omega_e, n, P)                  # primitive 8th root
-        inv8 = pow(8, -1, P)
+        zeta_c = pow(omega_e, n, P)        # primitive EXT_COSETS-th root
+        inv_c = pow(EXT_COSETS, -1, P)
         s_n_inv = pow(shift, -n, P)
         self.zc_planes = jnp.stack([
-            jnp.stack([_cplane(pow(zeta8, (-j * u) % 8, P) * inv8 % P)
-                       for j in range(8)])
-            for u in range(8)
+            jnp.stack([_cplane(pow(zeta_c, (-j * u) % EXT_COSETS, P)
+                               * inv_c % P)
+                       for j in range(EXT_COSETS)])
+            for u in range(EXT_COSETS)
         ])
         self.su_planes = jnp.stack(
-            [_cplane(pow(s_n_inv, u, P)) for u in range(8)])
+            [_cplane(pow(s_n_inv, u, P)) for u in range(EXT_COSETS)])
 
         self._bary: dict = {}
 
@@ -560,35 +592,40 @@ class DeviceProver:
                                self.plan.W_B, self.plan.T16, nb)
 
     def ext_chunks(self, coeffs: jnp.ndarray, blinds=None) -> list:
-        return [self.ext_chunk(coeffs, j, blinds) for j in range(8)]
+        return [self.ext_chunk(coeffs, j, blinds)
+                for j in range(EXT_COSETS)]
 
     # --- quotient ---------------------------------------------------------
 
     def challenge_planes(self, beta, gamma, beta_lk, alpha, shifts):
-        a2 = alpha * alpha % P
-        a3 = a2 * alpha % P
-        a4 = a3 * alpha % P
-        vals = [beta, gamma, beta_lk, alpha, a2, a3, a4] + \
+        # layout: see _CH_ALPHA/_CH_BSHIFT
+        apows = []
+        a = 1
+        for _ in range(8):
+            a = a * alpha % P
+            apows.append(a)
+        vals = [beta, gamma, beta_lk] + apows + \
             [beta * s % P for s in shifts]
         return jnp.concatenate([_cplane(v) for v in vals], axis=1)
 
-    def quotient_chunk(self, j, wires_e, z_e, m_e, phi_e, pi_e,
+    def quotient_chunk(self, j, wires_e, z_e, m_e, phi_e, pi_e, uv_e,
                        ch_planes) -> jnp.ndarray:
-        """Device twin of the C++ quotient_eval on coset chunk j;
-        ``ch_planes`` from :meth:`challenge_planes`. Dispatches to the
-        streaming variant when the pk ext chunks are not resident."""
+        """Device twin of the C++ quotient_eval2 on coset chunk j;
+        ``uv_e`` = [u1, u2, v1, v2] ext chunks; ``ch_planes`` from
+        :meth:`challenge_planes`. Dispatches to the streaming variant
+        when the pk ext chunks are not resident."""
         if not self.ext_resident:
             return self._quotient_chunk_streaming(
-                j, wires_e, z_e, m_e, phi_e, pi_e, ch_planes)
+                j, wires_e, z_e, m_e, phi_e, pi_e, uv_e, ch_planes)
         return _quotient_chunk_impl(
-            tuple(wires_e), z_e, m_e, phi_e, pi_e,
+            tuple(wires_e), z_e, m_e, phi_e, pi_e, tuple(uv_e),
             tuple(self.fixed_ext[i][j] for i in range(9)),
             tuple(self.sigma_ext[i][j] for i in range(6)),
             self.xs_fs[j], self.l0_fs[j], ch_planes,
             self.zh_inv_planes[j], self.A, self.B)
 
     def _quotient_chunk_streaming(self, j, wires_e, z_e, m_e, phi_e,
-                                  pi_e, ch_planes) -> jnp.ndarray:
+                                  pi_e, uv_e, ch_planes) -> jnp.ndarray:
         """Same math as ``_quotient_chunk_impl``, but each pk column's
         ext chunk is generated on the fly and folded immediately, so at
         most one is live — see the streaming-quotient section above.
@@ -598,7 +635,7 @@ class DeviceProver:
 
         # pre-dispatched (packed uint16) witness ext chunks: z/phi must
         # unpack before the index roll (the roll reshapes by L planes);
-        # wires/m/pi unpack inside the guarded kernels
+        # wires/m/pi/uv unpack inside the guarded kernels
         if z_e.dtype == jnp.uint16:
             z_e = _unpack16_impl(z_e)
         if phi_e.dtype == jnp.uint16:
@@ -621,15 +658,33 @@ class DeviceProver:
         gate = _add2_impl(gate, self.ext_chunk(self.fixed_coeffs[7], j))
         gate = _add2_impl(gate, pi_e)
 
-        # permutation products (sequential in k — one σ ext live)
+        # z-split partial-product chains. X-side factors need no pk
+        # columns; the σ-side streams one σ ext chunk at a time.
+        bs = _CH_BSHIFT
+        t_u1 = _perm_step_x_impl(z_e, self.xs_fs[j], cp(bs + 0),
+                                 wires_e[0], cp(1))
+        t_u1 = _perm_step_x_impl(t_u1, self.xs_fs[j], cp(bs + 1),
+                                 wires_e[1], cp(1))
+        t_u2 = _perm_step_x_impl(uv_e[0], self.xs_fs[j], cp(bs + 2),
+                                 wires_e[2], cp(1))
+        t_u2 = _perm_step_x_impl(t_u2, self.xs_fs[j], cp(bs + 3),
+                                 wires_e[3], cp(1))
+        link_f = _perm_step_x_impl(uv_e[1], self.xs_fs[j], cp(bs + 4),
+                                   wires_e[4], cp(1))
+        link_f = _perm_step_x_impl(link_f, self.xs_fs[j], cp(bs + 5),
+                                   wires_e[5], cp(1))
         zwi = fs_roll_next(z_e, self.A, self.B)
-        pn, pd = z_e, zwi
-        for kk in range(6):
-            pn = _perm_step_x_impl(pn, self.xs_fs[j], cp(7 + kk),
-                                   wires_e[kk], cp(1))
-            sg = self.ext_chunk(self.sigma_coeffs[kk], j)
-            pd = _perm_step_sg_impl(pd, sg, cp(0), wires_e[kk], cp(1))
-            del sg
+        chains_g = [(zwi, 0), (uv_e[2], 2), (uv_e[3], 4)]
+        outs_g = []
+        for base, k0 in chains_g:
+            acc = base
+            for kk in (k0, k0 + 1):
+                sg = self.ext_chunk(self.sigma_coeffs[kk], j)
+                acc = _perm_step_sg_impl(acc, sg, cp(0), wires_e[kk],
+                                         cp(1))
+                del sg
+            outs_g.append(acc)
+        t_v1, t_v2, link_g = outs_g
 
         # LogUp
         phiwi = fs_roll_next(phi_e, self.A, self.B)
@@ -637,23 +692,25 @@ class DeviceProver:
         lk = _lk_impl(wires_e[5], fx8, m_e, phi_e, phiwi, cp(2))
         del fx8
 
-        return _qfinal_impl(gate, pn, pd, lk, z_e, phi_e,
-                            self.l0_fs[j], ch_planes,
+        return _qfinal_impl(gate, link_f, link_g, t_u1, t_u2, t_v1, t_v2,
+                            uv_e[0], uv_e[1], uv_e[2], uv_e[3], lk, z_e,
+                            phi_e, self.l0_fs[j], ch_planes,
                             self.zh_inv_planes[j])
 
-    # --- 8n inverse -------------------------------------------------------
+    # --- 4n inverse -------------------------------------------------------
 
-    def intt8(self, t_chunks: list) -> list:
-        """FS coset chunks of t → list of 8 (L, n) coefficient chunks
-        a[u·n:(u+1)·n] (derivation: iNTT_n folds coefficients; after the
-        ωₑ^{−jd} twiddle, an 8-point inverse DFT across chunks recovers
-        b_u[d] = a_{d+un}·s^{d+un}, then the s-power unscale).
+    def intt_ext(self, t_chunks: list) -> list:
+        """FS coset chunks of t → list of EXT_COSETS (L, n) coefficient
+        chunks a[u·n:(u+1)·n] (derivation: iNTT_n folds coefficients;
+        after the ωₑ^{−jd} twiddle, an EXT_COSETS-point inverse DFT
+        across chunks recovers b_u[d] = a_{d+un}·s^{d+un}, then the
+        s-power unscale).
 
         CONSUMES ``t_chunks`` (entries are dropped as their iNTT
         completes) and emits output chunks one at a time — the HBM peak
         here decides whether k=20 fits the chip."""
         hats = []
-        for j in range(8):
+        for j in range(EXT_COSETS):
             src = t_chunks[j]
             if src.dtype == jnp.uint16:  # streaming mode packs t chunks
                 src = _unpack16_impl(src)
@@ -662,7 +719,7 @@ class DeviceProver:
             del src
             hats.append(_twiddle_mul(cj, self.we_neg_pows[j]))
         out = []
-        for u in range(8):
+        for u in range(EXT_COSETS):
             chunk = _combine1_impl(self.zc_planes[u], self.s_neg_pows,
                                    self.su_planes[u], *hats)
             # streaming mode keeps the coefficient chunks packed too —

@@ -27,7 +27,7 @@ from protocol_tpu.zk.plonk import _find_coset_shifts  # noqa: E402
 
 K = int(__import__("os").environ.get("PTPU_TEST_K", "6"))
 N = 1 << K
-EXT_N = N * 8
+EXT_N = N * 4  # 4n extension coset (z-split)
 SHIFT = _find_coset_shifts(EXT_N, 2)[1]
 
 
@@ -48,10 +48,10 @@ def dp():
 
 
 def _host_ext(coeffs_u64, blinds=None):
-    """Host oracle: blinded coeffs zero-padded to 8n, coset-scaled,
+    """Host oracle: blinded coeffs zero-padded to 4n, coset-scaled,
     NTT'd — the exact prove_fast round-3 ``ext()``."""
     fk = native.FieldKernel(P)
-    de = EvaluationDomain(K + 3)
+    de = EvaluationDomain(K + 2)
     arr = np.zeros((EXT_N, 4), dtype="<u8")
     m = len(coeffs_u64)
     arr[:m] = coeffs_u64
@@ -70,12 +70,12 @@ def _host_ext(coeffs_u64, blinds=None):
 
 def _chunks_to_host_order(dp_obj, chunks):
     """Device chunk arrays (FS layout per chunk) → host ext order
-    (m = j + 8i)."""
+    (m = j + 4i)."""
     out = np.zeros((EXT_N, 4), dtype="<u8")
     for j, ch in enumerate(chunks):
         nat = ptpu.natural_from_fs(ch, dp_obj.A, dp_obj.B)
         vals = ptpu.download_std(nat)
-        out[j::8] = vals
+        out[j::4] = vals
     return out
 
 
@@ -114,20 +114,20 @@ def test_roll_matches_omega_shift(dp):
     assert np.array_equal(got, _host_ext(shifted))
 
 
-def test_intt8_matches_host(dp):
+def test_intt_ext_matches_host(dp):
     dp_obj, _, _ = dp
     ext_u64 = _rand_u64(EXT_N, 11)[0]
     # device chunks from the host-order ext array
     chunks = []
-    for j in range(8):
-        nat = ptpu.upload_mont(np.ascontiguousarray(ext_u64[j::8]))
+    for j in range(4):
+        nat = ptpu.upload_mont(np.ascontiguousarray(ext_u64[j::4]))
         chunks.append(ptpu.fs_from_natural(nat, dp_obj.A, dp_obj.B))
-    dev_chunks = dp_obj.intt8(chunks)
+    dev_chunks = dp_obj.intt_ext(chunks)
     got = np.concatenate([ptpu.download_std(dev_chunks[u])
-                          for u in range(8)])
+                          for u in range(4)])
 
     fk = native.FieldKernel(P)
-    de = EvaluationDomain(K + 3)
+    de = EvaluationDomain(K + 2)
     host = ext_u64.copy()
     fk.ntt(host, de.omega, inverse=True)
     fk.coset_scale(host, SHIFT, invert=True)
@@ -194,21 +194,23 @@ def test_streaming_quotient_matches_resident(dp):
     m = ptpu.upload_mont(_rand_u64(N, 511)[0])
     phi = ptpu.upload_mont(_rand_u64(N, 512)[0])
     pi = ptpu.upload_mont(_rand_u64(N, 513)[0])
+    uv = [ptpu.upload_mont(_rand_u64(N, 520 + i)[0]) for i in range(4)]
     beta, gamma, beta_lk, alpha = [int(x) % P for x in
                                    rng.integers(1, 2**62, 4)]
     shifts = _find_coset_shifts(N, 6)
     ch_r = dp_obj.challenge_planes(beta, gamma, beta_lk, alpha, shifts)
     ch_s = dp_stream.challenge_planes(beta, gamma, beta_lk, alpha, shifts)
-    for j in (0, 5):
+    for j in (0, 3):
         we_r = [dp_obj.ext_chunk(dp_obj.intt_natural(w), j) for w in wires]
         ze_r = dp_obj.ext_chunk(dp_obj.intt_natural(z), j)
         me_r = dp_obj.ext_chunk(dp_obj.intt_natural(m), j)
         pe_r = dp_obj.ext_chunk(dp_obj.intt_natural(phi), j)
         pie_r = dp_obj.ext_chunk(dp_obj.intt_natural(pi), j)
+        uve_r = [dp_obj.ext_chunk(dp_obj.intt_natural(u), j) for u in uv]
         t_res = dp_obj.quotient_chunk(j, we_r, ze_r, me_r, pe_r, pie_r,
-                                      ch_r)
+                                      uve_r, ch_r)
         t_str = dp_stream.quotient_chunk(j, we_r, ze_r, me_r, pe_r,
-                                         pie_r, ch_s)
+                                         pie_r, uve_r, ch_s)
         assert np.array_equal(ptpu.download_std(t_res),
                               ptpu.download_std(t_str))
 
@@ -237,7 +239,7 @@ def test_prove_streaming_mode_bytes_equal_host():
     cs.check_satisfied()
     params = pf.setup_params_fast(6, seed=b"stream-lock")
     pk = pf.keygen_fast(params, cs, eval_pk=True)
-    ext_n = (1 << pk.k) * 8
+    ext_n = (1 << pk.k) * 4
     shift = _find_coset_shifts(ext_n, 2)[1]
     dp_stream = ptpu.DeviceProver(
         pk.k, shift,
@@ -267,19 +269,21 @@ def test_quotient_chunk_matches_host(dp):
     m_u64 = _rand_u64(N, 401)[0]
     phi_u64 = _rand_u64(N, 402)[0]
     pi_u64 = _rand_u64(N, 403)[0]
+    uv_u64 = [_rand_u64(N, 404 + i)[0] for i in range(4)]
     beta, gamma, beta_lk, alpha = [int(x) % P for x in
                                    rng.integers(1, 2**62, 4)]
     shifts = _find_coset_shifts(N, 6)
 
     # host ext arrays + quotient
     fk = native.FieldKernel(P)
-    de = EvaluationDomain(K + 3)
+    de = EvaluationDomain(K + 2)
     d = EvaluationDomain(K)
 
     def host_ext(c):
         return _host_ext(c)
 
     wires_e = np.stack([host_ext(c) for c in wires_u64])
+    uv_e = np.stack([host_ext(c) for c in uv_u64])
     z_e = host_ext(z_u64)
     zw_c = z_u64.copy(); fk.coset_scale(zw_c, d.omega)
     zw_e = host_ext(zw_c)
@@ -305,20 +309,20 @@ def test_quotient_chunk_matches_host(dp):
     shift_arr = np.frombuffer(int(SHIFT).to_bytes(32, "little"), dtype="<u8")
     xs[:] = shift_arr
     fk.coset_scale(xs, de.omega)
-    w8 = pow(de.omega, N, P)
+    w4 = pow(de.omega, N, P)
     shift_n = pow(SHIFT, N, P)
-    zh8 = [(shift_n * pow(w8, i, P) - 1) % P for i in range(8)]
-    zh8_inv = [pow(v, -1, P) for v in zh8]
-    reps = EXT_N // 8
-    zh_inv = np.tile(native.ints_to_limbs(zh8_inv), (reps, 1))
-    zh_tiled = np.tile(native.ints_to_limbs(zh8), (reps, 1))
+    zh4 = [(shift_n * pow(w4, i, P) - 1) % P for i in range(4)]
+    zh4_inv = [pow(v, -1, P) for v in zh4]
+    reps = EXT_N // 4
+    zh_inv = np.tile(native.ints_to_limbs(zh4_inv), (reps, 1))
+    zh_tiled = np.tile(native.ints_to_limbs(zh4), (reps, 1))
     l0_den = fk.scalar_mul(fk.scalar_sub(xs, 1), N % P)
     fk.batch_inverse(l0_den)
     l0 = fk.vec_mul(zh_tiled, l0_den)
 
     t_host = fk.quotient_eval(wires_e, z_e, zw_e, m_e, phi_e, phiw_e,
-                              fixed_e, sigma_e, pi_e, xs, zh_inv, l0,
-                              beta, gamma, beta_lk, alpha, shifts)
+                              uv_e, fixed_e, sigma_e, pi_e, xs, zh_inv,
+                              l0, beta, gamma, beta_lk, alpha, shifts)
 
     # device: per-chunk quotient from the same inputs (polys degree < n,
     # no blinds here — blinding correctness is covered separately)
@@ -327,13 +331,14 @@ def test_quotient_chunk_matches_host(dp):
     m_dev = dp_obj.ext_chunks(ptpu.upload_mont(m_u64))
     phi_dev = dp_obj.ext_chunks(ptpu.upload_mont(phi_u64))
     pi_dev = dp_obj.ext_chunks(ptpu.upload_mont(pi_c))
+    uv_dev = [dp_obj.ext_chunks(ptpu.upload_mont(c)) for c in uv_u64]
 
     ch_planes = dp_obj.challenge_planes(beta, gamma, beta_lk, alpha,
                                         shifts)
     t_dev = []
-    for j in range(8):
+    for j in range(4):
         t_dev.append(dp_obj.quotient_chunk(
             j, [w[j] for w in wires_dev], z_dev[j], m_dev[j], phi_dev[j],
-            pi_dev[j], ch_planes))
+            pi_dev[j], [u[j] for u in uv_dev], ch_planes))
     got = _chunks_to_host_order(dp_obj, t_dev)
     assert np.array_equal(got, t_host)
