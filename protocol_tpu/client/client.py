@@ -39,6 +39,18 @@ DEFAULT_NUM_DECIMAL_LIMBS = 2
 DEFAULT_POWER_OF_TEN = 72
 
 
+def _device_present() -> bool:
+    """True when an accelerator backend is live (batched-ingest auto
+    mode). Fails closed on jax-less hosts — the scalar path needs no
+    device at all."""
+    try:
+        import jax
+
+        return jax.devices()[0].platform in ("tpu", "axon", "gpu")
+    except Exception:
+        return False
+
+
 @dataclass
 class ClientConfig:
     """CliConfig twin (eigentrust-cli/src/cli.rs:27-43)."""
@@ -73,7 +85,7 @@ class Client:
         num_neighbours: int = DEFAULT_NUM_NEIGHBOURS,
         num_iterations: int = DEFAULT_NUM_ITERATIONS,
         initial_score: int = DEFAULT_INITIAL_SCORE,
-        batched_ingest: bool = False,
+        batched_ingest: bool | None = None,
     ):
         self.config = config
         self.mnemonic = mnemonic
@@ -84,6 +96,11 @@ class Client:
         # True routes signer recovery through the TPU batch path
         # (client.ingest) — worth it for large ingest batches; the host
         # scalar loop stays default for small sets
+        # None = auto: batch on an accelerator (the per-attestation
+        # scalar path is the reference's ingest hot spot,
+        # ecdsa/native.rs:298-331 — on a TPU the batched Poseidon +
+        # Strauss kernels win from a few dozen attestations up; on a
+        # jax-less or CPU-only host the scalar path stays default)
         self.batched_ingest = batched_ingest
         if chain is not None:
             self.chain = chain
@@ -172,7 +189,10 @@ class Client:
         pub_key_map: dict = {}
         origins: list = []
         participants: set = set()
-        if self.batched_ingest and attestations:
+        use_batched = self.batched_ingest
+        if use_batched is None:
+            use_batched = len(attestations) >= 32 and _device_present()
+        if use_batched and attestations:
             from .ingest import recover_signers_batch
 
             pks, addr_list, valid = recover_signers_batch(attestations)

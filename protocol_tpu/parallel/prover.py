@@ -1,0 +1,338 @@
+"""Sharded PLONK round 3 — the prover's distributed seam, widened.
+
+``parallel/ntt.py`` sharded one forward NTT; this module shards the
+whole round-3 pipeline the way a TPU-pod prover would run it
+(VERDICT r3 ask #2):
+
+    ext (coset-scale + NTT)  →  quotient  →  inverse NTT + combine
+
+- every (L, n) array lives as (L, A, B) with the **B axis sharded**
+  over a 1-D mesh; the FS layout's flat index k1·B + k2 is exactly the
+  (A, B) ravel, so a B-shard is a contiguous lane range on every
+  device;
+- the forward/inverse NTT stages pay ONE ``psum_scatter`` each over
+  the mesh axis (the tensor-parallel matmul with a reduce-scatter —
+  1/D the collective volume of an all-reduce); everything else in the
+  pipeline is pointwise and therefore communication-free;
+- the quotient identity is the SAME function the single-chip kernel
+  runs (``prover_tpu.quotient_pointwise`` — one home for the math);
+  the only distributed step it needs is z(ωX)/φ(ωX): an FS-layout roll
+  whose wrap row crosses the shard boundary, served by a single
+  one-element ``ppermute`` from the lane-neighbor device;
+- the radix-4 cross-chunk combine of the 4n inverse is pointwise per
+  chunk — zero communication.
+
+Exact integer arithmetic end to end: per-device lazy partials are the
+single-chip accumulator's own plane sums, so every output is
+bit-identical to ``zk/prover_tpu.DeviceProver`` (tested on 2/4/8-shard
+virtual meshes, ``tests/test_parallel_prover.py``).
+
+Scale note (the pod split this seam buys): at k=21 a 4-shard mesh
+holds n/4 lanes of every ext array per chip — the resident-table mode
+that exceeds one chip's HBM fits trivially, and the two collectives
+per NTT ride ICI at reduce-scatter volume (n/D · L · 4 B per stage).
+
+Reference anchor: the reference prover is single-machine halo2
+(``eigentrust-zk`` driving rayon-threaded FFTs, utils.rs:206-228); a
+device-mesh decomposition of the quotient pipeline has no counterpart
+there — this is the TPU-native thesis, built on jax.sharding +
+shard_map exactly like the converge engine (``parallel/sharded.py``).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+try:  # jax >= 0.6 exposes shard_map at top level
+    from jax import shard_map
+except ImportError:  # pragma: no cover
+    from jax.experimental.shard_map import shard_map
+
+from ..ops import fieldops2 as f2
+from ..ops import ntt_tpu
+from ..zk import prover_tpu as ptpu
+
+L, L6 = f2.L, f2.L6
+EXT_COSETS = ptpu.EXT_COSETS
+
+
+def _shard_spec(axis):
+    return P(None, None, axis)
+
+
+def _grid(x, A, B):
+    """(·, n) → (·, A, B) FS/natural grid view."""
+    return x.reshape(x.shape[0], A, B)
+
+
+class ShardedRound3:
+    """Round-3 pipeline over a 1-D mesh, table-compatible with a
+    ``DeviceProver``: the scalar/packed tables are the DeviceProver's
+    own (bit-identical by construction), re-placed with a B-axis
+    sharding."""
+
+    def __init__(self, dp: ptpu.DeviceProver, mesh: Mesh,
+                 axis: str | None = None):
+        self.dp = dp
+        self.mesh = mesh
+        self.axis = axis or mesh.axis_names[0]
+        self.A, self.B = dp.A, dp.B
+        self.D = mesh.devices.size
+        if self.B % self.D:
+            raise ValueError(
+                f"B={self.B} must divide over {self.D} devices")
+        spec = _shard_spec(self.axis)
+        self._sh = NamedSharding(mesh, spec)
+
+        def place(packed16):
+            return jax.device_put(_grid(packed16, self.A, self.B),
+                                  self._sh)
+
+        self.coset_pows = [place(t) for t in dp.coset_pows]
+        self.xs_fs = [place(t) for t in dp.xs_fs]
+        self.l0_fs = [place(t) for t in dp.l0_fs]
+        self.we_neg_pows = [place(t) for t in dp.we_neg_pows]
+        self.s_neg_pows = place(dp.s_neg_pows)
+        self.plan = dp.plan
+
+    def shard(self, x: jnp.ndarray) -> jnp.ndarray:
+        """Place a (·, n) device array into the mesh sharding."""
+        return jax.device_put(_grid(x, self.A, self.B), self._sh)
+
+    # --- sharded building blocks -----------------------------------------
+
+    def _roll_next(self, m):
+        """FS-layout z(ωX) roll of a (L, A, Bd) shard: rows shift
+        locally; the wrap row's global lane roll fetches ONE element
+        from the next device."""
+        axis = self.axis
+        D = self.D
+        main = m[:, 1:, :]
+        wrap = m[:, :1, :]
+        # global roll -1 over the sharded lane axis: local tail + the
+        # neighbor's first lane
+        recv = jax.lax.ppermute(
+            wrap[:, :, :1], axis,
+            perm=[((d + 1) % D, d) for d in range(D)])
+        wrap_rolled = jnp.concatenate([wrap[:, :, 1:], recv], axis=2)
+        return jnp.concatenate([main, wrap_rolled], axis=1)
+
+    def ext_chunk(self, coeffs: jnp.ndarray, j: int,
+                  blinds=None) -> jnp.ndarray:
+        """Sharded twin of ``DeviceProver.ext_chunk``: (L, A, B)
+        B-sharded coefficients → FS-layout ext chunk, same sharding."""
+        dp = self.dp
+        if blinds:
+            bp = jnp.asarray(
+                f2.ints_to_planes([ptpu._mont(b) for b in blinds]))
+            nb = len(blinds)
+        else:
+            bp = jnp.zeros((L, 1), jnp.int32)
+            nb = 0
+        axis = self.axis
+        A = self.A
+
+        def kernel(c_loc, coset_loc, xs_loc, w_a, w_b, t16, zh_plane,
+                   blind_planes):
+            Bd = c_loc.shape[2]
+            idx = jax.lax.axis_index(axis)
+            scaled = f2.mont_mul(
+                _as_flat(c_loc), _unpack_flat(coset_loc))
+            # forward four-step: stage 1 (A axis, local), twiddle
+            # (pointwise local slice), stage 2 (contract over the
+            # sharded axis -> psum_scatter)
+            x6 = f2.to_mxu_planes(scaled).reshape(L6, A, Bd)
+            y = ntt_tpu._plane_matmul_left(w_a, x6)
+            tw_loc = jax.lax.dynamic_slice_in_dim(
+                t16, idx * Bd, Bd, axis=2)
+            tw = f2.unpack16(tw_loc.reshape(16, -1)).reshape(L, A, Bd)
+            y = f2.mont_mul(y.reshape(L, -1), tw.reshape(L, -1))
+            y6 = f2.to_mxu_planes(y).reshape(L6, A, Bd)
+            w_b_local = jax.lax.dynamic_slice_in_dim(
+                w_b, idx * Bd, Bd, axis=2)
+            partial = ntt_tpu._plane_accum_right(y6, w_b_local)
+            shard = jax.lax.psum_scatter(partial, axis,
+                                         scatter_dimension=2, tiled=True)
+            chunk = f2.reduce_mxu_planes(
+                shard.reshape(shard.shape[0], -1))
+            if nb:
+                nloc = chunk.shape[1]
+                xs = _unpack_flat(xs_loc)
+                corr = jnp.broadcast_to(blind_planes[:, 0:1], (L, nloc))
+                xp = xs
+                for i in range(1, nb):
+                    corr = f2.add(corr, f2.mont_mul(
+                        xp, jnp.broadcast_to(blind_planes[:, i:i + 1],
+                                             (L, nloc))))
+                    if i + 1 < nb:
+                        xp = f2.mont_mul(xp, xs)
+                chunk = f2.add(chunk, f2.mont_mul(
+                    corr, jnp.broadcast_to(zh_plane, (L, nloc))))
+            chunk = f2.mont_mul_const(chunk, f2.R_MONT)
+            return chunk.reshape(L, A, Bd)
+
+        rep = P(None, None, None)
+        spec = _shard_spec(self.axis)
+        fn = shard_map(
+            kernel, mesh=self.mesh,
+            in_specs=(spec, spec, spec, rep, rep, rep,
+                      P(None, None), P(None, None)),
+            out_specs=spec, check_vma=False)
+        return fn(coeffs, self.coset_pows[j], self.xs_fs[j],
+                  self.plan.W_A, self.plan.W_B, self.plan.T16,
+                  dp.zh_planes[j], bp)
+
+    def quotient_chunk(self, j: int, wires_e, z_e, m_e, phi_e, pi_e,
+                       uv_e, ch_planes) -> jnp.ndarray:
+        """Sharded z-split quotient: the single-chip pointwise core,
+        with the two FS rolls served by one-element ppermutes."""
+        axis = self.axis
+
+        def kernel(xs_loc, l0_loc, ch, zh_inv_plane, z_loc, phi_loc,
+                   m_loc, pi_loc, *polys):
+            w = [_as_flat(p) for p in polys[:6]]
+            uv = [_as_flat(p) for p in polys[6:10]]
+            zi3 = z_loc
+            phii3 = phi_loc
+            zwi = _as_flat(self._roll_next(zi3))
+            phiwi = _as_flat(self._roll_next(phii3))
+            out = ptpu.quotient_pointwise(
+                w, _as_flat(zi3), zwi, _as_flat(m_loc), _as_flat(phii3),
+                phiwi, _as_flat(pi_loc), uv,
+                [_as_flat(p) for p in polys[10:19]],
+                [_as_flat(p) for p in polys[19:25]],
+                _unpack_flat(xs_loc), _unpack_flat(l0_loc), ch,
+                zh_inv_plane)
+            return out.reshape(z_loc.shape)
+
+        dp = self.dp
+        fixed = [self._reshard_table(dp.fixed_ext[i][j]) for i in range(9)]
+        sigma = [self._reshard_table(dp.sigma_ext[i][j]) for i in range(6)]
+        rep2 = P(None, None)
+        spec = _shard_spec(self.axis)
+        fn = shard_map(
+            kernel, mesh=self.mesh,
+            in_specs=(spec, spec, rep2, rep2,
+                      *([spec] * (4 + 25))),
+            out_specs=spec, check_vma=False)
+        return fn(self.xs_fs[j], self.l0_fs[j], ch_planes,
+                  dp.zh_inv_planes[j], z_e, phi_e, m_e, pi_e,
+                  *wires_e, *uv_e, *fixed, *sigma)
+
+    _table_cache: dict
+
+    def _reshard_table(self, packed16) -> jnp.ndarray:
+        cache = getattr(self, "_tc", None)
+        if cache is None:
+            cache = self._tc = {}
+        key = id(packed16)
+        out = cache.get(key)
+        if out is None:
+            out = cache[key] = jax.device_put(
+                _grid(packed16, self.A, self.B), self._sh)
+        return out
+
+    def intt_chunk(self, z: jnp.ndarray) -> jnp.ndarray:
+        """Sharded inverse NTT of one FS-layout chunk (mirror of the
+        forward: right matmul contracts the sharded axis first)."""
+        axis = self.axis
+        A = self.A
+        plan = self.plan
+        n_inv = f2._const_planes(plan.n_inv_mont, 1)
+
+        def kernel(z_loc, w_a, w_b, t16_inv, n_inv_plane):
+            Bd = z_loc.shape[2]
+            idx = jax.lax.axis_index(axis)
+            z6 = f2.to_mxu_planes(
+                _as_flat(z_loc)).reshape(L6, A, Bd)
+            # stage 1: contract over k2 (sharded) with flipped W_B —
+            # per-device lazy partial + psum_scatter hands each device
+            # its j2 output tile
+            w_b_flip = ntt_tpu._flip_rows(w_b)
+            w_b_local = jax.lax.dynamic_slice_in_dim(
+                w_b_flip, idx * Bd, Bd, axis=2)
+            partial = ntt_tpu._plane_accum_right(z6, w_b_local)
+            shard = jax.lax.psum_scatter(partial, axis,
+                                         scatter_dimension=2, tiled=True)
+            y = f2.reduce_mxu_planes(shard.reshape(shard.shape[0], -1))
+            t_loc = jax.lax.dynamic_slice_in_dim(
+                t16_inv, idx * Bd, Bd, axis=2)
+            t_inv = f2.unpack16(t_loc.reshape(16, -1)).reshape(L, A, Bd)
+            y = f2.mont_mul(y, t_inv.reshape(L, -1))
+            y6 = f2.to_mxu_planes(y).reshape(L6, A, Bd)
+            out = ntt_tpu._plane_matmul_left(ntt_tpu._flip_rows(w_a), y6)
+            out = out.reshape(L, -1)
+            out = f2.mont_mul(
+                out, jnp.broadcast_to(n_inv_plane, out.shape))
+            return out.reshape(L, A, Bd)
+
+        rep = P(None, None, None)
+        spec = _shard_spec(self.axis)
+        fn = shard_map(
+            kernel, mesh=self.mesh,
+            in_specs=(spec, rep, rep, rep, P(None, None)),
+            out_specs=spec, check_vma=False)
+        return fn(z, plan.W_A, plan.W_B, plan.T16_inv, n_inv)
+
+    def intt_ext(self, t_chunks: list) -> list:
+        """Sharded twin of ``DeviceProver.intt_ext``: per-chunk sharded
+        iNTTs + the pointwise radix-4 cross-chunk combine."""
+        dp = self.dp
+        hats = []
+        for j in range(EXT_COSETS):
+            cj = self.intt_chunk(t_chunks[j])
+            hats.append(self._pointwise_mul(cj, self.we_neg_pows[j]))
+        out = []
+        spec = _shard_spec(self.axis)
+        rep2 = P(None, None)
+
+        def combine(zc_u, su_u, s_neg, *hats_loc):
+            nloc = hats_loc[0].shape[1] * hats_loc[0].shape[2]
+            acc = None
+            for jj in range(EXT_COSETS):
+                term = f2.mont_mul(
+                    _as_flat(hats_loc[jj]),
+                    jnp.broadcast_to(zc_u[jj], (L, nloc)))
+                acc = term if acc is None else f2.add(acc, term)
+            acc = f2.mont_mul(acc, _unpack_flat(s_neg))
+            acc = f2.mont_mul(acc, jnp.broadcast_to(su_u, (L, nloc)))
+            return acc.reshape(hats_loc[0].shape)
+
+        fn = shard_map(
+            combine, mesh=self.mesh,
+            in_specs=(P(None, None, None), rep2, spec,
+                      *([spec] * EXT_COSETS)),
+            out_specs=spec, check_vma=False)
+        for u in range(EXT_COSETS):
+            out.append(fn(dp.zc_planes[u], dp.su_planes[u],
+                          self.s_neg_pows, *hats))
+        return out
+
+    def _pointwise_mul(self, x, packed16):
+        spec = _shard_spec(self.axis)
+
+        def kernel(a, b16):
+            flat = f2.mont_mul(_as_flat(a), _unpack_flat(b16))
+            return flat.reshape(a.shape)
+
+        fn = shard_map(kernel, mesh=self.mesh, in_specs=(spec, spec),
+                       out_specs=spec, check_vma=False)
+        return fn(x, packed16)
+
+    def gather(self, x: jnp.ndarray) -> jnp.ndarray:
+        """(L, A, B) sharded → (L, n) single-device (test convenience)."""
+        return jnp.asarray(x).reshape(L, self.A * self.B)
+
+
+def _as_flat(x3):
+    """(K, A, Bd) block → (K, A·Bd) flat planes (unpacking uint16)."""
+    flat = x3.reshape(x3.shape[0], -1)
+    if flat.dtype == jnp.uint16:
+        return f2.unpack16(flat)
+    return flat
+
+
+def _unpack_flat(x3):
+    return f2.unpack16(x3.reshape(16, -1))

@@ -1098,9 +1098,11 @@ def prove_fast_tpu(params: KZGParams, pk: FastProvingKey,
     # MSM call releases the GIL, so the downloader thread streams chunk
     # u+1 through the tunnel while chunk u commits)
     with trace.span("prove_tpu.r3_top_check"):
-        top = ptpu.download_std(t_coeff_chunks[QUOTIENT_CHUNKS])
+        # device-side zero check: one scalar over the wire, not a chunk
+        top_max = int(np.asarray(
+            ptpu._is_zero_poly(t_coeff_chunks[QUOTIENT_CHUNKS])))
         t_coeff_chunks[QUOTIENT_CHUNKS] = None
-        if top.any():
+        if top_max != 0:
             raise EigenError(
                 "proving_error",
                 "quotient degree overflow — witness does not satisfy "

@@ -64,6 +64,15 @@ def _to_u64_ready(x):
 
 
 @jax.jit
+def _is_zero_poly(x):
+    """Device-side all-zero check of a coefficient array — the quotient
+    degree gate downloads ONE int32 instead of a 32 MB chunk."""
+    if x.dtype == jnp.uint16:
+        x = f2.unpack16(x)
+    return jnp.max(f2.canonical(f2.exit_mont(x)))
+
+
+@jax.jit
 def _to_u16_wire(x):
     """Device side of ``download_std``: canonical standard-form value
     packed to (16, n) uint16 — 32 MB per 2^20 column on the wire
@@ -202,36 +211,19 @@ _CH_ALPHA = 3
 _CH_BSHIFT = 11
 
 
-@partial(jax.jit, static_argnames=("A", "B"))
-def _quotient_chunk_impl(wires, z_e, m_e, phi_e, pi_e, uv_e, fixed16,
-                         sigma16, xs16, l016, ch, zh_inv_plane,
-                         A: int, B: int):
-    """z-split quotient identity on coset chunk j (zk/plonk.py round 3;
-    exact twin of the C++ ``quotient_eval2``). xs/l0 arrive packed
-    uint16. ``wires``/``uv_e``/``fixed16``/``sigma16`` are TUPLES of
-    per-poly arrays — a stacked operand would copy ~GBs of resident
-    packed tables through HBM on every chunk dispatch. Witness entries
-    may arrive packed uint16 (the pre-dispatched ext-chunk path)."""
-    n = A * B
+def quotient_pointwise(w, zi, zwi, mi, phii, phiwi, pii, uv, fx, sg, xs,
+                       l0, ch, zh_inv_plane):
+    """The z-split quotient identity as PURE POINTWISE limb-plane math —
+    every input already unpacked/rolled (lists of (L, m) planes). This
+    is the single home of the identity for the single-chip kernel below
+    AND the sharded prover (parallel/prover.py), whose per-shard slices
+    feed exactly this function inside shard_map."""
+    n = w[0].shape[-1]
 
     def cc(idx):
         return jnp.broadcast_to(ch[:, idx : idx + 1], (L, n))
 
     one = f2._const_planes(_mont(1), n)
-    xs = f2.unpack16(xs16)
-    l0 = f2.unpack16(l016)
-    fx = [f2.unpack16(fixed16[i]) for i in range(9)]
-    sg = [f2.unpack16(sigma16[i]) for i in range(6)]
-    w = [_as_planes(wires[i]) for i in range(6)]
-    uv = [_as_planes(uv_e[i]) for i in range(4)]
-    z_e = _as_planes(z_e)
-    m_e = _as_planes(m_e)
-    phi_e = _as_planes(phi_e)
-    pi_e = _as_planes(pi_e)
-    zi, phii, mi, pii = z_e, phi_e, m_e, pi_e
-    zwi = _fs_roll_next(zi, A, B)
-    phiwi = _fs_roll_next(phii, A, B)
-
     gate = f2.mont_mul(fx[0], w[0])
     for kk in range(1, 5):
         gate = f2.add(gate, f2.mont_mul(fx[kk], w[kk]))
@@ -274,6 +266,33 @@ def _quotient_chunk_impl(wires, z_e, m_e, phi_e, pi_e, uv_e, fixed16,
     total = f2.add(total, f2.mont_mul(c_v1, cc(a + 6)))
     total = f2.add(total, f2.mont_mul(c_v2, cc(a + 7)))
     return f2.mont_mul(total, jnp.broadcast_to(zh_inv_plane, (L, n)))
+
+
+@partial(jax.jit, static_argnames=("A", "B"))
+def _quotient_chunk_impl(wires, z_e, m_e, phi_e, pi_e, uv_e, fixed16,
+                         sigma16, xs16, l016, ch, zh_inv_plane,
+                         A: int, B: int):
+    """z-split quotient identity on coset chunk j (zk/plonk.py round 3;
+    exact twin of the C++ ``quotient_eval2``): unpack + FS rolls, then
+    the shared pointwise core. xs/l0 arrive packed uint16.
+    ``wires``/``uv_e``/``fixed16``/``sigma16`` are TUPLES of per-poly
+    arrays — a stacked operand would copy ~GBs of resident packed
+    tables through HBM on every chunk dispatch. Witness entries may
+    arrive packed uint16 (the pre-dispatched ext-chunk path)."""
+    xs = f2.unpack16(xs16)
+    l0 = f2.unpack16(l016)
+    fx = [f2.unpack16(fixed16[i]) for i in range(9)]
+    sg = [f2.unpack16(sigma16[i]) for i in range(6)]
+    w = [_as_planes(wires[i]) for i in range(6)]
+    uv = [_as_planes(uv_e[i]) for i in range(4)]
+    zi = _as_planes(z_e)
+    mi = _as_planes(m_e)
+    phii = _as_planes(phi_e)
+    pii = _as_planes(pi_e)
+    zwi = _fs_roll_next(zi, A, B)
+    phiwi = _fs_roll_next(phii, A, B)
+    return quotient_pointwise(w, zi, zwi, mi, phii, phiwi, pii, uv, fx,
+                              sg, xs, l0, ch, zh_inv_plane)
 
 
 # --- streaming quotient (large k: the 15 packed fixed/sigma ext-chunk

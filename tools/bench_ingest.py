@@ -1,0 +1,181 @@
+"""Ingest throughput on the real chip (VERDICT r3 ask #3).
+
+Measures the batched attestation-ingest kernels — one Poseidon hash +
+one recovery Strauss ladder + one verification ladder per attestation
+(``client/ingest.py`` → ``ops/poseidon_batch.py`` / ``ops/secp_batch.py``)
+— at scale, with synthetic but CRYPTOGRAPHICALLY VALID signatures:
+
+- generation (untimed): random opinions signed with real low-s ECDSA,
+  the nonce muls R = k·G batched through the same Strauss ladder so
+  10M-attestation fixtures are feasible (one k·G per attestation is
+  the cost signing fundamentally has);
+- timed region per chunk: attestation Poseidon hashes + recover_batch
+  + verify_batch, i.e. exactly what ``Client.et_circuit_setup`` pays
+  per attestation on the scalar path
+  (reference hot spot: eigentrust/src/attestation.rs:215 →
+  ecdsa/native.rs:298-331);
+- the first 64 recoveries are asserted equal to the scalar-path
+  ``recover_public_key`` results (correctness gate on the fixture AND
+  the kernels).
+
+Prints one JSON line: {"n": ..., "att_per_s": ..., ...}.
+
+Usage:  python tools/bench_ingest.py [--n 1048576] [--chunk 524288]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+import numpy as np  # noqa: E402
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--n", type=int, default=1 << 20)
+    ap.add_argument("--chunk", type=int, default=1 << 19)
+    ap.add_argument("--signers", type=int, default=256)
+    ap.add_argument("--no-verify", action="store_true",
+                    help="skip the verification ladder (recover only)")
+    args = ap.parse_args()
+    os.chdir(REPO)
+    try:
+        import jax
+
+        jax.config.update("jax_compilation_cache_dir",
+                          os.path.join(REPO, "bench_cache", "zk",
+                                       "xla_cache"))
+        jax.config.update("jax_persistent_cache_min_compile_time_secs",
+                          1.0)
+    except Exception:
+        pass
+
+    from protocol_tpu.crypto.secp256k1 import (SECP256K1_N as N_ORD,
+                                               EcdsaKeypair, Signature,
+                                               recover_public_key)
+    from protocol_tpu.models.eigentrust import HASHER_WIDTH
+    from protocol_tpu.ops import secp_batch as sb
+    from protocol_tpu.ops.poseidon_batch import get_poseidon_batch
+    import jax.numpy as jnp
+
+    rng = np.random.default_rng(4096)
+    keys = [EcdsaKeypair(int(rng.integers(1, 2**62)))
+            for _ in range(args.signers)]
+    privs = [kp.private_key for kp in keys]
+    pb = get_poseidon_batch(width=HASHER_WIDTH)
+
+    n = args.n
+    chunk = min(args.chunk, n)
+    border = (N_ORD - 1) * pow(2, -1, N_ORD) % N_ORD
+
+    def batch_inv_mod_n(vals):
+        """Montgomery-trick batch inversion over python ints."""
+        pre = [1] * (len(vals) + 1)
+        for i, v in enumerate(vals):
+            pre[i + 1] = pre[i] * v % N_ORD
+        inv = pow(pre[-1], -1, N_ORD)
+        out = [0] * len(vals)
+        for i in range(len(vals) - 1, -1, -1):
+            out[i] = inv * pre[i] % N_ORD
+            inv = inv * vals[i] % N_ORD
+        return out
+
+    t_gen = 0.0
+    t_hash = 0.0
+    t_recover = 0.0
+    t_verify = 0.0
+    done = 0
+    first_check = True
+    zeros_pl = None
+    while done < n:
+        c = min(chunk, n - done)
+        # --- generation (untimed vs the ingest measurement) -----------
+        g0 = time.perf_counter()
+        rows = np.stack([
+            rng.integers(1, 1 << 160, c).astype(object),  # about
+            np.full(c, 42, dtype=object),                 # domain
+            rng.integers(1, 256, c).astype(object),       # value
+            np.zeros(c, dtype=object),                    # message
+        ], axis=1)
+        rows_l = [[int(v) for v in row] for row in rows]
+        msgs = [int(h) for h in pb.hash_batch(rows_l)]
+        ks = [int(x) for x in rng.integers(1, 2**62, c)]
+        signer_idx = rng.integers(0, args.signers, c)
+        # R = k·G through the batched ladder (u2 = 0 never selects Q)
+        k_pl = jnp.asarray(sb.to_limbs(ks))
+        if zeros_pl is None or zeros_pl.shape[0] != c:
+            zeros_pl = jnp.zeros_like(k_pl)
+        dummy_q = (sb._const_mont(sb.CTX_P, 1, c),
+                   sb._const_mont(sb.CTX_P, 2, c))
+        rpt = sb._strauss(k_pl, zeros_pl, dummy_q)
+        rx, ry = sb._to_affine(sb.CTX_P, rpt)
+        rx = sb.from_limbs(np.asarray(sb.from_mont(sb.CTX_P, rx)))
+        ry = sb.from_limbs(np.asarray(sb.from_mont(sb.CTX_P, ry)))
+        k_invs = batch_inv_mod_n(ks)
+        rs, ss, recs = [], [], []
+        for i in range(c):
+            r = int(rx[i]) % N_ORD
+            s = k_invs[i] * (msgs[i] + r * privs[signer_idx[i]]) % N_ORD
+            rec = int(ry[i]) & 1
+            if s >= border:  # low-s normalization, parity flip
+                s = N_ORD - s
+                rec ^= 1
+            rs.append(r)
+            ss.append(s)
+            recs.append(rec)
+        t_gen += time.perf_counter() - g0
+
+        # --- timed ingest: hash + recover (+ verify) ------------------
+        h0 = time.perf_counter()
+        msgs_t = [int(h) for h in pb.hash_batch(rows_l)]
+        t_hash += time.perf_counter() - h0
+        r0 = time.perf_counter()
+        xs, ys, valid = sb.recover_batch(rs, ss, recs, msgs_t)
+        t_recover += time.perf_counter() - r0
+        if not args.no_verify:
+            v0 = time.perf_counter()
+            ok = sb.verify_batch(rs, ss, msgs_t, list(zip(xs, ys)))
+            t_verify += time.perf_counter() - v0
+            valid = valid & ok
+        assert valid.all(), f"{int((~valid).sum())} invalid lanes"
+
+        if first_check:  # scalar-path oracle on the first 64
+            for i in range(min(64, c)):
+                pk = recover_public_key(
+                    Signature(rs[i], ss[i], recs[i]), msgs_t[i])
+                assert (int(xs[i]), int(ys[i])) == (
+                    pk.point.x, pk.point.y), f"lane {i} diverges"
+                assert pk.point == keys[signer_idx[i]].public_key.point
+            first_check = False
+        done += c
+        print(f"  {done}/{n} "
+              f"(hash {t_hash:.1f}s recover {t_recover:.1f}s "
+              f"verify {t_verify:.1f}s gen {t_gen:.1f}s)",
+              file=sys.stderr, flush=True)
+
+    ingest_s = t_hash + t_recover + t_verify
+    out = {
+        "metric": "ingest_att_per_s",
+        "n": n,
+        "chunk": chunk,
+        "hash_s": round(t_hash, 2),
+        "recover_s": round(t_recover, 2),
+        "verify_s": round(t_verify, 2),
+        "ingest_s": round(ingest_s, 2),
+        "att_per_s": round(n / ingest_s, 1),
+        "gen_s": round(t_gen, 2),
+        "verify_included": not args.no_verify,
+    }
+    print(json.dumps(out), flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
