@@ -96,6 +96,9 @@ class ShardedRound3:
         self.we_neg_pows = [place(t) for t in dp.we_neg_pows]
         self.s_neg_pows = place(dp.s_neg_pows)
         self.plan = dp.plan
+        # jitted shard_map callables, built once per instance: a fresh
+        # closure per call would re-trace and re-compile every dispatch
+        self._fns: dict = {}
 
     def shard(self, x: jnp.ndarray) -> jnp.ndarray:
         """Place a (·, n) device array into the mesh sharding."""
@@ -173,13 +176,15 @@ class ShardedRound3:
             chunk = f2.mont_mul_const(chunk, f2.R_MONT)
             return chunk.reshape(L, A, Bd)
 
-        rep = P(None, None, None)
-        spec = _shard_spec(self.axis)
-        fn = shard_map(
-            kernel, mesh=self.mesh,
-            in_specs=(spec, spec, spec, rep, rep, rep,
-                      P(None, None), P(None, None)),
-            out_specs=spec, check_vma=False)
+        fn = self._fns.get(("ext", nb))
+        if fn is None:
+            rep = P(None, None, None)
+            spec = _shard_spec(self.axis)
+            fn = self._fns[("ext", nb)] = jax.jit(shard_map(
+                kernel, mesh=self.mesh,
+                in_specs=(spec, spec, spec, rep, rep, rep,
+                          P(None, None), P(None, None)),
+                out_specs=spec, check_vma=False))
         return fn(coeffs, self.coset_pows[j], self.xs_fs[j],
                   self.plan.W_A, self.plan.W_B, self.plan.T16,
                   dp.zh_planes[j], bp)
@@ -210,13 +215,15 @@ class ShardedRound3:
         dp = self.dp
         fixed = [self._reshard_table(dp.fixed_ext[i][j]) for i in range(9)]
         sigma = [self._reshard_table(dp.sigma_ext[i][j]) for i in range(6)]
-        rep2 = P(None, None)
-        spec = _shard_spec(self.axis)
-        fn = shard_map(
-            kernel, mesh=self.mesh,
-            in_specs=(spec, spec, rep2, rep2,
-                      *([spec] * (4 + 25))),
-            out_specs=spec, check_vma=False)
+        fn = self._fns.get("quot")
+        if fn is None:
+            rep2 = P(None, None)
+            spec = _shard_spec(self.axis)
+            fn = self._fns["quot"] = jax.jit(shard_map(
+                kernel, mesh=self.mesh,
+                in_specs=(spec, spec, rep2, rep2,
+                          *([spec] * (4 + 25))),
+                out_specs=spec, check_vma=False))
         return fn(self.xs_fs[j], self.l0_fs[j], ch_planes,
                   dp.zh_inv_planes[j], z_e, phi_e, m_e, pi_e,
                   *wires_e, *uv_e, *fixed, *sigma)
@@ -268,12 +275,14 @@ class ShardedRound3:
                 out, jnp.broadcast_to(n_inv_plane, out.shape))
             return out.reshape(L, A, Bd)
 
-        rep = P(None, None, None)
-        spec = _shard_spec(self.axis)
-        fn = shard_map(
-            kernel, mesh=self.mesh,
-            in_specs=(spec, rep, rep, rep, P(None, None)),
-            out_specs=spec, check_vma=False)
+        fn = self._fns.get("intt")
+        if fn is None:
+            rep = P(None, None, None)
+            spec = _shard_spec(self.axis)
+            fn = self._fns["intt"] = jax.jit(shard_map(
+                kernel, mesh=self.mesh,
+                in_specs=(spec, rep, rep, rep, P(None, None)),
+                out_specs=spec, check_vma=False))
         return fn(z, plan.W_A, plan.W_B, plan.T16_inv, n_inv)
 
     def intt_ext(self, t_chunks: list) -> list:
@@ -300,25 +309,30 @@ class ShardedRound3:
             acc = f2.mont_mul(acc, jnp.broadcast_to(su_u, (L, nloc)))
             return acc.reshape(hats_loc[0].shape)
 
-        fn = shard_map(
-            combine, mesh=self.mesh,
-            in_specs=(P(None, None, None), rep2, spec,
-                      *([spec] * EXT_COSETS)),
-            out_specs=spec, check_vma=False)
+        fn = self._fns.get("combine")
+        if fn is None:
+            fn = self._fns["combine"] = jax.jit(shard_map(
+                combine, mesh=self.mesh,
+                in_specs=(P(None, None, None), rep2, spec,
+                          *([spec] * EXT_COSETS)),
+                out_specs=spec, check_vma=False))
         for u in range(EXT_COSETS):
             out.append(fn(dp.zc_planes[u], dp.su_planes[u],
                           self.s_neg_pows, *hats))
         return out
 
     def _pointwise_mul(self, x, packed16):
-        spec = _shard_spec(self.axis)
+        fn = self._fns.get("pmul")
+        if fn is None:
+            spec = _shard_spec(self.axis)
 
-        def kernel(a, b16):
-            flat = f2.mont_mul(_as_flat(a), _unpack_flat(b16))
-            return flat.reshape(a.shape)
+            def kernel(a, b16):
+                flat = f2.mont_mul(_as_flat(a), _unpack_flat(b16))
+                return flat.reshape(a.shape)
 
-        fn = shard_map(kernel, mesh=self.mesh, in_specs=(spec, spec),
-                       out_specs=spec, check_vma=False)
+            fn = self._fns["pmul"] = jax.jit(shard_map(
+                kernel, mesh=self.mesh, in_specs=(spec, spec),
+                out_specs=spec, check_vma=False))
         return fn(x, packed16)
 
     def gather(self, x: jnp.ndarray) -> jnp.ndarray:
