@@ -32,6 +32,7 @@ are bit-identical to the host path (tested)."""
 
 from __future__ import annotations
 
+import os
 from functools import partial
 
 import numpy as np
@@ -498,9 +499,12 @@ class DeviceProver:
         # resident packed ext chunks are a speed/HBM trade: ~1.9 GB at
         # k=20, ~3.9 GB at k=21 post-z-split. k=21 resident is now
         # plausible on a 16 GB chip but unmeasured — default stays
-        # k ≤ 20 until the flagship HBM headroom is confirmed
-        self.ext_resident = (k <= 20 if ext_resident is None
-                             else ext_resident)
+        # k ≤ 20 until the flagship HBM headroom is confirmed.
+        # PTPU_EXT_RESIDENT={0,1} overrides for measurement runs.
+        if ext_resident is None:
+            env = os.environ.get("PTPU_EXT_RESIDENT")
+            ext_resident = (env == "1") if env in ("0", "1") else k <= 20
+        self.ext_resident = ext_resident
         # pre-compile the upload/download programs at the working shape
         # BEFORE the heavy jit battery: the remote worker has repeatedly
         # faulted when the download program compiles after dozens of
