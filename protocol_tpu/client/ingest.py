@@ -47,9 +47,9 @@ def attestation_hashes_batch(attestations: Sequence) -> list:
     (``Attestation.hash``: Poseidon_5(about, domain, value, message, 0)).
     Padded to the same power-of-two bucket as the recovery ladder so the
     permutation compile is shared across nearby batch sizes."""
-    from ..ops.poseidon_batch import get_poseidon_batch
+    from ..ops.poseidon_batch import get_poseidon_batch_planes
 
-    pb = get_poseidon_batch(width=HASHER_WIDTH)
+    pb = get_poseidon_batch_planes(HASHER_WIDTH)
     rows = []
     for signed in attestations:
         att = signed.attestation.to_scalar()

@@ -146,3 +146,131 @@ class PoseidonBatch:
         return [row[0] for row in self.permute(padded)]
 
 
+
+
+# --- limb-plane engine variant (fieldops2) ---------------------------------
+
+@lru_cache(maxsize=2)
+def get_poseidon_batch_planes(width: int = DEFAULT_WIDTH
+                              ) -> "PoseidonBatchPlanes":
+    return PoseidonBatchPlanes(width)
+
+
+class PoseidonBatchPlanes:
+    """Hades permutation on the (L, n) limb-plane engine
+    (``ops.fieldops2`` — the prover pipeline's arithmetic), Fr only.
+
+    The row-engine ``PoseidonBatch`` above measures ~1 ms/hash on the
+    chip (the (n, L) layout burns VPU lanes and its CIOS loops
+    materialize state through HBM per limb step); this twin keeps the
+    state as width contiguous (L, n) lane blocks and runs ~20x faster
+    at ingest batch sizes — it is what ``client/ingest.py`` ships.
+    Bit-exact against ``crypto.poseidon`` (tested)."""
+
+    def __init__(self, width: int = DEFAULT_WIDTH):
+        from . import fieldops2 as f2
+
+        self.f2 = f2
+        self.width = width
+        self.modulus = f2.P
+        rc, mds, full_rounds, partial_rounds = poseidon_params(
+            width, f2.P)
+        self.full_rounds = full_rounds
+        self.partial_rounds = partial_rounds
+        R_ = f2.R_MONT
+        P_ = f2.P
+
+        def cplane(v):
+            return f2.ints_to_planes([v * R_ % P_])
+
+        total = full_rounds + partial_rounds
+        self.rc_planes = jnp.asarray(np.stack([
+            np.stack([cplane(rc[r * width + i]) for i in range(width)])
+            for r in range(total)
+        ]))  # (rounds, w, L, 1)
+        self.mds_planes = jnp.asarray(np.stack([
+            np.stack([cplane(mds[i][j]) for j in range(width)])
+            for i in range(width)
+        ]))  # (w, w, L, 1)
+
+    @partial(jax.jit, static_argnames=("self",))
+    def permute_mont(self, state: jnp.ndarray) -> jnp.ndarray:
+        """(L, w·n) Montgomery planes (lane blocks) → same, permuted."""
+        f2 = self.f2
+        w = self.width
+        L = f2.L
+        n = state.shape[1] // w
+        half = self.full_rounds // 2
+        mm = f2.mont_mul_compact
+
+        def lane(s, i):
+            return lax.dynamic_slice_in_dim(s, i * n, n, axis=1)
+
+        def sbox(x):
+            x2 = mm(x, x)
+            return mm(mm(x2, x2), x)
+
+        def add_rc(s, r):
+            rc = lax.dynamic_index_in_dim(self.rc_planes, r,
+                                          keepdims=False)  # (w, L, 1)
+            tiled = jnp.concatenate(
+                [jnp.broadcast_to(rc[i], (L, n)) for i in range(w)],
+                axis=1)
+            return f2.ripple(s + tiled, passes=1)
+
+        def mds_apply(s):
+            outs = []
+            for i in range(w):
+                acc = None
+                for j in range(w):
+                    term = mm(lane(s, j), jnp.broadcast_to(
+                        self.mds_planes[i, j], (L, n)))
+                    acc = term if acc is None else f2.ripple(acc + term, 1)
+                outs.append(acc)
+            return jnp.concatenate(outs, axis=1)
+
+        def full_round(r, s):
+            s = add_rc(s, r)
+            return mds_apply(sbox(s))
+
+        def partial_round(r, s):
+            s = add_rc(s, r)
+            s0 = sbox(lane(s, 0))
+            s = lax.dynamic_update_slice_in_dim(s, s0, 0, axis=1)
+            return mds_apply(s)
+
+        state = lax.fori_loop(0, half, full_round, state)
+        state = lax.fori_loop(half, half + self.partial_rounds,
+                              partial_round, state)
+        state = lax.fori_loop(half + self.partial_rounds,
+                              self.full_rounds + self.partial_rounds,
+                              full_round, state)
+        return state
+
+    def hash_batch(self, inputs) -> list:
+        """Batch of ≤width tuples → lane-0 digests (ints); the ingest
+        hot path. Host↔device conversion rides fieldops2's vectorized
+        u64 pack (the (n, L) engine's per-int python loops were ~2 s
+        per 32k batch on their own)."""
+        f2 = self.f2
+        w = self.width
+        n = len(inputs)
+        P_, R_ = f2.P, f2.R_MONT
+        # lane-major blocks, Montgomery form on host (one python mul
+        # per value; values are small ints for attestation rows)
+        blocks = np.zeros((n * w, 4), dtype="<u8")
+        flat_idx = 0
+        for i in range(w):
+            for row in inputs:
+                v = int(row[i]) if i < len(row) else 0
+                blocks[flat_idx] = np.frombuffer(
+                    (v % P_ * R_ % P_).to_bytes(32, "little"), dtype="<u8")
+                flat_idx += 1
+        planes = jnp.asarray(f2.pack_u64(blocks).astype(np.int32))
+        out = self.permute_mont(planes)
+        digest = lax.dynamic_slice_in_dim(out, 0, n, axis=1)
+        ready = f2._pack16_slices(f2.canonical(
+            jax.jit(f2.exit_mont)(digest)))
+        host = np.ascontiguousarray(np.asarray(ready).T).view("<u8")
+        return [int.from_bytes(host[i].tobytes(), "little")
+                for i in range(n)]
