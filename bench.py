@@ -26,6 +26,7 @@ bandwidth, not the kernel). Median of 3.
 
 import argparse
 import json
+import os
 import sys
 import time
 from pathlib import Path
@@ -43,6 +44,10 @@ def _fmt_peers(n: int) -> str:
 
 def main():
     parser = argparse.ArgumentParser()
+    parser.add_argument("--ingest", action="store_true",
+                        help="measure the batched attestation-ingest "
+                             "kernels instead of converge (delegates to "
+                             "tools/bench_ingest.py; --n = attestations)")
     parser.add_argument("--n", type=int, default=10_000_000, help="peers")
     parser.add_argument("--m", type=int, default=8, help="BA attachment degree")
     parser.add_argument("--tol", type=float, default=1e-6)
@@ -54,6 +59,17 @@ def main():
     parser.add_argument("--cache-dir", default="bench_cache",
                         help="routed-operator cache ('' disables)")
     args = parser.parse_args()
+
+    if args.ingest:
+        # chip-measured att/s for hash+recover+verify; 32k chunks are
+        # the largest single ladder dispatch the tunnel worker survives
+        import subprocess
+
+        n_att = args.n if args.n != 10_000_000 else 1 << 20
+        return subprocess.call(
+            [sys.executable, os.path.join(os.path.dirname(
+                os.path.abspath(__file__)), "tools", "bench_ingest.py"),
+             "--n", str(n_att), "--chunk", "32768"])
 
     from protocol_tpu.utils.platform import honor_jax_platforms_env
 

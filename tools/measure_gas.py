@@ -1,0 +1,62 @@
+"""Replayed gas of the generated EVM verifier on a REAL tiny-shape ET
+proof (keccak transcript) — the BASELINE gas row's measurement tool.
+
+Uses the cached k=20 SRS + eval-form pk (bench_cache/zk), proves via
+prove_auto (device path when the chip is visible), generates the Yul
+verifier, and replays the proof through the in-repo EVM under the
+yellow-paper schedule. Prints one JSON line.
+"""
+import json
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+os.chdir(REPO)
+
+
+def main() -> int:
+    try:
+        import jax
+
+        jax.config.update("jax_compilation_cache_dir",
+                          os.path.join(REPO, "bench_cache", "zk",
+                                       "xla_cache"))
+    except Exception:
+        pass
+    from protocol_tpu.zk import api
+    from protocol_tpu.zk import evm, prover_fast as pf
+    from protocol_tpu.zk.kzg import KZGParams
+    from protocol_tpu.zk.yul import YulVM
+
+    params_b = open("bench_cache/zk/params_k20.bin", "rb").read()
+    params = KZGParams.from_bytes(params_b)
+    pk = pf.FastProvingKey.from_bytes(
+        open("bench_cache/zk/pk_et_tiny_k20.fpk2", "rb").read())
+    shape = api.TINY_SHAPE
+    witness, *_ = api._dummy_et_fixture(shape)
+    chips, pubs = api._build_et_circuit(witness, shape)
+    t0 = time.time()
+    proof = pf.prove_auto(params, pk, chips.cs, transcript="keccak")
+    prove_s = time.time() - t0
+    code = evm.gen_evm_verifier_code(params, pk, transcript="keccak")
+    calldata = evm.encode_calldata(pubs, proof)
+    out, gas = YulVM(code).run(calldata)
+    ok = int.from_bytes(out, "big") == 1
+    _, tx_gas = YulVM(code).run_tx(calldata)
+    # poseidon variant for the recursion-parity row
+    proof_p = pf.prove_auto(params, pk, chips.cs, transcript="poseidon")
+    code_p = evm.gen_evm_verifier_code(params, pk, transcript="poseidon")
+    out_p, gas_p = YulVM(code_p).run(evm.encode_calldata(pubs, proof_p))
+    print(json.dumps({
+        "keccak_gas_replayed": gas, "keccak_tx_gas": tx_gas,
+        "accepted": ok, "prove_s": round(prove_s, 1),
+        "poseidon_gas_replayed": gas_p,
+        "poseidon_accepted": int.from_bytes(out_p, "big") == 1,
+    }), flush=True)
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
