@@ -550,6 +550,12 @@ class DeviceProver:
         # streaming mode additionally keeps the pk coefficient arrays
         # PACKED (uint16, half HBM): every consumer kernel unpacks at
         # trace time via _as_planes
+        # pk coefficient columns are stored PACKED in BOTH modes (every
+        # consumer unpacks at trace time via _as_planes): 15 unpacked
+        # (L, n) int32 columns are ~2.8 GB at k=21 — the difference
+        # between resident mode fitting the 16 GB chip and
+        # RESOURCE_EXHAUSTED at init. In resident mode the ext chunks
+        # are built from the unpacked transient before it is dropped.
         self.fixed_coeffs = []
         self.fixed_ext = []
         for a in fixed_evals_u64:
@@ -557,11 +563,10 @@ class DeviceProver:
             cf = self.intt_natural(ev)
             del ev
             if self.ext_resident:
-                self.fixed_coeffs.append(cf)
                 self.fixed_ext.append(
                     [pk16(self.ext_chunk(cf, j)) for j in range(EXT_COSETS)])
-            else:
-                self.fixed_coeffs.append(pk16(cf))
+            self.fixed_coeffs.append(pk16(cf))
+            del cf
         self.sigma_coeffs = []
         self.sigma_ext = []
         for a in sigma_evals_u64:
@@ -569,11 +574,10 @@ class DeviceProver:
             cf = self.intt_natural(ev)
             del ev
             if self.ext_resident:
-                self.sigma_coeffs.append(cf)
                 self.sigma_ext.append(
                     [pk16(self.ext_chunk(cf, j)) for j in range(EXT_COSETS)])
-            else:
-                self.sigma_coeffs.append(pk16(cf))
+            self.sigma_coeffs.append(pk16(cf))
+            del cf
 
         # intt_ext combine tables (packed)
         self.we_neg_pows = [pk16(powers_vector(pow(omega_e, -j, P), n))
