@@ -225,3 +225,38 @@ def test_proving_key_roundtrip(plonk_setup):
     cs2 = _mul_add_circuit(8, 9)
     proof = prove(params, back, cs2)
     assert verify(params, back, cs2.public_values(), proof)
+
+
+def test_plonk_rejects_forged_zsplit_partials(plonk_setup):
+    """Targeted z-split soundness negatives: a partial-product
+    commitment or evaluation that disagrees with its defining
+    constraint (u1 = z·f0·f1 etc., plonk.py round 2c) must fail — both
+    when a uv COMMITMENT point is perturbed (breaks the batched KZG
+    opening) and when a uv EVAL word is perturbed (breaks the quotient
+    identity at ζ)."""
+    from protocol_tpu.zk.plonk import (NUM_PERM_PARTIALS, NUM_WIRES,
+                                       Proof)
+
+    cs, pk, params = plonk_setup
+    proof = prove(params, pk, cs)
+    parsed = Proof.from_bytes(proof)
+    assert len(parsed.uv_commits) == NUM_PERM_PARTIALS
+    assert len(parsed.uv_evals) == NUM_PERM_PARTIALS
+
+    # flip one byte inside each uv commitment point (x coordinate)
+    pt0 = 64 * (NUM_WIRES + 3)  # byte offset of u1's commitment
+    for i in range(NUM_PERM_PARTIALS):
+        bad = bytearray(proof)
+        bad[pt0 + 64 * i + 5] ^= 1
+        assert not verify(params, pk, cs.public_values(), bytes(bad)), i
+
+    # flip one byte inside each uv evaluation word
+    npts = NUM_WIRES + 3 + NUM_PERM_PARTIALS + len(parsed.t_commits)
+    ev0 = 64 * npts + 32 * (NUM_WIRES + 5)
+    for i in range(NUM_PERM_PARTIALS):
+        bad = bytearray(proof)
+        bad[ev0 + 32 * i + 3] ^= 1
+        assert not verify(params, pk, cs.public_values(), bytes(bad)), i
+
+    # round-trip sanity: the untampered proof still verifies
+    assert verify(params, pk, cs.public_values(), proof)
