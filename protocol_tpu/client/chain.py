@@ -17,6 +17,7 @@ contract via ethers-rs abigen, ``eigentrust/src/att_station.rs``):
 from __future__ import annotations
 
 import json
+import time
 import urllib.request
 from dataclasses import dataclass
 
@@ -28,6 +29,42 @@ from ..utils.keccak import keccak256
 EVENT_SIGNATURE = "AttestationCreated(address,address,bytes32,bytes)"
 EVENT_TOPIC = "0x" + keccak256(EVENT_SIGNATURE.encode()).hex()
 ATTEST_SELECTOR = keccak256(b"attest((address,bytes32,bytes)[])")[:4]
+
+
+def _await_deploy_receipt(rpc, txh: str, created: bytes,
+                          receipt_timeout: float = 120.0) -> None:
+    """Poll for a contract-creation receipt and validate it.
+
+    Without this, a rejected creation surfaces much later as reads
+    against a missing contract (eth_call returns 0x — e.g. a valid
+    proof misreported as rejected). Real nodes return null until the
+    tx is mined — poll up to receipt_timeout (default covers several
+    ~12 s blocks; raise it for congested networks); the mock devnet
+    mines synchronously, so the first poll hits. A timeout is reported
+    as 'possibly still pending', distinct from an executed-and-failed
+    (status != 0x1) deploy, so callers don't blindly re-deploy and pay
+    gas twice."""
+    deadline = time.monotonic() + receipt_timeout
+    while True:
+        receipt = rpc("eth_getTransactionReceipt", [txh])
+        if receipt or time.monotonic() >= deadline:
+            break
+        time.sleep(min(2.0, max(0.1, receipt_timeout / 60)))
+    if not receipt:
+        raise EigenError(
+            "transaction_error",
+            f"no deploy receipt for {txh} after {receipt_timeout:.0f}s; "
+            "the creation tx may still be pending — do not re-send "
+            "without checking the nonce")
+    if receipt.get("status") != "0x1":
+        raise EigenError(
+            "transaction_error",
+            f"contract deploy reverted (receipt={receipt!r})")
+    got = receipt.get("contractAddress")
+    if got and bytes.fromhex(got.removeprefix("0x")) != created:
+        raise EigenError(
+            "transaction_error",
+            f"deploy address mismatch: {got} != 0x{created.hex()}")
 
 
 @dataclass
@@ -240,8 +277,9 @@ class RpcChain(AttestationStation):
             data=creation_bytecode(),
             chain_id=chain_id,
         )
-        chain.rpc("eth_sendRawTransaction", ["0x" + raw.hex()])
+        txh = chain.rpc("eth_sendRawTransaction", ["0x" + raw.hex()])
         created = keccak256(rlp_encode([sender_b, nonce]))[12:]
+        _await_deploy_receipt(chain.rpc, txh, created)
         chain.contract_address = created
         return chain
 
@@ -304,8 +342,8 @@ class VerifierContract:
 
     @classmethod
     def deploy_signed(cls, node_url: str, keypair, yul_source: str,
-                      chain_id: int = 31337,
-                      gas: int = 10_000_000) -> "VerifierContract":
+                      chain_id: int = 31337, gas: int = 10_000_000,
+                      receipt_timeout: float = 120.0) -> "VerifierContract":
         from .eth import address_from_public_key, rlp_encode, sign_legacy_tx
 
         probe = cls(node_url, b"\x00" * 20, chain_id)
@@ -318,8 +356,9 @@ class VerifierContract:
             to=b"", value=0, data=yul_source.encode("utf-8"),
             chain_id=chain_id,
         )
-        probe.rpc("eth_sendRawTransaction", ["0x" + raw.hex()])
+        txh = probe.rpc("eth_sendRawTransaction", ["0x" + raw.hex()])
         created = keccak256(rlp_encode([sender_b, nonce]))[12:]
+        _await_deploy_receipt(probe.rpc, txh, created, receipt_timeout)
         return cls(node_url, created, chain_id)
 
     def verify(self, calldata: bytes) -> bool:

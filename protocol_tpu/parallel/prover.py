@@ -213,8 +213,19 @@ class ShardedRound3:
             return out.reshape(z_loc.shape)
 
         dp = self.dp
-        fixed = [self._reshard_table(dp.fixed_ext[i][j]) for i in range(9)]
-        sigma = [self._reshard_table(dp.sigma_ext[i][j]) for i in range(6)]
+        if not dp.ext_resident:
+            raise ValueError(
+                "quotient_chunk needs a resident-mode DeviceProver: in "
+                "streaming mode fixed_ext/sigma_ext are not "
+                "materialized, so there are no pk tables to reshard. "
+                "Construct the DeviceProver with ext_resident=True "
+                "(each shard holds n/D lanes, so the resident tables "
+                "that exceed one chip fit the mesh); the ext/intt "
+                "stages work in either mode.")
+        fixed = [self._reshard_table(("fixed", i, j), dp.fixed_ext[i][j])
+                 for i in range(9)]
+        sigma = [self._reshard_table(("sigma", i, j), dp.sigma_ext[i][j])
+                 for i in range(6)]
         fn = self._fns.get("quot")
         if fn is None:
             rep2 = P(None, None)
@@ -228,17 +239,19 @@ class ShardedRound3:
                   dp.zh_inv_planes[j], z_e, phi_e, m_e, pi_e,
                   *wires_e, *uv_e, *fixed, *sigma)
 
-    _table_cache: dict
-
-    def _reshard_table(self, packed16) -> jnp.ndarray:
+    def _reshard_table(self, key, packed16) -> jnp.ndarray:
+        # keyed by (table_kind, column, chunk); each entry pins a strong
+        # reference to its source array and re-validates with `is`, so a
+        # rebuilt pk table can neither alias a recycled id() nor hit a
+        # stale positional entry — it just re-uploads
         cache = getattr(self, "_tc", None)
         if cache is None:
             cache = self._tc = {}
-        key = id(packed16)
-        out = cache.get(key)
-        if out is None:
-            out = cache[key] = jax.device_put(
-                _grid(packed16, self.A, self.B), self._sh)
+        hit = cache.get(key)
+        if hit is not None and hit[0] is packed16:
+            return hit[1]
+        out = jax.device_put(_grid(packed16, self.A, self.B), self._sh)
+        cache[key] = (packed16, out)
         return out
 
     def intt_chunk(self, z: jnp.ndarray) -> jnp.ndarray:

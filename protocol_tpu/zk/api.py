@@ -366,10 +366,21 @@ def _dummy_snark_path(digest: bytes, shape: CircuitShape) -> str | None:
     return os.path.join(d, f"th_inner_dummy_{tag}.bin")
 
 
-def _load_dummy_snark(params: bytes, digest, shape: CircuitShape):
+def _load_dummy_snark(params: bytes, digest, shape: CircuitShape,
+                      expect=None):
     """(et_pk_obj, et_pubs, et_proof) from the disk cache, or None.
     The cached proof is re-verified under these params before use —
-    a stale or corrupt cache falls through to the fresh path."""
+    a stale or corrupt cache falls through to the fresh path.
+
+    ``expect=(addrs, scores, domain)`` (the fixture ``generate_th_pk``
+    computes anyway) cross-checks the cache against the circuit it is
+    supposed to encode: the verify alone is self-referential (proof vs a vk
+    parsed from the SAME cached bytes), so a tampered-but-consistent
+    file would silently swap the inner circuit the Threshold pk is
+    keygen'd for and only surface later as an opaque prove failure.
+    The ET public-input layout is participants ‖ scores ‖ domain ‖
+    op-hash (eigentrust_circuit.py build), so the prefix is natively
+    recomputable without a circuit build."""
     path = _dummy_snark_path(digest, shape)
     if path is None or not os.path.exists(path):
         return None
@@ -384,9 +395,20 @@ def _load_dummy_snark(params: bytes, digest, shape: CircuitShape):
         pubs = [int(v) for v in header["pubs"]]
         from .plonk import verify
 
-        if not verify(_load_params_verifier(params), _load_vk(pk_bytes),
-                      pubs, proof):
+        vk = _load_vk(pk_bytes)
+        if not verify(_load_params_verifier(params), vk, pubs, proof):
             return None
+        if expect is not None:
+            addrs, scores, domain = expect
+            n = shape.num_neighbours
+            ok = (len(pubs) == 2 * n + 2
+                  and pubs[:n] == [int(a) for a in addrs]
+                  and pubs[n:2 * n] == [int(s) for s in scores]
+                  and pubs[2 * n] == int(domain)
+                  and vk.lookup_bits == shape.lookup_bits
+                  and len(vk.public_rows) == len(pubs))
+            if not ok:
+                return None
         return _load_pk(pk_bytes), pubs, proof
     except Exception:
         return None
@@ -460,16 +482,16 @@ def generate_th_pk(params: bytes, shape: CircuitShape = DEFAULT_SHAPE) -> bytes:
     p = _load_params(params)
     digest = _params_digest(params)
     cache_key = (digest, shape)
-    cached = _load_dummy_snark(params, digest, shape)
+    witness, addrs, scores, ratios = _dummy_et_fixture(shape)
+    cached = _load_dummy_snark(params, digest, shape,
+                               expect=(addrs, scores, witness.domain))
     if cached is not None:
         et_pk, et_pubs, et_proof = cached
         _INNER_ET_PK_CACHE.clear()
         _INNER_ET_PK_CACHE[cache_key] = et_pk
-        witness, addrs, _, ratios = _dummy_et_fixture(shape)
         chips, _ = _build_th_circuit(et_pk, et_pubs, et_proof, addrs[0],
                                      Fr(1), ratios[0], shape)
         return _keygen(p, chips.cs).to_bytes()
-    witness, addrs, _, ratios = _dummy_et_fixture(shape)
     et_chips, et_pubs = _build_et_circuit(witness, shape)
     et_pk = _inner_et_keygen(p, et_chips.cs, cache_key)
     et_proof = _prove(p, et_pk, et_chips.cs)
