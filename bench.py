@@ -61,8 +61,9 @@ def main():
     args = parser.parse_args()
 
     if args.ingest:
-        # chip-measured att/s for hash+recover+verify; 32k chunks are
-        # the largest single ladder dispatch the tunnel worker survives
+        # chip-measured att/s for hash + binding-checked GLV recovery;
+        # 32k chunks are the largest single ladder dispatch the tunnel
+        # worker survives (tools/probe_lane_crash.py canary)
         import subprocess
 
         n_att = args.n if args.n != 10_000_000 else 1 << 20

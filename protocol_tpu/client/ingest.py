@@ -8,10 +8,13 @@ module replaces that per-attestation loop with two device dispatches:
 
 1. all attestation hashes in one batched Poseidon permutation
    (``ops.poseidon_batch``),
-2. all pubkey recoveries in one batched Strauss ladder
-   (``ops.secp_batch``), with an optional batched verification pass
-   replicating the scalar path's recover-then-verify sanity check
-   (``crypto.secp256k1.recover_public_key`` asserts the same).
+2. all pubkey recoveries in one batched GLV + fixed-base-window ladder
+   (``ops.secp_batch``). Validity comes from recovery's own binding
+   checks (r/s range, curve lift, non-∞) — recover⇒verify is an
+   algebraic identity, so the scalar path's second verification ladder
+   is redundant work (the reference keeps it only as a debug assert,
+   ``ecdsa/native.rs:322-328``; equivalence is property-tested, and
+   ``full_verify=True`` re-enables it for audits).
 
 Batches pad to the next power of two so repeated ingests reuse the
 ladder's jit cache instead of retracing per batch size. ``Client``
@@ -60,15 +63,28 @@ def attestation_hashes_batch(attestations: Sequence) -> list:
     return pb.hash_batch(rows)[:k]
 
 
-def recover_signers_batch(attestations: Sequence, check: bool = True):
+def recover_signers_batch(attestations: Sequence,
+                          full_verify: bool = False):
     """Batched twin of per-attestation ``recover_public_key``.
 
     Returns (pub_keys, addresses, valid): recovered ``PublicKey``s,
-    their 20-byte addresses, and a bool mask. ``check=True`` adds the
-    batched verification pass the scalar path asserts (recovered key
-    must verify the signature); lanes failing any stage come back
-    invalid instead of raising — batch ingest must not let one
-    malformed attestation poison the rest.
+    their 20-byte addresses, and a bool mask. Lanes failing any stage
+    come back invalid instead of raising — batch ingest must not let
+    one malformed attestation poison the rest.
+
+    Validity is the binding-check set ``recover_batch`` enforces
+    (r, s ∈ [1, n), r lifts onto the curve, result ≠ ∞) — by
+    construction the recovered key then satisfies the verify equation
+    (R' = z·s⁻¹·G + r·s⁻¹·Q = s⁻¹·(z·G + (s·R − z·G)) = R), so the
+    second full verification ladder the scalar path runs is a
+    re-derivation, not an independent check. The reference itself
+    treats it as a debug-grade sanity assert
+    (``ecdsa/native.rs:322-328``); SURVEY.md §7.3 licenses dropping it
+    with documentation, and the recover⇒verify equivalence is
+    property-tested against the scalar oracle
+    (``tests/test_secp_batch.py::TestRecoverImpliesVerify``).
+    ``full_verify=True`` re-enables the redundant ladder for audits —
+    it must never change the mask (also asserted by that suite).
     """
     from ..ops.secp_batch import recover_batch, verify_batch
 
@@ -91,7 +107,7 @@ def recover_signers_batch(attestations: Sequence, check: bool = True):
     msgs_p = msgs + [1] * pad
     with trace.span("ingest.recover_batch", n=k):
         xs, ys, valid = recover_batch(rs, ss, rec, msgs_p)
-    if check:
+    if full_verify:
         with trace.span("ingest.verify_batch", n=k):
             ok = verify_batch(rs, ss, msgs_p, list(zip(xs, ys)))
         valid = valid & ok

@@ -73,21 +73,51 @@ class FieldCtx:
 # --- host <-> limb conversion ----------------------------------------------
 
 def to_limbs(values) -> np.ndarray:
-    """Python ints → (n, L) int32 limb rows (plain, not Montgomery)."""
-    out = np.zeros((len(values), NUM_LIMBS), dtype=np.int32)
-    for i, v in enumerate(values):
-        v = int(v)
-        for j in range(NUM_LIMBS):
-            out[i, j] = (v >> (LIMB_BITS * j)) & MASK
+    """Python ints → (n, L) int32 limb rows (plain, not Montgomery).
+
+    Fast path: serialize through ``int.to_bytes`` and split 3 bytes →
+    two 12-bit limbs vectorized (the per-int double loop was ~0.6 s per
+    32k×4 ingest chunk — wall-clock at 1M-attestation scale). Values
+    outside [0, 2^264) (never produced by the field paths) fall back to
+    the per-limb masking loop."""
+    vals = [int(v) for v in values]
+    n = len(vals)
+    try:
+        buf = b"".join(v.to_bytes(33, "little") for v in vals)
+    except (OverflowError, ValueError):  # negative or >= 2^264
+        out = np.zeros((n, NUM_LIMBS), dtype=np.int32)
+        for i, v in enumerate(vals):
+            for j in range(NUM_LIMBS):
+                out[i, j] = (v >> (LIMB_BITS * j)) & MASK
+        return out
+    raw = np.frombuffer(buf, dtype=np.uint8).reshape(n, 33)
+    b = raw.reshape(n, 11, 3).astype(np.int32)
+    out = np.empty((n, NUM_LIMBS), dtype=np.int32)
+    out[:, 0::2] = b[:, :, 0] | ((b[:, :, 1] & 0xF) << 8)
+    out[:, 1::2] = (b[:, :, 1] >> 4) | (b[:, :, 2] << 4)
     return out
 
 
 def from_limbs(arr) -> list:
+    """(n, L) limb rows → Python ints (vectorized repack for normalized
+    rows; arbitrary/unnormalized limbs take the exact summation path)."""
     arr = np.asarray(arr)
-    return [
-        sum(int(arr[i, j]) << (LIMB_BITS * j) for j in range(NUM_LIMBS))
-        for i in range(arr.shape[0])
-    ]
+    n = arr.shape[0]
+    if n and ((arr < 0) | (arr > MASK)).any():
+        return [
+            sum(int(arr[i, j]) << (LIMB_BITS * j)
+                for j in range(NUM_LIMBS))
+            for i in range(n)
+        ]
+    b = np.empty((n, 33), dtype=np.uint8)
+    l0 = arr[:, 0::2]
+    l1 = arr[:, 1::2]
+    b[:, 0::3] = l0 & 0xFF
+    b[:, 1::3] = (l0 >> 8) | ((l1 & 0xF) << 4)
+    b[:, 2::3] = l1 >> 4
+    by = b.tobytes()
+    return [int.from_bytes(by[33 * i:33 * (i + 1)], "little")
+            for i in range(n)]
 
 
 # --- carry handling ---------------------------------------------------------

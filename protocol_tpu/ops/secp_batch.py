@@ -24,7 +24,15 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-from ..crypto.secp256k1 import GX, GY, N as SECP_N, P as SECP_P
+from ..crypto.secp256k1 import (
+    GLV_BETA,
+    GX,
+    GY,
+    N as SECP_N,
+    P as SECP_P,
+    AffinePoint,
+    glv_decompose,
+)
 from .fieldops import (
     NUM_LIMBS,
     FieldCtx,
@@ -185,6 +193,206 @@ def _mod_n_plain(x_plain: jnp.ndarray) -> jnp.ndarray:
     return _cond_sub_p(x_plain, CTX_N)
 
 
+# --- GLV + fixed-base-window recovery ladder (round 5) ----------------------
+#
+# The 256-bit Strauss ladder above costs ~29 field muls per bit
+# (double + branchless table add with its nested doubling fallback)
+# ≈ 7.4k muls per lane — two thirds of measured ingest wall. Recovery
+# Q = u1·G + u2·R is restructured the way the circuit path already is
+# (zk/ecdsa_chip.py _glv_mul):
+#
+# - u1·G rides 64 unsigned 4-bit windows into PRECOMPUTED affine tables
+#   T[j][d] = d·16^j·G — zero doublings, 64 mixed adds (~18 muls each);
+# - u2·R splits through the λ-endomorphism (crypto glv_decompose,
+#   host-side Babai: ~2.4 µs/lane) into 129-bit halves riding a joint
+#   2-bit-window ladder over {i·(e1R) + j·(e2λR)} — 65 iterations of
+#   2 doublings + 1 add, plus a 16-entry per-lane table (2 dbl, 11 add).
+#
+# ≈ 3.8k muls per lane, ~0.5× the one-ladder cost; bit-exact against
+# the scalar oracle (tests/test_secp_batch.py).
+
+FB_WINDOW_BITS = 4
+FB_WINDOWS = 64  # 256 bits / 4
+GLV_WINDOW_BITS = 2
+GLV_WINDOWS = 65  # ceil(129 / 2) windows of the half-scalars
+
+
+class _FixedBaseTables:
+    """Affine Montgomery tables d·16^j·G, j<64, d<16 — built once on
+    host (Python EC adds), closed over jitted ladders as constants
+    ((64, 16, L) int32 ×2 ≈ 180 KB)."""
+
+    def __init__(self):
+        xs = np.zeros((FB_WINDOWS, 16, NUM_LIMBS), dtype=np.int32)
+        ys = np.zeros((FB_WINDOWS, 16, NUM_LIMBS), dtype=np.int32)
+        base = AffinePoint(GX, GY)
+        for j in range(FB_WINDOWS):
+            row = [AffinePoint.identity()]
+            for _ in range(15):
+                row.append(row[-1].add(base))
+            mont = [(0, 0) if p.is_identity() else
+                    (p.x * CTX_P.r % SECP_P, p.y * CTX_P.r % SECP_P)
+                    for p in row]
+            xs[j] = to_limbs([m[0] for m in mont])
+            ys[j] = to_limbs([m[1] for m in mont])
+            base = row[-1].add(base)  # 16^{j+1}·G
+        # keep HOST arrays: the cache outlives traces, so storing a
+        # jnp array materialized inside a jit trace would leak a tracer
+        # into later traces (jnp.asarray at the use site is per-trace)
+        self.xs = xs
+        self.ys = ys
+
+
+_FB_TABLES: list = []
+
+
+def _fb_tables() -> _FixedBaseTables:
+    if not _FB_TABLES:
+        _FB_TABLES.append(_FixedBaseTables())
+    return _FB_TABLES[0]
+
+
+def _add_mixed(ctx, p, ex, ey, e_inf):
+    """P (Jacobian) + E (affine Montgomery, Z=1), branchless: ∞
+    operands, P == E (doubling fallback) and P == −E handled by lane
+    selects; ``e_inf`` marks lanes whose table entry is the identity."""
+    x1, y1, z1 = p
+    z1z1 = mont_mul(ctx, z1, z1)
+    u2 = mont_mul(ctx, ex, z1z1)
+    s2 = mont_mul(ctx, ey, mont_mul(ctx, z1, z1z1))
+    h = sub_mod(ctx, u2, x1)
+    rr = sub_mod(ctx, s2, y1)
+    hh = mont_mul(ctx, h, h)
+    hhh = mont_mul(ctx, h, hh)
+    v = mont_mul(ctx, x1, hh)
+    rr2 = mont_mul(ctx, rr, rr)
+    x3 = sub_mod(ctx, sub_mod(ctx, rr2, hhh), add_mod(ctx, v, v))
+    y3 = sub_mod(ctx, mont_mul(ctx, rr, sub_mod(ctx, v, x3)),
+                 mont_mul(ctx, y1, hhh))
+    z3 = mont_mul(ctx, z1, h)
+    general = (x3, y3, z3)
+
+    n = x1.shape[0]
+    p_inf = _is_zero_row(z1)
+    h_zero = _is_zero_row(h)
+    r_zero = _is_zero_row(rr)
+    doubled = _dbl(ctx, p)
+    inf = (_zeros(n),) * 3
+    one = _const_mont(ctx, 1, n)
+    lifted = (ex, ey, one)
+
+    out = _select(h_zero & r_zero, doubled, general)  # P == E
+    out = _select(h_zero & ~r_zero & ~p_inf, inf, out)  # P == −E
+    out = _select(p_inf, lifted, out)
+    out = _select(e_inf, p, out)  # E == ∞ (also wins when both ∞)
+    return out
+
+
+def _fb_digit(u_plain, j):
+    """4-bit window j of (n, L) plain 12-bit limb rows; 12 = 3·4 so
+    windows never straddle a limb."""
+    from .fieldops import LIMB_BITS
+
+    limb = lax.dynamic_slice_in_dim(u_plain, j // 3, 1, axis=1)[:, 0]
+    return (limb >> (4 * (j % 3))) & 15
+
+
+def _glv_digits(s_plain, w):
+    """2-bit window w (traced) of a half-scalar's limb rows."""
+    limb = lax.dynamic_slice_in_dim(s_plain, w // 6, 1, axis=1)[:, 0]
+    return (limb >> (2 * (w % 6))) & 3
+
+
+@partial(jax.jit, static_argnames=())
+def _recover_glv(u1_plain, s1_plain, s2_plain, e1_neg, e2_neg, rx, ry):
+    """u1·G + (e1·s1)·R + (e2·s2)·λR → affine Montgomery (x, y) and a
+    not-∞ flag. Scalars are plain limb rows (s1, s2 < 2^129); rx/ry is
+    the lifted R in affine Montgomery; e*_neg are bool lanes for the
+    GLV component signs."""
+    ctx = CTX_P
+    n = u1_plain.shape[0]
+    tab = _fb_tables()
+    inf = (_zeros(n),) * 3
+    one = _const_mont(ctx, 1, n)
+
+    # --- fixed-base sum: 64 window adds, no doublings ------------------
+    # (fori_loop, not unrolled: every mont_mul nests a while-loop, so an
+    # unrolled 64×18-mul chain is minutes of XLA compile — the same
+    # reason fieldops.mont_pow stays rolled)
+    fbx = jnp.asarray(tab.xs)
+    fby = jnp.asarray(tab.ys)
+
+    def fb_body(j, acc):
+        d = _fb_digit(u1_plain, j)
+        ex = jnp.take(lax.dynamic_index_in_dim(fbx, j, keepdims=False),
+                      d, axis=0)
+        ey = jnp.take(lax.dynamic_index_in_dim(fby, j, keepdims=False),
+                      d, axis=0)
+        return _add_mixed(ctx, acc, ex, ey, d == 0)
+
+    fb = lax.fori_loop(0, FB_WINDOWS, fb_body, inf)
+
+    # --- GLV joint ladder over P1 = e1·R, P2 = e2·λR -------------------
+    neg_ry = sub_mod(ctx, _zeros(n), ry)
+    y1 = jnp.where(e1_neg[:, None], neg_ry, ry)
+    y2 = jnp.where(e2_neg[:, None], neg_ry, ry)
+    beta = _const_mont(ctx, GLV_BETA, n)
+    x2 = mont_mul(ctx, rx, beta)
+    p1 = (rx, y1, one)
+    p2 = (x2, y2, one)
+
+    # 16-entry joint table i·P1 + j·P2, (n, 16, L) per coord. The 13
+    # point ops ride 3 BATCHED group ops on stacked lane blocks (the
+    # compile-size discipline again, and fewer dispatch rounds):
+    #   [2P1|2P2] = dbl([P1|P2]);  [3P1|3P2] = [2P1|2P2] + [P1|P2];
+    #   the 9 interior entries = one 9n-lane add A[i] + B[j].
+    p12 = tuple(jnp.concatenate([a, b]) for a, b in zip(p1, p2))
+    d12 = _dbl(ctx, p12)
+    t12 = _add(ctx, d12, p12)
+    a_row = [inf, p1, tuple(c[:n] for c in d12), tuple(c[:n] for c in t12)]
+    b_row = [inf, p2, tuple(c[n:] for c in d12), tuple(c[n:] for c in t12)]
+    big_a = tuple(jnp.concatenate([a_row[ii][c] for jj in range(1, 4)
+                                   for ii in range(1, 4)])
+                  for c in range(3))
+    big_b = tuple(jnp.concatenate([b_row[jj][c] for jj in range(1, 4)
+                                   for ii in range(1, 4)])
+                  for c in range(3))
+    sums = _add(ctx, big_a, big_b)
+    entries = []
+    for jj in range(4):
+        for ii in range(4):
+            if jj == 0:
+                entries.append(a_row[ii])
+            elif ii == 0:
+                entries.append(b_row[jj])
+            else:
+                k = (jj - 1) * 3 + (ii - 1)
+                entries.append(tuple(
+                    c[k * n:(k + 1) * n] for c in sums))
+    table = [jnp.stack([e[c] for e in entries], axis=1)
+             for c in range(3)]  # 3 × (n, 16, L)
+
+    def body(i, acc):
+        w = GLV_WINDOWS - 1 - i
+        acc = _dbl(ctx, _dbl(ctx, acc))
+        idx = _glv_digits(s1_plain, w) + 4 * _glv_digits(s2_plain, w)
+        entry = tuple(
+            jnp.take_along_axis(
+                t, idx[:, None, None].astype(jnp.int32), axis=1
+            )[:, 0, :]
+            for t in table
+        )
+        return _add(ctx, acc, entry)
+
+    glv = lax.fori_loop(0, GLV_WINDOWS, body, inf)
+
+    # --- combine + affine ---------------------------------------------
+    pt = _add(ctx, glv, fb)
+    not_inf = ~_is_zero_row(pt[2])
+    ax, ay = _to_affine(ctx, pt)
+    return (from_mont(ctx, ax), from_mont(ctx, ay), not_inf)
+
+
 # --- public batch ops -------------------------------------------------------
 
 def verify_batch(rs, ss, msgs, pub_points) -> np.ndarray:
@@ -229,16 +437,11 @@ def verify_batch(rs, ss, msgs, pub_points) -> np.ndarray:
     return np.asarray(not_inf & x_matches & nonzero & pk_ok)
 
 
-def recover_batch(rs, ss, rec_ids, msgs):
-    """Batched pubkey recovery: pk = r⁻¹·(s·R − m·G) with R lifted from
-    (r, rec_id) — the ingest hot path (``ecdsa/native.rs:298-331``,
-    driven per-attestation by ``Client.et_circuit_setup``).
-
-    Returns (xs, ys, valid): affine coordinate int lists and a bool
-    array (False where r does not lift to a curve point or the result
-    is ∞)."""
-    k = len(rs)
-    r_pl = jnp.asarray(to_limbs([v % SECP_P for v in rs]))
+@partial(jax.jit, static_argnames=())
+def _recover_prep(r_pl, rn_pl, m_pl, s_pl, want_odd):
+    """Lift R from (r, parity) and derive the recovery scalars — the
+    challenge-independent front half of recovery, one dispatch."""
+    k = r_pl.shape[0]
     r_m = to_mont(CTX_P, r_pl)
 
     # lift_x: y = (x³ + 7)^((p+1)/4); valid iff y² == x³ + 7
@@ -249,29 +452,78 @@ def recover_batch(rs, ss, rec_ids, msgs):
     y = mont_pow(CTX_P, rhs, (SECP_P + 1) // 4)
     lift_ok = jnp.all(mont_mul(CTX_P, y, y) == rhs, axis=1)
 
-    # parity select: plain lsb vs rec_id
+    # parity select: plain lsb vs rec_id (host recover_public_key lifts
+    # with bool(rec_id): ANY nonzero rec_id selects the odd-y point)
     y_plain = from_mont(CTX_P, y)
-    # host recover_public_key lifts with bool(rec_id): ANY nonzero
-    # rec_id selects the odd-y point (rec_id is a full wire byte)
-    want_odd = jnp.asarray([int(bool(v)) for v in rec_ids], dtype=jnp.int32)
     y_odd = y_plain[:, 0] & 1
     y_neg = sub_mod(CTX_P, _zeros(k), y)
     y_sel = jnp.where((y_odd == want_odd)[:, None], y, y_neg)
 
     # scalars: u1 = −m·r⁻¹, u2 = s·r⁻¹ (mod n)
-    rn_m = to_mont(CTX_N, jnp.asarray(to_limbs([v % SECP_N for v in rs])))
+    rn_m = to_mont(CTX_N, rn_pl)
     r_inv = inv_mod(CTX_N, rn_m)
-    m_m = to_mont(CTX_N, jnp.asarray(to_limbs([v % SECP_N for v in msgs])))
-    s_m = to_mont(CTX_N, jnp.asarray(to_limbs([v % SECP_N for v in ss])))
+    m_m = to_mont(CTX_N, m_pl)
+    s_m = to_mont(CTX_N, s_pl)
     u1 = sub_mod(CTX_N, jnp.zeros_like(m_m),
                  mont_mul(CTX_N, m_m, r_inv))
     u2 = mont_mul(CTX_N, s_m, r_inv)
-    u1_pl = jnp.asarray(np.asarray(from_mont(CTX_N, u1)))
-    u2_pl = jnp.asarray(np.asarray(from_mont(CTX_N, u2)))
+    return (r_m, y_sel, lift_ok,
+            from_mont(CTX_N, u1), from_mont(CTX_N, u2))
 
-    pk = _strauss(u1_pl, u2_pl, (r_m, y_sel))
-    not_inf = ~_is_zero_row(pk[2])
-    ax, ay = _to_affine(CTX_P, pk)
-    xs = from_limbs(np.asarray(from_mont(CTX_P, ax)))
-    ys = from_limbs(np.asarray(from_mont(CTX_P, ay)))
-    return xs, ys, np.asarray(lift_ok & not_inf)
+
+def recover_batch(rs, ss, rec_ids, msgs, _prep=None, _glv=None):
+    """Batched pubkey recovery: pk = r⁻¹·(s·R − m·G) with R lifted from
+    (r, rec_id) — the ingest hot path (``ecdsa/native.rs:298-331``,
+    driven per-attestation by ``Client.et_circuit_setup``), on the
+    GLV + fixed-base-window ladder (``_recover_glv``).
+
+    Returns (xs, ys, valid): affine coordinate int lists and a bool
+    array. A lane is valid iff r ∈ [1, n), s ≢ 0 (mod n), r lifts onto
+    the curve and the result is not ∞ — EXACTLY the acceptance set of
+    the scalar pipeline (recover, then verify with the recovered key):
+    verify mod-reduces s, rejects r = 0 / r ≥ n through the final
+    R'.x ≡ r comparison, and rejects the crafted sR = mG identity-key
+    case via ``is_default``. Within that set recover⇒verify is an
+    algebraic identity (R' = s⁻¹·(z·G + s·R − z·G) = R), so a True
+    lane's key is GUARANTEED to verify — pinned lane-for-lane by
+    tests/test_secp_batch.py::TestRecoverImpliesVerify.
+
+    ``_prep``/``_glv`` override the two jitted device cores — the
+    lane-sharded multichip twins (``parallel.ingest``) reuse this host
+    orchestration unchanged (the ladders are embarrassingly lane-
+    parallel; only the Babai split runs on host between them)."""
+    k = len(rs)
+    rs = [int(v) for v in rs]
+    ss = [int(v) for v in ss]
+    r_pl = jnp.asarray(to_limbs([v % SECP_P for v in rs]))
+    rn_pl = jnp.asarray(to_limbs([v % SECP_N for v in rs]))
+    m_pl = jnp.asarray(to_limbs([v % SECP_N for v in msgs]))
+    s_pl = jnp.asarray(to_limbs([v % SECP_N for v in ss]))
+    want_odd = jnp.asarray([int(bool(v)) for v in rec_ids],
+                           dtype=jnp.int32)
+
+    r_m, y_sel, lift_ok, u1, u2 = (_prep or _recover_prep)(
+        r_pl, rn_pl, m_pl, s_pl, want_odd)
+
+    # host: Babai-round the λ-split of u2 (~2.4 µs/lane)
+    u2_ints = from_limbs(np.asarray(u2))
+    e1_neg = np.zeros(k, dtype=bool)
+    e2_neg = np.zeros(k, dtype=bool)
+    halves1, halves2 = [], []
+    for i, u in enumerate(u2_ints):
+        h1, e1, h2, e2 = glv_decompose(u)
+        halves1.append(h1)
+        halves2.append(h2)
+        e1_neg[i] = e1 < 0
+        e2_neg[i] = e2 < 0
+    s1l = to_limbs(halves1)
+    s2l = to_limbs(halves2)
+
+    ax, ay, not_inf = (_glv or _recover_glv)(
+        u1, jnp.asarray(s1l), jnp.asarray(s2l),
+        jnp.asarray(e1_neg), jnp.asarray(e2_neg), r_m, y_sel)
+    xs = from_limbs(np.asarray(ax))
+    ys = from_limbs(np.asarray(ay))
+    range_ok = np.array([0 < r < SECP_N and s % SECP_N != 0
+                         for r, s in zip(rs, ss)], dtype=bool)
+    return xs, ys, np.asarray(lift_ok & not_inf) & range_ok

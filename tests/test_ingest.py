@@ -69,12 +69,20 @@ class TestBatchedIngest:
         pub_keys, addresses, valid = recover_signers_batch([])
         assert pub_keys == [] and addresses == [] and valid.shape == (0,)
 
-    def test_check_pass_consistent(self, batch):
-        """check=True (verify pass) must not reject honest lanes."""
-        _, signed = batch
-        _, _, v1 = recover_signers_batch(signed, check=True)
-        _, _, v2 = recover_signers_batch(signed, check=False)
-        assert v1.all() and v2.all()
+    def test_full_verify_never_changes_the_mask(self, batch):
+        """The audit-mode redundant verification ladder must agree with
+        the binding checks on every lane — honest AND forged (the
+        recover⇒verify property the default path rests on)."""
+        kps, signed = batch
+        forged = list(signed)
+        forged[1] = SignedAttestationData(forged[1].attestation,
+                                          signed[3].signature)
+        for pop in (signed, forged):
+            _, _, v1 = recover_signers_batch(pop, full_verify=True)
+            _, _, v2 = recover_signers_batch(pop)
+            assert (v1 == v2).all()
+        _, _, v_honest = recover_signers_batch(signed)
+        assert v_honest.all()
 
 
 class TestClientBatchedIngest:

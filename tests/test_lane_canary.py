@@ -1,0 +1,49 @@
+"""Canary for the tunnel worker's ladder-dispatch lane ceiling.
+
+Ingest chunks at 32k lanes because ≥64k-lane dispatches crash the TPU
+tunnel worker (BASELINE.md; tools/probe_lane_crash.py holds the
+bisect). This canary pins the workaround's boundary: if a runtime
+update ever shifts the ceiling BELOW the ingest chunk size, the chip
+battery fails here with the probe's signature instead of ingest dying
+mid-run with no diagnostic (VERDICT r4 → r5 ask #6).
+
+Chip-only: ``PTPU_TPU=1 pytest tests/test_lane_canary.py`` (the crash
+is a tunnel-backend behavior; the CPU backend has no such ceiling).
+"""
+
+import os
+
+import pytest
+
+_REAL_TPU = os.environ.get("PTPU_TPU", "") in ("1", "true", "yes")
+
+pytestmark = pytest.mark.skipif(
+    not _REAL_TPU, reason="tunnel lane-ceiling canary needs the real "
+    "chip (PTPU_TPU=1)")
+
+
+def test_ingest_chunk_cap_dispatch_survives():
+    """One fresh-process recovery dispatch at the ingest chunk cap
+    (32k lanes) must succeed — the boundary bench.py relies on."""
+    from tools.probe_lane_crash import run_child
+
+    ok, code, tail = run_child(1 << 15)
+    assert ok, (
+        f"32k-lane dispatch crashed (exit {code}) — the tunnel lane "
+        f"ceiling moved below the ingest chunk cap; re-bisect with "
+        f"tools/probe_lane_crash.py and lower bench.py's --chunk. "
+        f"stderr tail:\n{tail}")
+
+
+def test_report_64k_status():
+    """Informational: does the historical 64k crash still reproduce?
+    Never fails — prints the current status so the boundary's drift is
+    visible in the battery log without blocking on a runtime fix."""
+    from tools.probe_lane_crash import run_child
+
+    ok, code, _ = run_child(1 << 16)
+    if ok:
+        msg = "OK — ceiling lifted, consider raising the ingest chunk"
+    else:
+        msg = f"still crashes (exit {code})"
+    print(f"64k-lane dispatch: {msg}")

@@ -1,0 +1,144 @@
+"""Where does batched-ingest time go? (round-5 design probe)
+
+Times, at one 32k-lane chunk on the live backend: the host-side limb
+preprocessing of recover_batch, the Strauss ladder dispatch itself,
+the affine conversion + download, and the Poseidon hash batch —
+separating host Python from device wall so the GLV/window redesign
+targets the real bound.
+
+Usage: python tools/probe_ingest_profile.py [--lanes 32768]
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+import numpy as np  # noqa: E402
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--lanes", type=int, default=1 << 15)
+    args = ap.parse_args()
+    os.chdir(REPO)
+    import jax
+
+    jax.config.update("jax_compilation_cache_dir",
+                      os.path.join(REPO, "bench_cache", "zk", "xla_cache"))
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    import jax.numpy as jnp
+
+    from protocol_tpu.crypto.secp256k1 import N as N_ORD, P as SECP_P
+    from protocol_tpu.models.eigentrust import HASHER_WIDTH
+    from protocol_tpu.ops import secp_batch as sb
+    from protocol_tpu.ops.poseidon_batch import get_poseidon_batch_planes
+
+    k = args.lanes
+    rng = np.random.default_rng(7)
+    rs = [int.from_bytes(rng.bytes(31), "little") % N_ORD or 1
+          for _ in range(k)]
+    ss = [int.from_bytes(rng.bytes(31), "little") % N_ORD or 1
+          for _ in range(k)]
+    recs = [int(v) for v in rng.integers(0, 2, k)]
+    msgs = [int.from_bytes(rng.bytes(31), "little") % N_ORD or 1
+            for _ in range(k)]
+
+    out = {"lanes": k, "backend": jax.default_backend()}
+
+    # --- recover_batch internals, phase by phase ----------------------
+    def timed(label, fn, n=3):
+        ts = []
+        for _ in range(n):
+            t0 = time.perf_counter()
+            r = fn()
+            ts.append(time.perf_counter() - t0)
+        out[label] = round(min(ts), 4)
+        return r
+
+    # host limb prep (the Python-int comprehensions recover_batch runs)
+    def host_prep():
+        r_pl = sb.to_limbs([v % SECP_P for v in rs])
+        rn = sb.to_limbs([v % N_ORD for v in rs])
+        m = sb.to_limbs([v % N_ORD for v in msgs])
+        s = sb.to_limbs([v % N_ORD for v in ss])
+        return r_pl, rn, m, s
+
+    r_pl, rn, m, s = timed("host_to_limbs_4arrays_s", host_prep)
+
+    # device scalar algebra (inversions etc.) — everything before the ladder
+    r_m = sb.to_mont(sb.CTX_P, jnp.asarray(r_pl))
+    rn_m = sb.to_mont(sb.CTX_N, jnp.asarray(rn))
+    m_m = sb.to_mont(sb.CTX_N, jnp.asarray(m))
+    s_m = sb.to_mont(sb.CTX_N, jnp.asarray(s))
+
+    def scalar_algebra():
+        r_inv = sb.inv_mod(sb.CTX_N, rn_m)
+        u1 = sb.sub_mod(sb.CTX_N, jnp.zeros_like(m_m),
+                        sb.mont_mul(sb.CTX_N, m_m, r_inv))
+        u2 = sb.mont_mul(sb.CTX_N, s_m, r_inv)
+        return (np.asarray(sb.from_mont(sb.CTX_N, u1)),
+                np.asarray(sb.from_mont(sb.CTX_N, u2)))
+
+    u1_pl, u2_pl = timed("scalar_algebra_s", scalar_algebra)
+
+    # the 256-bit Strauss ladder itself (block until ready)
+    q = (r_m, r_m)  # any affine pair; cost is shape-dependent only
+
+    def ladder():
+        pt = sb._strauss(jnp.asarray(u1_pl), jnp.asarray(u2_pl), q)
+        jax.block_until_ready(pt)
+        return pt
+
+    pt = timed("strauss256_s", ladder)
+
+    def affine_dl():
+        ax, ay = sb._to_affine(sb.CTX_P, pt)
+        xs = sb.from_limbs(np.asarray(sb.from_mont(sb.CTX_P, ax)))
+        ys = sb.from_limbs(np.asarray(sb.from_mont(sb.CTX_P, ay)))
+        return xs, ys
+
+    timed("affine_download_s", affine_dl)
+
+    # end-to-end recover_batch + verify_batch for reference
+    def full_recover():
+        r = sb.recover_batch(rs, ss, recs, msgs)
+        return r
+
+    xs, ys, ok = timed("recover_batch_total_s", full_recover)
+
+    def full_verify():
+        return sb.verify_batch(rs, ss, msgs, list(zip(xs, ys)))
+
+    timed("verify_batch_total_s", full_verify)
+
+    # Poseidon hash batch
+    pb = get_poseidon_batch_planes(HASHER_WIDTH)
+    rows = [[int(v) for v in rng.integers(1, 1 << 62, 4)] for _ in range(k)]
+
+    def hash_batch():
+        return pb.hash_batch(rows)
+
+    timed("poseidon_hash_batch_s", hash_batch)
+
+    # GLV decomposition on host, per-lane python (candidate ladder input)
+    from protocol_tpu.crypto.secp256k1 import glv_decompose
+
+    def glv_host():
+        return [glv_decompose(u) for u in ss]
+
+    timed("glv_decompose_host_s", glv_host)
+
+    out["recover_ladder_frac"] = round(
+        out["strauss256_s"] / out["recover_batch_total_s"], 3)
+    print(json.dumps(out), flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

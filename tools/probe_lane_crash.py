@@ -1,0 +1,140 @@
+"""Bisect the TPU tunnel worker's ladder-dispatch lane ceiling.
+
+Round-4 ingest hard-coded 32k-lane chunks because ≥64k-lane Strauss
+dispatches crash the tunnel worker (BASELINE.md ingest row). This
+probe makes that boundary MEASURED and MONITORED instead of a magic
+constant (VERDICT r4 → r5 ask #6):
+
+- each attempt runs in a FRESH SUBPROCESS (a crashed tunnel backend
+  dies with its process; the parent survives to record the outcome);
+- parent bisects the first failing lane count between a known-good
+  floor and a known-bad ceiling and emits one JSON line with the
+  boundary and the failure signature (exit code + stderr tail);
+- ``tests/test_lane_canary.py`` runs the 32k attempt as a canary so a
+  runtime update that shifts the ceiling below the ingest chunk size
+  fails loudly in the chip battery, not mid-ingest.
+
+Usage:
+  python tools/probe_lane_crash.py                    # bisect (chip)
+  python tools/probe_lane_crash.py --attempt 32768    # one child run
+  python tools/probe_lane_crash.py --lo 32768 --hi 262144
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def attempt(lanes: int) -> int:
+    """Child: one recovery-pipeline dispatch at ``lanes`` lanes against
+    the live backend (the ingest kernel itself — GLV ladder + prep),
+    real signatures not required: random in-range scalars exercise the
+    same program shapes."""
+    import numpy as np
+
+    import jax
+
+    jax.config.update("jax_compilation_cache_dir",
+                      os.path.join(REPO, "bench_cache", "zk", "xla_cache"))
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+
+    from protocol_tpu.ops import secp_batch as sb
+
+    rng = np.random.default_rng(lanes)
+    # r values drawn from valid curve x-coords: lift a generator
+    # multiple once on host, reuse (lane count is what's probed)
+    from protocol_tpu.crypto.secp256k1 import SECP256K1_GENERATOR
+
+    base = SECP256K1_GENERATOR.mul(12345)
+    rs = [base.x] * lanes
+    ss = [int(v) for v in rng.integers(1, 1 << 62, lanes)]
+    recs = [int(v) for v in rng.integers(0, 2, lanes)]
+    msgs = [int(v) for v in rng.integers(1, 1 << 62, lanes)]
+    t0 = time.perf_counter()
+    xs, ys, valid = sb.recover_batch(rs, ss, recs, msgs)
+    dt = time.perf_counter() - t0
+    assert valid.all(), "probe lanes should all be recoverable"
+    print(json.dumps({"lanes": lanes, "ok": True,
+                      "dispatch_s": round(dt, 2)}), flush=True)
+    return 0
+
+
+def run_child(lanes: int, timeout: float = 1200.0):
+    """(ok, exit_code, stderr_tail) for one fresh-process attempt."""
+    proc = subprocess.run(
+        [sys.executable, os.path.abspath(__file__),
+         "--attempt", str(lanes)],
+        capture_output=True, text=True, timeout=timeout, cwd=REPO)
+    tail = (proc.stderr or "")[-2000:]
+    return proc.returncode == 0, proc.returncode, tail
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--attempt", type=int, default=0,
+                    help="child mode: run one dispatch at N lanes")
+    ap.add_argument("--lo", type=int, default=1 << 15,
+                    help="known-good floor (bisect start)")
+    ap.add_argument("--hi", type=int, default=1 << 18,
+                    help="first suspected-bad ceiling")
+    args = ap.parse_args()
+    os.chdir(REPO)
+
+    if args.attempt:
+        return attempt(args.attempt)
+
+    results = {}
+
+    def probe(lanes):
+        if lanes not in results:
+            ok, code, tail = run_child(lanes)
+            results[lanes] = {"ok": ok, "exit_code": code}
+            if not ok:
+                results[lanes]["stderr_tail"] = tail[-400:]
+            print(f"  lanes={lanes}: {'OK' if ok else f'CRASH({code})'}",
+                  file=sys.stderr, flush=True)
+        return results[lanes]["ok"]
+
+    lo, hi = args.lo, args.hi
+    if not probe(lo):
+        print(json.dumps({"error": f"floor {lo} already crashes",
+                          "results": results}))
+        return 1
+    while probe(hi) and hi < (1 << 22):
+        lo = hi
+        hi *= 2
+    if hi >= (1 << 22) and results.get(hi, {}).get("ok"):
+        print(json.dumps({"boundary": None, "note":
+                          f"no crash up to {hi} lanes — ceiling lifted",
+                          "results": results}))
+        return 0
+    # first failing count in (lo, hi]
+    while hi - lo > 4096:  # 4k resolution is plenty for a chunk cap
+        mid = (lo + hi) // 2 // 4096 * 4096
+        if mid in (lo, hi):
+            break
+        if probe(mid):
+            lo = mid
+        else:
+            hi = mid
+    out = {
+        "last_good_lanes": lo,
+        "first_bad_lanes": hi,
+        "bad_signature": {k: v for k, v in results[hi].items()},
+        "ingest_chunk_cap": 1 << 15,
+        "results": {str(k): v["ok"] for k, v in sorted(results.items())},
+    }
+    print(json.dumps(out), flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
