@@ -29,6 +29,8 @@ from ..utils.keccak import keccak256
 EVENT_SIGNATURE = "AttestationCreated(address,address,bytes32,bytes)"
 EVENT_TOPIC = "0x" + keccak256(EVENT_SIGNATURE.encode()).hex()
 ATTEST_SELECTOR = keccak256(b"attest((address,bytes32,bytes)[])")[:4]
+ATTESTATIONS_SELECTOR = keccak256(
+    b"attestations(address,address,bytes32)")[:4]
 
 
 def _await_deploy_receipt(rpc, txh: str, created: bytes,
@@ -150,6 +152,96 @@ class LocalChain(AttestationStation):
             chain.logs.append(log)
             chain.store[(log.creator, log.about, log.key)] = log.val
         return chain
+
+
+class ExecutedChain(AttestationStation):
+    """AttestationStation backed by the REAL vendored contract bytecode
+    running in the in-repo EVM (``client/evm.py``) — the executed twin
+    of ``LocalChain``'s modeled semantics.
+
+    Deploy runs the actual creation code (constructor included);
+    ``attest`` executes the runtime's calldata decoder, storage writes
+    and LOG4 emission; ``get_attestation`` executes the public-mapping
+    getter. The reference gets this loop from Anvil + real bytecode
+    (``eigentrust/src/lib.rs:695-788``); here the devnet's contract
+    registry instantiates THIS class, so a codec or semantic divergence
+    between the Python model and the real contract surfaces as a test
+    failure (``tests/test_evm_exec.py`` asserts LocalChain equivalence
+    tx for tx)."""
+
+    def __init__(self):
+        from .att_station_bytecode import creation_bytecode
+        from .evm import Evm
+
+        # devnet account: a fixed self address (the EVM only exposes it
+        # through ADDRESS, which the contract does not read)
+        self.evm = Evm.deploy(creation_bytecode(),
+                              caller=b"\x00" * 20,
+                              address=b"\xa7" * 20)
+        self.logs: list = []
+        self.block = 0
+        self.gas_used = 0
+
+    def attest(self, creator: bytes, entries: list) -> str:
+        return self.attest_raw(creator, abi_encode_attest(entries),
+                               entries)
+
+    def attest_raw(self, creator: bytes, calldata: bytes,
+                   entries: list) -> str:
+        """Execute an attest with the CALLER'S raw calldata — the
+        devnet path, so the real contract's calldata decoder sees the
+        exact wire bytes (not a re-encoding)."""
+        from .evm import EvmRevert
+
+        self.block += 1
+        try:
+            _, gas, logs = self.evm.call(creator, calldata)
+        except EvmRevert as e:
+            raise EigenError(
+                "transaction_error",
+                f"attest reverted: {e.data.hex() or e}") from e
+        self.gas_used += gas
+        for log in logs:
+            if log.topics[0] != int(EVENT_TOPIC, 16):
+                continue
+            # AttestationCreated(indexed creator, indexed about,
+            # indexed key, bytes val): val is ABI-encoded in data
+            off = int.from_bytes(log.data[:32], "big")
+            ln = int.from_bytes(log.data[off:off + 32], "big")
+            val = log.data[off + 32:off + 32 + ln]
+            self.logs.append(AttestationLog(
+                creator=log.topics[1].to_bytes(32, "big")[12:],
+                about=log.topics[2].to_bytes(32, "big")[12:],
+                key=log.topics[3].to_bytes(32, "big"),
+                val=val,
+                block_number=self.block,
+            ))
+        digest = keccak256(
+            creator + b"".join(a + k + v for a, k, v in entries))
+        return "0x" + digest.hex()
+
+    def get_attestation(self, creator: bytes, about: bytes,
+                        key: bytes) -> bytes:
+        data = (ATTESTATIONS_SELECTOR + _pad32(b"\x00" * 12 + creator)
+                + _pad32(b"\x00" * 12 + about) + key)
+        return abi_decode_bytes(self.call_raw(data))
+
+    def call_raw(self, calldata: bytes) -> bytes:
+        """eth_call against the executed contract: raw calldata in,
+        raw ABI return out. eth_call semantics: state changes are
+        DISCARDED (storage snapshot/restore), so a mutating simulation
+        can never desync the getter from the event log."""
+        snapshot = dict(self.evm.storage)
+        try:
+            ret, gas, _ = self.evm.call(b"\x00" * 20, calldata)
+        finally:
+            self.evm.storage = snapshot
+        self.gas_used += gas
+        return ret
+
+    def get_logs(self, from_block: int = 0) -> list:
+        return [log for log in self.logs
+                if log.block_number >= from_block]
 
 
 # --- minimal ABI coding ---------------------------------------------------
@@ -284,8 +376,8 @@ class RpcChain(AttestationStation):
         return chain
 
     def get_attestation(self, creator: bytes, about: bytes, key: bytes) -> bytes:
-        selector = keccak256(b"attestations(address,address,bytes32)")[:4]
-        data = selector + _pad32(b"\x00" * 12 + creator) + _pad32(b"\x00" * 12 + about) + key
+        data = (ATTESTATIONS_SELECTOR + _pad32(b"\x00" * 12 + creator)
+                + _pad32(b"\x00" * 12 + about) + key)
         result = self.rpc(
             "eth_call",
             [{"to": "0x" + self.contract_address.hex(), "data": "0x" + data.hex()}, "latest"],

@@ -17,18 +17,25 @@ round trip:
   ``attest((address,bytes32,bytes)[])`` calldata and append logs.
 - ``eth_getLogs`` / ``eth_call`` (the ``attestations`` getter).
 
-Contract semantics are implemented natively via ``LocalChain`` (this is
-a protocol mock, not a bytecode interpreter — the vendored creation
-bytecode is accepted and its deployed semantics modeled exactly).
+Both contract families EXECUTE real code (r5; previously the station
+was modeled):
 
-The one contract that IS executed rather than modeled is the generated
-PLONK verifier: a creation transaction whose data is Yul source (the
-``object "PlonkVerifier"`` artifact from ``zk/evm.py``) registers a
-contract whose ``eth_call``/``eth_estimateGas`` run the code through
-the in-repo EVM (``zk/yul.py``, yellow-paper gas schedule) — closing
-the loop the reference gets from Anvil: the proof artifact is verified
-*on-chain over JSON-RPC*, not by a library call
-(``eigentrust-zk/src/verifier/mod.rs:148-168``).
+- a creation transaction carrying the vendored AttestationStation
+  creation bytecode deploys through the in-repo EVM **bytecode**
+  interpreter (``client/evm.py``): the constructor runs, attest txs
+  run the real calldata decoder/storage writes/LOG4 emission on the
+  wire bytes, and ``eth_call`` executes the real public-mapping
+  getter — the loop the reference gets from Anvil + real bytecode
+  (``eigentrust/src/lib.rs:695-788``). Equivalence with the modeled
+  ``LocalChain`` semantics is asserted in ``tests/test_evm_exec.py``;
+  any OTHER non-Yul creation data still registers a modeled
+  ``LocalChain`` (documented fallback for protocol-level tests).
+- a creation transaction whose data is Yul source (the
+  ``object "PlonkVerifier"`` artifact from ``zk/evm.py``) registers a
+  contract whose ``eth_call``/``eth_estimateGas`` run the code through
+  the in-repo Yul EVM (``zk/yul.py``, yellow-paper gas schedule) — the
+  proof artifact is verified *on-chain over JSON-RPC*, not by a
+  library call (``eigentrust-zk/src/verifier/mod.rs:148-168``).
 """
 
 from __future__ import annotations
@@ -39,10 +46,15 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 from ..crypto.secp256k1 import Signature, recover_public_key
 from ..utils.keccak import keccak256
-from .chain import ATTEST_SELECTOR, EVENT_TOPIC, LocalChain
+from .att_station_bytecode import creation_bytecode
+from .chain import (
+    ATTEST_SELECTOR,
+    ATTESTATIONS_SELECTOR,
+    EVENT_TOPIC,
+    ExecutedChain,
+    LocalChain,
+)
 from .eth import address_from_public_key, rlp_encode
-
-ATTESTATIONS_SELECTOR = keccak256(b"attestations(address,address,bytes32)")[:4]
 
 YUL_CREATION_MARKER = b'object "PlonkVerifier"'
 
@@ -161,6 +173,10 @@ class MockNode:
                 if YUL_CREATION_MARKER in bytes(data):
                     self.contracts[addr] = YulContract(
                         bytes(data).decode("utf-8"))
+                elif bytes(data) == creation_bytecode():
+                    # the real artifact: run its constructor in the
+                    # bytecode EVM and serve the executed contract
+                    self.contracts[addr] = ExecutedChain()
                 else:
                     self.contracts[addr] = LocalChain()
                 self.receipts[txh] = {"contractAddress": "0x" + addr.hex(),
@@ -174,7 +190,12 @@ class MockNode:
                     raise ValueError(
                         "verifier contract is view-only; use eth_call")
                 entries = _decode_attest_calldata(bytes(data))
-                chain.attest(sender, entries)
+                if isinstance(chain, ExecutedChain):
+                    # executed station: the REAL decoder sees the wire
+                    # calldata; entries only feed the tx-digest
+                    chain.attest_raw(sender, bytes(data), entries)
+                else:
+                    chain.attest(sender, entries)
                 self.receipts[txh] = {"contractAddress": None,
                                       "status": "0x1",
                                       "blockNumber": hex(self.block)}
@@ -238,6 +259,14 @@ class MockNode:
                     return "0x" + chain.call(data).hex()
                 except VMRevert as e:
                     raise ValueError(f"execution reverted: {e}") from e
+            if isinstance(chain, ExecutedChain):
+                from .evm import EvmRevert
+
+                try:
+                    return "0x" + chain.call_raw(data).hex()
+                except EvmRevert as e:
+                    raise ValueError(
+                        f"execution reverted: {e}") from e
             if data[:4] != ATTESTATIONS_SELECTOR:
                 raise ValueError("unsupported call selector")
             creator = data[16:36]
