@@ -1834,6 +1834,12 @@ struct ColorScratch {
     // — ~5-6 dependent misses per step collapse to ~2.
     std::vector<u64> pairs;
     std::vector<u32> meta;
+    // lcur/rcur double as the fused build's pend arrays; they hold -1
+    // everywhere between euler_split calls (every vertex pairs off —
+    // degrees are even), so they are filled ONCE here and only after a
+    // cursor-fallback clobber (pend_clean). Refilling the m-sized
+    // arrays per small split would dominate deep recursion levels.
+    bool pend_clean = false;
 
     void ensure(i64 El, i64 m) {
         if ((i64)eids.size() < El) {
@@ -1846,6 +1852,7 @@ struct ColorScratch {
         if ((i64)lptr.size() < m + 1) {
             lptr.resize(m + 1); rptr.resize(m + 1);
             lcur.resize(m); rcur.resize(m);
+            pend_clean = false;  // fresh elements are uninitialized
         }
     }
 };
@@ -1869,17 +1876,24 @@ struct ColorScratch {
 static void euler_split_cursor(const i32 *ls, const i32 *rs,
                                ColorScratch &S, i64 k, i64 m);
 
-static i64 euler_split(const i32 *i_src, ColorScratch &S, i64 lo, i64 hi,
-                       i64 m) {
-    i64 k = hi - lo;
-    i32 *e = S.eids.data() + lo;
-    i32 *ls = S.ls.data();
-    i32 *rs = S.rs.data();
-    for (i64 j = 0; j < k; ++j) {
-        i32 eid = e[j];
-        ls[j] = i_src[eid];
-        rs[j] = eid >> 7;
-    }
+// CLOS_SPLIT_DEBUG=1: per-phase nanosecond accumulators across every
+// euler_split call (all threads), printed by clos_plan — the evidence
+// for where plan wall-clock actually goes (r5: the adjacency/pairing
+// build vs the orbit walk).
+struct SplitPhaseNanos {
+    std::atomic<i64> build{0}, walk{0}, finish{0};
+};
+static SplitPhaseNanos g_split_nanos;
+
+static inline i64 _now_ns() {
+    return std::chrono::duration_cast<std::chrono::nanoseconds>(
+        std::chrono::steady_clock::now().time_since_epoch()).count();
+}
+
+static void build_adjacency(const i32 *ls, const i32 *rs,
+                            ColorScratch &S, i64 k, i64 m) {
+    // counting-sort CSR build (lptr/rptr/ladj/radj) — the cursor
+    // walk's structure; the large-split path no longer needs it
     i64 *lptr = S.lptr.data();
     i64 *rptr = S.rptr.data();
     std::fill(lptr, lptr + m + 1, 0);
@@ -1904,37 +1918,90 @@ static i64 euler_split(const i32 *i_src, ColorScratch &S, i64 lo, i64 hi,
         ladj[lcur[ls[j]]++] = (i32)j;
         radj[rcur[rs[j]]++] = (i32)j;
     }
+}
+
+static i64 euler_split(const i32 *i_src, ColorScratch &S, i64 lo, i64 hi,
+                       i64 m) {
+    const bool dbg = std::getenv("CLOS_SPLIT_DEBUG") != nullptr;
+    i64 t0 = dbg ? _now_ns() : 0;
+    i64 k = hi - lo;
+    i32 *e = S.eids.data() + lo;
+    i32 *ls = S.ls.data();
+    i32 *rs = S.rs.data();
     u8 *side_a = S.side_a.data();   // pre-flip color: member=1, lpart=0
 
-    if (k < (1 << 16)) {
-        // small splits are cache-resident: the plain cursor walk beats
-        // the interleaved machinery's bookkeeping (and its pairing
-        // construction) there; fall through to the shared partition
-        euler_split_cursor(ls, rs, S, k, m);
-        goto partition;
-    }
-
     {
-    // pair consecutive incident edges per vertex (degrees are even).
-    // The pairings are written into ONE packed array: pairs[j] =
-    // lpart(j) | rpart(j)<<32 — the walk's two involution lookups at
-    // an edge share a cache line (r4 memory-layout optimization; the
-    // walk is DRAM-latency-bound at plan scale).
+    // FUSED pairing build (r5): pair each vertex's incident edges by
+    // ARRIVAL order in one streaming pass — any perfect per-vertex
+    // matching yields the even alternating cycles the halving needs,
+    // so the counting-sort CSR (histogram + prefix + two scatter
+    // passes into E-sized ladj/radj, ~4 random accesses per edge) is
+    // dead weight on this path. pend[v] holds the unmatched edge at
+    // vertex v (degrees are even, so none remain). pairs[j] packs
+    // (lpart, rpart) in ONE 8-byte word (r4: one line feeds both
+    // involutions in the walk).
     u64 *pairs = S.pairs.data();
-    for (i64 v = 0; v < m; ++v) {
-        for (i64 p = lptr[v]; p < lptr[v + 1]; p += 2) {
-            i32 a = ladj[p], b = ladj[p + 1];
-            pairs[a] = (pairs[a] & ~(u64)0xffffffffu) | (u32)b;
-            pairs[b] = (pairs[b] & ~(u64)0xffffffffu) | (u32)a;
+    i32 *pendL = S.lcur.data();  // m-sized scratch, free on this path
+    i32 *pendR = S.rcur.data();
+    if (!S.pend_clean) {
+        std::fill(pendL, pendL + S.lcur.size(), -1);
+        std::fill(pendR, pendR + S.rcur.size(), -1);
+        S.pend_clean = true;
+    }
+    for (i64 j = 0; j < k; ++j) {
+        i32 eid = e[j];
+        i32 v = i_src[eid];
+        i32 w = eid >> 7;
+        i32 &pl = pendL[v];
+        if (pl < 0) {
+            pl = (i32)j;
+        } else {
+            pairs[pl] = (pairs[pl] & ~(u64)0xffffffffu) | (u32)j;
+            pairs[j] = (pairs[j] & ~(u64)0xffffffffu) | (u32)pl;
+            pl = -1;
         }
-        for (i64 p = rptr[v]; p < rptr[v + 1]; p += 2) {
-            i32 a = radj[p], b = radj[p + 1];
-            pairs[a] = (pairs[a] & 0xffffffffu) | ((u64)(u32)b << 32);
-            pairs[b] = (pairs[b] & 0xffffffffu) | ((u64)(u32)a << 32);
+        i32 &pr = pendR[w];
+        if (pr < 0) {
+            pr = (i32)j;
+        } else {
+            pairs[pr] = (pairs[pr] & 0xffffffffu) | ((u64)(u32)j << 32);
+            pairs[j] = (pairs[j] & 0xffffffffu) | ((u64)(u32)pr << 32);
+            pr = -1;
         }
     }
     auto lpart_of = [&](i64 j) -> i32 { return (i32)(u32)pairs[j]; };
     auto rpart_of = [&](i64 j) -> i32 { return (i32)(pairs[j] >> 32); };
+    if (dbg) {
+        g_split_nanos.build.fetch_add(_now_ns() - t0);
+        t0 = _now_ns();
+    }
+
+    if (k < (1 << 16)) {
+        // cache-resident splits: one sequential walker colors each
+        // alternating cycle end to end — no collisions, so none of the
+        // interleaved path's segment/constraint bookkeeping (r5; the
+        // r4 small path built a full counting-sort CSR + cursor walk)
+        u8 *used = S.used.data();
+        std::fill(used, used + k, (u8)0);
+        for (i64 s0 = 0; s0 < k; ++s0) {
+            if (used[s0]) continue;
+            i32 cur = (i32)s0;
+            used[s0] = 1;
+            side_a[s0] = 1;
+            for (;;) {
+                i32 p = lpart_of(cur);
+                used[p] = 1;
+                side_a[p] = 0;
+                i32 nxt = rpart_of(p);
+                if (nxt == (i32)s0) break;
+                used[nxt] = 1;
+                side_a[nxt] = 1;
+                cur = nxt;
+            }
+        }
+        if (dbg) g_split_nanos.walk.fetch_add(_now_ns() - t0);
+        goto partition;
+    }
 
     // per-edge walk state fused into one word: seg<<2 | colored<<1 |
     // side — the three former arrays (used/seg_of/side_a) cost three
@@ -2029,70 +2096,86 @@ static i64 euler_split(const i32 *i_src, ColorScratch &S, i64 lo, i64 hi,
         }
     }
 
+    if (dbg) {
+        g_split_nanos.walk.fetch_add(_now_ns() - t0);
+        t0 = _now_ns();
+    }
     // solve segment flips: BFS over the constraint graph with parity
+    // (flat CSR adjacency — per-segment std::vectors were allocation
+    // churn at 32-walker segment counts)
     i64 ns = (i64)segs.size();
-    std::vector<std::vector<std::pair<i32, u8>>> adj(ns);
-    bool cons_ok = true;
-    for (const Con &c : cons) {
+    i64 nc = (i64)cons.size();
+    bool ok = true;
+    for (const Con &c : cons)
         if (c.a < 0 || c.a >= ns || c.b < 0 || c.b >= ns) {
-            cons_ok = false;  // should be impossible; defensive
+            ok = false;  // should be impossible; defensive
             break;
         }
-        adj[c.a].push_back({c.b, c.parity});
-        adj[c.b].push_back({c.a, c.parity});
-    }
+    std::vector<i32> cptr(ns + 1, 0), cadj;
+    std::vector<u8> cpar;
     std::vector<int8_t> flip(ns, -1);
-    std::vector<i32> queue;
-    bool ok = cons_ok;
-    for (i64 s0 = 0; s0 < ns && ok; ++s0) {
-        if (flip[s0] >= 0) continue;
-        flip[s0] = 0;
-        queue.clear();
-        queue.push_back((i32)s0);
-        while (!queue.empty() && ok) {
-            i32 cur = queue.back();
-            queue.pop_back();
-            for (auto &pr : adj[cur]) {
-                int8_t want = (int8_t)(flip[cur] ^ pr.second);
-                if (flip[pr.first] < 0) {
-                    flip[pr.first] = want;
-                    queue.push_back(pr.first);
-                } else if (flip[pr.first] != want) {
-                    ok = false;   // should be impossible; fallback below
-                    break;
+    if (ok) {
+        for (const Con &c : cons) {
+            cptr[c.a + 1]++;
+            cptr[c.b + 1]++;
+        }
+        for (i64 s = 0; s < ns; ++s) cptr[s + 1] += cptr[s];
+        cadj.resize(2 * nc);
+        cpar.resize(2 * nc);
+        std::vector<i32> ccur(cptr.begin(), cptr.end() - 1);
+        for (const Con &c : cons) {
+            cadj[ccur[c.a]] = c.b;
+            cpar[ccur[c.a]++] = c.parity;
+            cadj[ccur[c.b]] = c.a;
+            cpar[ccur[c.b]++] = c.parity;
+        }
+        std::vector<i32> queue;
+        for (i64 s0 = 0; s0 < ns && ok; ++s0) {
+            if (flip[s0] >= 0) continue;
+            flip[s0] = 0;
+            queue.clear();
+            queue.push_back((i32)s0);
+            while (!queue.empty() && ok) {
+                i32 cur = queue.back();
+                queue.pop_back();
+                for (i32 p = cptr[cur]; p < cptr[cur + 1]; ++p) {
+                    int8_t want = (int8_t)(flip[cur] ^ cpar[p]);
+                    if (flip[cadj[p]] < 0) {
+                        flip[cadj[p]] = want;
+                        queue.push_back(cadj[p]);
+                    } else if (flip[cadj[p]] != want) {
+                        ok = false;  // impossible; fallback below
+                        break;
+                    }
                 }
             }
         }
     }
     if (!ok) {
+        // correctness fallback needs ls/rs and the CSR the fused path
+        // skips; building them clobbers the lcur/rcur pend invariant
+        for (i64 j = 0; j < k; ++j) {
+            ls[j] = i_src[e[j]];
+            rs[j] = e[j] >> 7;
+        }
+        build_adjacency(ls, rs, S, k, m);
+        S.pend_clean = false;
         euler_split_cursor(ls, rs, S, k, m);   // recompute side_a exactly
     } else {
-        // apply flips by re-walking flipped segments
-        for (i64 si = 0; si < ns; ++si) {
-            if (!flip[si]) continue;
-            i32 cur = segs[si].start;
-            i32 mleft = segs[si].members - 1;
-            i32 lleft = segs[si].lparts;
-            meta[cur] ^= 1u;
-            while (lleft > 0) {
-                i32 p = lpart_of(cur);
-                meta[p] ^= 1u;
-                --lleft;
-                if (mleft <= 0) break;
-                cur = rpart_of(p);
-                meta[cur] ^= 1u;
-                --mleft;
-            }
-        }
-        // hand the packed sides to the shared partition pass (one
-        // streaming sweep; the cursor fallback writes side_a itself)
-        for (i64 j = 0; j < k; ++j) side_a[j] = (u8)(meta[j] & 1u);
+        // apply flips in ONE streaming pass: meta[j] already carries
+        // (seg, side), so the final side is side ^ flip[seg] — the r4
+        // code re-WALKED every flipped segment (2 random loads per
+        // edge, a second walk's worth of DRAM misses) to do this
+        for (i64 j = 0; j < k; ++j)
+            side_a[j] = (u8)((meta[j] & 1u)
+                             ^ (u8)flip[meta[j] >> 2]);
     }
 
     }
 
 partition:
     // stable partition: side-A edges first
+    {
     i32 *tmp = S.tmp.data();
     i64 na = 0;
     for (i64 j = 0; j < k; ++j)
@@ -2101,7 +2184,10 @@ partition:
     for (i64 j = 0; j < k; ++j)
         if (!side_a[j]) tmp[nb++] = e[j];
     std::copy(tmp, tmp + k, e);
+    if (dbg && k >= (1 << 16))
+        g_split_nanos.finish.fetch_add(_now_ns() - t0);
     return na;
+    }
 }
 
 // Original cursor-based Euler walk (sequential, no pairing) — retained
@@ -2357,6 +2443,17 @@ int clos_plan(const int32_t *perm, int64_t E, const int32_t *bits,
     if (nlevels > 1) S.ensure(E, 0, nlevels);
     else S.mid.resize(1);
     plan_rec(C, S, perm, E, 0, 0);
+    if (std::getenv("CLOS_SPLIT_DEBUG")) {
+        std::fprintf(stderr,
+                     "clos_split phases (large splits, all levels): "
+                     "build %.2fs walk %.2fs finish %.2fs\n",
+                     g_split_nanos.build.load() * 1e-9,
+                     g_split_nanos.walk.load() * 1e-9,
+                     g_split_nanos.finish.load() * 1e-9);
+        g_split_nanos.build = 0;
+        g_split_nanos.walk = 0;
+        g_split_nanos.finish = 0;
+    }
     return 0;
 }
 

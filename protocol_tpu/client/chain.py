@@ -180,17 +180,21 @@ class ExecutedChain(AttestationStation):
                               address=b"\xa7" * 20)
         self.logs: list = []
         self.block = 0
-        self.gas_used = 0
+        self.gas_used = 0       # tx gas (attest executions)
+        self.view_gas_used = 0  # eth_call gas (state discarded)
 
     def attest(self, creator: bytes, entries: list) -> str:
         return self.attest_raw(creator, abi_encode_attest(entries),
                                entries)
 
     def attest_raw(self, creator: bytes, calldata: bytes,
-                   entries: list) -> str:
+                   entries: list | None) -> str:
         """Execute an attest with the CALLER'S raw calldata — the
         devnet path, so the real contract's calldata decoder sees the
-        exact wire bytes (not a re-encoding)."""
+        exact wire bytes (not a re-encoding). ``entries`` feeds only
+        the tx digest (LocalChain hash parity); pass None when the
+        modeled decoder cannot parse what the real contract accepted —
+        the digest then covers the raw calldata."""
         from .evm import EvmRevert
 
         self.block += 1
@@ -216,8 +220,11 @@ class ExecutedChain(AttestationStation):
                 val=val,
                 block_number=self.block,
             ))
-        digest = keccak256(
-            creator + b"".join(a + k + v for a, k, v in entries))
+        if entries is None:
+            digest = keccak256(creator + calldata)
+        else:
+            digest = keccak256(
+                creator + b"".join(a + k + v for a, k, v in entries))
         return "0x" + digest.hex()
 
     def get_attestation(self, creator: bytes, about: bytes,
@@ -230,13 +237,17 @@ class ExecutedChain(AttestationStation):
         """eth_call against the executed contract: raw calldata in,
         raw ABI return out. eth_call semantics: state changes are
         DISCARDED (storage snapshot/restore), so a mutating simulation
-        can never desync the getter from the event log."""
+        can never desync the getter from the event log. View gas is
+        tracked separately — it is not transaction gas. NOT
+        thread-safe against concurrent attests (the snapshot/restore
+        writes storage): the devnet serializes through MockNode's
+        lock."""
         snapshot = dict(self.evm.storage)
         try:
             ret, gas, _ = self.evm.call(b"\x00" * 20, calldata)
         finally:
             self.evm.storage = snapshot
-        self.gas_used += gas
+        self.view_gas_used += gas
         return ret
 
     def get_logs(self, from_block: int = 0) -> list:

@@ -189,12 +189,18 @@ class MockNode:
                 if isinstance(chain, YulContract):
                     raise ValueError(
                         "verifier contract is view-only; use eth_call")
-                entries = _decode_attest_calldata(bytes(data))
                 if isinstance(chain, ExecutedChain):
-                    # executed station: the REAL decoder sees the wire
-                    # calldata; entries only feed the tx-digest
+                    # executed station: the REAL solc decoder is
+                    # authoritative on the wire calldata; the modeled
+                    # decoder runs only afterwards for the tx digest
+                    # (None if it cannot parse what the contract took)
+                    try:
+                        entries = _decode_attest_calldata(bytes(data))
+                    except Exception:
+                        entries = None
                     chain.attest_raw(sender, bytes(data), entries)
                 else:
+                    entries = _decode_attest_calldata(bytes(data))
                     chain.attest(sender, entries)
                 self.receipts[txh] = {"contractAddress": None,
                                       "status": "0x1",
@@ -262,11 +268,14 @@ class MockNode:
             if isinstance(chain, ExecutedChain):
                 from .evm import EvmRevert
 
-                try:
-                    return "0x" + chain.call_raw(data).hex()
-                except EvmRevert as e:
-                    raise ValueError(
-                        f"execution reverted: {e}") from e
+                # the snapshot/restore in call_raw writes storage:
+                # serialize against concurrent attest txs
+                with self._lock:
+                    try:
+                        return "0x" + chain.call_raw(data).hex()
+                    except EvmRevert as e:
+                        raise ValueError(
+                            f"execution reverted: {e}") from e
             if data[:4] != ATTESTATIONS_SELECTOR:
                 raise ValueError("unsupported call selector")
             creator = data[16:36]

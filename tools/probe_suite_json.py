@@ -1,0 +1,112 @@
+"""Chip probe suite → committed JSON artifact (VERDICT r5 ask #8).
+
+Re-runs the measurements behind the device-MSM kill decision and the
+tunnel characterization, emitting one machine-readable line to stdout
+and (with --out) a PROBES_r{N}.json file: elementwise field-mul
+throughput, row-gather latency, tunnel bandwidth both directions, and
+dispatch round-trip latency. The prose study lives in BASELINE.md
+("Why the MSM stays on the host"); this artifact keeps the numbers
+auditable when the hardware or runtime changes.
+
+Usage: python tools/probe_suite_json.py [--out PROBES_r05.json]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+import numpy as np  # noqa: E402
+
+
+def best_of(fn, reps=3, warm=1):
+    for _ in range(warm):
+        fn()
+    ts = []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        fn()
+        ts.append(time.perf_counter() - t0)
+    return min(ts)
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--out", default=None)
+    args = ap.parse_args()
+    os.chdir(REPO)
+    import jax
+
+    jax.config.update("jax_compilation_cache_dir",
+                      os.path.join(REPO, "bench_cache", "zk", "xla_cache"))
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    import jax.numpy as jnp
+
+    from protocol_tpu.ops import fieldops2 as f2
+
+    out = {"backend": jax.default_backend(),
+           "device": str(jax.devices()[0]),
+           "timestamp": time.strftime("%Y-%m-%dT%H:%M:%S")}
+
+    # 1. dependent elementwise Montgomery-mul throughput (the VPU
+    # bound that kills a device Pippenger: ~16n EC adds x ~12 muls)
+    n = 1 << 20
+    rng = np.random.default_rng(3)
+    a = jnp.asarray(rng.integers(0, 1 << 11, (f2.L, n), dtype=np.int64),
+                    dtype=jnp.int32)
+
+    @jax.jit
+    def chain4(x):
+        y = f2.mont_mul(x, x)
+        y = f2.mont_mul(y, x)
+        y = f2.mont_mul(y, y)
+        y = f2.mont_mul(y, x)
+        return y
+
+    t = best_of(lambda: jax.block_until_ready(chain4(a)))
+    out["field_mul_dependent_Mmuls_per_s"] = round(4 * n / t / 1e6, 1)
+    out["field_mul_batch_shape"] = [f2.L, n]
+
+    # 2. row gather latency (flat in row width — scalar-core bound)
+    for width in (4, 64):
+        tbl = jnp.asarray(rng.integers(0, 1 << 30, (1 << 20, width),
+                                       dtype=np.int64), dtype=jnp.int32)
+        idx = jnp.asarray(rng.integers(0, 1 << 20, 1 << 20),
+                          dtype=jnp.int32)
+        g = jax.jit(lambda t_, i_: jnp.take(t_, i_, axis=0))
+        t = best_of(lambda: jax.block_until_ready(g(tbl, idx)))
+        out[f"row_gather_ns_per_row_w{width}"] = round(t / (1 << 20)
+                                                       * 1e9, 1)
+
+    # 3. tunnel bandwidth, both directions (64 MB payload)
+    host = np.zeros((1 << 24,), dtype=np.int32)  # 64 MB
+    t = best_of(lambda: jax.block_until_ready(jax.device_put(host)),
+                reps=2)
+    out["tunnel_upload_MB_per_s"] = round(host.nbytes / 2**20 / t, 1)
+    dev = jax.device_put(host)
+    t = best_of(lambda: np.asarray(dev), reps=2)
+    out["tunnel_download_MB_per_s"] = round(host.nbytes / 2**20 / t, 1)
+
+    # 4. dispatch round-trip latency (tiny program, sync)
+    tiny = jnp.zeros((8,), jnp.int32)
+    bump = jax.jit(lambda x: x + 1)
+    jax.block_until_ready(bump(tiny))
+    t = best_of(lambda: jax.block_until_ready(bump(tiny)), reps=5)
+    out["dispatch_sync_rtt_ms"] = round(t * 1e3, 2)
+
+    line = json.dumps(out)
+    print(line, flush=True)
+    if args.out:
+        with open(args.out, "w") as f:
+            f.write(line + "\n")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
