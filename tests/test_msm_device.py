@@ -1,0 +1,60 @@
+"""Sorted-prefix device MSM skeleton vs the host BN254 oracle.
+
+Skip-marked by default (VERDICT r5 ask #8): the chip probes killed the
+device MSM on THIS hardware (VPU-emulated int32 multiply — see
+BASELINE.md "Why the MSM stays on the host"), so these tests exist to
+keep the design executable, not to run in the battery. Re-litigate
+with ``PTPU_DEVICE_MSM=1 pytest tests/test_msm_device.py`` when
+hardware with native 32-bit multiply or faster gathers arrives.
+"""
+
+import os
+import random
+
+import pytest
+
+pytestmark = pytest.mark.skipif(
+    os.environ.get("PTPU_DEVICE_MSM", "") not in ("1", "true"),
+    reason="device MSM is measured-off on this hardware "
+    "(BASELINE.md); set PTPU_DEVICE_MSM=1 to run the skeleton")
+
+
+def _fixture(n, seed):
+    from protocol_tpu.zk.bn254 import G1_GEN, R as FR, g1_mul
+
+    rng = random.Random(seed)
+    points = [g1_mul(G1_GEN, rng.randrange(1, FR)) for _ in range(n)]
+    scalars = [rng.randrange(0, FR) for _ in range(n)]
+    return points, scalars
+
+
+class TestSortedPrefixMsm:
+    def test_matches_host_oracle(self):
+        from protocol_tpu.ops.msm_device import msm_device
+        from protocol_tpu.zk.bn254 import g1_msm
+
+        points, scalars = _fixture(64, 0xE11)
+        got = msm_device(points, scalars, c=4)
+        want = g1_msm(points, scalars)
+        assert got == want
+
+    def test_zero_and_duplicate_digits(self):
+        from protocol_tpu.ops.msm_device import msm_device
+        from protocol_tpu.zk.bn254 import g1_msm
+
+        points, _ = _fixture(32, 0xE12)
+        # adversarial scalar population: zeros, ones, equal scalars,
+        # single-bucket collisions
+        scalars = ([0] * 7 + [1] * 7 + [0xF0F0] * 9
+                   + [(1 << 200) + 5] * 9)
+        got = msm_device(points, scalars, c=4)
+        want = g1_msm(points, scalars)
+        assert got == want
+
+    def test_sum_cancels_to_identity(self):
+        from protocol_tpu.ops.msm_device import msm_device
+        from protocol_tpu.zk.bn254 import R as FR, g1_mul, G1_GEN
+
+        p = g1_mul(G1_GEN, 7)
+        # 3·P + (r-3)·P = r·P = ∞
+        assert msm_device([p, p], [3, FR - 3], c=4) is None
