@@ -44,7 +44,7 @@ from jax import lax
 
 from .clos import _apply_route_jit, _use_pallas, plan_route
 from .converge import adaptive_loop, dangling_and_damping
-from ..graph import filter_edges
+from ..graph import filter_edges, stable_argsort_bounded
 
 __all__ = [
     "RoutedOperator",
@@ -151,7 +151,7 @@ class _Side(NamedTuple):
 
 def _bucketize_blocked(n, key, other, weight, min_width=8):
     """Group edges by ``key`` node into blocked pow2-width ELL buckets."""
-    order = np.argsort(key, kind="stable")
+    order = stable_argsort_bounded(key, n)
     key_s = key[order].astype(np.int64)
     w_s = weight[order]
 
