@@ -113,6 +113,13 @@ def _bind(lib, u64p) -> None:
                               ctypes.c_int32,
                               ctypes.POINTER(ctypes.c_uint8)]
     lib.clos_plan.restype = ctypes.c_int
+    lib.clos_apply_route.argtypes = [ctypes.POINTER(ctypes.c_uint8),
+                                     ctypes.c_int64,
+                                     ctypes.POINTER(ctypes.c_int32),
+                                     ctypes.c_int32,
+                                     ctypes.POINTER(ctypes.c_int32),
+                                     ctypes.POINTER(ctypes.c_int32)]
+    lib.clos_apply_route.restype = ctypes.c_int
 
 
 def available() -> bool:
@@ -219,6 +226,48 @@ def clos_plan(perm: np.ndarray, bits) -> np.ndarray | None:
         # the C++ returns 2 both for bad level bits and for a length
         # that is not a power of two >= 128
         raise ValueError("clos_plan: invalid length or level bits")
+    return out
+
+
+def clos_apply_route(stages, bits, x: np.ndarray) -> np.ndarray | None:
+    """Replay a finished plan on int32 data (the numpy twin is
+    ``ops.clos.apply_route_np``) — used by plan VALIDATION, where the
+    numpy replay's take_along_axis + swapaxes copies cost ~1/5 of the
+    plan itself at 2^28. ``stages`` is the per-stage list (or the flat
+    array) of uint8 stage bytes. None when the library is unavailable."""
+    lib = _load()
+    if lib is None:
+        return None
+    if isinstance(stages, (list, tuple)):
+        views = [np.asarray(s) for s in stages]
+        base = views[0].base if views else None
+        if (base is not None and base.dtype == np.uint8
+                and all(v.base is base and v.dtype == np.uint8
+                        for v in views)
+                and all(v.ctypes.data == base.ctypes.data
+                        + sum(len(u) for u in views[:i])
+                        for i, v in enumerate(views))
+                and sum(len(v) for v in views) == len(base)):
+            # native plans hand back adjacent views of ONE flat buffer
+            # — replaying through it is zero-copy (a concatenate here
+            # is a ~1.9 GB transient at the 10M scale this serves)
+            stages = base
+        else:
+            stages = np.concatenate([np.asarray(s, dtype=np.uint8)
+                                     for s in views])
+    stages = np.ascontiguousarray(stages, dtype=np.uint8)
+    bits_arr = np.ascontiguousarray(bits, dtype=np.int32)
+    out = np.ascontiguousarray(x, dtype=np.int32).copy()
+    tmp = np.empty_like(out)
+    rc = lib.clos_apply_route(
+        stages.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+        len(out),
+        bits_arr.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        len(bits_arr),
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        tmp.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)))
+    if rc != 0:
+        raise ValueError("clos_apply_route: invalid length or bits")
     return out
 
 

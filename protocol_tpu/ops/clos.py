@@ -211,7 +211,16 @@ def plan_route(perm: np.ndarray, prefer_native: bool = True,
         if not validate:
             return True
         probe = np.arange(E, dtype=np.int32 if e < 31 else np.int64)
-        if np.array_equal(apply_route_np(plan, probe), perm):
+        replay = None
+        if e < 31:
+            from .. import native as pn
+
+            if pn.available():  # fused C++ replay (~5× the numpy one)
+                replay = pn.clos_apply_route(plan.stages, plan.bits,
+                                             probe)
+        if replay is None:
+            replay = apply_route_np(plan, probe)
+        if np.array_equal(replay, perm):
             return True
         warnings.warn(
             f"plan_route: {source} planner produced a plan that does not "
