@@ -1,0 +1,43 @@
+#!/bin/bash
+# Round-5 chip measurement battery — run serially on a healthy tunnel.
+# Each step is its own process; NEVER kill one mid-first-compile (a
+# killed compile wedges the tunnel worker for hours — BASELINE r5
+# outage note). Logs land in bench_cache/r5_logs/.
+cd "$(dirname "$0")/.." || exit 1
+mkdir -p bench_cache/r5_logs
+L=bench_cache/r5_logs
+note() { echo "[$(date +%H:%M:%S)] $*" | tee -a "$L/battery.log"; }
+
+note "health gate"
+python -c "import jax; print(jax.devices())" || {
+  note "tunnel unhealthy - aborting"; exit 1; }
+
+note "1. ingest warm (first GLV compile: may take 30-60 min)"
+python -u tools/bench_ingest.py --n 32768 --chunk 32768 \
+  2>&1 | tee "$L/ingest_warm.log"
+
+note "2. ingest 1M (the >=7k att/s measurement)"
+python -u tools/bench_ingest.py --n 1048576 --chunk 32768 \
+  2>&1 | tee "$L/ingest_1m.log"
+
+note "3. probe suite -> PROBES_r05.json"
+python -u tools/probe_suite_json.py --out PROBES_r05.json \
+  2>&1 | tee "$L/probes.log"
+
+note "4. lane-ceiling bisect"
+python -u tools/probe_lane_crash.py 2>&1 | tee "$L/lanes.log"
+
+note "5. k=21 resident-mode probe (packed coeffs since r4 00fcd65)"
+PTPU_EXT_RESIDENT=1 python -u tools/prove_flagship.py \
+  2>&1 | tee "$L/flagship_resident.log"
+
+note "6. flagship streaming control (only if 5 failed)"
+# python -u tools/prove_flagship.py 2>&1 | tee "$L/flagship_stream.log"
+
+note "7. threshold cycle"
+python -u tools/th_cycle.py 2>&1 | tee "$L/th_cycle.log"
+
+note "8. converge bench (the driver's headline)"
+python -u bench.py 2>&1 | tee "$L/bench.log"
+
+note "battery done"
