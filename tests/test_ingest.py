@@ -85,6 +85,58 @@ class TestBatchedIngest:
         assert v_honest.all()
 
 
+class TestShardedIngest:
+    def test_lane_sharded_recovery_bit_identical(self):
+        """parallel/ingest.py over the virtual 8-device mesh: outputs
+        must be bit-identical to the single-device path, with the
+        binding checks agreeing lane for lane (the driver's
+        dryrun_multichip runs the same check; this keeps it in the
+        battery)."""
+        import random
+
+        import jax
+        from jax.sharding import Mesh
+        import numpy as np
+
+        from protocol_tpu.crypto.secp256k1 import EcdsaKeypair
+        from protocol_tpu.ops.secp_batch import SECP_N, recover_batch
+        from protocol_tpu.parallel.ingest import sharded_recover_batch
+
+        if jax.device_count() < 8:
+            pytest.skip("needs the 8-device virtual mesh (conftest)")
+        ndev = 8
+        mesh = Mesh(np.array(jax.devices()[:ndev]), ("lanes",))
+        rng = random.Random(0xB00)
+        k = 16
+        kps = [EcdsaKeypair(61_000 + i) for i in range(k)]
+        msgs = [rng.randrange(1, SECP_N) for _ in range(k)]
+        sigs = [kp.sign(m) for kp, m in zip(kps, msgs)]
+        rs = [s.r for s in sigs]
+        ss = [s.s for s in sigs]
+        recs = [s.rec_id for s in sigs]
+        ss[3] = 0  # binding-check reject must survive the sharding
+        xs0, ys0, v0 = recover_batch(rs, ss, recs, msgs)
+        xs1, ys1, v1 = sharded_recover_batch(rs, ss, recs, msgs, mesh)
+        assert (v0 == v1).all() and not v1[3] and v1.sum() == k - 1
+        assert xs0 == xs1 and ys0 == ys1
+
+    def test_indivisible_lane_count_rejected(self):
+        import jax
+        from jax.sharding import Mesh
+        import numpy as np
+
+        from protocol_tpu.parallel.ingest import sharded_recover_batch
+
+        ndev = min(8, jax.device_count())
+        if ndev < 2:
+            pytest.skip("needs a multi-device mesh")
+        mesh = Mesh(np.array(jax.devices()[:ndev]), ("lanes",))
+        with pytest.raises(ValueError):
+            sharded_recover_batch([1] * (ndev + 1), [1] * (ndev + 1),
+                                  [0] * (ndev + 1), [1] * (ndev + 1),
+                                  mesh)
+
+
 class TestClientBatchedIngest:
     def test_et_setup_identical_between_paths(self):
         """Client(batched_ingest=True) must produce the same ETSetup as
