@@ -31,8 +31,11 @@ note "5. k=21 resident-mode probe (packed coeffs since r4 00fcd65)"
 PTPU_EXT_RESIDENT=1 python -u tools/prove_flagship.py \
   2>&1 | tee "$L/flagship_resident.log"
 
-note "6. flagship streaming control (only if 5 failed)"
+note "6. flagship streaming control (if 5 failed) / predispatch retest"
 # python -u tools/prove_flagship.py 2>&1 | tee "$L/flagship_stream.log"
+# PTPU_PREDISPATCH=1 python -u tools/prove_flagship.py \
+#   2>&1 | tee "$L/flagship_predispatch.log"   # r4 measured it under
+#   # full-suite CPU contention only - retest on a quiet core
 
 note "7. threshold cycle"
 python -u tools/th_cycle.py 2>&1 | tee "$L/th_cycle.log"
