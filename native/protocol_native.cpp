@@ -18,6 +18,9 @@
 #include <cstdio>
 #include <cstdlib>
 #ifdef __linux__
+#include <sys/mman.h>
+#endif
+#ifdef __linux__
 #include <sched.h>
 #endif
 #include <cstdint>
@@ -1818,6 +1821,25 @@ typedef uint32_t u32;
 // (deeper levels only touch prefixes). The walk arrays are split-local
 // (indexed by local edge id) so the Euler chase stays in the smallest
 // possible working set.
+// Ask the kernel for 2 MB pages on a freshly-reserved buffer: random
+// access into the GB-scale walk arrays otherwise pays a 4 KB TLB miss
+// + page walk on top of each DRAM miss. Portable best-effort: the r5
+// measurement box (Firecracker microVM) ACCEPTS the advise but never
+// materializes huge pages (AnonHugePages stays 0, plan times
+// unchanged) — on hosts with working THP this is a known multi-x TLB
+// lever for the walk; keep the call sites and re-measure per box.
+static void advise_huge(void *p, size_t bytes) {
+#ifdef __linux__
+    uintptr_t a = ((uintptr_t)p + 4095) & ~(uintptr_t)4095;
+    uintptr_t e = ((uintptr_t)p + bytes) & ~(uintptr_t)4095;
+    if (e > a && e - a >= (2u << 20))
+        madvise((void *)a, e - a, MADV_HUGEPAGE);
+#else
+    (void)p;
+    (void)bytes;
+#endif
+}
+
 struct ColorScratch {
     std::vector<i32> eids;     // edge ids, partitioned in place
     std::vector<i32> tmp;      // partition buffer
@@ -1843,11 +1865,29 @@ struct ColorScratch {
 
     void ensure(i64 El, i64 m) {
         if ((i64)eids.size() < El) {
-            eids.resize(El); tmp.resize(El); ls.resize(El); rs.resize(El);
-            ladj.resize(El); radj.resize(El); used.resize(El);
-            lpart.resize(El); rpart.resize(El); seg_of.resize(El);
-            side_a.resize(El);
-            pairs.resize(El); meta.resize(El);
+            // madvise must land BEFORE first touch (resize's zero-fill
+            // faults the pages): reserve → advise → resize, so the
+            // fill faults 2 MB pages directly. The walk's
+            // random-access arrays are the TLB-critical set.
+            auto prep = [El](auto &v) {
+                v.reserve(El);
+                advise_huge(v.data(),
+                            (size_t)El * sizeof(*v.data()));
+                v.resize(El);
+            };
+            prep(eids);
+            prep(tmp);
+            prep(ls);
+            prep(rs);
+            prep(ladj);
+            prep(radj);
+            prep(used);
+            prep(lpart);
+            prep(rpart);
+            prep(seg_of);
+            prep(side_a);
+            prep(pairs);
+            prep(meta);
         }
         if ((i64)lptr.size() < m + 1) {
             lptr.resize(m + 1); rptr.resize(m + 1);
