@@ -506,16 +506,19 @@ def build_routed_operator(
     node_to_state[state_to_node[live]] = np.nonzero(live)[0]
 
     # --- edge route: in slot <- out slot ---------------------------------
+    # int32 throughout: these are 2^28-sized working arrays at 10M-peer
+    # scale — int64 doubles their alloc + scatter traffic for slot ids
+    # that fit 31 bits by construction (edge_e ≤ 31)
     edge_e = _ceil_pow2_exp(max(out_side.n_slots, in_side.n_slots, 128))
     E2 = 1 << edge_e
-    perm = np.full(E2, -1, dtype=np.int64)
+    perm = np.full(E2, -1, dtype=np.int32)
     perm[in_side.edge_slot] = out_side.edge_slot
     src_used = np.zeros(E2, dtype=bool)
     src_used[out_side.edge_slot] = True
     free_src = np.nonzero(~src_used)[0]   # out-ELL pads + tail: all zeros
     need = np.nonzero(perm < 0)[0]        # in-ELL pads + tail
     perm[need] = free_src[: len(need)]
-    plan = plan_route(perm.astype(np.int32), prefer_native=prefer_native)
+    plan = plan_route(perm, prefer_native=prefer_native)
 
     # --- state route: state slot <- z position ---------------------------
     # z = concatenated per-bucket in-row sums (column-major positions)
@@ -525,7 +528,7 @@ def build_routed_operator(
               else np.zeros(0, dtype=np.int64))
     node_in_pos = np.full(n, -1, dtype=np.int64)
     node_in_pos[in_nodes] = in_pos
-    sperm = np.full(N2, -1, dtype=np.int64)
+    sperm = np.full(N2, -1, dtype=np.int32)
     live_nodes = state_to_node[live]
     live_slots = np.nonzero(live)[0]
     with_in = node_in_pos[live_nodes] >= 0
@@ -535,7 +538,7 @@ def build_routed_operator(
     free_zero = np.nonzero(~sp_used)[0]   # z pads + tail: all zeros
     need = np.nonzero(sperm < 0)[0]
     sperm[need] = free_zero[: len(need)]
-    splan = plan_route(sperm.astype(np.int32), prefer_native=prefer_native)
+    splan = plan_route(sperm, prefer_native=prefer_native)
 
     valid_state = np.zeros(N2, dtype=np.float32)
     valid_state[live_slots] = valid_mask[live_nodes].astype(np.float32)
