@@ -511,6 +511,8 @@ def build_routed_operator(
     # that fit 31 bits by construction (edge_e ≤ 31)
     edge_e = _ceil_pow2_exp(max(out_side.n_slots, in_side.n_slots, 128))
     E2 = 1 << edge_e
+    assert edge_e <= 31, "edge slot space exceeds int32 (scale the " \
+        "assembly dtypes before routing this graph)"
     perm = np.full(E2, -1, dtype=np.int32)
     perm[in_side.edge_slot] = out_side.edge_slot
     src_used = np.zeros(E2, dtype=bool)
@@ -528,6 +530,8 @@ def build_routed_operator(
               else np.zeros(0, dtype=np.int64))
     node_in_pos = np.full(n, -1, dtype=np.int64)
     node_in_pos[in_nodes] = in_pos
+    assert state_e <= 31, "state slot space exceeds int32 (scale the " \
+        "assembly dtypes before routing this graph)"
     sperm = np.full(N2, -1, dtype=np.int32)
     live_nodes = state_to_node[live]
     live_slots = np.nonzero(live)[0]
