@@ -44,6 +44,65 @@ def _pow2_bucket(k: int) -> int:
     return size
 
 
+def _ingest_chunk() -> int:
+    """Largest single ladder dispatch the tunnel worker survives —
+    measured and monitored, not a magic constant: the boundary is
+    bisected by tools/probe_lane_crash.py and pinned by
+    tests/test_lane_canary.py; PTPU_INGEST_CHUNK overrides."""
+    import os
+
+    return int(os.environ.get("PTPU_INGEST_CHUNK", str(1 << 15)))
+
+
+def hash_recover_pipeline(row_chunks, sig_chunks, _prep=None, _glv=None):
+    """Software-pipelined hash + recovery over pre-chunked inputs,
+    yielding ``(msgs, (xs, ys, valid))`` per chunk in order.
+
+    While the device runs chunk i's GLV ladder (the dominant span), the
+    host hashes chunk i+1 and builds its limbs — the submit/midstage/
+    finalize split in ``ops.secp_batch`` plus the hash_submit/finalize
+    split in ``ops.poseidon_batch``. Per-chunk results are bit-identical
+    to the serial hash_batch → recover_batch sequence (same kernels,
+    same order within a chunk). ``sig_chunks`` entries are
+    ``(rs, ss, rec_ids)`` lists; ``row_chunks`` entries are hasher input
+    rows. This is the single home of the pipeline loop — the client
+    ingest path and tools/bench_ingest.py both drive it."""
+    from ..ops import secp_batch as sb
+    from ..ops.poseidon_batch import get_poseidon_batch_planes
+
+    row_chunks = list(row_chunks)
+    sig_chunks = list(sig_chunks)
+    assert len(row_chunks) == len(sig_chunks)
+    if not row_chunks:
+        return
+    pb = get_poseidon_batch_planes(HASHER_WIDTH)
+    mid = None
+    pending_msgs = None
+    hh = pb.hash_submit(row_chunks[0])
+    for i in range(len(row_chunks)):
+        msgs = pb.hash_finalize(hh)
+        rs, ss, recs = sig_chunks[i]
+        sub = sb.recover_submit(rs, ss, recs, msgs, _prep=_prep)
+        if i + 1 < len(row_chunks):
+            hh = pb.hash_submit(row_chunks[i + 1])
+        if mid is not None:
+            yield pending_msgs, sb.recover_finalize(mid)
+        pending_msgs = msgs
+        mid = sb.recover_midstage(sub, _glv=_glv)
+    yield pending_msgs, sb.recover_finalize(mid)
+
+
+def _att_rows(attestations: Sequence) -> list:
+    """Hasher input rows (``Attestation.hash`` operand order) for a
+    batch of SignedAttestationData."""
+    rows = []
+    for signed in attestations:
+        att = signed.attestation.to_scalar()
+        rows.append([int(att.about), int(att.domain), int(att.value),
+                     int(att.message)])
+    return rows
+
+
 def attestation_hashes_batch(attestations: Sequence) -> list:
     """Poseidon attestation hashes for a batch of
     SignedAttestationData, one device dispatch
@@ -53,11 +112,7 @@ def attestation_hashes_batch(attestations: Sequence) -> list:
     from ..ops.poseidon_batch import get_poseidon_batch_planes
 
     pb = get_poseidon_batch_planes(HASHER_WIDTH)
-    rows = []
-    for signed in attestations:
-        att = signed.attestation.to_scalar()
-        rows.append([int(att.about), int(att.domain), int(att.value),
-                     int(att.message)])
+    rows = _att_rows(attestations)
     k = len(rows)
     rows += [[0, 0, 0, 0]] * (_pow2_bucket(k) - k)
     return pb.hash_batch(rows)[:k]
@@ -92,26 +147,64 @@ def recover_signers_batch(attestations: Sequence,
         return [], [], np.zeros(0, dtype=bool)
 
     k = len(attestations)
-    # the Strauss ladder jit-caches per batch shape; bucketing sizes
-    # avoids a fresh multi-minute trace per distinct attestation count
-    pad = _pow2_bucket(k) - k
+    cap = _ingest_chunk()
+    if k > cap:
+        # beyond one ladder dispatch's measured lane ceiling: chunk AND
+        # software-pipeline (hash_recover_pipeline) — host prep of chunk
+        # i+1 hides under the device ladder of chunk i
+        from ..utils import trace
 
-    from ..utils import trace
+        rows = _att_rows(attestations)
+        sigs = [s.signature.to_signature() for s in attestations]
+        row_chunks, sig_chunks, spans = [], [], []
+        for lo in range(0, k, cap):
+            hi = min(lo + cap, k)
+            pad_c = _pow2_bucket(hi - lo) - (hi - lo)
+            row_chunks.append(rows[lo:hi] + [[0, 0, 0, 0]] * pad_c)
+            sig_chunks.append((
+                [s.r for s in sigs[lo:hi]] + [1] * pad_c,
+                [s.s for s in sigs[lo:hi]] + [1] * pad_c,
+                [s.rec_id for s in sigs[lo:hi]] + [0] * pad_c))
+            spans.append(hi - lo)
+        xs, ys, valid_parts = [], [], []
+        with trace.span("ingest.pipeline", n=k, chunks=len(spans)):
+            for (msgs_c, (cx, cy, cvalid)), c, (crs, css, _) in zip(
+                    hash_recover_pipeline(row_chunks, sig_chunks),
+                    spans, sig_chunks):
+                if full_verify:
+                    # audit mode: the synchronous verify ladder between
+                    # chunks SERIALIZES the pipeline — audited ingest
+                    # trades throughput for the redundant check
+                    with trace.span("ingest.verify_batch", n=c):
+                        ok = verify_batch(crs, css, msgs_c,
+                                          list(zip(cx, cy)))
+                    cvalid = cvalid & ok
+                xs.extend(cx[:c])
+                ys.extend(cy[:c])
+                valid_parts.append(cvalid[:c])
+        valid = np.concatenate(valid_parts)
+    else:
+        # the Strauss ladder jit-caches per batch shape; bucketing sizes
+        # avoids a fresh multi-minute trace per distinct attestation
+        # count
+        pad = _pow2_bucket(k) - k
 
-    with trace.span("ingest.hash_batch", n=k):
-        msgs = [int(h) for h in attestation_hashes_batch(attestations)]
-    sigs = [s.signature.to_signature() for s in attestations]
-    rs = [s.r for s in sigs] + [1] * pad
-    ss = [s.s for s in sigs] + [1] * pad
-    rec = [s.rec_id for s in sigs] + [0] * pad
-    msgs_p = msgs + [1] * pad
-    with trace.span("ingest.recover_batch", n=k):
-        xs, ys, valid = recover_batch(rs, ss, rec, msgs_p)
-    if full_verify:
-        with trace.span("ingest.verify_batch", n=k):
-            ok = verify_batch(rs, ss, msgs_p, list(zip(xs, ys)))
-        valid = valid & ok
-    xs, ys, valid = xs[:k], ys[:k], valid[:k]
+        from ..utils import trace
+
+        with trace.span("ingest.hash_batch", n=k):
+            msgs = [int(h) for h in attestation_hashes_batch(attestations)]
+        sigs = [s.signature.to_signature() for s in attestations]
+        rs = [s.r for s in sigs] + [1] * pad
+        ss = [s.s for s in sigs] + [1] * pad
+        rec = [s.rec_id for s in sigs] + [0] * pad
+        msgs_p = msgs + [1] * pad
+        with trace.span("ingest.recover_batch", n=k):
+            xs, ys, valid = recover_batch(rs, ss, rec, msgs_p)
+        if full_verify:
+            with trace.span("ingest.verify_batch", n=k):
+                ok = verify_batch(rs, ss, msgs_p, list(zip(xs, ys)))
+            valid = valid & ok
+        xs, ys, valid = xs[:k], ys[:k], valid[:k]
 
     pub_keys = []
     addresses = []

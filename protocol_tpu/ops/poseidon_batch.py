@@ -416,11 +416,11 @@ class PoseidonBatchPlanes:
                               full_round, state)
         return state
 
-    def hash_batch(self, inputs) -> list:
-        """Batch of ≤width tuples → lane-0 digests (ints); the ingest
-        hot path. Host↔device conversion rides fieldops2's vectorized
-        u64 pack (the (n, L) engine's per-int python loops were ~2 s
-        per 32k batch on their own)."""
+    def hash_submit(self, inputs) -> tuple:
+        """Dispatch half of ``hash_batch``: host block build + the
+        permutation dispatch (async). Returns an opaque handle for
+        ``hash_finalize`` — the split lets a chunked ingest pipeline
+        hash chunk i+1 while the recovery ladder runs chunk i."""
         f2 = self.f2
         w = self.width
         n = len(inputs)
@@ -440,6 +440,21 @@ class PoseidonBatchPlanes:
         digest = lax.dynamic_slice_in_dim(out, 0, n, axis=1)
         ready = f2._pack16_slices(f2.canonical(
             jax.jit(f2.exit_mont)(digest)))
+        return (ready, n)
+
+    @staticmethod
+    def hash_finalize(handle) -> list:
+        """Download half of ``hash_batch``: syncs the permutation and
+        converts the packed digests to host ints."""
+        ready, n = handle
         host = np.ascontiguousarray(np.asarray(ready).T).view("<u8")
         return [int.from_bytes(host[i].tobytes(), "little")
                 for i in range(n)]
+
+    def hash_batch(self, inputs) -> list:
+        """Batch of ≤width tuples → lane-0 digests (ints); the ingest
+        hot path. Host↔device conversion rides fieldops2's vectorized
+        u64 pack (the (n, L) engine's per-int python loops were ~2 s
+        per 32k batch on their own). Composition of hash_submit →
+        hash_finalize; chunked callers pipeline the halves."""
+        return self.hash_finalize(self.hash_submit(inputs))

@@ -471,6 +471,78 @@ def _recover_prep(r_pl, rn_pl, m_pl, s_pl, want_odd):
             from_mont(CTX_N, u1), from_mont(CTX_N, u2))
 
 
+def recover_submit(rs, ss, rec_ids, msgs, _prep=None):
+    """Phase 1 of the split recovery: host limb prep + the
+    challenge-independent ``_recover_prep`` dispatch (async — queues
+    device work and returns). The split exists so a chunked caller can
+    software-pipeline: while the device runs chunk i's ladder, the host
+    builds chunk i+1's limbs here (``recover_stream``)."""
+    k = len(rs)
+    rs = [int(v) for v in rs]
+    ss = [int(v) for v in ss]
+    r_pl = jnp.asarray(to_limbs([v % SECP_P for v in rs]))
+    rn_pl = jnp.asarray(to_limbs([v % SECP_N for v in rs]))
+    m_pl = jnp.asarray(to_limbs([v % SECP_N for v in msgs]))
+    s_pl = jnp.asarray(to_limbs([v % SECP_N for v in ss]))
+    want_odd = jnp.asarray([int(bool(v)) for v in rec_ids],
+                           dtype=jnp.int32)
+    prep = (_prep or _recover_prep)(r_pl, rn_pl, m_pl, s_pl, want_odd)
+    range_ok = np.array([0 < r < SECP_N and s % SECP_N != 0
+                         for r, s in zip(rs, ss)], dtype=bool)
+    return (k, prep, range_ok)
+
+
+def recover_midstage(handle, _glv=None):
+    """Phase 2: download u2 (syncs phase 1), host-side Babai GLV split
+    (~2.4 µs/lane), then the ladder dispatch (async)."""
+    k, (r_m, y_sel, lift_ok, u1, u2), range_ok = handle
+    u2_ints = from_limbs(np.asarray(u2))
+    e1_neg = np.zeros(k, dtype=bool)
+    e2_neg = np.zeros(k, dtype=bool)
+    halves1, halves2 = [], []
+    for i, u in enumerate(u2_ints):
+        h1, e1, h2, e2 = glv_decompose(u)
+        halves1.append(h1)
+        halves2.append(h2)
+        e1_neg[i] = e1 < 0
+        e2_neg[i] = e2 < 0
+    s1l = to_limbs(halves1)
+    s2l = to_limbs(halves2)
+    ax, ay, not_inf = (_glv or _recover_glv)(
+        u1, jnp.asarray(s1l), jnp.asarray(s2l),
+        jnp.asarray(e1_neg), jnp.asarray(e2_neg), r_m, y_sel)
+    return (ax, ay, lift_ok, not_inf, range_ok)
+
+
+def recover_finalize(handle):
+    """Phase 3: download the affine results (syncs the ladder) and
+    assemble the validity mask."""
+    ax, ay, lift_ok, not_inf, range_ok = handle
+    xs = from_limbs(np.asarray(ax))
+    ys = from_limbs(np.asarray(ay))
+    return xs, ys, np.asarray(lift_ok & not_inf) & range_ok
+
+
+def recover_stream(chunks, _prep=None, _glv=None):
+    """Pipelined recovery over an iterable of (rs, ss, rec_ids, msgs)
+    chunks, yielding (xs, ys, valid) per chunk in order.
+
+    Two chunks are in flight: while the device runs chunk i's GLV
+    ladder (the dominant span), the host builds chunk i+1's limbs and
+    dispatches its prep — JAX dispatch is async through the tunnel, so
+    the reorder alone buys the overlap. Results are bit-identical to
+    per-chunk ``recover_batch`` (same kernels, same order within a
+    chunk; pinned by tests/test_secp_batch.py::TestRecoverStream)."""
+    mid = None
+    for ch in chunks:
+        sub = recover_submit(*ch, _prep=_prep)
+        if mid is not None:
+            yield recover_finalize(mid)
+        mid = recover_midstage(sub, _glv=_glv)
+    if mid is not None:
+        yield recover_finalize(mid)
+
+
 def recover_batch(rs, ss, rec_ids, msgs, _prep=None, _glv=None):
     """Batched pubkey recovery: pk = r⁻¹·(s·R − m·G) with R lifted from
     (r, rec_id) — the ingest hot path (``ecdsa/native.rs:298-331``,
@@ -491,39 +563,9 @@ def recover_batch(rs, ss, rec_ids, msgs, _prep=None, _glv=None):
     ``_prep``/``_glv`` override the two jitted device cores — the
     lane-sharded multichip twins (``parallel.ingest``) reuse this host
     orchestration unchanged (the ladders are embarrassingly lane-
-    parallel; only the Babai split runs on host between them)."""
-    k = len(rs)
-    rs = [int(v) for v in rs]
-    ss = [int(v) for v in ss]
-    r_pl = jnp.asarray(to_limbs([v % SECP_P for v in rs]))
-    rn_pl = jnp.asarray(to_limbs([v % SECP_N for v in rs]))
-    m_pl = jnp.asarray(to_limbs([v % SECP_N for v in msgs]))
-    s_pl = jnp.asarray(to_limbs([v % SECP_N for v in ss]))
-    want_odd = jnp.asarray([int(bool(v)) for v in rec_ids],
-                           dtype=jnp.int32)
+    parallel; only the Babai split runs on host between them).
 
-    r_m, y_sel, lift_ok, u1, u2 = (_prep or _recover_prep)(
-        r_pl, rn_pl, m_pl, s_pl, want_odd)
-
-    # host: Babai-round the λ-split of u2 (~2.4 µs/lane)
-    u2_ints = from_limbs(np.asarray(u2))
-    e1_neg = np.zeros(k, dtype=bool)
-    e2_neg = np.zeros(k, dtype=bool)
-    halves1, halves2 = [], []
-    for i, u in enumerate(u2_ints):
-        h1, e1, h2, e2 = glv_decompose(u)
-        halves1.append(h1)
-        halves2.append(h2)
-        e1_neg[i] = e1 < 0
-        e2_neg[i] = e2 < 0
-    s1l = to_limbs(halves1)
-    s2l = to_limbs(halves2)
-
-    ax, ay, not_inf = (_glv or _recover_glv)(
-        u1, jnp.asarray(s1l), jnp.asarray(s2l),
-        jnp.asarray(e1_neg), jnp.asarray(e2_neg), r_m, y_sel)
-    xs = from_limbs(np.asarray(ax))
-    ys = from_limbs(np.asarray(ay))
-    range_ok = np.array([0 < r < SECP_N and s % SECP_N != 0
-                         for r, s in zip(rs, ss)], dtype=bool)
-    return xs, ys, np.asarray(lift_ok & not_inf) & range_ok
+    Composition of recover_submit → recover_midstage → recover_finalize;
+    chunked callers pipeline the phases via ``recover_stream``."""
+    return recover_finalize(recover_midstage(
+        recover_submit(rs, ss, rec_ids, msgs, _prep=_prep), _glv=_glv))

@@ -27,6 +27,7 @@ import os
 from dataclasses import dataclass
 from fractions import Fraction
 
+from ..utils import trace
 from ..utils.errors import EigenError
 from ..utils.fields import Fr
 
@@ -462,13 +463,16 @@ def _aggregate_th_circuit(p, et_chips, et_pubs, target_address: Fr,
     circuit, aggregator/native.rs:78-96). The keygen half is served
     from ``_INNER_ET_PK_CACHE`` when the same (SRS, shape) was keyed
     before."""
-    if cache_key is not None:
-        et_pk = _inner_et_keygen(p, et_chips.cs, cache_key)
-    else:
-        et_pk = _keygen(p, et_chips.cs)
-    et_proof = _prove(p, et_pk, et_chips.cs)
-    return _build_th_circuit(et_pk, et_pubs, et_proof, target_address,
-                             threshold, ratio, shape)
+    with trace.span("th.inner_et_keygen"):
+        if cache_key is not None:
+            et_pk = _inner_et_keygen(p, et_chips.cs, cache_key)
+        else:
+            et_pk = _keygen(p, et_chips.cs)
+    with trace.span("th.inner_et_prove"):
+        et_proof = _prove(p, et_pk, et_chips.cs)
+    with trace.span("th.build_th_circuit"):
+        return _build_th_circuit(et_pk, et_pubs, et_proof, target_address,
+                                 threshold, ratio, shape)
 
 
 def generate_th_pk(params: bytes, shape: CircuitShape = DEFAULT_SHAPE) -> bytes:
@@ -489,9 +493,11 @@ def generate_th_pk(params: bytes, shape: CircuitShape = DEFAULT_SHAPE) -> bytes:
         et_pk, et_pubs, et_proof = cached
         _INNER_ET_PK_CACHE.clear()
         _INNER_ET_PK_CACHE[cache_key] = et_pk
-        chips, _ = _build_th_circuit(et_pk, et_pubs, et_proof, addrs[0],
-                                     Fr(1), ratios[0], shape)
-        return _keygen(p, chips.cs).to_bytes()
+        with trace.span("th.build_th_circuit"):
+            chips, _ = _build_th_circuit(et_pk, et_pubs, et_proof, addrs[0],
+                                         Fr(1), ratios[0], shape)
+        with trace.span("th.outer_keygen"):
+            return _keygen(p, chips.cs).to_bytes()
     et_chips, et_pubs = _build_et_circuit(witness, shape)
     et_pk = _inner_et_keygen(p, et_chips.cs, cache_key)
     et_proof = _prove(p, et_pk, et_chips.cs)
@@ -515,7 +521,8 @@ def generate_th_proof(params: bytes, pk: bytes, setup,
             "Client.th_circuit_setup",
         )
     p = _load_params(params)
-    et_chips, et_pubs = _et_setup_circuit(setup.et_setup, shape)
+    with trace.span("th.et_setup_circuit"):
+        et_chips, et_pubs = _et_setup_circuit(setup.et_setup, shape)
     chips, pubs = _aggregate_th_circuit(
         p, et_chips, et_pubs, setup.pub_inputs.address,
         setup.pub_inputs.threshold, setup.ratio, shape,
@@ -532,7 +539,8 @@ def generate_th_proof(params: bytes, pk: bytes, setup,
             "threshold circuit public inputs diverge from the setup",
         )
     setup.pub_inputs.agg_instances = [Fr(v) for v in pubs[3:]]
-    return _prove(p, _load_pk(pk), chips.cs)
+    with trace.span("th.outer_prove"):
+        return _prove(p, _load_pk(pk), chips.cs)
 
 
 def _accumulator_from_limbs(limbs: list):

@@ -944,8 +944,11 @@ def prove_fast_tpu(params: KZGParams, pk: FastProvingKey,
     # eval-form device arrays are transient: intt to coeffs, then drop
     # (ζ-evals run from coeffs; keeping 10 eval arrays resident is what
     # pushed k=20 over the 16 GB HBM line)
-    # streaming (k>=21) mode keeps every coefficient array packed
-    pack = (lambda x: x) if dp.ext_resident else ptpu._pack16_impl
+    # witness coefficient arrays stay packed in BOTH modes (every
+    # consumer unpacks at trace time via _as_planes): the 14 unpacked
+    # (L, n) columns are ~2.6 GB at k=21 — budget the resident-mode
+    # flagship needs for the quotient kernel's working set
+    pack = ptpu._pack16_impl
 
     # Host/device overlap: the 8n ext-chunk NTTs of every poly whose
     # coefficients and blinds are already fixed (wires, m, pi — and z,
@@ -955,11 +958,16 @@ def prove_fast_tpu(params: KZGParams, pk: FastProvingKey,
     # after it. Chunks are packed uint16 on arrival (~2.6 GB resident
     # for all 80 at k=20; the quotient kernel unpacks at trace time).
     # Device dispatch is async through the tunnel — these calls queue
-    # work and return. Default: resident mode only. In streaming mode
-    # (k=21) the packed witness ext chunks cost ~3.6 GB of HBM on top
-    # of the ~7.5 GB streaming plan — close enough to the 16 GB line
-    # that it stays opt-in (PTPU_PREDISPATCH=1) until measured safe.
-    pre = dp.ext_resident or os.environ.get("PTPU_PREDISPATCH") == "1"
+    # work and return. Default: resident mode at k ≤ 20 only — at k=21
+    # the 3.8 GB of predispatched witness chunks on top of the ~6.5 GB
+    # resident pk tables runs the 16 GB chip to the line, so k=21
+    # resident proves witness ext chunks per-coset from the packed
+    # coeffs instead (the pk-table NTTs are still saved). The same
+    # budget keeps it opt-in for streaming mode.
+    # PTPU_PREDISPATCH={0,1} overrides for measurement runs.
+    _pd = os.environ.get("PTPU_PREDISPATCH")
+    pre = ((dp.ext_resident and dp.k <= 20) if _pd not in ("0", "1")
+           else _pd == "1")
 
     def ext8(coeff_dev, blinds=None):
         return [ptpu._pack16_impl(e)

@@ -173,3 +173,47 @@ class TestClientBatchedIngest:
             assert (a is None) == (b is None)
             if a is not None:
                 assert (a.point.x, a.point.y) == (b.point.x, b.point.y)
+
+
+class TestChunkedPipelineIngest:
+    """Above the lane cap (PTPU_INGEST_CHUNK) the product path chunks
+    and software-pipelines; results must be identical to the
+    single-batch path, including validity masks and full-verify."""
+
+    def test_chunked_matches_single_batch(self, batch, monkeypatch):
+        _, signed = batch
+        many = (signed * 3)[:14]  # 14 lanes, cap 4 → 4 chunks, last short
+        ref_pks, ref_addrs, ref_valid = recover_signers_batch(many)
+        monkeypatch.setenv("PTPU_INGEST_CHUNK", "4")
+        pks, addrs, valid = recover_signers_batch(many)
+        assert (valid == ref_valid).all()
+        assert addrs == ref_addrs
+        for a, b in zip(pks, ref_pks):
+            assert (a is None) == (b is None)
+            if a is not None:
+                assert a.point == b.point
+
+    def test_chunked_flags_forged_lane(self, batch, monkeypatch):
+        kps, signed = batch
+        many = list(signed * 2)
+        # signature from key 0 pasted onto a different attestation.
+        # Lane 9 (second copy of lane 4) lands in the SHORT trailing
+        # chunk (10 lanes, cap 4 → [0-3][4-7][8-9]) — the padded-chunk
+        # boundary case
+        many[9] = SignedAttestationData(many[9].attestation,
+                                        signed[0].signature)
+        ref_pks, ref_addrs, ref_valid = recover_signers_batch(many)
+        monkeypatch.setenv("PTPU_INGEST_CHUNK", "4")
+        pks, addrs, valid = recover_signers_batch(many)
+        assert (valid == ref_valid).all()
+        assert addrs == ref_addrs
+        if valid[9]:
+            assert addrs[9] != kps[4].public_key.to_address_bytes()
+
+    def test_chunked_full_verify_mask_stable(self, batch, monkeypatch):
+        _, signed = batch
+        many = signed * 2
+        monkeypatch.setenv("PTPU_INGEST_CHUNK", "4")
+        _, _, base = recover_signers_batch(many)
+        _, _, audited = recover_signers_batch(many, full_verify=True)
+        assert (base == audited).all()

@@ -1,8 +1,12 @@
 """Canary for the tunnel worker's ladder-dispatch lane ceiling.
 
-Ingest chunks at 32k lanes because ≥64k-lane dispatches crash the TPU
-tunnel worker (BASELINE.md; tools/probe_lane_crash.py holds the
-bisect). This canary pins the workaround's boundary: if a runtime
+Ingest chunks at 32k lanes (PTPU_INGEST_CHUNK), well under the
+measured worker-crash boundary — the r5 bisect
+(tools/probe_lane_crash.py, 2026-08-01) found the GLV recovery
+program survives 405,504 lanes and crashes the TPU worker at 409,600
+("TPU worker process crashed or restarted ... kernel fault"), so the
+r4-era 64k ceiling was program-shape-specific, not a hard transport
+limit. This canary pins the cap's boundary: if a runtime
 update ever shifts the ceiling BELOW the ingest chunk size, the chip
 battery fails here with the probe's signature instead of ingest dying
 mid-run with no diagnostic (VERDICT r4 → r5 ask #6).

@@ -215,3 +215,55 @@ class TestHostParityEdges:
             host = recover_public_key(
                 Signature(r=s.r, s=s.s, rec_id=rec_ids[i]), m)
             assert (xs[i], ys[i]) == (host.point.x, host.point.y)
+
+
+class TestRecoverStream:
+    """The pipelined split (submit/midstage/finalize + recover_stream)
+    must be bit-identical to per-chunk recover_batch — same kernels,
+    same within-chunk order; only the host/device interleaving differs."""
+
+    def test_stream_matches_batch_per_chunk(self, signed):
+        _, msgs, sigs, _ = signed
+        half = BATCH // 2
+        chunks = []
+        for lo, hi in ((0, half), (half, BATCH)):
+            chunks.append(([s.r for s in sigs[lo:hi]],
+                           [s.s for s in sigs[lo:hi]],
+                           [s.rec_id for s in sigs[lo:hi]],
+                           msgs[lo:hi]))
+        streamed = list(sb.recover_stream(iter(chunks)))
+        assert len(streamed) == len(chunks)
+        for ch, (xs, ys, valid) in zip(chunks, streamed):
+            bx, by, bvalid = sb.recover_batch(*ch)
+            assert xs == bx and ys == by
+            assert (valid == bvalid).all()
+
+    def test_stream_single_chunk_and_empty(self, signed):
+        _, msgs, sigs, _ = signed
+        ch = ([s.r for s in sigs], [s.s for s in sigs],
+              [s.rec_id for s in sigs], msgs)
+        (xs, ys, valid), = list(sb.recover_stream([ch]))
+        bx, by, bvalid = sb.recover_batch(*ch)
+        assert xs == bx and ys == by and (valid == bvalid).all()
+        assert list(sb.recover_stream([])) == []
+
+    def test_invalid_lane_flagged_in_stream(self, signed):
+        _, msgs, sigs, _ = signed
+        rs = [s.r for s in sigs]
+        rs[0] = 0  # out of [1, n) — binding range check
+        ch = (rs, [s.s for s in sigs],
+              [s.rec_id for s in sigs], msgs)
+        (_, _, valid), = list(sb.recover_stream([ch]))
+        assert not valid[0] and valid[1:].all()
+
+
+class TestHashSubmitFinalize:
+    def test_split_matches_hash_batch(self):
+        from protocol_tpu.models.eigentrust import HASHER_WIDTH
+        from protocol_tpu.ops.poseidon_batch import (
+            get_poseidon_batch_planes,
+        )
+
+        pb = get_poseidon_batch_planes(HASHER_WIDTH)
+        rows = [[i + 1, 42, i * 7 + 3, 0] for i in range(8)]
+        assert pb.hash_finalize(pb.hash_submit(rows)) == pb.hash_batch(rows)

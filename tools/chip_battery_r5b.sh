@@ -1,0 +1,60 @@
+#!/bin/bash
+# Round-5 chip battery, part 2 — the steps that depend on round-5
+# session code (pipelined ingest, fixed probe suite, resident-mode
+# flagship, th cycle with spans, headline bench, scale ceiling).
+# Serial on a healthy tunnel; NEVER kill a step mid-first-compile
+# (BASELINE r5 outage note). Logs land in bench_cache/r5_logs/.
+cd "$(dirname "$0")/.." || exit 1
+mkdir -p bench_cache/r5_logs
+L=bench_cache/r5_logs
+note() { echo "[$(date +%H:%M:%S)] $*" | tee -a "$L/battery.log"; }
+
+note "=== battery part 2 start ==="
+note "health gate"
+timeout 300 python -c "import jax; print(jax.devices())" || {
+  note "tunnel unhealthy - aborting part 2"; exit 1; }
+
+note "5. pipelined ingest 1M (the >=7k att/s headline)"
+python -u tools/bench_ingest.py --n 1048576 --chunk 32768 \
+  2>&1 | tee "$L/ingest_1m_pipelined.log"
+note "step5 rc=$?"
+
+note "5b. pipelined ingest 1M, 128k chunks (lane ceiling measured ~400k)"
+python -u tools/bench_ingest.py --n 1048576 --chunk 131072 \
+  2>&1 | tee "$L/ingest_1m_128k.log"
+note "step5b rc=$?"
+
+note "6. probe suite re-run (fenced methodology) -> PROBES_r05.json"
+python -u tools/probe_suite_json.py --out PROBES_r05.json \
+  2>&1 | tee "$L/probes2.log"
+note "step6 rc=$?"
+
+note "7. k=21 flagship, RESIDENT mode (cold+warm; packed coeffs)"
+PTPU_EXT_RESIDENT=1 python -u tools/prove_flagship.py \
+  2>&1 | tee "$L/flagship_resident.log"
+rc=$?
+note "step7 rc=$rc"
+if [ $rc -ne 0 ]; then
+  note "7b. flagship STREAMING fallback"
+  python -u tools/prove_flagship.py 2>&1 | tee "$L/flagship_stream.log"
+  note "step7b rc=$?"
+fi
+
+note "8. threshold cycle COLD (fresh SRS + dummy snark)"
+python -u tools/th_cycle.py 2>&1 | tee "$L/th_cycle_cold.log"
+note "step8 rc=$?"
+
+note "8b. threshold cycle WARM (dummy-snark disk cache)"
+python -u tools/th_cycle.py 2>&1 | tee "$L/th_cycle_warm.log"
+note "step8b rc=$?"
+
+note "9. headline bench (fresh 10M build + converge)"
+python -u bench.py 2>&1 | tee "$L/bench.log"
+note "step9 rc=$?"
+
+note "10. scale ceiling 20M/30M, both backends -> SCALE_r05.json"
+python -u tools/probe_scale_ceiling.py --configs 20000000,30000000 \
+  2>&1 | tee "$L/scale.log"
+note "step10 rc=$?"
+
+note "=== battery part 2 done ==="

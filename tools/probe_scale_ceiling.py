@@ -1,0 +1,219 @@
+"""Single-chip scale ceiling: push peer count past the 10M headline
+until a resource wall stops each path, and record WHICH wall.
+
+VERDICT r4 ask #7: the 10M converge runs 3.5x under the north-star
+target and nothing documents where one chip actually runs out. This
+probe walks configs upward (default 20M, 30M peers, BA m=8 — 2x/3x
+the headline's 159M edges) through both SpMV engines and records, per
+config and phase:
+
+- host graph build / plan compile / staging wall-clock,
+- the device bytes the staged operator needs (the HBM bill converge
+  pays before any compute),
+- converge wall + iterations on success,
+- the exception type + message when a phase dies (RESOURCE_EXHAUSTED,
+  host OOM, plan-slot overflow...), which is the measured per-chip
+  shard budget the multichip design divides by.
+
+Results append to SCALE_r05.json (one JSON object per config+backend).
+Run AFTER the timing-critical battery steps — the host phases here are
+minutes of one-core work and would contend.
+
+Usage: python tools/probe_scale_ceiling.py [--configs 20000000,30000000]
+       [--backend routed|gather|both] [--out SCALE_r05.json]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import resource
+import sys
+import time
+import traceback
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _rss_gb() -> float:
+    return resource.getrusage(resource.RUSAGE_SELF).ru_maxrss / 1e6
+
+
+def _tree_bytes(tree) -> int:
+    import jax
+
+    return sum(int(x.nbytes) for x in jax.tree_util.tree_leaves(tree)
+               if hasattr(x, "nbytes"))
+
+
+def _fail(rec: dict, phase: str, exc: BaseException) -> dict:
+    rec["failed_phase"] = phase
+    rec["error_type"] = type(exc).__name__
+    rec["error"] = str(exc)[:400]
+    rec["traceback_tail"] = traceback.format_exc(limit=3)[-600:]
+    return rec
+
+
+def run_config(n: int, m: int, backend: str, cache_dir: str,
+               tol: float, alpha: float) -> dict:
+    from protocol_tpu.utils.platform import honor_jax_platforms_env
+
+    honor_jax_platforms_env()  # the subprocess must re-assert JAX_PLATFORMS
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    rec: dict = {"n_peers": n, "m": m, "backend": backend,
+                 "device": str(jax.devices()[0])}
+    t0 = time.perf_counter()
+    try:
+        from protocol_tpu.graph import barabasi_albert_edges
+
+        src, dst, val = barabasi_albert_edges(n, m, seed=0)
+        rec["edges"] = int(len(src))
+        rec["graph_s"] = round(time.perf_counter() - t0, 1)
+        rec["rss_after_graph_gb"] = round(_rss_gb(), 1)
+    except BaseException as e:  # noqa: BLE001 — the wall IS the result
+        return _fail(rec, "graph_build", e)
+
+    t0 = time.perf_counter()
+    try:
+        if backend == "routed":
+            from pathlib import Path
+
+            from protocol_tpu.ops.routed import (
+                RoutedOperator,
+                build_routed_operator,
+                converge_routed_adaptive,
+                routed_arrays,
+            )
+
+            cache = Path(cache_dir) / f"routed_ba_n{n}_m{m}_s0_v2"
+            if cache.exists():
+                op = RoutedOperator.load(cache)
+                rec["plan_cached"] = True
+            else:
+                op = build_routed_operator(n, src, dst, val)
+                cache.parent.mkdir(parents=True, exist_ok=True)
+                op.save(cache)
+            rec["plan_s"] = round(time.perf_counter() - t0, 1)
+            rec["rss_after_plan_gb"] = round(_rss_gb(), 1)
+            del src, dst, val
+            t0 = time.perf_counter()
+            arrs, static = routed_arrays(op, dtype=jnp.float32, alpha=alpha)
+            rec["operator_bytes_gb"] = round(_tree_bytes(arrs) / 2**30, 2)
+            arrs = jax.device_put(arrs)
+            s0 = jax.device_put(jnp.asarray(op.initial_scores(1000.0)))
+            jax.block_until_ready(s0)
+            rec["staging_s"] = round(time.perf_counter() - t0, 1)
+            n_valid, run = op.n_valid, (lambda: converge_routed_adaptive(
+                arrs, static, s0, tol=tol, max_iterations=500))
+
+            def total(scores):
+                return float(op.scores_for_nodes(np.asarray(scores)).sum())
+        else:
+            from protocol_tpu.graph import build_operator
+            from protocol_tpu.ops.converge import (
+                converge_sparse_adaptive,
+                operator_arrays,
+            )
+
+            op = build_operator(n, src, dst, val)
+            rec["plan_s"] = round(time.perf_counter() - t0, 1)
+            rec["rss_after_plan_gb"] = round(_rss_gb(), 1)
+            del src, dst, val
+            t0 = time.perf_counter()
+            host_arrs = operator_arrays(op, dtype=jnp.float32, alpha=alpha)
+            rec["operator_bytes_gb"] = round(_tree_bytes(host_arrs) / 2**30, 2)
+            arrs = jax.device_put(host_arrs)
+            del host_arrs
+            s0 = jax.device_put(
+                jnp.asarray(op.valid, dtype=jnp.float32) * 1000.0)
+            jax.block_until_ready(s0)
+            rec["staging_s"] = round(time.perf_counter() - t0, 1)
+            n_valid, run = op.n_valid, (lambda: converge_sparse_adaptive(
+                arrs, s0, tol=tol, max_iterations=500))
+
+            def total(scores):
+                return float(np.asarray(scores).sum())
+    except BaseException as e:  # noqa: BLE001
+        return _fail(rec, "plan_or_staging", e)
+
+    try:
+        scores, iters, delta = run()
+        float(delta)  # sync: compile + first run
+        t0 = time.perf_counter()
+        scores, iters, delta = run()
+        float(delta)
+        rec["converge_s"] = round(time.perf_counter() - t0, 3)
+        rec["iterations"] = int(iters)
+        rec["final_delta"] = float(delta)
+        rec["converged"] = bool(float(delta) <= tol)
+        expected = n_valid * 1000.0
+        rec["conservation_rel_err"] = abs(total(scores) - expected) / expected
+        rec["ok"] = True
+    except BaseException as e:  # noqa: BLE001
+        return _fail(rec, "converge", e)
+    return rec
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--configs", default="20000000,30000000")
+    ap.add_argument("--m", type=int, default=8)
+    ap.add_argument("--backend", choices=["routed", "gather", "both"],
+                    default="both")
+    ap.add_argument("--tol", type=float, default=1e-6)
+    ap.add_argument("--alpha", type=float, default=0.1)
+    ap.add_argument("--cache-dir", default="bench_cache")
+    ap.add_argument("--out", default="SCALE_r05.json")
+    args = ap.parse_args()
+    sys.path.insert(0, REPO)
+    os.chdir(REPO)
+
+    from protocol_tpu.utils.platform import honor_jax_platforms_env
+
+    honor_jax_platforms_env()
+
+    backends = (["routed", "gather"] if args.backend == "both"
+                else [args.backend])
+    results = []
+    if os.path.exists(args.out):
+        results = json.load(open(args.out))
+    for n in [int(x) for x in args.configs.split(",") if x]:
+        for backend in backends:
+            # each config+backend runs in a SUBPROCESS: a RESOURCE_EXHAUSTED
+            # or host OOM must not take down the sweep (and a dead tunnel
+            # worker dies with its process)
+            import subprocess
+
+            code = (
+                "import json, sys; sys.path.insert(0, {!r});"
+                "from tools.probe_scale_ceiling import run_config;"
+                "print('RESULT ' + json.dumps(run_config({}, {}, {!r}, {!r},"
+                " {}, {})))".format(REPO, n, args.m, backend, args.cache_dir,
+                                    args.tol, args.alpha)
+            )
+            print(f"--- n={n} backend={backend}", flush=True)
+            proc = subprocess.run([sys.executable, "-c", code],
+                                  capture_output=True, text=True)
+            rec = None
+            for line in proc.stdout.splitlines():
+                if line.startswith("RESULT "):
+                    rec = json.loads(line[len("RESULT "):])
+            if rec is None:
+                rec = {"n_peers": n, "m": args.m, "backend": backend,
+                       "failed_phase": "process",
+                       "error_type": f"exit_{proc.returncode}",
+                       "error": (proc.stderr or proc.stdout)[-400:]}
+            results.append(rec)
+            print(json.dumps(rec), flush=True)
+            with open(args.out, "w") as f:
+                json.dump(results, f, indent=1)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -8,6 +8,15 @@ dispatch round-trip latency. The prose study lives in BASELINE.md
 ("Why the MSM stays on the host"); this artifact keeps the numbers
 auditable when the hardware or runtime changes.
 
+Tunnel methodology (the same one bench.py documents): over the axon
+transport ``block_until_ready`` can return before execution finishes,
+and ``np.asarray`` on an already-fetched jax.Array re-reads a cached
+host copy. Every timed region here therefore fences through a real
+host read of fresh data — a scalar reduce fetch for compute probes,
+a freshly-produced buffer per rep for the download probe — and
+subtracts the separately-measured dispatch round-trip where it would
+dominate.
+
 Usage: python tools/probe_suite_json.py [--out PROBES_r05.json]
 """
 
@@ -47,6 +56,7 @@ def main() -> int:
                       os.path.join(REPO, "bench_cache", "zk", "xla_cache"))
     jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
     import jax.numpy as jnp
+    from jax import lax
 
     from protocol_tpu.ops import fieldops2 as f2
 
@@ -54,51 +64,86 @@ def main() -> int:
            "device": str(jax.devices()[0]),
            "timestamp": time.strftime("%Y-%m-%dT%H:%M:%S")}
 
+    def fetch_scalar(x):
+        # a 4-byte host read of a fresh value is the only reliable fence
+        return int(np.asarray(jnp.max(x)))
+
+    # 0. dispatch round-trip latency (tiny program + scalar fetch) —
+    # measured FIRST; the compute probes subtract it
+    tiny = jnp.zeros((8,), jnp.int32)
+    bump = jax.jit(lambda x: x + 1)
+    fetch_scalar(bump(tiny))
+    rtt = best_of(lambda: fetch_scalar(bump(tiny)), reps=5)
+    out["dispatch_sync_rtt_ms"] = round(rtt * 1e3, 2)
+
     # 1. dependent elementwise Montgomery-mul throughput (the VPU
-    # bound that kills a device Pippenger: ~16n EC adds x ~12 muls)
+    # bound that kills a device Pippenger: ~16n EC adds x ~12 muls).
+    # 40 dependent muls ride ONE dispatch via fori_loop so the ~100 ms
+    # tunnel RTT does not swamp the per-mul cost.
     n = 1 << 20
+    CHAIN = 40
     rng = np.random.default_rng(3)
     a = jnp.asarray(rng.integers(0, 1 << 11, (f2.L, n), dtype=np.int64),
                     dtype=jnp.int32)
 
     @jax.jit
-    def chain4(x):
-        y = f2.mont_mul(x, x)
-        y = f2.mont_mul(y, x)
-        y = f2.mont_mul(y, y)
-        y = f2.mont_mul(y, x)
-        return y
+    def chainK(x):
+        return lax.fori_loop(0, CHAIN, lambda i, y: f2.mont_mul(y, x), x)
 
-    t = best_of(lambda: jax.block_until_ready(chain4(a)))
-    out["field_mul_dependent_Mmuls_per_s"] = round(4 * n / t / 1e6, 1)
+    t = best_of(lambda: fetch_scalar(chainK(a))) - rtt
+    out["field_mul_dependent_Mmuls_per_s"] = round(CHAIN * n / t / 1e6, 1)
+    out["field_mul_ms_per_batch_mul"] = round(t / CHAIN * 1e3, 2)
     out["field_mul_batch_shape"] = [f2.L, n]
 
-    # 2. row gather latency (flat in row width — scalar-core bound)
+    # 2. row gather latency (flat in row width — scalar-core bound).
+    # The max-reduce fence adds one elementwise pass — noted, small vs
+    # the ~100 ns/row gather bound it guards.
     for width in (4, 64):
         tbl = jnp.asarray(rng.integers(0, 1 << 30, (1 << 20, width),
                                        dtype=np.int64), dtype=jnp.int32)
         idx = jnp.asarray(rng.integers(0, 1 << 20, 1 << 20),
                           dtype=jnp.int32)
         g = jax.jit(lambda t_, i_: jnp.take(t_, i_, axis=0))
-        t = best_of(lambda: jax.block_until_ready(g(tbl, idx)))
-        out[f"row_gather_ns_per_row_w{width}"] = round(t / (1 << 20)
-                                                       * 1e9, 1)
+        t_raw = best_of(lambda: fetch_scalar(g(tbl, idx)))
+        # record the raw wall too: when the gather cost nears the RTT,
+        # the subtraction is jitter-dominated — a negative corrected
+        # value must never land in the audit artifact
+        out[f"row_gather_raw_ms_w{width}"] = round(t_raw * 1e3, 2)
+        t = t_raw - rtt
+        if t <= 0:
+            out[f"row_gather_ns_per_row_w{width}"] = None
+        else:
+            out[f"row_gather_ns_per_row_w{width}"] = round(
+                t / (1 << 20) * 1e9, 1)
 
-    # 3. tunnel bandwidth, both directions (64 MB payload)
+    # 3. tunnel bandwidth, both directions (64 MB payload).
+    # Upload: device_put queues lazily — fence by consuming the array
+    # on device and fetching a scalar, minus the consume cost measured
+    # on an already-resident twin.
     host = np.zeros((1 << 24,), dtype=np.int32)  # 64 MB
-    t = best_of(lambda: jax.block_until_ready(jax.device_put(host)),
-                reps=2)
-    out["tunnel_upload_MB_per_s"] = round(host.nbytes / 2**20 / t, 1)
-    dev = jax.device_put(host)
-    t = best_of(lambda: np.asarray(dev), reps=2)
-    out["tunnel_download_MB_per_s"] = round(host.nbytes / 2**20 / t, 1)
+    resident = jax.device_put(host)
+    fetch_scalar(resident)
+    consume = best_of(lambda: fetch_scalar(resident), reps=3)
 
-    # 4. dispatch round-trip latency (tiny program, sync)
-    tiny = jnp.zeros((8,), jnp.int32)
-    bump = jax.jit(lambda x: x + 1)
-    jax.block_until_ready(bump(tiny))
-    t = best_of(lambda: jax.block_until_ready(bump(tiny)), reps=5)
-    out["dispatch_sync_rtt_ms"] = round(t * 1e3, 2)
+    def upload_once():
+        return fetch_scalar(jax.device_put(host))
+
+    t = best_of(upload_once, reps=2) - consume
+    out["tunnel_upload_MB_per_s"] = round(host.nbytes / 2**20 / t, 1)
+
+    # Download: a FRESH device buffer per rep (np.asarray on a fetched
+    # array re-reads the cached host copy), produced and fenced before
+    # the timed read.
+    def download_once():
+        fresh = bump(resident)
+        fetch_scalar(fresh)  # ensure produced before timing the read
+        t0 = time.perf_counter()
+        np.asarray(fresh)
+        return time.perf_counter() - t0
+
+    download_once()
+    t = min(download_once() for _ in range(2))
+    out["tunnel_download_MB_per_s"] = round(host.nbytes / 2**20 / t, 1)
 
     line = json.dumps(out)
     print(line, flush=True)

@@ -47,9 +47,15 @@ def main() -> int:
     except Exception:
         pass
 
+    from protocol_tpu.utils import trace
     from protocol_tpu.utils.fields import Fr
     from protocol_tpu.zk import api
     from protocol_tpu.zk.api import TINY_SHAPE as TINY
+
+    # per-phase spans (th.et_setup_circuit / th.inner_et_prove /
+    # th.outer_prove ...) decompose the two headline numbers below —
+    # BASELINE's th-cycle row is tuned against this map
+    trace.enable()
 
     tiny_et_setup = api.demo_et_setup
 
@@ -115,6 +121,11 @@ def main() -> int:
     timings["total_s"] = round(sum(v for v in timings.values()
                                    if isinstance(v, (int, float))), 1)
     timings["k"] = args.k
+    spans = {}
+    for name, stats in sorted(trace.summary().items()):
+        if name.startswith("th."):
+            spans[name] = round(stats["total_s"], 1)
+    timings["spans"] = spans
     print(json.dumps(timings), flush=True)
     return 0
 
