@@ -63,10 +63,13 @@ def main():
 
     if args.ingest:
         # chip-measured att/s for hash + binding-checked GLV recovery;
-        # 32k chunks are the largest single ladder dispatch the tunnel
-        # worker survives (tools/probe_lane_crash.py canary)
-        import subprocess
-
+        # 32k chunks ride far under the bisected ~408k worker-crash
+        # lane ceiling (tools/probe_lane_crash.py canary).
+        # NOTE: no local `import subprocess` here — a local import
+        # shadows the module-level one for the WHOLE function, making
+        # the non-ingest probe-and-retry path below die with
+        # UnboundLocalError (exactly how the r5 battery's bench step
+        # failed).
         n_att = args.n if args.n != 10_000_000 else 1 << 20
         return subprocess.call(
             [sys.executable, os.path.join(os.path.dirname(

@@ -4,6 +4,7 @@
 # flagship, th cycle with spans, headline bench, scale ceiling).
 # Serial on a healthy tunnel; NEVER kill a step mid-first-compile
 # (BASELINE r5 outage note). Logs land in bench_cache/r5_logs/.
+set -o pipefail  # rc checks below read the python status, not tee's
 cd "$(dirname "$0")/.." || exit 1
 mkdir -p bench_cache/r5_logs
 L=bench_cache/r5_logs

@@ -1,0 +1,37 @@
+#!/bin/bash
+# Round-5 chip battery, part 3 — flagship k=21 follow-ups after the
+# resident-mode probe RESOURCE_EXHAUSTED inside round 3 (init fit;
+# the quotient working set did not). Run AFTER part 2 finishes.
+#
+# 7c: plain streaming (the r4-comparable configuration, fresh box) —
+#     cold + warm in one process.
+# 7d: streaming + PTPU_PREDISPATCH=1 on a QUIET core — the witness
+#     ext chunks dispatch during the round-1/2 host commits (~11 GB
+#     projected; r4 only ever measured this under full-suite CPU
+#     contention, where it lost).
+set -o pipefail
+cd "$(dirname "$0")/.." || exit 1
+mkdir -p bench_cache/r5_logs
+L=bench_cache/r5_logs
+note() { echo "[$(date +%H:%M:%S)] $*" | tee -a "$L/battery.log"; }
+
+note "=== battery part 3 (flagship follow-ups) start ==="
+note "health gate"
+timeout 300 python -c "import jax; print(jax.devices())" || {
+  note "tunnel unhealthy - aborting part 3"; exit 1; }
+
+note "7c. k=21 flagship, streaming (cold+warm)"
+python -u tools/prove_flagship.py 2>&1 | tee "$L/flagship_stream.log"
+note "step7c rc=$?"
+
+note "7d. k=21 flagship, streaming + predispatch (warm, quiet core)"
+PTPU_PREDISPATCH=1 python -u tools/prove_flagship.py --skip-cold \
+  2>&1 | tee "$L/flagship_predispatch.log"
+note "step7d rc=$?"
+
+note "7e. k=21 flagship span map (TRACE_SYNC serializes - slower total)"
+PTPU_TRACE_SYNC=1 python -u tools/prove_flagship.py --skip-cold --trace \
+  2>&1 | tee "$L/flagship_trace.log"
+note "step7e rc=$?"
+
+note "=== battery part 3 done ==="

@@ -122,10 +122,17 @@ def main() -> int:
                                    if isinstance(v, (int, float))), 1)
     timings["k"] = args.k
     spans = {}
+    prover_spans = {}
     for name, stats in sorted(trace.summary().items()):
         if name.startswith("th."):
             spans[name] = round(stats["total_s"], 1)
+        elif name.startswith(("prove_tpu.", "ingest.")):
+            # decompose the inner/outer proves: device_prover_init,
+            # r1 uploads, commits, r3 quotient, openings... summed
+            # across BOTH proves (k=20 inner + k=21 outer)
+            prover_spans[name] = round(stats["total_s"], 1)
     timings["spans"] = spans
+    timings["prover_spans"] = prover_spans
     print(json.dumps(timings), flush=True)
     return 0
 
