@@ -344,6 +344,7 @@ def _perm_step_sg_impl(pd, sg_e, beta_plane, w, gamma_plane):
 @jax.jit
 def _lk_impl(w5, fx8_e, m_e, phii, phiwi, blk_plane):
     w5 = _as_planes(w5)
+    fx8_e = _as_planes(fx8_e)  # packed when read from a resident table
     m_e = _as_planes(m_e)
     n = w5.shape[1]
     one = f2._const_planes(_mont(1), n)
@@ -493,18 +494,32 @@ class DeviceProver:
     separate args (a 29-poly jnp.stack is a multi-GB transient)."""
 
     def __init__(self, k: int, shift: int, fixed_evals_u64, sigma_evals_u64,
-                 ext_resident: bool | None = None):
+                 ext_resident: "bool | str | None" = None):
         self.k = k
         self.n = n = 1 << k
-        # resident packed ext chunks are a speed/HBM trade: ~1.9 GB at
-        # k=20, ~3.9 GB at k=21 post-z-split. k=21 resident is now
-        # plausible on a 16 GB chip but unmeasured — default stays
-        # k ≤ 20 until the flagship HBM headroom is confirmed.
-        # PTPU_EXT_RESIDENT={0,1} overrides for measurement runs.
+        # Resident packed ext chunks are a speed/HBM trade — three modes:
+        #   True    full residency (~1.9 GB k=20 / ~3.9 GB k=21): the
+        #           fused quotient kernel. k=21 full residency was
+        #           measured RESOURCE_EXHAUSTED inside round 3 on the
+        #           16 GB chip (r5 battery) — init fits, the quotient
+        #           working set does not.
+        #   "fixed" PARTIAL residency: only the 9 fixed columns' ext
+        #           chunks stay resident (+~2.4 GB at k=21 on the
+        #           streaming plan); the streaming quotient skips 36 of
+        #           its 60 per-prove on-the-fly pk NTTs, σ columns
+        #           still stream.
+        #   False   pure streaming — at most one pk ext chunk live.
+        # PTPU_EXT_RESIDENT={0,1,fixed} overrides for measurement runs.
         if ext_resident is None:
             env = os.environ.get("PTPU_EXT_RESIDENT")
-            ext_resident = (env == "1") if env in ("0", "1") else k <= 20
-        self.ext_resident = ext_resident
+            if env == "fixed":
+                ext_resident = "fixed"
+            else:
+                ext_resident = (env == "1") if env in ("0", "1") \
+                    else k <= 20
+        self.ext_resident = ext_resident is True
+        self.fixed_ext_resident = (ext_resident is True
+                                   or ext_resident == "fixed")
         # pre-compile the upload/download programs at the working shape
         # BEFORE the heavy jit battery: the remote worker has repeatedly
         # faulted when the download program compiles after dozens of
@@ -562,7 +577,7 @@ class DeviceProver:
             ev = upload_mont(a)
             cf = self.intt_natural(ev)
             del ev
-            if self.ext_resident:
+            if self.fixed_ext_resident:
                 self.fixed_ext.append(
                     [pk16(self.ext_chunk(cf, j)) for j in range(EXT_COSETS)])
             self.fixed_coeffs.append(pk16(cf))
@@ -651,11 +666,20 @@ class DeviceProver:
             self.xs_fs[j], self.l0_fs[j], ch_planes,
             self.zh_inv_planes[j], self.A, self.B)
 
+    def _fixed_ext_chunk(self, i: int, j: int) -> jnp.ndarray:
+        """Fixed column i's ext chunk j: the resident packed table in
+        "fixed"/full residency, an on-the-fly NTT otherwise."""
+        if self.fixed_ext:
+            return self.fixed_ext[i][j]
+        return self.ext_chunk(self.fixed_coeffs[i], j)
+
     def _quotient_chunk_streaming(self, j, wires_e, z_e, m_e, phi_e,
                                   pi_e, uv_e, ch_planes) -> jnp.ndarray:
-        """Same math as ``_quotient_chunk_impl``, but each pk column's
-        ext chunk is generated on the fly and folded immediately, so at
+        """Same math as ``_quotient_chunk_impl``, but pk-column ext
+        chunks are generated on the fly and folded immediately, so at
         most one is live — see the streaming-quotient section above.
+        In partial ("fixed") residency the 9 fixed columns read their
+        resident packed tables instead (the σ chains still stream).
         Bit-identical to the resident path (tested)."""
         def cp(idx):  # (L, 1) challenge plane
             return ch_planes[:, idx : idx + 1]
@@ -671,18 +695,16 @@ class DeviceProver:
         # gate: Σ fx_i·w_i + fx5·w0w1 + fx6·w2w3 + fx7 + pi
         gate = None
         for i in range(5):
-            fx = self.ext_chunk(self.fixed_coeffs[i], j)
+            fx = self._fixed_ext_chunk(i, j)
             gate = (_mul_first_impl(fx, wires_e[i]) if gate is None
                     else _mul_acc_impl(gate, fx, wires_e[i]))
         w01 = _mul_first_impl(wires_e[0], wires_e[1])
-        gate = _mul_acc_impl(gate, self.ext_chunk(self.fixed_coeffs[5], j),
-                             w01)
+        gate = _mul_acc_impl(gate, self._fixed_ext_chunk(5, j), w01)
         del w01
         w23 = _mul_first_impl(wires_e[2], wires_e[3])
-        gate = _mul_acc_impl(gate, self.ext_chunk(self.fixed_coeffs[6], j),
-                             w23)
+        gate = _mul_acc_impl(gate, self._fixed_ext_chunk(6, j), w23)
         del w23
-        gate = _add2_impl(gate, self.ext_chunk(self.fixed_coeffs[7], j))
+        gate = _add2_impl(gate, self._fixed_ext_chunk(7, j))
         gate = _add2_impl(gate, pi_e)
 
         # z-split partial-product chains. X-side factors need no pk
@@ -715,7 +737,7 @@ class DeviceProver:
 
         # LogUp
         phiwi = fs_roll_next(phi_e, self.A, self.B)
-        fx8 = self.ext_chunk(self.fixed_coeffs[8], j)
+        fx8 = self._fixed_ext_chunk(8, j)
         lk = _lk_impl(wires_e[5], fx8, m_e, phi_e, phiwi, cp(2))
         del fx8
 

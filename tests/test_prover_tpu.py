@@ -200,6 +200,11 @@ def test_streaming_quotient_matches_resident(dp):
     shifts = _find_coset_shifts(N, 6)
     ch_r = dp_obj.challenge_planes(beta, gamma, beta_lk, alpha, shifts)
     ch_s = dp_stream.challenge_planes(beta, gamma, beta_lk, alpha, shifts)
+    dp_fixed = ptpu.DeviceProver(K, SHIFT, fixed_u64, sigma_u64,
+                                 ext_resident="fixed")
+    assert dp_fixed.fixed_ext and not dp_fixed.ext_resident \
+        and not dp_fixed.sigma_ext
+    ch_f = dp_fixed.challenge_planes(beta, gamma, beta_lk, alpha, shifts)
     for j in (0, 3):
         we_r = [dp_obj.ext_chunk(dp_obj.intt_natural(w), j) for w in wires]
         ze_r = dp_obj.ext_chunk(dp_obj.intt_natural(z), j)
@@ -211,8 +216,13 @@ def test_streaming_quotient_matches_resident(dp):
                                       uve_r, ch_r)
         t_str = dp_stream.quotient_chunk(j, we_r, ze_r, me_r, pe_r,
                                          pie_r, uve_r, ch_s)
-        assert np.array_equal(ptpu.download_std(t_res),
-                              ptpu.download_std(t_str))
+        # partial ("fixed") residency: resident packed fixed tables,
+        # streamed σ chains — same bits again
+        t_fix = dp_fixed.quotient_chunk(j, we_r, ze_r, me_r, pe_r,
+                                        pie_r, uve_r, ch_f)
+        res = ptpu.download_std(t_res)
+        assert np.array_equal(res, ptpu.download_std(t_str))
+        assert np.array_equal(res, ptpu.download_std(t_fix))
 
 
 def test_prove_streaming_mode_bytes_equal_host():
