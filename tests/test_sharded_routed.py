@@ -33,6 +33,60 @@ def _gather_reference(n, src, dst, val, valid, alpha, tol, iters):
     return converge_sparse_adaptive(garrs, s0, tol=tol, max_iterations=iters)
 
 
+def _run_isolated(func_name: str, *args) -> None:
+    """Run a module-level ``_impl_*`` body in a fresh subprocess, one
+    retry on an abnormal exit.
+
+    The 2026-08 runtime's XLA:CPU backend segfaults INTERMITTENTLY
+    while compiling/serializing the largest 8-device pjit programs in
+    this module (three full-suite crashes, each inside
+    backend_compile_and_load or the compilation cache's native
+    (de)serializer — see BASELINE's suite-stability note). Isolating
+    the big compiles keeps a platform crash from killing the whole
+    pytest session, and the retry absorbs the intermittency; a
+    reproducible failure still fails the test with the child's output.
+    """
+    import os
+    import subprocess
+    import sys
+
+    code = (
+        "import sys; sys.path.insert(0, {tests!r});"
+        # no conftest in the child: re-assert the CPU platform against
+        # the sitecustomize-preregistered tunnel backend
+        "from protocol_tpu.utils.platform import honor_jax_platforms_env;"
+        "honor_jax_platforms_env();"
+        "import test_sharded_routed as t;"
+        "t._impl_{fn}(*{args!r});"
+        "print('ISOLATED-OK')"
+    ).format(tests=os.path.dirname(os.path.abspath(__file__)),
+             fn=func_name, args=tuple(args))
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    # append (not overwrite) like conftest: ambient XLA_FLAGS may carry
+    # required stability/memory flags
+    mesh_flag = "--xla_force_host_platform_device_count=8"
+    prior = env.get("XLA_FLAGS", "")
+    if mesh_flag not in prior:
+        env["XLA_FLAGS"] = f"{prior} {mesh_flag}".strip()
+    env["JAX_ENABLE_X64"] = "1"  # match conftest's jax_enable_x64
+    last = None
+    for attempt in range(2):
+        proc = subprocess.run(
+            [sys.executable, "-c", code], capture_output=True, text=True,
+            env=env, cwd=os.path.dirname(os.path.dirname(
+                os.path.abspath(__file__))))
+        if proc.returncode == 0 and "ISOLATED-OK" in proc.stdout:
+            return
+        last = proc
+        crashed = proc.returncode in (-11, -6, 134, 139)
+        if not crashed:
+            break  # a real assertion failure: do not retry it away
+    raise AssertionError(
+        f"isolated {func_name} failed (rc={last.returncode}):\n"
+        f"{(last.stderr or last.stdout)[-1500:]}")
+
+
 @pytest.mark.parametrize("num_shards", [2, 8])
 def test_sharded_routed_matches_gather(num_shards):
     n, m = 700, 4
@@ -123,9 +177,15 @@ def test_sharded_routed_hub_buckets():
 
 
 def test_sharded_routed_checkpoint_resume(tmp_path):
-    """The chunked checkpoint driver accepts the routed operator: an
-    interrupted run resumes from the newest checkpoint and lands on the
-    uninterrupted trajectory."""
+    """The chunked checkpoint driver accepts the routed operator —
+    isolated: its pjit program is one of the big XLA:CPU compiles the
+    runtime intermittently crashes on (_run_isolated docstring)."""
+    _run_isolated("checkpoint_resume", str(tmp_path))
+
+
+def _impl_checkpoint_resume(tmp_path):
+    """An interrupted run resumes from the newest checkpoint and lands
+    on the uninterrupted trajectory."""
     from protocol_tpu.parallel import (
         build_sharded_routed_operator as build,
         sharded_routed_converge_adaptive,
@@ -135,6 +195,9 @@ def test_sharded_routed_checkpoint_resume(tmp_path):
     )
     from protocol_tpu.utils.checkpoint import CheckpointManager
 
+    from pathlib import Path
+
+    tmp_path = Path(tmp_path)
     n, m, D = 512, 3, 8
     src, dst, val = barabasi_albert_edges(n, m, seed=17)
     mesh = make_mesh(D)
@@ -168,10 +231,16 @@ def test_sharded_routed_rejects_bad_shard_count():
 
 @pytest.mark.parametrize("engine", ["routed", "gather"])
 def test_sharded_scale_10k_hub_structure(engine):
-    """VERDICT r3 ask #8: the virtual-mesh evidence at n in the tens of
-    thousands with REAL hub structure (BA m=6: top-degree hubs touch
-    thousands of peers, so per-shard hub buckets are non-trivial),
-    engine × topology, adaptive mode, conservation + gather-parity."""
+    """VERDICT r3 ask #8 — isolated (see _run_isolated): the n=10k
+    8-device programs are the largest XLA:CPU compiles in the suite."""
+    _run_isolated("scale_10k", engine)
+
+
+def _impl_scale_10k(engine):
+    """The virtual-mesh evidence at n in the tens of thousands with
+    REAL hub structure (BA m=6: top-degree hubs touch thousands of
+    peers, so per-shard hub buckets are non-trivial), engine ×
+    topology, adaptive mode, conservation + gather-parity."""
     from protocol_tpu.parallel import (
         build_sharded_operator,
         build_sharded_routed_operator,
@@ -204,9 +273,15 @@ def test_sharded_scale_10k_hub_structure(engine):
 
 @pytest.mark.slow
 def test_sharded_routed_25k_checkpoint_resume(tmp_path):
-    """Scale the engine × shards × checkpoint matrix to n=24576: a
-    mid-run crash under the 8-shard routed engine resumes onto the
-    uninterrupted trajectory, hub buckets populated on every shard."""
+    """n=24576 engine × shards × checkpoint matrix — isolated (see
+    _run_isolated)."""
+    _run_isolated("ckpt_25k", str(tmp_path))
+
+
+def _impl_ckpt_25k(tmp_path):
+    """A mid-run crash under the 8-shard routed engine resumes onto
+    the uninterrupted trajectory, hub buckets populated on every
+    shard."""
     from protocol_tpu.parallel import (
         build_sharded_routed_operator as build,
         sharded_routed_converge_adaptive,
@@ -216,6 +291,9 @@ def test_sharded_routed_25k_checkpoint_resume(tmp_path):
     )
     from protocol_tpu.utils.checkpoint import CheckpointManager
 
+    from pathlib import Path
+
+    tmp_path = Path(tmp_path)
     n, m, D = 24_576, 6, 8
     src, dst, val = barabasi_albert_edges(n, m, seed=5)
     mesh = make_mesh(D)
