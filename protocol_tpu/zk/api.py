@@ -97,6 +97,7 @@ def _keygen(params, cs):
 def _prove(params, pk, cs, transcript: str = "poseidon"):
     from .prover_fast import FastProvingKey, prove_auto
 
+    _join_prewarm()
     if isinstance(pk, FastProvingKey):
         # TPU round-3/4 when a device + eval-form key are available;
         # degrades to the host path on any device fault
@@ -112,17 +113,38 @@ def _load_params(params: bytes):
     return KZGParams.from_bytes(params)
 
 
+_PK_PARSE_CACHE: list = []  # MRU-first [(pk bytes object, parsed key)]
+
+
 def _load_pk(pk: bytes):
     """Format-sniffing load: FPK1/FPK2 limb-array keys (native kernels) or
     the pure-Python ProvingKey JSON — each proves via its own path in
-    ``_prove``."""
-    from .prover_fast import FastProvingKey
+    ``_prove``.
+
+    Parsed keys are cached per bytes OBJECT (identity compare, strong
+    refs, 2 entries): ``generate_th_proof`` passes the same ~0.5 GB key
+    bytes every call, and without the cache each call re-parses the key
+    AND breaks the identity key of the DeviceProver cache behind it —
+    re-paying the full device init per proof. Callers that re-read the
+    bytes from disk simply miss and parse, exactly as before."""
+    for i, entry in enumerate(_PK_PARSE_CACHE):
+        if entry[0] is pk:
+            if i:
+                _PK_PARSE_CACHE.insert(0, _PK_PARSE_CACHE.pop(i))
+            return entry[1]
+    from .prover_fast import FastProvingKey, _dp_cache_cap
 
     if pk[:4] in (b"FPK1", b"FPK2"):
-        return FastProvingKey.from_bytes(pk)
-    from .plonk import ProvingKey
+        obj = FastProvingKey.from_bytes(pk)
+    else:
+        from .plonk import ProvingKey
 
-    return ProvingKey.from_bytes(pk)
+        obj = ProvingKey.from_bytes(pk)
+    _PK_PARSE_CACHE.insert(0, (pk, obj))
+    # cap follows the DeviceProver cache: a smaller parse cache would
+    # silently defeat a raised PTPU_DP_CACHE (identity keys downstream)
+    del _PK_PARSE_CACHE[_dp_cache_cap():]
+    return obj
 
 
 def _load_vk(pk: bytes):
@@ -351,6 +373,50 @@ def _inner_et_keygen(p, cs, cache_key):
     return pk
 
 
+_PREWARM_THREADS: list = []
+
+
+def _prewarm_device_prover(pk_obj) -> None:
+    """Best-effort: build (or resume) ``pk_obj``'s DeviceProver on a
+    daemon thread, overlapping its device init (pk uploads + iNTTs +
+    resident ext-table builds — wall time dominated by the tunnel and
+    device compute, not host CPU) with the caller's GIL-releasing host
+    work. ``generate_th_pk``'s warm path starts this before the outer
+    Threshold keygen (a native MSM pass), so the inner ET prover that
+    ``generate_th_proof`` needs next is warm by the time it proves.
+    ``_prove`` joins any live prewarm before dispatching — the device
+    is never driven concurrently with a prove."""
+    _join_prewarm()
+    try:
+        import jax
+
+        if jax.devices()[0].platform not in ("tpu", "axon"):
+            return
+    except Exception:
+        return
+    if not getattr(pk_obj, "eval_form", False) or pk_obj.k > 21:
+        return  # prove_auto would not take the device path anyway
+    import threading
+
+    def _run():
+        try:
+            from .prover_fast import _device_prover
+
+            with trace.span("th.inner_dp_prewarm"):
+                _device_prover(pk_obj)
+        except Exception:
+            pass  # best effort — the prove path inits on demand
+
+    t = threading.Thread(target=_run, daemon=True, name="ptpu-dp-prewarm")
+    t.start()
+    _PREWARM_THREADS.append(t)
+
+
+def _join_prewarm() -> None:
+    while _PREWARM_THREADS:
+        _PREWARM_THREADS.pop().join()
+
+
 def _th_cache_dir() -> str | None:
     """PTPU_TH_CACHE_DIR opts into persisting the dummy inner-ET snark
     (pk + proof + public inputs) across processes — the CLI and the
@@ -493,6 +559,11 @@ def generate_th_pk(params: bytes, shape: CircuitShape = DEFAULT_SHAPE) -> bytes:
         et_pk, et_pubs, et_proof = cached
         _INNER_ET_PK_CACHE.clear()
         _INNER_ET_PK_CACHE[cache_key] = et_pk
+        # warm the inner prover's device state under the outer keygen:
+        # the cached-snark path never proves in this phase, so without
+        # this the inner ET prove in generate_th_proof pays the full
+        # k=20 device init serially
+        _prewarm_device_prover(et_pk)
         with trace.span("th.build_th_circuit"):
             chips, _ = _build_th_circuit(et_pk, et_pubs, et_proof, addrs[0],
                                          Fr(1), ratios[0], shape)

@@ -25,6 +25,7 @@ from __future__ import annotations
 import json
 import os
 import secrets
+import threading
 from concurrent.futures import ThreadPoolExecutor
 from dataclasses import dataclass
 
@@ -860,7 +861,19 @@ def prove_fast(params: KZGParams, pk: FastProvingKey, cs: ConstraintSystem,
 
 # --- TPU-pipelined prover ---------------------------------------------------
 
-_DEVICE_PROVER: list = [None, None]  # [pk object, DeviceProver]
+_DEVICE_PROVERS: list = []  # MRU-first [(pk object, DeviceProver)]
+_DEVICE_PROVERS_LOCK = threading.Lock()  # api's prewarm thread vs provers
+
+
+def _dp_cache_cap() -> int:
+    """PTPU_DP_CACHE bounds how many per-pk DeviceProvers stay alive
+    (default 2 — the Threshold cycle alternates the k=20 inner and the
+    k=21 outer pk every proof; 1 restores the single-slot behavior if
+    a suspended prover's resident coeffs ever crowd the HBM plan)."""
+    try:
+        return max(1, int(os.environ.get("PTPU_DP_CACHE", "2")))
+    except ValueError:
+        return 2
 
 
 def _sync_if_tracing(x) -> None:
@@ -877,24 +890,46 @@ def _sync_if_tracing(x) -> None:
 
 
 def _device_prover(pk: FastProvingKey):
-    """Cached DeviceProver for the last-used pk (the pk's fixed/sigma
-    cosets are device-resident, like halo2's ProvingKey holds its
-    cosets in RAM). The cache holds a strong reference to the pk and
-    compares identity — an id()-keyed map could alias a new key to a
-    garbage-collected one's DeviceProver."""
+    """Cached DeviceProver per pk (the pk's fixed/sigma cosets are
+    device-resident, like halo2's ProvingKey holds its cosets in RAM).
+    The cache is a small MRU list (cap: PTPU_DP_CACHE, default 2): the
+    Threshold cycle alternates a k=20 inner and a k=21 outer prover on
+    every proof, and a single slot paid BOTH full device inits
+    (uploads + iNTTs + resident ext builds, ~70 s summed) per call.
+    Inactive provers are suspended — resident ext tables released so
+    the active prove keeps its HBM working-set budget — and resumed
+    from their resident packed coeffs on reuse (device compute only).
+    Entries hold strong pk references and compare identity: an
+    id()-keyed map could alias a new key to a collected one's
+    DeviceProver. Serialized by a lock: api's prewarm daemon calls
+    this concurrently with engine-level provers — without it two
+    threads could miss on the same pk and double-init (double HBM)."""
     from . import prover_tpu
 
-    if _DEVICE_PROVER[0] is pk:
-        return _DEVICE_PROVER[1]
-    ext_n = (1 << pk.k) * 4
-    shift = _find_coset_shifts(ext_n, 2)[1]
-    dp = prover_tpu.DeviceProver(
-        pk.k, shift,
-        [pk.fixed_limbs[i] for i in range(len(FIXED_NAMES))],
-        [pk.sigma_limbs[w] for w in range(NUM_WIRES)])
-    _DEVICE_PROVER[0] = pk
-    _DEVICE_PROVER[1] = dp
-    return dp
+    with _DEVICE_PROVERS_LOCK:
+        for i, entry in enumerate(_DEVICE_PROVERS):
+            if entry[0] is pk:
+                if i:
+                    _DEVICE_PROVERS.insert(0, _DEVICE_PROVERS.pop(i))
+                for _, other in _DEVICE_PROVERS[1:]:
+                    other.suspend()
+                dp = entry[1]
+                with trace.span("prove_tpu.device_prover_resume"):
+                    dp.resume()
+                return dp
+        # free the evictee's and the suspendees' device arrays BEFORE
+        # the new prover's init starts claiming HBM
+        del _DEVICE_PROVERS[_dp_cache_cap() - 1:]
+        for _, other in _DEVICE_PROVERS:
+            other.suspend()
+        ext_n = (1 << pk.k) * 4
+        shift = _find_coset_shifts(ext_n, 2)[1]
+        dp = prover_tpu.DeviceProver(
+            pk.k, shift,
+            [pk.fixed_limbs[i] for i in range(len(FIXED_NAMES))],
+            [pk.sigma_limbs[w] for w in range(NUM_WIRES)])
+        _DEVICE_PROVERS.insert(0, (pk, dp))
+        return dp
 
 
 def prove_fast_tpu(params: KZGParams, pk: FastProvingKey,

@@ -550,63 +550,67 @@ class DeviceProver:
         self.zh_planes = [_cplane(z) for z in self.zh_c]
         self.zh_inv_planes = [_cplane(z) for z in self.zh_inv_c]
 
-        pk16 = jax.jit(f2.pack16)
+        self._tables_live = False
+        self._build_static_tables()
+
+        # pk columns: coeffs resident, PACKED uint16 in BOTH modes
+        # (every consumer unpacks at trace time via _as_planes): 15
+        # unpacked (L, n) int32 columns are ~2.8 GB at k=21 — the
+        # difference between fitting the 16 GB chip and
+        # RESOURCE_EXHAUSTED at init. The H-domain evals are NOT kept
+        # resident — ζ-evaluations run as coefficient dots
+        # (eval_coeffs_at_many), and dropping the 15 eval arrays saves
+        # ~1.3 GB of HBM at k=20 (the difference between fitting and
+        # RESOURCE_EXHAUSTED on a 16 GB chip).
+        self.fixed_coeffs = []
+        for a in fixed_evals_u64:
+            ev = upload_mont(a)
+            self.fixed_coeffs.append(_pack16_impl(self.intt_natural(ev)))
+            del ev
+        self.sigma_coeffs = []
+        for a in sigma_evals_u64:
+            ev = upload_mont(a)
+            self.sigma_coeffs.append(_pack16_impl(self.intt_natural(ev)))
+            del ev
+
+        self._bary: dict = {}
+        # resident packed ext-chunk tables per mode — built from the
+        # packed coeffs by resume() (the same rebuild a suspended
+        # prover runs when it is reactivated)
+        self.fixed_ext = []
+        self.sigma_ext = []
+        self.resume()
+
+    def _build_static_tables(self) -> None:
+        """Device tables that are pure functions of (k, shift): power
+        vectors, per-coset xs/L0 tables and the intt_ext combine
+        tables. Rebuilt by :meth:`resume` after a deep suspend."""
+        n = self.n
+        omega_e = self.omega_e
+        shift = self.shift
         self.omega_pows = powers_vector(self.omega, n)          # natural
-        self.coset_pows = [pk16(powers_vector(s, n)) for s in self.shifts_c]
+        self.coset_pows = [_pack16_impl(powers_vector(s, n))
+                           for s in self.shifts_c]
         n_plane = _cplane(n)
         self.xs_fs, self.l0_fs = [], []
         for j in range(EXT_COSETS):
             xs_nat, l0 = _xs_l0_impl(self.omega_pows,
                                      _cplane(self.shifts_c[j]),
                                      self.zh_planes[j], n_plane)
-            self.xs_fs.append(pk16(fs_from_natural(xs_nat, self.A, self.B)))
+            self.xs_fs.append(
+                _pack16_impl(fs_from_natural(xs_nat, self.A, self.B)))
             # l0 is produced in natural order like xs — BOTH must be
             # FS-converted (a natural-order l0 here permutes the L0 row
             # weights across the whole chunk; caught by
             # test_quotient_chunk_matches_host)
-            self.l0_fs.append(pk16(fs_from_natural(l0, self.A, self.B)))
-
-        # pk columns: coeffs + packed ext chunks. The H-domain evals are
-        # NOT kept resident — ζ-evaluations run as coefficient dots
-        # (eval_coeffs_at_many), and dropping the 15 eval arrays saves
-        # ~1.3 GB of HBM at k=20 (the difference between fitting and
-        # RESOURCE_EXHAUSTED on a 16 GB chip).
-        # streaming mode additionally keeps the pk coefficient arrays
-        # PACKED (uint16, half HBM): every consumer kernel unpacks at
-        # trace time via _as_planes
-        # pk coefficient columns are stored PACKED in BOTH modes (every
-        # consumer unpacks at trace time via _as_planes): 15 unpacked
-        # (L, n) int32 columns are ~2.8 GB at k=21 — the difference
-        # between resident mode fitting the 16 GB chip and
-        # RESOURCE_EXHAUSTED at init. In resident mode the ext chunks
-        # are built from the unpacked transient before it is dropped.
-        self.fixed_coeffs = []
-        self.fixed_ext = []
-        for a in fixed_evals_u64:
-            ev = upload_mont(a)
-            cf = self.intt_natural(ev)
-            del ev
-            if self.fixed_ext_resident:
-                self.fixed_ext.append(
-                    [pk16(self.ext_chunk(cf, j)) for j in range(EXT_COSETS)])
-            self.fixed_coeffs.append(pk16(cf))
-            del cf
-        self.sigma_coeffs = []
-        self.sigma_ext = []
-        for a in sigma_evals_u64:
-            ev = upload_mont(a)
-            cf = self.intt_natural(ev)
-            del ev
-            if self.ext_resident:
-                self.sigma_ext.append(
-                    [pk16(self.ext_chunk(cf, j)) for j in range(EXT_COSETS)])
-            self.sigma_coeffs.append(pk16(cf))
-            del cf
+            self.l0_fs.append(
+                _pack16_impl(fs_from_natural(l0, self.A, self.B)))
 
         # intt_ext combine tables (packed)
-        self.we_neg_pows = [pk16(powers_vector(pow(omega_e, -j, P), n))
+        self.we_neg_pows = [_pack16_impl(powers_vector(pow(omega_e, -j, P),
+                                                       n))
                             for j in range(EXT_COSETS)]
-        self.s_neg_pows = pk16(powers_vector(pow(shift, -1, P), n))
+        self.s_neg_pows = _pack16_impl(powers_vector(pow(shift, -1, P), n))
         zeta_c = pow(omega_e, n, P)        # primitive EXT_COSETS-th root
         inv_c = pow(EXT_COSETS, -1, P)
         s_n_inv = pow(shift, -n, P)
@@ -618,8 +622,50 @@ class DeviceProver:
         ])
         self.su_planes = jnp.stack(
             [_cplane(pow(s_n_inv, u, P)) for u in range(EXT_COSETS)])
+        self._tables_live = True
 
-        self._bary: dict = {}
+    def suspend(self, deep: "bool | None" = None) -> None:
+        """Park this prover: release the resident pk ext-chunk tables
+        and the per-ζ barycentric cache, keeping the packed coefficient
+        columns (so reactivation is device compute only — no
+        re-uploads). A multi-prover process (the Threshold cycle
+        alternates a k=20 inner and a k=21 outer prover every proof)
+        suspends the inactive prover so the active prove keeps its HBM
+        working-set budget. ``deep`` (the default;
+        PTPU_DP_SUSPEND=shallow opts out) also drops the static
+        (k, shift) tables — another ~0.5 GB at k=20 — rebuilt from
+        host scalars on resume for a few cheap dispatches."""
+        if deep is None:
+            deep = os.environ.get("PTPU_DP_SUSPEND", "deep") != "shallow"
+        self.fixed_ext = []
+        self.sigma_ext = []
+        self._bary = {}
+        if deep and self._tables_live:
+            for name in ("omega_pows", "coset_pows", "xs_fs", "l0_fs",
+                         "we_neg_pows", "s_neg_pows", "zc_planes",
+                         "su_planes"):
+                setattr(self, name, None)
+            self._tables_live = False
+
+    def resume(self) -> None:
+        """(Re)build whatever resident tables this prover's mode keeps:
+        the static tables if a deep suspend dropped them, then the
+        packed pk ext-chunk tables from the resident packed coeffs.
+        Bit-identical to a fresh init — pack16 output is canonical, and
+        the streaming quotient already proves from packed-coeff NTTs
+        (test_stream_prove_matches_host)."""
+        if not self._tables_live:
+            self._build_static_tables()
+        if self.fixed_ext_resident and not self.fixed_ext:
+            self.fixed_ext = [
+                [_pack16_impl(self.ext_chunk(cf, j))
+                 for j in range(EXT_COSETS)]
+                for cf in self.fixed_coeffs]
+        if self.ext_resident and not self.sigma_ext:
+            self.sigma_ext = [
+                [_pack16_impl(self.ext_chunk(cf, j))
+                 for j in range(EXT_COSETS)]
+                for cf in self.sigma_coeffs]
 
     # --- transforms -------------------------------------------------------
 

@@ -1,0 +1,37 @@
+#!/bin/bash
+# Round-5 chip battery, part 4 — after the DeviceProver multi-entry
+# cache (suspend/resume) + pk-parse cache + th-pk prewarm landed:
+#
+# 9a: ζ-eval dispatch probe (the 47 s r4_evals span vs ~8 s expected).
+# 9b: Threshold cycle, warm, --repeat 2 on a QUIET core — the
+#     steady-state serving row BASELINE still lists as "obvious first
+#     row for a future session". With the caches, proof #2 should skip
+#     BOTH device inits (inner k=20 resume + outer k=21 resume).
+# 9c: flagship k=21 re-verify under the refactored init path (partial
+#     residency default, warm steady state) — guards the 191.5 s row.
+set -o pipefail
+cd "$(dirname "$0")/.." || exit 1
+mkdir -p bench_cache/r5_logs
+L=bench_cache/r5_logs
+note() { echo "[$(date +%H:%M:%S)] $*" | tee -a "$L/battery.log"; }
+
+note "=== battery part 4 (dp-cache round) start ==="
+note "health gate"
+timeout 300 python -c "import jax; print(jax.devices())" || {
+  note "tunnel unhealthy - aborting part 4"; exit 1; }
+
+note "9a. zeta-eval dots probe"
+python -u tools/probe_dots.py --json "$L/probe_dots.json" \
+  2>&1 | tee "$L/probe_dots.log"
+note "step9a rc=$?"
+
+note "9b. th_cycle warm --repeat 2 (quiet core)"
+python -u tools/th_cycle.py --repeat 2 2>&1 | tee "$L/th_cycle_r2.log"
+note "step9b rc=$?"
+
+note "9c. flagship k=21 warm re-verify (--skip-cold --repeat 3)"
+python -u tools/prove_flagship.py --skip-cold --repeat 3 \
+  2>&1 | tee "$L/flagship_recheck.log"
+note "step9c rc=$?"
+
+note "=== battery part 4 done ==="

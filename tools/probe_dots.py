@@ -1,0 +1,159 @@
+"""Chip probe: where do the flagship's 47 s of r4 ζ-evals go?
+
+The r5 span map (BASELINE "flagship k=21" table) measured
+``prove_tpu.r4_evals`` at 47.0 s where mul throughput predicts ~8 s.
+The span is three ``eval_coeffs_at_many`` calls (30 + 2 + 3 packed
+coefficient columns at n = 2^21) — each a ``powers_vector`` build plus
+ONE ``_dots_impl`` dispatch plus a tiny blocking download. This probe
+times each leg separately on the real chip, plus candidate fixes:
+
+- dots over 30 polys in one dispatch vs split into batches,
+- powers_vector (21 dependent (22, n) muls) on its own,
+- the _download_scalars tail (transpose/pack/block on (30, 22, 1)),
+- a fused variant evaluating at ζ AND ζω in one dispatch.
+
+Methodology: every timed region ends in a scalar host read of the
+result (the tunnel's block_until_ready returns early — PROBES_r05
+note), and each configuration is timed warm (first call compiles).
+
+Usage:  python tools/probe_dots.py [--k 21] [--json out.json]
+"""
+import argparse
+import json
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+import numpy as np  # noqa: E402
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--k", type=int, default=21)
+    ap.add_argument("--json", default=None)
+    ap.add_argument("--reps", type=int, default=3)
+    args = ap.parse_args()
+
+    import jax
+    import jax.numpy as jnp
+
+    jax.config.update("jax_compilation_cache_dir",
+                      os.path.join(REPO, "bench_cache", "zk", "xla_cache"))
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+
+    from protocol_tpu.ops import fieldops2 as f2
+    from protocol_tpu.zk import prover_tpu as ptpu
+
+    n = 1 << args.k
+    print("devices:", jax.devices(), " n = 2^%d" % args.k, flush=True)
+    results = {"k": args.k}
+
+    def sync_scalar(x):
+        if isinstance(x, (list, tuple)):
+            x = x[0]
+        s = jnp.sum(x[..., :1].astype(jnp.int32))
+        return float(np.asarray(s))
+
+    def timeit(label, fn, reps=args.reps):
+        fn()  # warm/compile
+        ts = []
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            fn()
+            ts.append(time.perf_counter() - t0)
+        best = min(ts)
+        print(f"{label:56s} {best*1e3:10.1f} ms   (all: "
+              + ", ".join(f"{t*1e3:.0f}" for t in ts) + ")", flush=True)
+        results[label] = round(best, 4)
+        return best
+
+    # 30 packed pseudo-coefficient columns, generated ON device (no
+    # uploads): random-ish u16 planes are fine — pack16 output is just
+    # 16 u16 planes of a canonical value; any u16 pattern < 2^16 works
+    # as input to _as_planes (it unpacks then enters the mul domain).
+    key = jax.random.PRNGKey(0)
+    polys = []
+    for i in range(30):
+        key, sub = jax.random.split(key)
+        polys.append(jax.random.randint(sub, (16, n), 0, 1 << 15,
+                                        dtype=jnp.int32).astype(jnp.uint16))
+    jax.block_until_ready(polys[-1])
+
+    zeta = 0x1234567890ABCDEF1234567890ABCDEF
+    zp = ptpu.powers_vector(zeta, n)
+    sync_scalar(zp)
+
+    # leg 1: powers_vector alone (host scalars -> 21 dependent muls)
+    timeit("powers_vector(zeta, n)",
+           lambda: sync_scalar(ptpu.powers_vector(zeta, n)))
+
+    # leg 2: one 30-poly dots dispatch (the r4_evals base call)
+    timeit("dots 30 polys, one dispatch",
+           lambda: sync_scalar(ptpu._dots_impl(zp, *polys)))
+
+    # leg 3: split into 3 x 10
+    def split3():
+        outs = [ptpu._dots_impl(zp, *polys[i:i + 10])
+                for i in range(0, 30, 10)]
+        return sync_scalar(outs[-1])
+    timeit("dots 30 polys, 3 dispatches of 10", split3)
+
+    # leg 4: the full eval_coeffs_at_many tail incl. _download_scalars
+    def full_call():
+        outs = ptpu._dots_impl(zp, *polys)
+        return ptpu.DeviceProver._download_scalars(outs, 30)
+    timeit("dots 30 + _download_scalars", full_call)
+
+    # leg 5: the three r4 calls as the prover issues them (30 @ zeta,
+    # 2 @ zeta*omega, 3 @ zeta) including fresh powers_vector builds
+    omega = ptpu.ntt_tpu.NttPlan.get(args.k).omega
+
+    def as_prover():
+        zp1 = ptpu.powers_vector(zeta, n)
+        a = ptpu.DeviceProver._download_scalars(
+            ptpu._dots_impl(zp1, *polys), 30)
+        zp2 = ptpu.powers_vector(zeta * omega % f2.P, n)
+        b = ptpu.DeviceProver._download_scalars(
+            ptpu._dots_impl(zp2, *polys[:2]), 2)
+        c = ptpu.DeviceProver._download_scalars(
+            ptpu._dots_impl(zp1, *polys[:3]), 3)
+        return a[0] + b[0] + c[0]
+    timeit("r4_evals shape: 30@z + 2@zw + 3@z (full tail)", as_prover)
+
+    # leg 6: fused — all 35 dots in ONE dispatch (weights chosen per
+    # poly group); candidate fix if dispatch count is the cost
+    @jax.jit
+    def fused(zp1, zp2, *ps):
+        outs = [ptpu._sum_reduce_mont(f2.mont_mul(ptpu._as_planes(p), zp1))
+                for p in ps[:30]]
+        outs += [ptpu._sum_reduce_mont(
+            f2.mont_mul(ptpu._as_planes(p), zp2)) for p in ps[30:32]]
+        outs += [ptpu._sum_reduce_mont(
+            f2.mont_mul(ptpu._as_planes(p), zp1)) for p in ps[32:]]
+        return jnp.stack(outs)
+
+    def fused_call():
+        zp1 = ptpu.powers_vector(zeta, n)
+        zp2 = ptpu.powers_vector(zeta * omega % f2.P, n)
+        return ptpu.DeviceProver._download_scalars(
+            fused(zp1, zp2, *(polys + polys[:5])), 35)
+    timeit("fused 35 dots in one dispatch (full tail)", fused_call)
+
+    # leg 7: single mont_mul at this width for the roofline
+    up = ptpu._unpack16_impl(polys[0])
+    jax.block_until_ready(up)
+    timeit("mont_mul (22, n) single",
+           lambda: sync_scalar(f2.mont_mul(up, zp)))
+
+    if args.json:
+        with open(args.json, "w") as f:
+            json.dump(results, f, indent=1)
+        print("wrote", args.json)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
