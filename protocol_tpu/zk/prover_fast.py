@@ -1009,17 +1009,14 @@ def prove_fast_tpu(params: KZGParams, pk: FastProvingKey,
                 for e in dp.ext_chunks(coeff_dev, blinds)]
 
     with trace.span("prove_tpu.r1_upload_intt"):
-        wire_coeff_dev = []
-        for w in range(NUM_WIRES):
-            ev = ptpu.upload_mont(wire_vals[w])
-            wire_coeff_dev.append(pack(dp.intt_natural(ev)))
-            del ev
+        wire_coeff_dev = [dp.upload_intt_packed(wire_vals[w])
+                          for w in range(NUM_WIRES)]
         _sync_if_tracing(wire_coeff_dev[-1])
     wire_blinds = [[randint() for _ in range(2)] for _ in range(NUM_WIRES)]
     pi_vals = np.zeros((n, 4), dtype="<u8")
     for row, value in zip(pk.public_rows, pubs):
         _set_int(pi_vals, row, (-int(value)) % R)
-    pi_coeff_dev = pack(dp.intt_natural(ptpu.upload_mont(pi_vals)))
+    pi_coeff_dev = dp.upload_intt_packed(pi_vals)
     if pre:
         wire_ext = [ext8(wire_coeff_dev[w], wire_blinds[w])
                     for w in range(NUM_WIRES)]
@@ -1034,9 +1031,7 @@ def prove_fast_tpu(params: KZGParams, pk: FastProvingKey,
 
     table_size = 1 << pk.lookup_bits if pk.lookup_bits else 1
     m_vals = _lookup_multiplicities(cs, n, table_size)
-    m_dev = ptpu.upload_mont(m_vals)
-    m_coeff_dev = pack(dp.intt_natural(m_dev))
-    del m_dev
+    m_coeff_dev = dp.upload_intt_packed(m_vals)
     m_blinds = [randint() for _ in range(2)]
     if pre:
         m_ext = ext8(m_coeff_dev, m_blinds)
@@ -1054,9 +1049,7 @@ def prove_fast_tpu(params: KZGParams, pk: FastProvingKey,
     with trace.span("prove_tpu.r2_grand_products"):
         z_vals = fk.perm_grand_product(wire_vals, pk.sigma_eval_limbs,
                                        pk.shifts, omegas, beta, gamma)
-        z_dev = ptpu.upload_mont(z_vals)
-        z_coeff_dev = pack(dp.intt_natural(z_dev))
-        del z_dev
+        z_coeff_dev = dp.upload_intt_packed(z_vals)
         z_blinds = [randint() for _ in range(3)]
         if pre:
             z_ext = ext8(z_coeff_dev, z_blinds)
@@ -1067,9 +1060,7 @@ def prove_fast_tpu(params: KZGParams, pk: FastProvingKey,
     table_limbs[:table_size, 0] = np.arange(table_size, dtype=np.uint64)
     phi_vals = fk.logup_running_sum(wire_vals[LOOKUP_WIRE], table_limbs,
                                     m_vals, beta_lk)
-    phi_dev = ptpu.upload_mont(phi_vals)
-    phi_coeff_dev = pack(dp.intt_natural(phi_dev))
-    del phi_dev
+    phi_coeff_dev = dp.upload_intt_packed(phi_vals)
     phi_blinds = [randint() for _ in range(3)]
     if pre:
         phi_ext = ext8(phi_coeff_dev, phi_blinds)
@@ -1085,9 +1076,7 @@ def prove_fast_tpu(params: KZGParams, pk: FastProvingKey,
         uv_coeff_dev = []
         uv_blinds = []
         for vals in uv_vals:
-            dev = ptpu.upload_mont(vals)
-            uv_coeff_dev.append(pack(dp.intt_natural(dev)))
-            del dev
+            uv_coeff_dev.append(dp.upload_intt_packed(vals))
             uv_blinds.append([randint() for _ in range(2)])
         if pre:
             uv_ext = [ext8(uv_coeff_dev[i], uv_blinds[i])
@@ -1124,8 +1113,11 @@ def prove_fast_tpu(params: KZGParams, pk: FastProvingKey,
                     uv_e = [dp.ext_chunk(uv_coeff_dev[i], j,
                                          uv_blinds[i])
                             for i in range(NUM_PERM_PARTIALS)]
-                t_chunks_fs.append(pack(dp.quotient_chunk(
-                    j, wires_e, z_e, m_e, phi_e, pi_e, uv_e, ch_planes)))
+                t_j = dp.quotient_chunk(
+                    j, wires_e, z_e, m_e, phi_e, pi_e, uv_e, ch_planes)
+                # the fused streaming kernel packs in-program
+                t_chunks_fs.append(t_j if t_j.dtype == np.uint16
+                                   else pack(t_j))
                 if pre:  # chunk consumed — release its 14 ext arrays
                     for col in wire_ext:
                         col[j] = None

@@ -100,14 +100,31 @@ def _from_u16_wire(w16):
     return f2.enter_mont(f2.unpack16(w16))
 
 
+@jax.jit
+def _upload_intt_pack_impl(w16, w_a, w_b, t16_inv, n_inv_planes):
+    """Wire-format eval column → packed coefficient column as ONE
+    program (enter-Mont + FS reorder + iNTT + pack16): the prover runs
+    this 14× per prove, and the unfused upload→intt→pack chain was 3
+    dispatches each (dispatch economy, see _quotient_chunk_fused_impl)."""
+    fs = fs_from_natural(_from_u16_wire(w16), w_a.shape[1],
+                         w_b.shape[1])
+    return f2.pack16(ntt_tpu._intt_impl(fs, w_a, w_b, t16_inv,
+                                        n_inv_planes))
+
+
+def _wire16(arr_u64: np.ndarray) -> np.ndarray:
+    """Host side of the upload wire format: (n, 4) u64 → (16, n) uint16
+    value planes (a pure byte regroup of the u64 limbs)."""
+    a = np.ascontiguousarray(arr_u64)
+    return np.ascontiguousarray(a.view("<u2").reshape(len(a), 16).T)
+
+
 def upload_mont(arr_u64: np.ndarray) -> jnp.ndarray:
     """(n, 4) u64 standard → (L, n) Montgomery planes on device. The
-    wire format is (16, n) uint16 value planes (a pure byte regroup of
-    the u64 limbs — 32 MB per 2^20 column instead of 92 MB as int32
-    limb planes; the tunnel is the bottleneck, not the packing)."""
-    a = np.ascontiguousarray(arr_u64)
-    w16 = np.ascontiguousarray(a.view("<u2").reshape(len(a), 16).T)
-    return _from_u16_wire(jnp.asarray(w16))
+    wire format is (16, n) uint16 value planes — 32 MB per 2^20 column
+    instead of 92 MB as int32 limb planes; the tunnel is the
+    bottleneck, not the packing."""
+    return _from_u16_wire(jnp.asarray(_wire16(arr_u64)))
 
 
 def download_std(x: jnp.ndarray) -> np.ndarray:
@@ -357,6 +374,53 @@ def _lk_impl(w5, fx8_e, m_e, phii, phiwi, blk_plane):
     return f2.add(lk, f2.mont_mul(m_e, ba))
 
 
+@partial(jax.jit, static_argnames=("fixed_resident",))
+def _quotient_chunk_fused_impl(wires, z_e, m_e, phi_e, pi_e, uv_e,
+                               fixed_in, sigma_in, coset16, w_a, w_b,
+                               t16, xs16, l016, ch, zh_inv_plane,
+                               fixed_resident: bool):
+    """The ENTIRE streaming quotient for one coset chunk as ONE device
+    program: the σ-column NTTs (and the fixed-column NTTs when
+    ``fixed_resident`` is False) run inline, every pointwise chain of
+    the z-split identity fuses without HBM round-trips, and the output
+    leaves packed. Replaces the ~31-dispatch chain of
+    ``_quotient_chunk_streaming`` — dispatch economy is the measured
+    k=21 frontier (BASELINE: this runtime executes chatty small-dispatch
+    chains ~2× slower than their kernel arithmetic).
+
+    The coset/xs/l0 tables arrive as DATA, so one compile serves all
+    four chunks. The identity itself is ``quotient_pointwise`` — the
+    single home shared with the resident kernel and the sharded
+    prover; this wrapper only materializes the pk ext chunks inline
+    and packs the output. Bit-identical to both unfused paths
+    (tested)."""
+    A = w_a.shape[1]
+    B = w_b.shape[1]
+    coset = f2.unpack16(coset16)
+
+    def pk_ext(src, resident):
+        if resident:
+            return _as_planes(src)
+        scaled = f2.mont_mul(_as_planes(src), coset)
+        chunk = ntt_tpu._ntt_impl(scaled, w_a, w_b, t16)
+        return f2.mont_mul_const(chunk, f2.R_MONT)
+
+    w = [_as_planes(wires[i]) for i in range(6)]
+    z = _as_planes(z_e)
+    mi = _as_planes(m_e)
+    phii = _as_planes(phi_e)
+    pii = _as_planes(pi_e)
+    uv = [_as_planes(uv_e[i]) for i in range(4)]
+    fx = [pk_ext(fixed_in[i], fixed_resident) for i in range(9)]
+    sg = [pk_ext(sigma_in[k], False) for k in range(6)]
+    zwi = _fs_roll_next(z, A, B)
+    phiwi = _fs_roll_next(phii, A, B)
+    total = quotient_pointwise(w, z, zwi, mi, phii, phiwi, pii, uv, fx,
+                               sg, f2.unpack16(xs16), f2.unpack16(l016),
+                               ch, zh_inv_plane)
+    return f2.pack16(total)
+
+
 @jax.jit
 def _qfinal_impl(gate, link_f, link_g, t_u1, t_u2, t_v1, t_v2, uv0, uv1,
                  uv2, uv3, lk, z_e, phii, l016, ch, zh_inv_plane):
@@ -401,6 +465,27 @@ def _combine1_impl(zc_u, s_neg16, su_u, *hats):
 @jax.jit
 def _twiddle_mul(x, pows16):
     return f2.mont_mul(x, f2.unpack16(pows16))
+
+
+@jax.jit
+def _intt_ext_fused_impl(t_in, w_a, w_b, t16_inv, n_inv_planes,
+                         we_neg16, s_neg16, zc_planes, su_planes):
+    """The whole 4n inverse (4 per-coset iNTTs + twiddles + radix-4
+    cross-chunk combine + output packs) as ONE program — the streaming
+    prover's dispatch-economy twin of the incremental :meth:`intt_ext`
+    (which stays the resident-mode path, where freeing each input as
+    its iNTT completes is what bounds the k=20 HBM peak). Same
+    composites (jitted helpers inline when traced here) —
+    bit-identical (tested)."""
+    hats = []
+    for j in range(EXT_COSETS):
+        src = _as_planes(t_in[j])
+        cj = ntt_tpu._intt_impl(src, w_a, w_b, t16_inv, n_inv_planes)
+        hats.append(_twiddle_mul(cj, we_neg16[j]))
+    return tuple(
+        f2.pack16(_combine1_impl(zc_planes[u], s_neg16, su_planes[u],
+                                 *hats))
+        for u in range(EXT_COSETS))
 
 
 @jax.jit
@@ -538,6 +623,9 @@ class DeviceProver:
         warm[:, 0] = 1
         download_std(upload_mont(warm))
         self.plan = ntt_tpu.NttPlan.get(k)
+        # same rule for the fused upload→iNTT→pack program the prover
+        # runs 14× per prove: compile it now, not mid-round-1
+        jax.block_until_ready(self.upload_intt_packed(warm))
         self.A, self.B = self.plan.A, self.plan.B
         omega_e = ntt_tpu._root_of_unity(k + 2)     # order 4n
         self.omega = self.plan.omega                # order n
@@ -674,6 +762,16 @@ class DeviceProver:
         return ntt_tpu.intt(fs_from_natural(evals_nat, self.A, self.B),
                             self.plan)
 
+    def upload_intt_packed(self, arr_u64: np.ndarray) -> jnp.ndarray:
+        """(n, 4) u64 standard evals on host → packed (16, n) uint16
+        coefficient column on device, one fused dispatch. Bit-identical
+        to pack16(intt_natural(upload_mont(arr))) — the same composites
+        traced into one program."""
+        n_inv = f2._const_planes(self.plan.n_inv_mont, 1)
+        return _upload_intt_pack_impl(jnp.asarray(_wire16(arr_u64)),
+                                      self.plan.W_A, self.plan.W_B,
+                                      self.plan.T16_inv, n_inv)
+
     def ext_chunk(self, coeffs: jnp.ndarray, j: int,
                   blinds=None) -> jnp.ndarray:
         """One FS-layout ext chunk of a (possibly blinded) polynomial."""
@@ -710,8 +808,24 @@ class DeviceProver:
         """Device twin of the C++ quotient_eval2 on coset chunk j;
         ``uv_e`` = [u1, u2, v1, v2] ext chunks; ``ch_planes`` from
         :meth:`challenge_planes`. Dispatches to the streaming variant
-        when the pk ext chunks are not resident."""
+        when the pk ext chunks are not resident — fused into one
+        program per chunk unless PTPU_FUSED_QUOTIENT=0 (the fallback
+        keeps the ~31-dispatch chain whose lower in-program working
+        set is the escape hatch if a runtime ever OOMs the fused
+        one). The fused kernel returns a PACKED uint16 chunk (packing
+        happens in-program); the other two paths return unpacked
+        planes — consumers dispatch on dtype."""
         if not self.ext_resident:
+            if os.environ.get("PTPU_FUSED_QUOTIENT", "1") != "0":
+                fixed_in = (tuple(self.fixed_ext[i][j] for i in range(9))
+                            if self.fixed_ext else tuple(self.fixed_coeffs))
+                return _quotient_chunk_fused_impl(
+                    tuple(wires_e), z_e, m_e, phi_e, pi_e, tuple(uv_e),
+                    fixed_in, tuple(self.sigma_coeffs),
+                    self.coset_pows[j], self.plan.W_A, self.plan.W_B,
+                    self.plan.T16, self.xs_fs[j], self.l0_fs[j],
+                    ch_planes, self.zh_inv_planes[j],
+                    bool(self.fixed_ext))
             return self._quotient_chunk_streaming(
                 j, wires_e, z_e, m_e, phi_e, pi_e, uv_e, ch_planes)
         return _quotient_chunk_impl(
@@ -812,7 +926,20 @@ class DeviceProver:
 
         CONSUMES ``t_chunks`` (entries are dropped as their iNTT
         completes) and emits output chunks one at a time — the HBM peak
-        here decides whether k=20 fits the chip."""
+        here decides whether k=20 fits the chip. Streaming mode (packed
+        chunks, lighter peak) takes the fused single-program variant
+        unless PTPU_FUSED_QUOTIENT=0."""
+        if (not self.ext_resident
+                and os.environ.get("PTPU_FUSED_QUOTIENT", "1") != "0"):
+            outs = _intt_ext_fused_impl(
+                tuple(t_chunks), self.plan.W_A, self.plan.W_B,
+                self.plan.T16_inv,
+                f2._const_planes(self.plan.n_inv_mont, 1),
+                tuple(self.we_neg_pows), self.s_neg_pows,
+                self.zc_planes, self.su_planes)
+            for j in range(EXT_COSETS):
+                t_chunks[j] = None
+            return list(outs)
         hats = []
         for j in range(EXT_COSETS):
             src = t_chunks[j]

@@ -182,9 +182,11 @@ def test_prove_fast_tpu_bytes_equal_host():
     assert verify(params, pk, cs.public_values(), proof_tpu)
 
 
-def test_streaming_quotient_matches_resident(dp):
+def test_streaming_quotient_matches_resident(dp, monkeypatch):
     """The k≥21 streaming quotient (pk ext chunks generated on the fly)
-    must be BIT-identical to the resident-table path."""
+    must be BIT-identical to the resident-table path — in BOTH its
+    fused (one program per chunk, PTPU_FUSED_QUOTIENT default) and
+    unfused (dispatch-chain fallback) forms."""
     dp_obj, fixed_u64, sigma_u64 = dp
     dp_stream = ptpu.DeviceProver(K, SHIFT, fixed_u64, sigma_u64,
                                   ext_resident=False)
@@ -214,15 +216,18 @@ def test_streaming_quotient_matches_resident(dp):
         uve_r = [dp_obj.ext_chunk(dp_obj.intt_natural(u), j) for u in uv]
         t_res = dp_obj.quotient_chunk(j, we_r, ze_r, me_r, pe_r, pie_r,
                                       uve_r, ch_r)
-        t_str = dp_stream.quotient_chunk(j, we_r, ze_r, me_r, pe_r,
-                                         pie_r, uve_r, ch_s)
-        # partial ("fixed") residency: resident packed fixed tables,
-        # streamed σ chains — same bits again
-        t_fix = dp_fixed.quotient_chunk(j, we_r, ze_r, me_r, pe_r,
-                                        pie_r, uve_r, ch_f)
         res = ptpu.download_std(t_res)
-        assert np.array_equal(res, ptpu.download_std(t_str))
-        assert np.array_equal(res, ptpu.download_std(t_fix))
+        for fused in ("1", "0"):
+            monkeypatch.setenv("PTPU_FUSED_QUOTIENT", fused)
+            t_str = dp_stream.quotient_chunk(j, we_r, ze_r, me_r, pe_r,
+                                             pie_r, uve_r, ch_s)
+            # partial ("fixed") residency: resident packed fixed
+            # tables, streamed σ chains — same bits again
+            t_fix = dp_fixed.quotient_chunk(j, we_r, ze_r, me_r, pe_r,
+                                            pie_r, uve_r, ch_f)
+            assert (t_str.dtype == np.uint16) == (fused == "1")
+            assert np.array_equal(res, ptpu.download_std(t_str))
+            assert np.array_equal(res, ptpu.download_std(t_fix))
 
 
 def test_prove_streaming_mode_bytes_equal_host():
