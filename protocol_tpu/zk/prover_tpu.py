@@ -196,16 +196,16 @@ def _as_planes(x):
     return x
 
 
-@partial(jax.jit, static_argnames=("nblinds",))
-def _ext_chunk_impl(coeffs, coset16, xs16, zh_plane, blind_planes,
+def _ext_chunk_core(coeffs, coset, xs_fs, zh_plane, blind_planes,
                     w_a, w_b, t16, nblinds: int):
-    """Static tables arrive as packed (16, n) uint16 planes (half the
-    HBM of int32 limb planes; the unpack is trivial VPU work)."""
-    scaled = f2.mont_mul(_as_planes(coeffs), f2.unpack16(coset16))
+    """Traceable core of one (possibly blinded) ext-chunk NTT —
+    coset/xs arrive UNPACKED. The single home of the blind-correction
+    formula, shared by the standalone ``_ext_chunk_impl`` dispatch and
+    the fused streaming quotient (which inlines 14 of these)."""
+    scaled = f2.mont_mul(_as_planes(coeffs), coset)
     chunk = ntt_tpu._ntt_impl(scaled, w_a, w_b, t16)
     if nblinds:
         n = chunk.shape[1]
-        xs_fs = f2.unpack16(xs16)
         corr = jnp.broadcast_to(blind_planes[:, 0:1], (L, n))
         xp = xs_fs
         for i in range(1, nblinds):
@@ -220,6 +220,17 @@ def _ext_chunk_impl(coeffs, coset16, xs16, zh_plane, blind_planes,
     # contracts need < 2p — f2.sub's subtrahend in the quotient kernel
     # and pack16's 256-bit window. One value-preserving CIOS by R̃.
     return f2.mont_mul_const(chunk, f2.R_MONT)
+
+
+@partial(jax.jit, static_argnames=("nblinds",))
+def _ext_chunk_impl(coeffs, coset16, xs16, zh_plane, blind_planes,
+                    w_a, w_b, t16, nblinds: int):
+    """Static tables arrive as packed (16, n) uint16 planes (half the
+    HBM of int32 limb planes; the unpack is trivial VPU work)."""
+    return _ext_chunk_core(coeffs, f2.unpack16(coset16),
+                           f2.unpack16(xs16) if nblinds else None,
+                           zh_plane, blind_planes, w_a, w_b, t16,
+                           nblinds)
 
 
 # challenge-plane layout shared by both quotient variants:
@@ -471,12 +482,13 @@ def _twiddle_mul(x, pows16):
 def _intt_ext_fused_impl(t_in, w_a, w_b, t16_inv, n_inv_planes,
                          we_neg16, s_neg16, zc_planes, su_planes):
     """The whole 4n inverse (4 per-coset iNTTs + twiddles + radix-4
-    cross-chunk combine + output packs) as ONE program — the streaming
-    prover's dispatch-economy twin of the incremental :meth:`intt_ext`
-    (which stays the resident-mode path, where freeing each input as
-    its iNTT completes is what bounds the k=20 HBM peak). Same
-    composites (jitted helpers inline when traced here) —
-    bit-identical (tested)."""
+    cross-chunk combine + output packs) as ONE program — the
+    dispatch-economy twin of the incremental :meth:`intt_ext`. OPT-IN
+    (PTPU_FUSED_INTT=1): at k=21 partial residency the one-program
+    working set RESOURCE_EXHAUSTED the 16 GB chip, so the incremental
+    path (which frees each chunk as its iNTT completes) stays the
+    default. Same composites (jitted helpers inline when traced
+    here) — bit-identical (tested)."""
     hats = []
     for j in range(EXT_COSETS):
         src = _as_planes(t_in[j])
@@ -926,11 +938,14 @@ class DeviceProver:
 
         CONSUMES ``t_chunks`` (entries are dropped as their iNTT
         completes) and emits output chunks one at a time — the HBM peak
-        here decides whether k=20 fits the chip. Streaming mode (packed
-        chunks, lighter peak) takes the fused single-program variant
-        unless PTPU_FUSED_QUOTIENT=0."""
+        here decides whether k=20 fits the chip. The fused
+        single-program variant is OPT-IN (PTPU_FUSED_INTT=1): at k=21
+        under partial residency it measured RESOURCE_EXHAUSTED — XLA
+        keeps all four hats plus inputs live inside one program, and
+        unlike the quotient fusion (~124 dispatches saved) this one
+        only buys ~16, not worth defaulting against the HBM line."""
         if (not self.ext_resident
-                and os.environ.get("PTPU_FUSED_QUOTIENT", "1") != "0"):
+                and os.environ.get("PTPU_FUSED_INTT") == "1"):
             outs = _intt_ext_fused_impl(
                 tuple(t_chunks), self.plan.W_A, self.plan.W_B,
                 self.plan.T16_inv,

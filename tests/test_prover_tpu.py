@@ -230,11 +230,14 @@ def test_streaming_quotient_matches_resident(dp, monkeypatch):
             assert np.array_equal(res, ptpu.download_std(t_fix))
 
 
-def test_prove_streaming_mode_bytes_equal_host():
+def test_prove_streaming_mode_bytes_equal_host(monkeypatch):
     """Full prove_fast_tpu in streaming (k≥21-style) mode — packed
-    coefficient arrays, on-the-fly pk ext chunks, packed t chunks —
-    must still emit byte-identical proofs to the host prover."""
+    coefficient arrays, on-the-fly pk ext chunks, packed t chunks,
+    fused quotient AND the opt-in fused 4n inverse — must still emit
+    byte-identical proofs to the host prover."""
     import random
+
+    monkeypatch.setenv("PTPU_FUSED_INTT", "1")
 
     from protocol_tpu.utils.fields import BN254_FR_MODULUS as R
     from protocol_tpu.zk import prover_fast as pf
