@@ -120,8 +120,12 @@ class JaxSparseBackend(ConvergeBackend):
 
     def converge_edges(
         self, n, src, dst, val, valid, initial_score, num_iterations, tol=None,
-        alpha: float = 0.0,
+        alpha: float = 0.0, s0=None,
     ):
+        """``s0`` (node-order, length n) warm-starts the power iteration —
+        pair with :func:`ops.converge.warm_start_scores` to project a
+        previous score vector onto the current peer set. Omitted, the
+        cold uniform start (valid·initial_score) is used."""
         import jax.numpy as jnp
 
         from .graph import build_operator
@@ -133,7 +137,10 @@ class JaxSparseBackend(ConvergeBackend):
 
         op = build_operator(n, src, dst, val, valid)
         arrs = operator_arrays(op, dtype=self.dtype, alpha=alpha)
-        s0 = jnp.asarray(op.valid, dtype=self.dtype) * float(initial_score)
+        if s0 is None:
+            s0 = jnp.asarray(op.valid, dtype=self.dtype) * float(initial_score)
+        else:
+            s0 = jnp.asarray(np.asarray(s0), dtype=self.dtype)
         if tol is None:
             return np.asarray(converge_sparse_fixed(arrs, s0, num_iterations))
         scores, iters, delta = converge_sparse_adaptive(
@@ -151,7 +158,7 @@ class JaxRoutedBackend(JaxSparseBackend):
 
     def converge_edges(
         self, n, src, dst, val, valid, initial_score, num_iterations, tol=None,
-        alpha: float = 0.0, operator=None,
+        alpha: float = 0.0, operator=None, s0=None,
     ):
         import jax.numpy as jnp
 
@@ -166,7 +173,13 @@ class JaxRoutedBackend(JaxSparseBackend):
         if op is None:
             op = build_routed_operator(n, src, dst, val, valid)
         arrs, static = routed_arrays(op, dtype=self.dtype, alpha=alpha)
-        s0 = jnp.asarray(op.initial_scores(initial_score, dtype=self.dtype))
+        if s0 is None:
+            s0 = jnp.asarray(op.initial_scores(initial_score,
+                                               dtype=self.dtype))
+        else:
+            # node-order warm start → state-slot order
+            s0 = jnp.asarray(op.scores_from_nodes(np.asarray(s0),
+                                                  dtype=self.dtype))
         if tol is None:
             out = converge_routed_fixed(arrs, static, s0, num_iterations)
             return op.scores_for_nodes(np.asarray(out))

@@ -6,10 +6,12 @@ et-proving-key, et-verify, kzg-params, local-scores, scores, show,
 th-proof, th-proving-key, th-verify, update.
 
 Additions over the reference: a ``--backend {native,jax,jax-sparse}`` flag
-on the score verbs (the ConvergeBackend seam), and a file-persisted local
+on the score verbs (the ConvergeBackend seam), a file-persisted local
 chain (``node_url = "memory"``) so the full flow runs without an Ethereum
-node. The reference's handle_update bug (writing ``domain`` into
-``as_address``, cli.rs:639-643) is deliberately not replicated.
+node, and the ``serve`` verb — the long-running trust-scores service
+(``protocol_tpu.service``: chain tailer, incremental refresh, proof job
+queue, HTTP API). The reference's handle_update bug (writing ``domain``
+into ``as_address``, cli.rs:639-643) is deliberately not replicated.
 """
 
 from __future__ import annotations
@@ -119,6 +121,29 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--backend", choices=["native", "jax", "jax-sparse"], default="native")
     p.add_argument("--batched-ingest", action="store_true",
                    help="recover attestation signers on the device in one batch")
+
+    p = sub.add_parser(
+        "serve",
+        help="run the long-running trust-scores service (chain tailer, "
+             "incremental refresh, proof job queue, HTTP API)")
+    p.add_argument("--host", default=None, help="bind host (default "
+                   "127.0.0.1; PTPU_SERVE_HOST)")
+    p.add_argument("--port", type=int, default=None,
+                   help="bind port (0 = ephemeral; default 8799)")
+    p.add_argument("--poll-interval", type=float, default=None,
+                   help="seconds between chain polls")
+    p.add_argument("--tol", type=float, default=None,
+                   help="refresh stopping tolerance (relative L1)")
+    p.add_argument("--max-iterations", type=int, default=None)
+    p.add_argument("--queue-capacity", type=int, default=None,
+                   help="proof job backpressure bound")
+    p.add_argument("--shape", choices=["default", "tiny"], default=None,
+                   help="circuit shape served by proof jobs")
+    p.add_argument("--transcript", choices=["poseidon", "keccak"],
+                   default=None, help="default et-proof transcript")
+    p.add_argument("--checkpoint-dir", default=None,
+                   help="block-cursor checkpoint directory "
+                        "(default <assets>/service-cursor)")
 
     sub.add_parser("show", help="print the current config")
 
@@ -711,8 +736,53 @@ def handle_sparse_scores(args, files, config):
     return 0 if converged else 1
 
 
+def handle_serve(args, files, config):
+    """Boot the long-running service (protocol_tpu.service) against the
+    configured chain and block until SIGTERM/SIGINT drains it."""
+    from pathlib import Path
+
+    from ..utils.platform import honor_jax_platforms_env
+
+    honor_jax_platforms_env()
+
+    from ..service import ServiceConfig, TrustService
+
+    svc_config = ServiceConfig.from_env(
+        host=args.host, port=args.port,
+        poll_interval=args.poll_interval, tol=args.tol,
+        max_iterations=args.max_iterations,
+        queue_capacity=args.queue_capacity,
+        proof_shape=args.shape, transcript=args.transcript)
+    if args.checkpoint_dir:
+        ck_dir = Path(args.checkpoint_dir)
+        if not ck_dir.is_absolute():
+            ck_dir = files.assets / ck_dir
+    else:
+        ck_dir = files.assets / "service-cursor"
+    # batched_ingest=None → the Client's auto rule (batched signer
+    # recovery on an accelerator from 32 lanes up); the batch verbs'
+    # False default would pin the daemon to scalar recovery forever
+    client = _make_client(files, config, batched_ingest=None)
+    if config.node_url == "memory":
+        # tail the file-persisted local chain so attest runs from OTHER
+        # processes are visible (the in-memory LocalChain a fresh Client
+        # builds would be a frozen snapshot)
+        from ..service.tailer import FileBackedLocalChain
+
+        client.chain = FileBackedLocalChain(files.chain_json())
+    service = TrustService(client, svc_config, str(ck_dir), files=files)
+    url = service.start()
+    service.install_signal_handlers()
+    print(f"trust-scores service listening on {url} "
+          f"(chain: {config.node_url}, cursor: {service.tailer.cursor}); "
+          "SIGTERM drains", flush=True)
+    service.wait()
+    print("service drained", flush=True)
+
+
 HANDLERS = {
     "attest": handle_attest,
+    "serve": handle_serve,
     "attestations": handle_attestations,
     "bandada": handle_bandada,
     "deploy": handle_deploy,

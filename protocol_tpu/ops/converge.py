@@ -27,9 +27,47 @@ from functools import partial
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax import lax
 
 from ..graph import EllOperator
+
+
+def warm_start_scores(prev, n: int, valid, initial_score: float):
+    """Project a previous score vector onto a (possibly grown) peer set —
+    the warm-start seam of the incremental refresh loop
+    (``protocol_tpu.service``), per "Analysis of Power Iteration with
+    Partially Observed Matrix-vector Products" (PAPERS.md): when only a
+    small slice of the opinion matrix changed, the previous fixed point
+    is a far better starting vector than uniform, and the adaptive loop
+    stops in a handful of iterations instead of O(log(1/tol)/gap).
+
+    ``prev`` covers the FIRST ``len(prev)`` slots of the new id space
+    (service ids are append-only); new and previously-unseen peers start
+    at ``initial_score``; invalid slots are zeroed. The result is
+    rescaled so total mass equals the cold-start invariant
+    ``n_valid * initial_score`` — power iteration under the
+    mass-conserving trust operator converges to the fixed point with the
+    mass of its starting vector, so without the rescale a warm and a
+    cold converge would disagree by a scale factor whenever the peer
+    set changed. Returns a float64 numpy vector (callers cast at device
+    transfer).
+    """
+    valid = np.asarray(valid, dtype=bool)
+    if valid.shape != (n,):
+        raise ValueError(f"valid mask must have shape ({n},)")
+    s = np.full(n, float(initial_score), dtype=np.float64)
+    m = min(len(prev), n)
+    carried = np.asarray(prev[:m], dtype=np.float64)
+    if not len(carried) or float((carried * valid[:m]).sum()) <= 0.0:
+        # degenerate carry-over (nothing, or all-zero/invalid): a
+        # rescale would dump the whole mass on the new peers — cold
+        # uniform is the only sensible start
+        return valid.astype(np.float64) * float(initial_score)
+    s[:m] = carried
+    s *= valid
+    target = float(valid.sum()) * float(initial_score)
+    return s * (target / float(s.sum()))
 
 
 def operator_arrays(

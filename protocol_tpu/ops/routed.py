@@ -77,6 +77,18 @@ def _scores_for_nodes(state_to_node: np.ndarray, n: int,
     return out
 
 
+def _scores_from_nodes(state_to_node: np.ndarray, valid: np.ndarray,
+                       node_scores, dtype) -> np.ndarray:
+    """Inverse of ``_scores_for_nodes``: scatter a node-order vector into
+    state-slot order (dead slots stay 0) — the warm-start seam for the
+    routed engines (a previous converge's node scores restart the next)."""
+    node_scores = np.asarray(node_scores, dtype=np.float64)
+    out = np.zeros(len(state_to_node), dtype=np.float64)
+    live = state_to_node >= 0
+    out[live] = node_scores[state_to_node[live]]
+    return (out * valid).astype(dtype)
+
+
 def blocked_broadcast(arrs: dict, s, widths: tuple, xs: tuple,
                       total_len: int):
     """Expand a state(-slice) vector into weighted edge values across the
@@ -422,6 +434,12 @@ class RoutedOperator:
     def scores_for_nodes(self, state_scores: np.ndarray) -> np.ndarray:
         """Translate a state-order score vector to node order."""
         return _scores_for_nodes(self.state_to_node, self.n, state_scores)
+
+    def scores_from_nodes(self, node_scores: np.ndarray,
+                          dtype=np.float32) -> np.ndarray:
+        """Translate a node-order score vector to state order (warm start)."""
+        return _scores_from_nodes(self.state_to_node, self.valid,
+                                  node_scores, dtype)
 
     def save(self, path) -> None:
         """Persist the compiled operator so the one-time routing-plan

@@ -31,10 +31,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from ..graph import filter_edges, transpose_buckets
 from .mesh import rows_axis
 
-try:  # jax >= 0.6 exposes shard_map at top level
-    from jax import shard_map
-except ImportError:  # pragma: no cover
-    from jax.experimental.shard_map import shard_map
+from .mesh import shard_map_norep
 
 
 @dataclass
@@ -219,7 +216,7 @@ def _fixed_fn(mesh: Mesh, n_valid: float, num_iterations: int):
         return lax.fori_loop(0, num_iterations, body, s)
 
     # in_specs are pytree prefixes: every operator leaf shards on axis 0
-    shmapped = shard_map(
+    shmapped = shard_map_norep(
         run,
         mesh=mesh,
         in_specs=(P(rows_axis), P(rows_axis)),
@@ -237,7 +234,7 @@ def _adaptive_fn(mesh: Mesh, n_valid: float, tol: float, max_iterations: int):
             s, tol, max_iterations,
         )
 
-    shmapped = shard_map(
+    shmapped = shard_map_norep(
         run,
         mesh=mesh,
         in_specs=(P(rows_axis), P(rows_axis)),

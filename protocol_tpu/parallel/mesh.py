@@ -20,6 +20,25 @@ from jax.sharding import Mesh
 rows_axis = "rows"
 
 
+def shard_map_norep(fn, mesh, in_specs, out_specs):
+    """``shard_map`` with replication checking off, across jax
+    versions: the kwarg is ``check_vma`` on current jax and
+    ``check_rep`` on the 0.4.x experimental API. Every shard_map in the
+    framework that disables the check routes here so a runtime-version
+    skew shows up as nothing instead of a TypeError after a
+    multi-minute kernel compile."""
+    try:  # jax >= 0.6 exposes shard_map at top level
+        from jax import shard_map
+    except ImportError:  # pragma: no cover
+        from jax.experimental.shard_map import shard_map
+    try:
+        return shard_map(fn, mesh=mesh, in_specs=in_specs,
+                         out_specs=out_specs, check_vma=False)
+    except TypeError:
+        return shard_map(fn, mesh=mesh, in_specs=in_specs,
+                         out_specs=out_specs, check_rep=False)
+
+
 def make_mesh(n_devices: int | None = None, devices=None) -> Mesh:
     """A 1-D mesh over the first ``n_devices`` devices (default: all).
 

@@ -38,13 +38,9 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-try:  # jax >= 0.6 exposes shard_map at top level
-    from jax import shard_map
-except ImportError:  # pragma: no cover
-    from jax.experimental.shard_map import shard_map
-
 from ..ops import fieldops2 as f2
 from ..ops import ntt_tpu
+from .mesh import shard_map_norep
 
 L, L6 = f2.L, f2.L6
 
@@ -94,16 +90,14 @@ def ntt_sharded(x: jnp.ndarray, plan: ntt_tpu.NttPlan, mesh: Mesh,
     xg = x.reshape(L, A, B)
     t16g = t16  # (16, A, B)
     spec_in = P(None, None, axis)
-    # check_vma off: the field kernels build internal constants
-    # (jnp.zeros carries in fori loops) whose varying-axis type the
-    # checker can't unify with sharded operands; correctness is pinned
-    # by the bit-exactness tests instead
-    fn = shard_map(
-        kernel, mesh=mesh,
-        in_specs=(spec_in, spec_in, P(None, None, None),
-                  P(None, None, None)),
-        out_specs=spec_in,
-        check_vma=False,
+    # replication check off (shard_map_norep): the field kernels build
+    # internal constants (jnp.zeros carries in fori loops) whose
+    # varying-axis type the checker can't unify with sharded operands;
+    # correctness is pinned by the bit-exactness tests instead
+    fn = shard_map_norep(
+        kernel, mesh,
+        (spec_in, spec_in, P(None, None, None), P(None, None, None)),
+        spec_in,
     )
     xg = jax.device_put(xg, NamedSharding(mesh, spec_in))
     out = fn(xg, t16g, w_a, w_b)

@@ -45,10 +45,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-try:  # jax >= 0.6 exposes shard_map at top level
-    from jax import shard_map
-except ImportError:  # pragma: no cover
-    from jax.experimental.shard_map import shard_map
+from .mesh import shard_map_norep
 
 from ..ops import fieldops2 as f2
 from ..ops import ntt_tpu
@@ -180,11 +177,11 @@ class ShardedRound3:
         if fn is None:
             rep = P(None, None, None)
             spec = _shard_spec(self.axis)
-            fn = self._fns[("ext", nb)] = jax.jit(shard_map(
+            fn = self._fns[("ext", nb)] = jax.jit(shard_map_norep(
                 kernel, mesh=self.mesh,
                 in_specs=(spec, spec, spec, rep, rep, rep,
                           P(None, None), P(None, None)),
-                out_specs=spec, check_vma=False))
+                out_specs=spec))
         return fn(coeffs, self.coset_pows[j], self.xs_fs[j],
                   self.plan.W_A, self.plan.W_B, self.plan.T16,
                   dp.zh_planes[j], bp)
@@ -230,11 +227,11 @@ class ShardedRound3:
         if fn is None:
             rep2 = P(None, None)
             spec = _shard_spec(self.axis)
-            fn = self._fns["quot"] = jax.jit(shard_map(
+            fn = self._fns["quot"] = jax.jit(shard_map_norep(
                 kernel, mesh=self.mesh,
                 in_specs=(spec, spec, rep2, rep2,
                           *([spec] * (4 + 25))),
-                out_specs=spec, check_vma=False))
+                out_specs=spec))
         return fn(self.xs_fs[j], self.l0_fs[j], ch_planes,
                   dp.zh_inv_planes[j], z_e, phi_e, m_e, pi_e,
                   *wires_e, *uv_e, *fixed, *sigma)
@@ -292,10 +289,10 @@ class ShardedRound3:
         if fn is None:
             rep = P(None, None, None)
             spec = _shard_spec(self.axis)
-            fn = self._fns["intt"] = jax.jit(shard_map(
+            fn = self._fns["intt"] = jax.jit(shard_map_norep(
                 kernel, mesh=self.mesh,
                 in_specs=(spec, rep, rep, rep, P(None, None)),
-                out_specs=spec, check_vma=False))
+                out_specs=spec))
         return fn(z, plan.W_A, plan.W_B, plan.T16_inv, n_inv)
 
     def intt_ext(self, t_chunks: list) -> list:
@@ -324,11 +321,11 @@ class ShardedRound3:
 
         fn = self._fns.get("combine")
         if fn is None:
-            fn = self._fns["combine"] = jax.jit(shard_map(
+            fn = self._fns["combine"] = jax.jit(shard_map_norep(
                 combine, mesh=self.mesh,
                 in_specs=(P(None, None, None), rep2, spec,
                           *([spec] * EXT_COSETS)),
-                out_specs=spec, check_vma=False))
+                out_specs=spec))
         for u in range(EXT_COSETS):
             out.append(fn(dp.zc_planes[u], dp.su_planes[u],
                           self.s_neg_pows, *hats))
@@ -343,9 +340,9 @@ class ShardedRound3:
                 flat = f2.mont_mul(_as_flat(a), _unpack_flat(b16))
                 return flat.reshape(a.shape)
 
-            fn = self._fns["pmul"] = jax.jit(shard_map(
+            fn = self._fns["pmul"] = jax.jit(shard_map_norep(
                 kernel, mesh=self.mesh, in_specs=(spec, spec),
-                out_specs=spec, check_vma=False))
+                out_specs=spec))
         return fn(x, packed16)
 
     def gather(self, x: jnp.ndarray) -> jnp.ndarray:

@@ -47,16 +47,12 @@ from ..ops.routed import (
     _expand_matrix,
     _initial_scores,
     _scores_for_nodes,
+    _scores_from_nodes,
     blocked_broadcast,
     blocked_reduce,
 )
 from .converge import mesh_adaptive_loop, psum_dangling_and_damping
-from .mesh import rows_axis
-
-try:  # jax >= 0.6 exposes shard_map at top level
-    from jax import shard_map
-except ImportError:  # pragma: no cover
-    from jax.experimental.shard_map import shard_map
+from .mesh import rows_axis, shard_map_norep
 
 __all__ = [
     "ShardedRoutedOperator",
@@ -133,6 +129,12 @@ class ShardedRoutedOperator:
 
     def scores_for_nodes(self, state_scores: np.ndarray) -> np.ndarray:
         return _scores_for_nodes(self.state_to_node, self.n, state_scores)
+
+    def scores_from_nodes(self, node_scores: np.ndarray,
+                          dtype=np.float32) -> np.ndarray:
+        """Node-order vector → device-major state order (warm start)."""
+        return _scores_from_nodes(self.state_to_node, self.valid,
+                                  node_scores, dtype)
 
     def save(self, path) -> None:
         """Persist the compiled device-major operator (uncompressed .npz,
@@ -424,10 +426,10 @@ def _fixed_fn(mesh: Mesh, n_valid: float, num_iterations: int, cfg):
 
         return lax.fori_loop(0, num_iterations, body, s)
 
-    shmapped = shard_map(
-        run, mesh=mesh,
-        in_specs=(P(rows_axis), P(rows_axis)),
-        out_specs=P(rows_axis),
+    shmapped = shard_map_norep(
+        run, mesh,
+        (P(rows_axis), P(rows_axis)),
+        P(rows_axis),
     )
     return jax.jit(shmapped)
 
@@ -442,10 +444,10 @@ def _adaptive_fn(mesh: Mesh, n_valid: float, tol: float,
             s, tol, max_iterations,
         )
 
-    shmapped = shard_map(
-        run, mesh=mesh,
-        in_specs=(P(rows_axis), P(rows_axis)),
-        out_specs=(P(rows_axis), P(), P()),
+    shmapped = shard_map_norep(
+        run, mesh,
+        (P(rows_axis), P(rows_axis)),
+        (P(rows_axis), P(), P()),
     )
     return jax.jit(shmapped)
 

@@ -1,0 +1,57 @@
+"""Long-running trust-scores service.
+
+Everything else in the repo is batch-shaped — 17 CLI verbs driving a
+proving stack that already has warm-cache steady-state primitives
+(multi-entry DeviceProver suspend/resume, pipelined ingest, sub-2 s
+10M-peer converge) but nothing that *stays up* and serves them. This
+package is the daemon the ROADMAP north star implies and TrustFlow
+(PAPERS.md, arXiv 2603.19452) frames: reputation as a continuously
+propagating service, not a batch artifact.
+
+Components (one file each):
+
+- :class:`ChainTailer` (``tailer.py``) — follows the AttestationStation
+  over the existing chain clients (``client/chain.py`` RpcChain against
+  a real node or the mock devnet, or a file-backed LocalChain) with
+  retry + exponential backoff and a resumable block cursor persisted
+  through ``utils/checkpoint.py``.
+- :class:`OpinionGraph` (``state.py``) — the in-memory opinion graph:
+  append-only address→id interning, latest-wins edges, edit accounting
+  for the staleness bound.
+- :class:`ScoreRefresher` (``refresh.py``) — incremental score refresh:
+  warm-starts ``ConvergeBackend`` power iteration from the last score
+  vector (``ops.converge.warm_start_scores``), falling back to a cold
+  converge past a staleness bound.
+- :class:`ProofJobQueue` (``jobs.py``) — bounded proof job queue
+  (submit/status/result) with a single device worker, layered on the
+  zk layer's identity-keyed prover caches so steady-state proofs never
+  re-pay device init.
+- ``http_api.py`` — stdlib ``http.server`` API: GET /scores,
+  GET /score/<addr>, POST /proofs, GET /proofs/<id>, GET /healthz,
+  GET /metrics (Prometheus text from ``utils/trace.py``).
+- :class:`TrustService` (``daemon.py``) — the supervisor: threads,
+  SIGTERM graceful drain, fault-injection seam (``faults.py``).
+
+Wired to the CLI as the ``serve`` verb (``cli/main.py``).
+"""
+
+from .config import ServiceConfig
+from .daemon import TrustService
+from .faults import FaultInjector
+from .jobs import ProofJob, ProofJobQueue, QueueFullError
+from .refresh import ScoreRefresher, ScoreTable
+from .state import OpinionGraph
+from .tailer import ChainTailer
+
+__all__ = [
+    "ChainTailer",
+    "FaultInjector",
+    "OpinionGraph",
+    "ProofJob",
+    "ProofJobQueue",
+    "QueueFullError",
+    "ScoreRefresher",
+    "ScoreTable",
+    "ServiceConfig",
+    "TrustService",
+]

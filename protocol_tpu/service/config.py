@@ -1,0 +1,78 @@
+"""Service configuration: one dataclass, env-overridable.
+
+The batch CLI keeps its knobs in ``ClientConfig`` + flags; the daemon
+adds serving-specific ones (poll cadence, refresh tolerances, staleness
+bounds, queue sizes, drain budget). Every field has a ``PTPU_SERVE_*``
+env override so a supervisor (systemd/k8s) can tune a deployment
+without editing code; CLI flags (``serve`` verb) win over env.
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass, fields
+
+from ..utils.errors import EigenError
+
+
+@dataclass
+class ServiceConfig:
+    # --- HTTP -------------------------------------------------------------
+    host: str = "127.0.0.1"
+    port: int = 8799  # 0 = ephemeral (the bound port is logged/returned)
+
+    # --- chain tailer -----------------------------------------------------
+    poll_interval: float = 1.0      # seconds between get_logs polls
+    backoff_base: float = 0.5       # first retry delay after an RPC fault
+    backoff_max: float = 30.0       # exponential backoff cap
+    cursor_keep: int = 3            # block-cursor checkpoints retained
+
+    # --- score refresh ----------------------------------------------------
+    refresh_interval: float = 0.5   # max latency from ingest to refresh
+    tol: float = 1e-9               # relative-L1 stopping tolerance
+    max_iterations: int = 500
+    initial_score: float = 1000.0
+    alpha: float = 0.0              # pre-trust damping (0 = reference)
+    # staleness bound for the warm start: past either, refresh runs COLD
+    # (uniform start) — warm starting assumes the previous fixed point
+    # is near the new one, which stops holding when a large slice of
+    # the opinion matrix changed (PAPERS.md, arXiv 2606.11956)
+    cold_edit_fraction: float = 0.5  # edits since last cold / edge count
+    cold_every: int = 64             # periodic cold resync regardless
+
+    # --- proof jobs -------------------------------------------------------
+    queue_capacity: int = 8         # backpressure: submits beyond this 429
+    proof_shape: str = "default"    # "default" (k=21 SRS) | "tiny" (k=20)
+    transcript: str = "keccak"
+
+    # --- lifecycle --------------------------------------------------------
+    drain_timeout: float = 30.0     # SIGTERM: budget to finish in-flight
+
+    @classmethod
+    def from_env(cls, **overrides) -> "ServiceConfig":
+        """Env-resolved config: ``PTPU_SERVE_<FIELD>`` per field, then
+        explicit ``overrides`` (CLI flags) on top. Unknown override
+        keys are rejected — a typo'd flag must not silently no-op."""
+        values = {}
+        for f in fields(cls):
+            env = os.environ.get(f"PTPU_SERVE_{f.name.upper()}")
+            if env is None:
+                continue
+            try:
+                if f.type == "float":
+                    values[f.name] = float(env)
+                elif f.type == "int":
+                    values[f.name] = int(env)
+                else:
+                    values[f.name] = env
+            except ValueError as e:
+                raise EigenError(
+                    "config_error",
+                    f"bad PTPU_SERVE_{f.name.upper()}={env!r}: {e}") from e
+        for k, v in overrides.items():
+            if k not in cls.__dataclass_fields__:
+                raise EigenError("config_error",
+                                 f"unknown service config field {k!r}")
+            if v is not None:
+                values[k] = v
+        return cls(**values)

@@ -1,0 +1,217 @@
+"""The service supervisor: threads, lifecycle, graceful drain.
+
+Thread layout (all daemon threads, all stopping on one event):
+
+- **tailer** — ``ChainTailer.run``: poll chain → decode → sink;
+- **refresher** — ``ScoreRefresher.run``: wake on dirty, converge,
+  publish;
+- **proof worker** — ``ProofJobQueue``'s single device worker;
+- **HTTP** — ``ThreadingHTTPServer`` (its own accept loop + per-request
+  threads; GETs only read immutable snapshots).
+
+The ingest sink is the only producer-side coupling: it recovers signer
+keys (batched TPU pipeline on an accelerator, scalar otherwise), folds
+the batch into the opinion graph AND the raw attestation buffer (the
+proof provers need the actual signed attestations, not just edges),
+then wakes the refresher.
+
+SIGTERM/SIGINT → :meth:`TrustService.shutdown`: mark draining (POSTs
+503, health says so), stop the tailer/refresher, drain the job queue
+within ``drain_timeout``, persist the cursor one last time, then stop
+HTTP. The cursor is already persisted per poll, so even a SIGKILL loses
+at most one poll's worth of re-fetchable logs.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+from ..utils import trace
+from ..utils.checkpoint import CheckpointManager
+from ..utils.errors import EigenError
+from .config import ServiceConfig
+from .faults import FaultInjector
+from .jobs import ProofJobQueue
+from .refresh import ScoreRefresher
+from .state import OpinionGraph, recover_signers
+from .tailer import ChainTailer
+
+
+class TrustService:
+    """Wire-up + lifecycle for one service instance."""
+
+    def __init__(self, client, config: ServiceConfig, checkpoint_dir: str,
+                 provers: dict | None = None, backend=None,
+                 faults: FaultInjector | None = None, files=None):
+        """``client``: a ``client.Client`` (chain + domain + circuit
+        hyperparameters); ``checkpoint_dir``: block-cursor durability;
+        ``provers``: job registry (default: the production
+        EigenTrust/Threshold provers over ``files``' assets)."""
+        self.client = client
+        self.config = config
+        self.faults = faults or FaultInjector()
+        self.graph = OpinionGraph()
+        self.refresher = ScoreRefresher(self.graph, config,
+                                        backend=backend,
+                                        faults=self.faults)
+        self.tailer = ChainTailer(
+            client.chain, client._domain_bytes(), self._sink,
+            CheckpointManager(checkpoint_dir, keep=config.cursor_keep),
+            faults=self.faults, backoff_base=config.backoff_base,
+            backoff_max=config.backoff_max)
+        if provers is None:
+            if files is None:
+                raise EigenError(
+                    "config_error",
+                    "need an EigenFile assets layout (files=) to build "
+                    "the default provers, or pass provers= explicitly")
+            from .provers import make_provers
+
+            provers = make_provers(self, files,
+                                   shape_name=config.proof_shape,
+                                   transcript=config.transcript)
+        self.jobs = ProofJobQueue(provers, capacity=config.queue_capacity,
+                                  faults=self.faults)
+        self._attestations: list = []
+        self._att_lock = threading.Lock()
+        self._stop = threading.Event()
+        self._dirty = threading.Event()
+        self._threads: list = []
+        self._server = None
+        self._server_thread = None
+        self.started_at: float | None = None
+        self.draining = False
+
+    # --- ingest sink ------------------------------------------------------
+    def _sink(self, batch: list, block: int) -> None:
+        with trace.span("service.ingest", n=len(batch), block=block):
+            signers = recover_signers(batch,
+                                      batched=self.client.batched_ingest)
+        with self._att_lock:
+            self._attestations.extend(batch)
+        self.graph.apply(batch, signers)
+        self._dirty.set()
+
+    def attestation_snapshot(self) -> list:
+        with self._att_lock:
+            return list(self._attestations)
+
+    # --- introspection ----------------------------------------------------
+    def health(self) -> dict:
+        table = self.refresher.table
+        return {
+            "ok": True,
+            "draining": self.draining,
+            "block_cursor": self.tailer.cursor,
+            "peers": self.graph.n,
+            "edges": self.graph.n_edges,
+            "revision": self.graph.revision,
+            "score_revision": table.revision,
+            "queue_depth": self.jobs.depth(),
+            "uptime_s": (time.time() - self.started_at
+                         if self.started_at else 0.0),
+        }
+
+    def extra_metrics(self) -> dict:
+        """Service-local gauges merged into /metrics (things the tracer
+        does not carry because they are state, not samples)."""
+        return {
+            "service.up": 0.0 if self.draining else 1.0,
+            "service.queue_depth": float(self.jobs.depth()),
+            "service.proof_completed": float(self.jobs.completed),
+            "service.proof_failed": float(self.jobs.failed),
+            "service.uptime_seconds": (time.time() - self.started_at
+                                       if self.started_at else 0.0),
+        }
+
+    @property
+    def url(self) -> str:
+        host, port = self._server.server_address[:2]
+        return f"http://{host}:{port}"
+
+    # --- lifecycle --------------------------------------------------------
+    def start(self) -> str:
+        """Start all threads + the HTTP listener; returns the base URL.
+        Tracing is force-enabled (in-memory) — /metrics is part of the
+        service contract, not an opt-in."""
+        from .http_api import make_server
+
+        if not trace.TRACER.enabled:
+            trace.enable()
+        self.started_at = time.time()
+        self.jobs.start()
+        t = threading.Thread(
+            target=self.tailer.run,
+            args=(self._stop, self.config.poll_interval),
+            daemon=True, name="ptpu-tailer")
+        t.start()
+        self._threads.append(t)
+        t = threading.Thread(
+            target=self.refresher.run,
+            args=(self._stop, self._dirty, self.config.refresh_interval),
+            daemon=True, name="ptpu-refresher")
+        t.start()
+        self._threads.append(t)
+        self._server = make_server(self, self.config.host,
+                                   self.config.port)
+        self._server_thread = threading.Thread(
+            target=self._server.serve_forever, daemon=True,
+            name="ptpu-http")
+        self._server_thread.start()
+        trace.event("service.started", url=self.url)
+        return self.url
+
+    def shutdown(self, timeout: float | None = None) -> bool:
+        """Graceful drain; idempotent; returns True on a clean drain.
+
+        Order: stop ingest/refresh producers → drain the proof queue
+        (finish in-flight within the budget) → persist the cursor →
+        stop HTTP last (health stays observable while draining)."""
+        if self.draining:
+            return True
+        self.draining = True
+        timeout = self.config.drain_timeout if timeout is None else timeout
+        trace.event("service.draining", timeout_s=timeout)
+        self._stop.set()
+        self._dirty.set()  # unblock the refresher wait
+        deadline = time.monotonic() + timeout
+        for t in self._threads:
+            t.join(timeout=max(0.1, deadline - time.monotonic()))
+        clean = not any(t.is_alive() for t in self._threads)
+        clean = self.jobs.drain(
+            timeout=max(0.1, deadline - time.monotonic())) and clean
+        try:
+            self.tailer._persist_cursor()
+        except EigenError:
+            clean = False
+        if self._server is not None:
+            self._server.shutdown()
+            self._server.server_close()
+            self._server_thread.join(timeout=5.0)
+        trace.event("service.stopped", clean=clean)
+        return clean
+
+    def install_signal_handlers(self) -> None:
+        """SIGTERM/SIGINT → graceful drain (main thread only — the
+        ``serve`` verb and ``tools/serve_smoke.py`` call this)."""
+        import signal
+
+        def _handle(signum, frame):
+            trace.event("service.signal", signum=signum)
+            # drain on a helper thread: a second signal must still be
+            # deliverable, and handlers should return promptly
+            threading.Thread(target=self.shutdown, daemon=True,
+                             name="ptpu-drain").start()
+
+        signal.signal(signal.SIGTERM, _handle)
+        signal.signal(signal.SIGINT, _handle)
+
+    def wait(self, poll: float = 0.2) -> None:
+        """Block until shutdown completes (the serve verb's main loop)."""
+        while not self._stop.is_set():
+            time.sleep(poll)
+        # _stop set by shutdown(); wait for the drain thread to finish
+        # the queue + server teardown
+        while self._server is not None and self._server_thread.is_alive():
+            time.sleep(poll)

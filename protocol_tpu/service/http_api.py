@@ -1,0 +1,129 @@
+"""HTTP API for the trust-scores service (stdlib ``http.server``).
+
+Same dependency posture as the mock devnet (``client/mocknode.py``): a
+``ThreadingHTTPServer`` with a closure-bound handler, no framework.
+
+Routes:
+
+- ``GET /healthz``        liveness + cursor/peer/queue gauges
+- ``GET /scores``         the full published score table (JSON)
+- ``GET /score/<addr>``   one peer's score (404 before first sighting)
+- ``POST /proofs``        submit a proof job ``{"kind", "params"}`` →
+  202 + job id; 429 on queue backpressure; 503 while draining
+- ``GET /proofs/<id>``    job status/result
+- ``GET /metrics``        Prometheus text (``service/metrics.py``)
+
+GETs are lock-free against the hot path: the score table is an
+immutable object swapped by the refresher, so a read races at worst
+into the previous table, never a torn one.
+"""
+
+from __future__ import annotations
+
+import json
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from ..utils.errors import EigenError
+from .jobs import QueueFullError
+from .metrics import render_prometheus
+
+
+def _parse_address(text: str) -> bytes | None:
+    try:
+        raw = bytes.fromhex(text.removeprefix("0x"))
+    except ValueError:
+        return None
+    return raw if len(raw) == 20 else None
+
+
+def make_server(service, host: str, port: int) -> ThreadingHTTPServer:
+    """Bind (not start) the API server for ``service``; ``port=0``
+    picks an ephemeral port (``server_address[1]`` has the real one)."""
+
+    class Handler(BaseHTTPRequestHandler):
+        def _reply(self, status: int, obj, content_type="application/json"):
+            body = (json.dumps(obj).encode()
+                    if content_type == "application/json"
+                    else obj.encode())
+            self.send_response(status)
+            self.send_header("Content-Type", content_type)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        # --- GET ----------------------------------------------------------
+        def do_GET(self):  # noqa: N802 - BaseHTTPRequestHandler API
+            path = self.path.split("?", 1)[0].rstrip("/") or "/"
+            if path == "/healthz":
+                return self._reply(200, service.health())
+            if path == "/metrics":
+                return self._reply(
+                    200, render_prometheus(service.extra_metrics()),
+                    content_type="text/plain; version=0.0.4")
+            if path == "/scores":
+                table = service.refresher.table
+                return self._reply(200, {
+                    "revision": table.revision,
+                    "computed_at": table.computed_at,
+                    "iterations": table.iterations,
+                    "delta": table.delta,
+                    "cold": table.cold,
+                    "scores": [
+                        {"address": "0x" + a.hex(), "score": float(s)}
+                        for a, s in zip(table.addresses, table.scores)
+                    ],
+                })
+            if path.startswith("/score/"):
+                addr = _parse_address(path[len("/score/"):])
+                if addr is None:
+                    return self._reply(
+                        400, {"error": "address must be 20 hex bytes"})
+                table = service.refresher.table
+                score = table.score_of(addr)
+                if score is None:
+                    return self._reply(
+                        404, {"error": "unknown peer",
+                              "address": "0x" + addr.hex()})
+                return self._reply(200, {
+                    "address": "0x" + addr.hex(),
+                    "score": score,
+                    "revision": table.revision,
+                })
+            if path.startswith("/proofs/"):
+                job = service.jobs.get(path[len("/proofs/"):])
+                if job is None:
+                    return self._reply(404, {"error": "unknown job"})
+                return self._reply(200, job.to_json())
+            return self._reply(404, {"error": f"no route {path}"})
+
+        # --- POST ---------------------------------------------------------
+        def do_POST(self):  # noqa: N802
+            path = self.path.split("?", 1)[0].rstrip("/")
+            if path != "/proofs":
+                return self._reply(404, {"error": f"no route {path}"})
+            try:
+                length = int(self.headers.get("Content-Length", 0))
+                req = json.loads(self.rfile.read(length) or b"{}")
+                if not isinstance(req, dict):
+                    raise ValueError("body must be a JSON object")
+                kind = req["kind"]
+                params = req.get("params", {})
+                if not isinstance(params, dict):
+                    raise ValueError("params must be an object")
+            except (ValueError, KeyError) as e:
+                return self._reply(
+                    400, {"error": f"bad request body: {e}; expected "
+                                   '{"kind": ..., "params": {...}}'})
+            try:
+                job = service.jobs.submit(kind, params)
+            except QueueFullError as e:
+                return self._reply(429, {"error": str(e)})
+            except EigenError as e:
+                status = 503 if e.kind == "service_busy" else 400
+                return self._reply(status, {"error": str(e)})
+            return self._reply(202, job.to_json())
+
+        def log_message(self, *a):  # quiet (the tracer is the log)
+            pass
+
+    return ThreadingHTTPServer((host, port), Handler)

@@ -1,0 +1,70 @@
+"""Prometheus text rendering of ``utils/trace.py`` counters + spans.
+
+The tracer is the repo's single observability sink (every hot path
+already emits spans/metrics into it); the service turns it outward:
+``GET /metrics`` serves the text exposition format (version 0.0.4 — the
+one every Prometheus scraper speaks) rendered from
+
+- ``TRACER.metrics_latest()`` → one gauge per metric name
+  (``service.block_cursor`` → ``ptpu_service_block_cursor``), and
+- ``TRACER.summary()`` → per-span-name ``_count`` / ``_seconds_total``
+  / ``_seconds_max`` series with the span name as a label (stable
+  cardinality: span names are static strings in code).
+
+Metric names are sanitized to the Prometheus grammar
+``[a-zA-Z_:][a-zA-Z0-9_:]*`` — dots become underscores.
+"""
+
+from __future__ import annotations
+
+import re
+
+from ..utils import trace
+
+_NAME_OK = re.compile(r"[^a-zA-Z0-9_:]")
+
+
+def _sanitize(name: str) -> str:
+    name = _NAME_OK.sub("_", name)
+    if not name or not (name[0].isalpha() or name[0] in "_:"):
+        name = "_" + name
+    return name
+
+
+def _fmt(value: float) -> str:
+    # integers render bare (Prometheus accepts both; bare reads better
+    # for counters), non-integers as repr floats
+    f = float(value)
+    return str(int(f)) if f == int(f) else repr(f)
+
+
+def render_prometheus(extra: dict | None = None) -> str:
+    """The full exposition page; ``extra`` adds service-local gauges
+    (queue depth, liveness) the tracer does not carry."""
+    lines = []
+    gauges = dict(trace.TRACER.metrics_latest())
+    if extra:
+        gauges.update(extra)
+    for name in sorted(gauges):
+        metric = _sanitize(f"ptpu_{name}")
+        lines.append(f"# TYPE {metric} gauge")
+        lines.append(f"{metric} {_fmt(gauges[name])}")
+
+    summary = trace.TRACER.summary()
+    if summary:
+        lines.append("# TYPE ptpu_span_count gauge")
+        for name in sorted(summary):
+            lines.append(
+                f'ptpu_span_count{{span="{_sanitize(name)}"}} '
+                f'{summary[name]["count"]}')
+        lines.append("# TYPE ptpu_span_seconds_total gauge")
+        for name in sorted(summary):
+            lines.append(
+                f'ptpu_span_seconds_total{{span="{_sanitize(name)}"}} '
+                f'{summary[name]["total_s"]:.6f}')
+        lines.append("# TYPE ptpu_span_seconds_max gauge")
+        for name in sorted(summary):
+            lines.append(
+                f'ptpu_span_seconds_max{{span="{_sanitize(name)}"}} '
+                f'{summary[name]["max_s"]:.6f}')
+    return "\n".join(lines) + "\n"

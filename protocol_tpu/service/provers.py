@@ -1,0 +1,99 @@
+"""Production provers for the job queue: EigenTrust + Threshold.
+
+The steady-state contract: artifact BYTES are loaded once and the same
+objects are passed to ``zk.api`` on every job — its parse cache and the
+DeviceProver MRU behind it key on byte-object IDENTITY
+(``zk/api._load_pk`` docstring), so holding the objects here is what
+turns "a proof job" into "a warm prove" (no re-parse, no device
+re-init, suspend/resume between the k=20 inner and k=21 outer
+provers). A byte-equal re-read from disk would silently re-pay
+everything.
+"""
+
+from __future__ import annotations
+
+import threading
+
+from ..utils.errors import EigenError
+
+
+class ArtifactCache:
+    """Path → bytes, loaded once, identity-stable across jobs."""
+
+    def __init__(self):
+        self._cache: dict = {}
+        self._lock = threading.Lock()
+
+    def read(self, path) -> bytes:
+        key = str(path)
+        with self._lock:
+            data = self._cache.get(key)
+            if data is None:
+                try:
+                    data = path.read_bytes()
+                except OSError as e:
+                    raise EigenError(
+                        "file_io_error",
+                        f"missing proving artifact {path} — generate it "
+                        "with the kzg-params / et-proving-key / "
+                        "th-proving-key verbs first") from e
+                self._cache[key] = data
+            return data
+
+
+def make_provers(service, files, shape_name: str = "default",
+                 transcript: str = "keccak") -> dict:
+    """The default registry for :class:`jobs.ProofJobQueue`.
+
+    ``service`` supplies the live attestation set and the Client (domain
+    + circuit hyperparameters); ``files`` is the ``cli.fs.EigenFile``
+    assets layout the batch verbs already populate."""
+    from ..cli.main import ET_PARAMS_K, TH_PARAMS_K
+    from ..zk import api as zk
+
+    if shape_name == "tiny":
+        shape, params_k = zk.TINY_SHAPE, 20
+    else:
+        shape, params_k = zk.DEFAULT_SHAPE, ET_PARAMS_K
+    cache = ArtifactCache()
+
+    def eigentrust(params: dict) -> dict:
+        atts = service.attestation_snapshot()
+        setup = service.client.et_circuit_setup(atts)
+        tr = params.get("transcript", transcript)
+        proof = zk.generate_et_proof(
+            cache.read(files.kzg_params(params_k)),
+            cache.read(files.et_proving_key()),
+            setup, shape=shape, transcript=tr)
+        return {
+            "proof": proof.hex(),
+            "public_inputs": setup.pub_inputs.to_bytes().hex(),
+            "transcript": tr,
+            "participants": len(setup.address_set),
+        }
+
+    def threshold(params: dict) -> dict:
+        try:
+            peer = bytes.fromhex(
+                str(params["peer"]).removeprefix("0x"))
+            threshold_v = int(params["threshold"])
+        except (KeyError, ValueError) as e:
+            raise EigenError(
+                "validation_error",
+                "threshold jobs need {'peer': '0x…20 bytes', "
+                "'threshold': int}") from e
+        if len(peer) != 20:
+            raise EigenError("validation_error", "peer must be 20 bytes")
+        atts = service.attestation_snapshot()
+        setup = service.client.th_circuit_setup(atts, peer, threshold_v)
+        proof = zk.generate_th_proof(
+            cache.read(files.kzg_params(TH_PARAMS_K)),
+            cache.read(files.th_proving_key()),
+            setup)
+        return {
+            "proof": proof.hex(),
+            "public_inputs": setup.pub_inputs.to_bytes().hex(),
+            "threshold_check": bool(setup.pub_inputs.threshold_check),
+        }
+
+    return {"eigentrust": eigentrust, "threshold": threshold}

@@ -1,0 +1,175 @@
+"""Incremental score refresh: warm-started power iteration.
+
+The insight this loop productizes (PAPERS.md — "Analysis of Power
+Iteration with Partially Observed Matrix-vector Products", arXiv
+2606.11956): when only a small slice of the opinion matrix changed, the
+previous fixed point is within O(‖ΔC‖) of the new one, so restarting
+the adaptive converge from it reaches tolerance in a handful of
+iterations instead of the full cold O(log(1/tol)/spectral-gap) sweep.
+The refresher therefore:
+
+1. snapshots the opinion graph (one lock hold),
+2. builds the warm-start vector from the last published scores
+   (``ops.converge.warm_start_scores`` — append-only ids make the
+   projection a pad + mass rescale),
+3. runs the ConvergeBackend adaptive converge (the same seam the batch
+   verbs use — device faults injectable via ``faults.py``),
+4. publishes an immutable :class:`ScoreTable` the HTTP layer serves
+   lock-free (attribute swap).
+
+Past a staleness bound — too many edits since the last cold converge,
+or every ``cold_every`` refreshes as a drift backstop — the warm start
+is skipped and the iteration runs cold from uniform, re-anchoring the
+vector. Warm and cold converge to the same fixed point on ergodic
+graphs; the periodic cold resync bounds the error for adversarially
+disconnected ones.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass
+
+import numpy as np
+
+from ..utils import trace
+from .config import ServiceConfig
+from .faults import FaultInjector
+from .state import OpinionGraph
+
+
+@dataclass(frozen=True)
+class ScoreTable:
+    """One published refresh result (immutable; swapped atomically)."""
+
+    addresses: tuple      # id -> 20-byte address
+    scores: np.ndarray    # float scores, id order
+    revision: int         # graph revision this table reflects
+    iterations: int
+    delta: float
+    cold: bool
+    computed_at: float
+
+    def __post_init__(self):
+        # O(1) address lookups for /score/<addr>: built once per
+        # publish, not a linear scan per HTTP request (frozen dataclass
+        # → assign through object.__setattr__)
+        object.__setattr__(
+            self, "_index",
+            {a: i for i, a in enumerate(self.addresses)})
+
+    def score_of(self, addr: bytes):
+        i = self._index.get(addr)
+        return None if i is None else float(self.scores[i])
+
+
+_EMPTY = ScoreTable(addresses=(), scores=np.zeros(0), revision=-1,
+                    iterations=0, delta=0.0, cold=True, computed_at=0.0)
+
+
+class ScoreRefresher:
+    """Owns the backend + the published table; one refresh at a time."""
+
+    def __init__(self, graph: OpinionGraph, config: ServiceConfig,
+                 backend=None, faults: FaultInjector | None = None):
+        self.graph = graph
+        self.config = config
+        self.faults = faults or FaultInjector({"rpc": 0.0, "device": 0.0})
+        if backend is None:
+            from ..backend import JaxSparseBackend
+
+            backend = JaxSparseBackend()
+        self.backend = backend
+        self.table: ScoreTable = _EMPTY
+        self.refreshes = 0
+        self.cold_refreshes = 0
+        self.warm_iterations = 0  # cumulative, warm refreshes only
+
+    def stale(self) -> bool:
+        return self.graph.revision != self.table.revision
+
+    def _want_cold(self, n_edges: int, edits: int) -> bool:
+        if self.table.revision < 0:
+            return True  # nothing to warm-start from
+        if self.config.cold_every and (
+                self.refreshes % self.config.cold_every == 0):
+            return True
+        return edits > self.config.cold_edit_fraction * max(n_edges, 1)
+
+    def refresh(self, force_cold: bool = False) -> ScoreTable:
+        """Converge the current graph and publish; returns the table
+        (unchanged table if the graph is empty/unchanged). Raises
+        EigenError on (injected) device faults — the caller loop owns
+        retry; the previously published table stays live throughout."""
+        n, src, dst, val, revision, edits = self.graph.snapshot()
+        if revision == self.table.revision:
+            return self.table
+        addresses = self.graph.addresses()[:n]
+        if n < 2 or not len(src):
+            # no scorable graph yet: publish the empty/zero table so
+            # /scores reflects "seen but unscored" peers honestly
+            self.table = ScoreTable(addresses, np.zeros(n), revision,
+                                    0, 0.0, True, time.time())
+            return self.table
+
+        cold = force_cold or self._want_cold(len(src), edits)
+        valid = np.ones(n, dtype=bool)
+        s0 = None
+        if not cold:
+            from ..ops.converge import warm_start_scores
+
+            s0 = warm_start_scores(self.table.scores, n, valid,
+                                   self.config.initial_score)
+        self.faults.check("device")
+        with trace.span("service.refresh", n=n, edges=len(src),
+                        cold=cold):
+            scores, iters, delta = self.backend.converge_edges(
+                n, src, dst, val, valid, self.config.initial_score,
+                self.config.max_iterations, tol=self.config.tol,
+                alpha=self.config.alpha, s0=s0)
+        if not cold and (delta > self.config.tol
+                         or not np.isfinite(scores).all()):
+            # warm start failed to converge inside the budget (graph
+            # drifted further than the bound assumed): re-anchor cold
+            with trace.span("service.refresh", n=n, edges=len(src),
+                            cold=True, fallback=True):
+                scores, iters, delta = self.backend.converge_edges(
+                    n, src, dst, val, valid, self.config.initial_score,
+                    self.config.max_iterations, tol=self.config.tol,
+                    alpha=self.config.alpha)
+            cold = True
+
+        self.refreshes += 1
+        if cold:
+            self.cold_refreshes += 1
+            self.graph.mark_cold()
+        else:
+            self.warm_iterations += int(iters)
+        self.table = ScoreTable(addresses, np.asarray(scores)[:n],
+                                revision, int(iters), float(delta), cold,
+                                time.time())
+        trace.metric("service.refresh_total", self.refreshes)
+        trace.metric("service.refresh_cold_total", self.cold_refreshes)
+        trace.metric("service.refresh_iterations", int(iters))
+        trace.metric("service.refresh_delta", float(delta))
+        return self.table
+
+    def run(self, stop_event, dirty_event, refresh_interval: float) -> None:
+        """Refresher loop: wake on new data (or the interval), refresh,
+        repeat. Failures (injected device faults included) back off one
+        interval and retry — the published table is never torn down on
+        failure."""
+        while not stop_event.is_set():
+            dirty_event.wait(refresh_interval)
+            if stop_event.is_set():
+                return
+            dirty_event.clear()
+            if not self.stale():
+                continue
+            try:
+                self.refresh()
+            except Exception:  # noqa: BLE001 - daemon thread: serve the
+                # last good table and retry rather than dying
+                trace.event("service.refresh_failed")
+                stop_event.wait(refresh_interval)
+                dirty_event.set()  # data is still pending — retry

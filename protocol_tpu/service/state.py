@@ -1,0 +1,148 @@
+"""In-memory opinion graph + signer recovery for the service.
+
+The batch path rebuilds its opinion structures from the full CSV every
+invocation; the daemon instead maintains one mutable graph:
+
+- **interning**: addresses get APPEND-ONLY integer ids — an id is never
+  reassigned, so a previous score vector indexes the first ``len(prev)``
+  slots of any later snapshot (the invariant
+  ``ops.converge.warm_start_scores`` builds on);
+- **latest-wins edges**: the AttestationStation stores one value per
+  (creator, about, key) — re-attesting overwrites (chain.py store
+  semantics) — so the graph keeps a dict edge map where newer
+  attestations replace older ones, value 0 meaning "retracted" (the
+  filter drops non-positive weights, exactly the contract semantics);
+- **edit accounting**: edits since the last cold converge feed the
+  refresh staleness bound.
+
+Signer recovery routes through the batched TPU ingest pipeline
+(``client.ingest.recover_signers_batch``) when an accelerator is live —
+the same auto rule as ``Client.et_circuit_setup`` — and the scalar
+per-attestation path otherwise. Invalid signatures are counted and
+skipped, never fatal: one forged attestation must not stall the tail.
+"""
+
+from __future__ import annotations
+
+import threading
+
+import numpy as np
+
+from ..client.client import _device_present
+from ..client.eth import address_from_public_key
+from ..utils import trace
+from ..utils.errors import EigenError
+
+
+def recover_signers(attestations, batched: bool | None = None):
+    """[(signer_address | None)] per attestation; None = invalid.
+
+    ``batched=None`` auto-selects the device pipeline the way the
+    Client does (≥32 lanes and an accelerator present)."""
+    if batched is None:
+        batched = len(attestations) >= 32 and _device_present()
+    if batched and attestations:
+        from ..client.ingest import recover_signers_batch
+
+        _, addresses, valid = recover_signers_batch(attestations)
+        return [a if v else None for a, v in zip(addresses, valid)]
+    out = []
+    with trace.span("service.recover_scalar", n=len(attestations)):
+        for signed in attestations:
+            try:
+                out.append(address_from_public_key(
+                    signed.recover_public_key()))
+            except (EigenError, ValueError):
+                out.append(None)
+    return out
+
+
+class OpinionGraph:
+    """Mutable trust graph; snapshots are cheap numpy edge arrays."""
+
+    def __init__(self):
+        self._lock = threading.RLock()
+        self._ids: dict = {}       # address bytes -> id
+        self._addrs: list = []     # id -> address bytes
+        self._edges: dict = {}     # (src_id, dst_id) -> value
+        self.revision = 0          # bumps on every effective change
+        self.edits_since_cold = 0
+        self.invalid = 0           # rejected attestations (bad sig/self)
+
+    def _intern(self, addr: bytes) -> int:
+        i = self._ids.get(addr)
+        if i is None:
+            i = len(self._addrs)
+            self._ids[addr] = i
+            self._addrs.append(addr)
+        return i
+
+    # --- ingest -----------------------------------------------------------
+    def apply(self, attestations, signer_addrs) -> int:
+        """Fold a decoded batch in; returns the number of effective edge
+        changes. Self-attestations and invalid signers are counted in
+        ``invalid`` and dropped (the filter would null them anyway —
+        rejecting here keeps them out of the peer set too)."""
+        changed = 0
+        with self._lock:
+            for signed, signer in zip(attestations, signer_addrs):
+                about = signed.attestation.about
+                if signer is None or signer == about:
+                    self.invalid += 1
+                    continue
+                i = self._intern(signer)
+                j = self._intern(about)
+                value = float(signed.attestation.value)
+                if self._edges.get((i, j)) != value:
+                    self._edges[(i, j)] = value
+                    changed += 1
+            if changed:
+                self.revision += 1
+                self.edits_since_cold += changed
+            trace.metric("service.peers", len(self._addrs))
+            trace.metric("service.edges", len(self._edges))
+            trace.metric("service.revision", self.revision)
+            trace.metric("service.invalid_attestations", self.invalid)
+        return changed
+
+    def mark_cold(self) -> None:
+        with self._lock:
+            self.edits_since_cold = 0
+
+    # --- snapshots --------------------------------------------------------
+    @property
+    def n(self) -> int:
+        with self._lock:
+            return len(self._addrs)
+
+    @property
+    def n_edges(self) -> int:
+        with self._lock:
+            return len(self._edges)
+
+    def address_of(self, peer_id: int) -> bytes:
+        with self._lock:
+            return self._addrs[peer_id]
+
+    def id_of(self, addr: bytes):
+        with self._lock:
+            return self._ids.get(addr)
+
+    def addresses(self) -> tuple:
+        with self._lock:
+            return tuple(self._addrs)
+
+    def snapshot(self):
+        """(n, src, dst, val, revision, edits_since_cold) under one lock
+        hold — a consistent cut for the refresher. Zero-valued edges are
+        included; ``graph.filter_edges`` drops them (contract
+        semantics: value 0 = retracted)."""
+        with self._lock:
+            n = len(self._addrs)
+            m = len(self._edges)
+            src = np.empty(m, dtype=np.int64)
+            dst = np.empty(m, dtype=np.int64)
+            val = np.empty(m, dtype=np.float64)
+            for e, ((i, j), v) in enumerate(self._edges.items()):
+                src[e], dst[e], val[e] = i, j, v
+            return n, src, dst, val, self.revision, self.edits_since_cold

@@ -35,6 +35,10 @@ class EigenError(Exception):
             # framework-specific: circuit construction/satisfiability
             # (the reference surfaces these as halo2 VerifyFailure values)
             "circuit_error",
+            # service layer (protocol_tpu.service): queue backpressure /
+            # drain rejection, and the chaos seam's synthetic failures
+            "service_busy",
+            "injected_fault",
         }
     )
 

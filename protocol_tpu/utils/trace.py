@@ -28,6 +28,11 @@ import time
 from dataclasses import dataclass, field
 
 
+# per-name metric history bound (samples kept for dump_jsonl); the
+# latest value is never dropped — see Tracer.metric
+METRIC_HISTORY_CAP = 4096
+
+
 @dataclass
 class SpanRecord:
     name: str
@@ -48,6 +53,10 @@ class Tracer:
         self.spans: list = []
         self.events: list = []
         self.metrics: dict = {}
+        # exact running aggregates per span name: summary() stays
+        # correct even after the bounded spans list drops old records
+        # (a daemon emits spans indefinitely)
+        self._span_agg: dict = {}
 
     # --- lifecycle --------------------------------------------------------
     def enable(self, stream_path: str | None = None) -> None:
@@ -66,6 +75,7 @@ class Tracer:
             self.spans.clear()
             self.events.clear()
             self.metrics.clear()
+            self._span_agg.clear()
 
     # --- recording --------------------------------------------------------
     def _depth(self) -> int:
@@ -87,6 +97,13 @@ class Tracer:
             rec = SpanRecord(name, t0, dt, depth, fields)
             with self._lock:
                 self.spans.append(rec)
+                if len(self.spans) > METRIC_HISTORY_CAP:
+                    del self.spans[: len(self.spans) - METRIC_HISTORY_CAP]
+                agg = self._span_agg.setdefault(
+                    name, {"count": 0, "total_s": 0.0, "max_s": 0.0})
+                agg["count"] += 1
+                agg["total_s"] += dt
+                agg["max_s"] = max(agg["max_s"], dt)
             self._emit({"type": "span", "name": name, "duration_s": dt,
                         "depth": depth, **fields})
 
@@ -95,15 +112,29 @@ class Tracer:
             return
         with self._lock:
             self.events.append((time.time(), name, fields))
+            if len(self.events) > METRIC_HISTORY_CAP:
+                del self.events[: len(self.events) - METRIC_HISTORY_CAP]
         self._emit({"type": "event", "name": name, **fields})
 
     def metric(self, name: str, value) -> None:
-        """Record a gauge/counter sample (last-write-wins + history)."""
+        """Record a gauge/counter sample (last-write-wins + history).
+        History is bounded per name: a long-running daemon samples
+        counters continuously and an unbounded list is a slow leak —
+        the latest value (what /metrics serves) is always kept."""
         if not self.enabled:
             return
         with self._lock:
-            self.metrics.setdefault(name, []).append(float(value))
+            hist = self.metrics.setdefault(name, [])
+            hist.append(float(value))
+            if len(hist) > METRIC_HISTORY_CAP:
+                del hist[: len(hist) - METRIC_HISTORY_CAP]
         self._emit({"type": "metric", "name": name, "value": float(value)})
+
+    def metrics_latest(self) -> dict:
+        """{name: most recent sample} — the gauge view Prometheus-style
+        exporters (``service.metrics``) render."""
+        with self._lock:
+            return {k: v[-1] for k, v in self.metrics.items() if v}
 
     def _emit(self, obj: dict) -> None:
         if self._stream is not None:
@@ -111,16 +142,12 @@ class Tracer:
 
     # --- reporting --------------------------------------------------------
     def summary(self) -> dict:
-        """Aggregate span stats: {name: {count, total_s, max_s}}."""
-        out: dict = {}
+        """Aggregate span stats: {name: {count, total_s, max_s}} — from
+        the exact running aggregates (immune to the bounded spans list
+        trimming old records)."""
         with self._lock:
-            for rec in self.spans:
-                agg = out.setdefault(
-                    rec.name, {"count": 0, "total_s": 0.0, "max_s": 0.0})
-                agg["count"] += 1
-                agg["total_s"] += rec.duration
-                agg["max_s"] = max(agg["max_s"], rec.duration)
-        return out
+            return {name: dict(agg)
+                    for name, agg in self._span_agg.items()}
 
     def dump_jsonl(self, path: str) -> None:
         with open(path, "w") as f:

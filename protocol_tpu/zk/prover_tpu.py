@@ -47,6 +47,26 @@ from ..utils.fields import BN254_FR_MODULUS as P
 L, L6 = f2.L, f2.L6
 EXT_COSETS = 4  # the z-split quotient runs on a 4n coset (was 8n)
 
+_FUSED_INTT_WARNED = False
+
+
+def _warn_fused_intt_ignored() -> None:
+    """PTPU_FUSED_INTT only applies to the streaming/partial-residency
+    4n inverse; a full-residency prover takes the incremental path
+    regardless. Say so ONCE per process instead of silently ignoring a
+    measurement flag (ADVICE r5)."""
+    global _FUSED_INTT_WARNED
+    if _FUSED_INTT_WARNED:
+        return
+    _FUSED_INTT_WARNED = True
+    import warnings
+
+    warnings.warn(
+        "PTPU_FUSED_INTT=1 is ignored on a full-residency DeviceProver "
+        "(ext_resident=True): the fused 4n inverse is streaming-only. "
+        "Set PTPU_EXT_RESIDENT=0/fixed to measure it.",
+        stacklevel=3)
+
 
 def _mont(v: int) -> int:
     return int(v) % P * f2.R_MONT % P
@@ -626,6 +646,14 @@ class DeviceProver:
         self.ext_resident = ext_resident is True
         self.fixed_ext_resident = (ext_resident is True
                                    or ext_resident == "fixed")
+        # One prove = one quotient storage mode: latch the fused-quotient
+        # switch here (like ext_resident above) so toggling
+        # PTPU_FUSED_QUOTIENT mid-prove cannot yield a t_chunks list
+        # mixing packed (uint16) and unpacked chunks (ADVICE r5).
+        self.fused_quotient = (
+            os.environ.get("PTPU_FUSED_QUOTIENT", "1") != "0")
+        if self.ext_resident and os.environ.get("PTPU_FUSED_INTT") == "1":
+            _warn_fused_intt_ignored()
         # pre-compile the upload/download programs at the working shape
         # BEFORE the heavy jit battery: the remote worker has repeatedly
         # faulted when the download program compiles after dozens of
@@ -821,14 +849,16 @@ class DeviceProver:
         ``uv_e`` = [u1, u2, v1, v2] ext chunks; ``ch_planes`` from
         :meth:`challenge_planes`. Dispatches to the streaming variant
         when the pk ext chunks are not resident — fused into one
-        program per chunk unless PTPU_FUSED_QUOTIENT=0 (the fallback
-        keeps the ~31-dispatch chain whose lower in-program working
-        set is the escape hatch if a runtime ever OOMs the fused
-        one). The fused kernel returns a PACKED uint16 chunk (packing
-        happens in-program); the other two paths return unpacked
-        planes — consumers dispatch on dtype."""
+        program per chunk unless PTPU_FUSED_QUOTIENT=0, LATCHED once in
+        ``__init__`` (like ext_resident) so one prove's t_chunks are
+        all one storage form (the fallback keeps the ~31-dispatch
+        chain whose lower in-program working set is the escape hatch
+        if a runtime ever OOMs the fused one). The fused kernel
+        returns a PACKED uint16 chunk (packing happens in-program);
+        the other two paths return unpacked planes — consumers
+        dispatch on dtype."""
         if not self.ext_resident:
-            if os.environ.get("PTPU_FUSED_QUOTIENT", "1") != "0":
+            if self.fused_quotient:
                 fixed_in = (tuple(self.fixed_ext[i][j] for i in range(9))
                             if self.fixed_ext else tuple(self.fixed_coeffs))
                 return _quotient_chunk_fused_impl(
@@ -939,11 +969,15 @@ class DeviceProver:
         CONSUMES ``t_chunks`` (entries are dropped as their iNTT
         completes) and emits output chunks one at a time — the HBM peak
         here decides whether k=20 fits the chip. The fused
-        single-program variant is OPT-IN (PTPU_FUSED_INTT=1): at k=21
-        under partial residency it measured RESOURCE_EXHAUSTED — XLA
-        keeps all four hats plus inputs live inside one program, and
-        unlike the quotient fusion (~124 dispatches saved) this one
-        only buys ~16, not worth defaulting against the HBM line."""
+        single-program variant is OPT-IN (PTPU_FUSED_INTT=1) and
+        STREAMING-ONLY: a full-residency prover (ext_resident=True)
+        ignores the flag — and warns once at init — because its t
+        chunks arrive unpacked and stay resident through round 4. At
+        k=21 under partial residency the fused program measured
+        RESOURCE_EXHAUSTED — XLA keeps all four hats plus inputs live
+        inside one program, and unlike the quotient fusion (~124
+        dispatches saved) this one only buys ~16, not worth defaulting
+        against the HBM line."""
         if (not self.ext_resident
                 and os.environ.get("PTPU_FUSED_INTT") == "1"):
             outs = _intt_ext_fused_impl(

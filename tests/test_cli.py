@@ -31,6 +31,7 @@ def test_parser_accepts_all_verbs():
         ("kzg-params", ["--k", "10"]),
         ("local-scores", []),
         ("scores", ["--backend", "jax"]),
+        ("serve", ["--port", "0", "--poll-interval", "0.5"]),
         ("show", []),
         ("th-proof", ["--peer", "0xaa", "--threshold", "500"]),
         ("th-proving-key", []),

@@ -116,9 +116,40 @@ class TestShardedIngest:
         recs = [s.rec_id for s in sigs]
         ss[3] = 0  # binding-check reject must survive the sharding
         xs0, ys0, v0 = recover_batch(rs, ss, recs, msgs)
-        xs1, ys1, v1 = sharded_recover_batch(rs, ss, recs, msgs, mesh)
+        # shard_glv=True forces the FULL sharded ladder even on the CPU
+        # mesh (the default trims it there for compile budget): this is
+        # the committed coverage of the sharded GLV stage
+        xs1, ys1, v1 = sharded_recover_batch(rs, ss, recs, msgs, mesh,
+                                             shard_glv=True)
         assert (v0 == v1).all() and not v1[3] and v1.sum() == k - 1
         assert xs0 == xs1 and ys0 == ys1
+
+    def test_dryrun_ingest_stage_within_cpu_budget(self):
+        """Timing guard for the driver's multichip ingest stage: the
+        r5 regression was minutes-long GLV-ladder XLA:CPU compiles
+        timing out the whole dryrun (MULTICHIP_r05.json rc=124). The
+        stage's CPU form (prep-stage parity, no ladder) must stay
+        inside a small fraction of the driver budget — if this starts
+        failing, a minutes-long compile crept back into the dryrun."""
+        import time
+
+        import jax
+
+        if jax.device_count() < 8:
+            pytest.skip("needs the 8-device virtual mesh (conftest)")
+        import __graft_entry__ as graft
+        from protocol_tpu.parallel import make_mesh
+
+        mesh = make_mesh(8)
+        t0 = time.monotonic()
+        graft._dryrun_sharded_ingest(8, mesh)
+        wall = time.monotonic() - t0
+        budget = float(
+            __import__("os").environ.get("PTPU_DRYRUN_INGEST_BUDGET_S",
+                                         "600"))
+        assert wall < budget, (
+            f"dryrun ingest stage took {wall:.0f}s (> {budget:.0f}s): "
+            "a minutes-long XLA:CPU compile is back on the dryrun path")
 
     def test_indivisible_lane_count_rejected(self):
         import jax

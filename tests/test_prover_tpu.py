@@ -182,7 +182,7 @@ def test_prove_fast_tpu_bytes_equal_host():
     assert verify(params, pk, cs.public_values(), proof_tpu)
 
 
-def test_streaming_quotient_matches_resident(dp, monkeypatch):
+def test_streaming_quotient_matches_resident(dp):
     """The k≥21 streaming quotient (pk ext chunks generated on the fly)
     must be BIT-identical to the resident-table path — in BOTH its
     fused (one program per chunk, PTPU_FUSED_QUOTIENT default) and
@@ -218,7 +218,11 @@ def test_streaming_quotient_matches_resident(dp, monkeypatch):
                                       uve_r, ch_r)
         res = ptpu.download_std(t_res)
         for fused in ("1", "0"):
-            monkeypatch.setenv("PTPU_FUSED_QUOTIENT", fused)
+            # the env var is LATCHED per DeviceProver at __init__ (one
+            # prove = one t-chunk storage form); flip the latch itself
+            # to exercise both modes on the same provers
+            dp_stream.fused_quotient = fused == "1"
+            dp_fixed.fused_quotient = fused == "1"
             t_str = dp_stream.quotient_chunk(j, we_r, ze_r, me_r, pe_r,
                                              pie_r, uve_r, ch_s)
             # partial ("fixed") residency: resident packed fixed
@@ -226,8 +230,33 @@ def test_streaming_quotient_matches_resident(dp, monkeypatch):
             t_fix = dp_fixed.quotient_chunk(j, we_r, ze_r, me_r, pe_r,
                                             pie_r, uve_r, ch_f)
             assert (t_str.dtype == np.uint16) == (fused == "1")
+            assert (t_fix.dtype == np.uint16) == (fused == "1")
             assert np.array_equal(res, ptpu.download_std(t_str))
             assert np.array_equal(res, ptpu.download_std(t_fix))
+
+
+def test_fused_quotient_latched_and_fused_intt_warns(dp, monkeypatch):
+    """PTPU_FUSED_QUOTIENT is read ONCE at __init__ — a mid-prove env
+    flip must not change the latched mode (one prove, one t-chunk
+    storage form). PTPU_FUSED_INTT=1 on a full-residency prover is
+    streaming-only and must warn once instead of silently ignoring the
+    measurement flag (ADVICE r5)."""
+    dp_obj, fixed_u64, sigma_u64 = dp
+    monkeypatch.setenv("PTPU_FUSED_QUOTIENT", "0")
+    dp2 = ptpu.DeviceProver(K, SHIFT, fixed_u64, sigma_u64,
+                            ext_resident=False)
+    assert dp2.fused_quotient is False
+    monkeypatch.setenv("PTPU_FUSED_QUOTIENT", "1")
+    assert dp2.fused_quotient is False  # latched, not re-read per chunk
+    assert dp_obj.fused_quotient is True  # fixture built under the default
+
+    monkeypatch.setenv("PTPU_FUSED_INTT", "1")
+    monkeypatch.setattr(ptpu, "_FUSED_INTT_WARNED", False)
+    with pytest.warns(UserWarning, match="streaming-only"):
+        dp3 = ptpu.DeviceProver(K, SHIFT, fixed_u64, sigma_u64,
+                                ext_resident=True)
+    assert ptpu._FUSED_INTT_WARNED
+    del dp2, dp3
 
 
 def test_prove_streaming_mode_bytes_equal_host(monkeypatch):

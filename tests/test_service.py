@@ -1,0 +1,389 @@
+"""End-to-end tests for the long-running trust-scores service
+(``protocol_tpu.service``) against the in-repo mock devnet: tail →
+ingest → incremental refresh → HTTP serving → proof jobs → fault
+injection → graceful drain — the serving twin of the batch flow
+``tests/test_mocknode.py`` locks down."""
+
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+
+from protocol_tpu.client import Client, ClientConfig  # noqa: E402
+from protocol_tpu.client.chain import RpcChain  # noqa: E402
+from protocol_tpu.client.eth import (  # noqa: E402
+    address_from_public_key,
+    ecdsa_keypairs_from_mnemonic,
+)
+from protocol_tpu.client.mocknode import MockNode  # noqa: E402
+from protocol_tpu.service import (  # noqa: E402
+    FaultInjector,
+    ProofJobQueue,
+    QueueFullError,
+    ServiceConfig,
+    TrustService,
+)
+from protocol_tpu.utils.errors import EigenError  # noqa: E402
+
+MNEMONIC = "test test test test test test test test test test test junk"
+
+
+def _get(url, expect=200):
+    try:
+        with urllib.request.urlopen(url, timeout=10) as resp:
+            return resp.status, json.loads(resp.read())
+    except urllib.error.HTTPError as e:
+        assert e.code == expect, (e.code, e.read())
+        return e.code, json.loads(e.read())
+
+
+def _get_text(url):
+    with urllib.request.urlopen(url, timeout=10) as resp:
+        return resp.read().decode()
+
+
+def _post(url, obj, expect=(202,)):
+    req = urllib.request.Request(
+        url, data=json.dumps(obj).encode(),
+        headers={"Content-Type": "application/json"})
+    try:
+        with urllib.request.urlopen(req, timeout=10) as resp:
+            assert resp.status in expect
+            return resp.status, json.loads(resp.read())
+    except urllib.error.HTTPError as e:
+        assert e.code in expect, (e.code, e.read())
+        return e.code, json.loads(e.read())
+
+
+def _wait(pred, timeout=30.0, interval=0.05, what="condition"):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return
+        time.sleep(interval)
+    raise AssertionError(f"timed out waiting for {what}")
+
+
+@pytest.fixture()
+def devnet():
+    node = MockNode()
+    url = node.start()
+    yield node, url
+    node.stop()
+
+
+def _make_service(tmp_path, node_url, **svc_overrides):
+    deployer = ecdsa_keypairs_from_mnemonic(MNEMONIC, 1)[0]
+    chain = RpcChain.deploy_signed(node_url, deployer)
+    config = ClientConfig(
+        as_address="0x" + chain.contract_address.hex(),
+        node_url=node_url, domain="0x" + "00" * 20)
+    client = Client(config, MNEMONIC)
+    overrides = dict(
+        port=0, poll_interval=0.05, refresh_interval=0.05,
+        tol=1e-10, backoff_base=0.05, backoff_max=0.2,
+        drain_timeout=10.0)
+    overrides.update(svc_overrides)
+    svc = TrustService(
+        client, ServiceConfig(**overrides), str(tmp_path / "cursor"),
+        provers={"echo": lambda params: {"echo": params}},
+        faults=FaultInjector({"rpc": 0.0, "device": 0.0}, seed=7))
+    return svc, client
+
+
+def _attest_round(client, kps, addrs, values):
+    """Every peer attests every other with ``values[(i, j)]``."""
+    for i, kp in enumerate(kps):
+        client.keypairs[0] = kp
+        for j in range(len(kps)):
+            if i != j:
+                client.attest(addrs[j], values[(i, j)])
+
+
+def _oracle(client, base_kp):
+    """The batch local-scores oracle over the SAME chain contents."""
+    client.keypairs[0] = base_kp
+    atts = client.get_attestations()
+    scores = client.calculate_scores(atts)
+    return {s.address: float(s.ratio) for s in scores}
+
+
+def test_service_end_to_end(tmp_path, devnet):
+    """The acceptance flow: start → stream 2 attestation batches →
+    HTTP scores match the batch oracle after each → a proof job
+    completes → injected RPC faults retry without dropping the cursor →
+    /metrics exposes ingest/refresh/proof counters → drain is clean."""
+    _, node_url = devnet
+    svc, client = _make_service(tmp_path, node_url)
+    url = svc.start()
+    try:
+        kps = ecdsa_keypairs_from_mnemonic(MNEMONIC, 3)
+        addrs = [address_from_public_key(kp.public_key) for kp in kps]
+
+        # --- batch 1 ------------------------------------------------------
+        _attest_round(client, kps, addrs,
+                      {(i, j): 4 + (i + 2 * j) % 5
+                       for i in range(3) for j in range(3) if i != j})
+        expected = _oracle(client, kps[0])
+        _wait(lambda: svc.refresher.table.revision == svc.graph.revision
+              and svc.graph.n == 3,
+              what="batch 1 scored")
+        _, scores1 = _get(f"{url}/scores")
+        got = {bytes.fromhex(r["address"].removeprefix("0x")): r["score"]
+               for r in scores1["scores"]}
+        assert set(got) == set(expected)
+        for addr, ref in expected.items():
+            assert got[addr] == pytest.approx(ref, rel=1e-3), \
+                f"peer 0x{addr.hex()} diverged from the batch oracle"
+
+        # --- injected RPC faults: retries, cursor intact ------------------
+        cursor_before = svc.tailer.cursor
+        retries_before = svc.tailer.retries
+        svc.faults.rates["rpc"] = 1.0
+        _wait(lambda: svc.tailer.retries >= retries_before + 2,
+              what="injected RPC faults to be retried")
+        assert svc.tailer.cursor == cursor_before, \
+            "a failed poll moved the block cursor"
+        assert svc.faults.injected["rpc"] >= 2
+        svc.faults.rates["rpc"] = 0.0
+
+        # --- batch 2: re-attestations + a new peer (warm refresh) ---------
+        kps4 = ecdsa_keypairs_from_mnemonic(MNEMONIC, 4)
+        addrs4 = [address_from_public_key(kp.public_key) for kp in kps4]
+        _attest_round(client, kps4, addrs4,
+                      {(i, j): 1 + (3 * i + j) % 7
+                       for i in range(4) for j in range(4) if i != j})
+        expected2 = _oracle(client, kps4[0])
+        _wait(lambda: svc.graph.n == 4
+              and svc.refresher.table.revision == svc.graph.revision,
+              what="batch 2 scored")
+        for addr, ref in expected2.items():
+            code, one = _get(f"{url}/score/0x{addr.hex()}")
+            assert code == 200
+            assert one["score"] == pytest.approx(ref, rel=1e-3)
+        assert svc.refresher.refreshes >= 2
+        assert svc.tailer.cursor > cursor_before
+
+        # --- batch 3: ONE changed attestation → warm incremental refresh
+        # (reset the edit counter so the staleness bound deterministically
+        # classifies the single edit as warm-startable regardless of how
+        # the poll loop happened to slice batch 2)
+        svc.graph.mark_cold()
+        client.keypairs[0] = kps4[0]
+        client.attest(addrs4[1], 255)
+        expected3 = _oracle(client, kps4[0])
+        assert expected3 != expected2  # the edit moves the fixed point
+        _wait(lambda: svc.refresher.table.revision == svc.graph.revision
+              and _get(f"{url}/score/0x{addrs4[1].hex()}")[1]["score"]
+              == pytest.approx(expected3[addrs4[1]], rel=1e-3),
+              what="batch 3 scored")
+        for addr, ref in expected3.items():
+            assert _get(f"{url}/score/0x{addr.hex()}")[1]["score"] \
+                == pytest.approx(ref, rel=1e-3)
+        assert svc.refresher.cold_refreshes < svc.refresher.refreshes, \
+            "no refresh ever warm-started"
+
+        # unknown peer → 404; bad address → 400
+        code, _ = _get(f"{url}/score/0x" + "ee" * 20, expect=404)
+        assert code == 404
+        code, _ = _get(f"{url}/score/zzz", expect=400)
+        assert code == 400
+
+        # --- proof job over HTTP ------------------------------------------
+        code, job = _post(f"{url}/proofs",
+                          {"kind": "echo", "params": {"tag": 42}})
+        assert code == 202
+        _wait(lambda: _get(f"{url}/proofs/{job['job_id']}")[1]["status"]
+              == "done", what="proof job completion")
+        _, done = _get(f"{url}/proofs/{job['job_id']}")
+        assert done["result"] == {"echo": {"tag": 42}}
+        code, _ = _post(f"{url}/proofs", {"kind": "nope"}, expect=(400,))
+        assert code == 400
+        code, _ = _get(f"{url}/proofs/job-999", expect=404)
+        assert code == 404
+
+        # --- health + metrics ---------------------------------------------
+        _, health = _get(f"{url}/healthz")
+        assert health["ok"] and not health["draining"]
+        assert health["peers"] == 4 and health["block_cursor"] > 0
+        metrics = _get_text(f"{url}/metrics")
+        for needle in ("ptpu_service_ingest_attestations",
+                       "ptpu_service_refresh_total",
+                       "ptpu_service_proof_completed",
+                       "ptpu_service_block_cursor",
+                       "ptpu_span_seconds_total"):
+            assert needle in metrics, f"/metrics missing {needle}"
+    finally:
+        assert svc.shutdown() is True, "drain was not clean"
+    # post-drain: POSTs are refused (the server is down entirely)
+    with pytest.raises(urllib.error.URLError):
+        _get(f"{url}/healthz")
+
+
+def test_cursor_survives_restart(tmp_path, devnet):
+    """A restarted service resumes from the persisted cursor: already-
+    delivered blocks are not re-fetched (from_block > cursor), and new
+    attestations keep flowing."""
+    _, node_url = devnet
+    svc, client = _make_service(tmp_path, node_url)
+    svc.start()
+    kps = ecdsa_keypairs_from_mnemonic(MNEMONIC, 2)
+    addrs = [address_from_public_key(kp.public_key) for kp in kps]
+    _attest_round(client, kps, addrs, {(0, 1): 5, (1, 0): 7})
+    _wait(lambda: svc.tailer.attestations == 2, what="first service ingest")
+    cursor = svc.tailer.cursor
+    assert svc.shutdown() is True
+
+    svc2, client2 = _make_service(tmp_path, node_url)
+    # same contract: point the second service at the FIRST deployment
+    svc2.client.chain = client.chain
+    svc2.tailer.chain = client.chain
+    assert svc2.tailer.cursor == cursor, "cursor did not persist"
+    svc2.start()
+    try:
+        client.keypairs[0] = kps[0]
+        client.attest(addrs[1], 9)
+        _wait(lambda: svc2.tailer.cursor > cursor, what="resumed tailing")
+        # only the post-restart block was delivered to the sink
+        assert svc2.tailer.attestations == 1
+    finally:
+        svc2.shutdown()
+
+
+def test_proof_queue_backpressure():
+    """Bounded queue: submits beyond capacity raise QueueFullError
+    (→ HTTP 429), the worker drains FIFO, failures are isolated, and
+    drain cancels what it cannot finish."""
+    gate = threading.Event()
+    done = []
+
+    def slow(params):
+        gate.wait(10)
+        done.append(params["i"])
+        return {"i": params["i"]}
+
+    def boom(params):
+        raise EigenError("proving_error", "synthetic failure")
+
+    q = ProofJobQueue({"slow": slow, "boom": boom}, capacity=2)
+    q.start()
+    running = q.submit("slow", {"i": 0})
+    # let the worker claim job 0 so the QUEUE (not the worker) fills
+    deadline = time.monotonic() + 5
+    while q.get(running.job_id).status != "running":
+        assert time.monotonic() < deadline
+        time.sleep(0.01)
+    q.submit("slow", {"i": 1})
+    q.submit("boom", {"i": 2})
+    with pytest.raises(QueueFullError):
+        q.submit("slow", {"i": 3})
+    with pytest.raises(EigenError, match="unknown proof kind"):
+        q.submit("nope", {})
+    gate.set()
+    deadline = time.monotonic() + 10
+    while q.completed + q.failed < 3:
+        assert time.monotonic() < deadline, "worker stalled"
+        time.sleep(0.01)
+    assert done == [0, 1]
+    assert q.failed == 1
+    boom_job = [q.get(f"job-{i}") for i in (1, 2, 3)][2]
+    assert boom_job.status == "failed"
+    assert "synthetic failure" in boom_job.error
+    assert q.drain(5.0) is True
+    with pytest.raises(EigenError, match="draining"):
+        q.submit("slow", {"i": 9})
+
+
+def test_device_fault_injection_keeps_table_live(tmp_path, devnet):
+    """An injected device fault fails one refresh; the previously
+    published table stays served and the retry converges once the
+    fault clears."""
+    _, node_url = devnet
+    svc, client = _make_service(tmp_path, node_url)
+    url = svc.start()
+    try:
+        kps = ecdsa_keypairs_from_mnemonic(MNEMONIC, 2)
+        addrs = [address_from_public_key(kp.public_key) for kp in kps]
+        _attest_round(client, kps, addrs, {(0, 1): 5, (1, 0): 7})
+        _wait(lambda: svc.refresher.table.revision == svc.graph.revision
+              and svc.graph.n == 2, what="initial scores")
+        table_rev = svc.refresher.table.revision
+
+        svc.faults.rates["device"] = 1.0
+        client.keypairs[0] = kps[0]
+        client.attest(addrs[1], 2)
+        _wait(lambda: svc.graph.revision > table_rev,
+              what="ingest past the fault")
+        time.sleep(0.3)  # a few refresh attempts under 100% fault rate
+        assert svc.refresher.table.revision == table_rev, \
+            "a faulted refresh replaced the published table"
+        _, scores = _get(f"{url}/scores")  # still served
+        assert len(scores["scores"]) == 2
+
+        svc.faults.rates["device"] = 0.0
+        _wait(lambda: svc.refresher.table.revision == svc.graph.revision,
+              what="refresh recovery after the fault cleared")
+        assert svc.faults.injected["device"] >= 1
+    finally:
+        svc.shutdown()
+
+
+def test_warm_start_matches_cold_fixed_point():
+    """ops.converge.warm_start_scores + the backend ``s0`` seam: a
+    warm-started adaptive converge lands on the SAME fixed point as a
+    cold one (same tolerance), in no more iterations."""
+    from protocol_tpu.backend import JaxSparseBackend
+    from protocol_tpu.graph import barabasi_albert_edges
+    from protocol_tpu.ops.converge import warm_start_scores
+
+    n = 400
+    src, dst, val = barabasi_albert_edges(n, 3, seed=3)
+    valid = np.ones(n, dtype=bool)
+    backend = JaxSparseBackend(dtype=jax.numpy.float64)
+    # damping guarantees geometric convergence at rate (1-alpha): the
+    # mutual-attestation BA graph has a period-2 mode that undamped
+    # power iteration never fully sheds (delta plateaus ~5e-5)
+    tol, alpha = 1e-10, 0.1
+    cold, cold_iters, _ = backend.converge_edges(
+        n, src, dst, val, valid, 1000.0, 500, tol=tol, alpha=alpha)
+
+    # perturb one row's weights (a "small slice" of the matrix) and
+    # re-converge both ways
+    val2 = val.copy()
+    val2[src == 7] *= 3.0
+    cold2, cold2_iters, d2 = backend.converge_edges(
+        n, src, dst, val2, valid, 1000.0, 500, tol=tol, alpha=alpha)
+    s0 = warm_start_scores(cold, n, valid, 1000.0)
+    warm2, warm2_iters, dw = backend.converge_edges(
+        n, src, dst, val2, valid, 1000.0, 500, tol=tol, alpha=alpha,
+        s0=s0)
+    assert dw <= tol and d2 <= tol
+    np.testing.assert_allclose(warm2, cold2, rtol=1e-6, atol=1e-8)
+    assert warm2_iters <= cold2_iters, \
+        (warm2_iters, cold2_iters, "warm start did not help")
+    # mass conservation through the warm start
+    assert np.isclose(warm2.sum(), n * 1000.0, rtol=1e-6)
+
+
+def test_warm_start_scores_projection():
+    """The projection contract: new peers seeded at initial_score,
+    invalid zeroed, total mass rescaled to n_valid·initial."""
+    from protocol_tpu.ops.converge import warm_start_scores
+
+    prev = np.array([3000.0, 1000.0])
+    valid = np.array([True, True, True, False])
+    s = warm_start_scores(prev, 4, valid, 1000.0)
+    assert s.shape == (4,)
+    assert s[3] == 0.0
+    assert np.isclose(s.sum(), 3 * 1000.0)
+    # relative order of carried-over scores is preserved
+    assert s[0] / s[1] == pytest.approx(3.0)
+    # degenerate all-zero carry-over falls back to cold uniform
+    s2 = warm_start_scores(np.zeros(2), 3, np.ones(3, dtype=bool), 10.0)
+    np.testing.assert_allclose(s2, [10.0, 10.0, 10.0])

@@ -1,0 +1,26 @@
+"""Fast pytest wrapper for the committed service smoke tool — the CI
+entry for ``tools/serve_smoke.py`` (boot against the mock devnet,
+attest over raw-tx RPC, serve the score over HTTP, SIGTERM drain)."""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+pytest.importorskip("jax")
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_serve_smoke_tool():
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    # the tool is its own process: the real SIGTERM path (signal
+    # handler in a fresh main thread), not an in-process simulation
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "serve_smoke.py")],
+        capture_output=True, text=True, timeout=420, env=env, cwd=REPO)
+    assert proc.returncode == 0, (
+        f"serve_smoke failed\nstdout:\n{proc.stdout}\n"
+        f"stderr:\n{proc.stderr}")
+    assert "SERVE_SMOKE_OK" in proc.stdout

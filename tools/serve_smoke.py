@@ -1,0 +1,131 @@
+"""Service smoke: boot the trust-scores daemon against the mock devnet,
+attest, watch the score appear over HTTP, check /metrics, SIGTERM-drain.
+
+The one-command liveness check for ``protocol_tpu.service`` (CI hook:
+``tests/test_service_smoke.py`` runs this under the tier-1 timeout):
+
+1. start an in-repo mock devnet (``client/mocknode.py``) and deploy the
+   real AttestationStation bytecode,
+2. start the service (ephemeral port) with its SIGTERM handler
+   installed — the same wiring the ``serve`` CLI verb uses,
+3. submit signed attestations over raw JSON-RPC transactions,
+4. poll ``GET /score/<addr>`` until the scores reflect them and match
+   the batch ``local-scores`` oracle,
+5. assert ``GET /metrics`` serves non-empty Prometheus text with the
+   service counters,
+6. ``kill -TERM $$`` and verify the drain completes cleanly.
+
+Exit code 0 = all of the above held.
+"""
+
+import os
+import signal
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def main() -> int:
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import json
+    import urllib.request
+
+    from protocol_tpu.client import Client, ClientConfig
+    from protocol_tpu.client.chain import RpcChain
+    from protocol_tpu.client.eth import (
+        address_from_public_key,
+        ecdsa_keypairs_from_mnemonic,
+    )
+    from protocol_tpu.client.mocknode import MockNode
+    from protocol_tpu.service import FaultInjector, ServiceConfig, TrustService
+
+    mnemonic = ("test test test test test test test test test test test "
+                "junk")
+    t0 = time.monotonic()
+
+    def step(msg):
+        print(f"[{time.monotonic() - t0:6.1f}s] {msg}", flush=True)
+
+    node = MockNode()
+    node_url = node.start()
+    step(f"mock devnet at {node_url}")
+    deployer = ecdsa_keypairs_from_mnemonic(mnemonic, 1)[0]
+    chain = RpcChain.deploy_signed(node_url, deployer)
+    step(f"AttestationStation at 0x{chain.contract_address.hex()}")
+
+    config = ClientConfig(as_address="0x" + chain.contract_address.hex(),
+                          node_url=node_url, domain="0x" + "00" * 20)
+    client = Client(config, mnemonic)
+    import tempfile
+
+    with tempfile.TemporaryDirectory(prefix="ptpu-smoke-") as tmp:
+        service = TrustService(
+            client, ServiceConfig(port=0, poll_interval=0.1,
+                                  refresh_interval=0.1, tol=1e-10,
+                                  drain_timeout=15.0),
+            os.path.join(tmp, "cursor"),
+            provers={"noop": lambda p: {"ok": True}},
+            faults=FaultInjector({"rpc": 0.0, "device": 0.0}))
+        url = service.start()
+        service.install_signal_handlers()
+        step(f"service at {url}")
+
+        kps = ecdsa_keypairs_from_mnemonic(mnemonic, 2)
+        addrs = [address_from_public_key(kp.public_key) for kp in kps]
+        for i, values in ((0, 7), (1, 9)):
+            client.keypairs[0] = kps[i]
+            client.attest(addrs[1 - i], values)
+        step("posted 2 attestations over raw-tx JSON-RPC")
+
+        client.keypairs[0] = kps[0]
+        oracle = {s.address: float(s.ratio)
+                  for s in client.calculate_scores(
+                      client.get_attestations())}
+
+        def get(path):
+            with urllib.request.urlopen(url + path, timeout=10) as r:
+                body = r.read()
+            return (json.loads(body) if path != "/metrics"
+                    else body.decode())
+
+        deadline = time.monotonic() + 120
+        scored = None
+        while time.monotonic() < deadline:
+            try:
+                scored = get(f"/score/0x{addrs[0].hex()}")
+                break
+            except urllib.error.HTTPError:
+                time.sleep(0.2)
+        assert scored is not None, "score never appeared over HTTP"
+        for addr in addrs:
+            got = get(f"/score/0x{addr.hex()}")["score"]
+            ref = oracle[addr]
+            assert abs(got - ref) <= 1e-3 * max(abs(ref), 1.0), \
+                f"0x{addr.hex()}: served {got} vs oracle {ref}"
+        step(f"scores match the local-scores oracle ({oracle})")
+
+        metrics = get("/metrics")
+        assert metrics.strip(), "/metrics is empty"
+        for needle in ("ptpu_service_ingest_attestations",
+                       "ptpu_service_refresh_total",
+                       "ptpu_service_block_cursor"):
+            assert needle in metrics, f"/metrics missing {needle}"
+        health = get("/healthz")
+        assert health["ok"] and health["peers"] == 2
+        step(f"/metrics ok ({len(metrics.splitlines())} lines), "
+             f"cursor={health['block_cursor']}")
+
+        os.kill(os.getpid(), signal.SIGTERM)
+        step("sent SIGTERM to self")
+        service.wait()
+        assert service.draining
+        step("drain complete")
+    node.stop()
+    print("SERVE_SMOKE_OK", flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
