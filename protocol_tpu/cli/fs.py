@@ -77,6 +77,17 @@ class EigenFile:
     def chain_json(self) -> Path:
         return self.assets / "chain.json"
 
+    def service_state_dir(self) -> Path:
+        """Root of the serve daemon's durable state store (WAL, graph
+        snapshots, operator cache, block cursor) — ``protocol_tpu.store``."""
+        return self.assets / "service-state"
+
+    def proofs_dir(self) -> Path:
+        """Persisted proof artifacts, one directory per job id with the
+        stable file names ``proof.bin`` / ``public-inputs.bin`` /
+        ``job.json`` (the service twin of ``et_proof()`` and friends)."""
+        return self.assets / "proofs"
+
     def read(self, path: Path) -> bytes:
         if not path.exists():
             raise EigenError("file_io_error", f"missing artifact: {path}")

@@ -10,8 +10,10 @@ on the score verbs (the ConvergeBackend seam), a file-persisted local
 chain (``node_url = "memory"``) so the full flow runs without an Ethereum
 node, and the ``serve`` verb — the long-running trust-scores service
 (``protocol_tpu.service``: chain tailer, incremental refresh, proof job
-queue, HTTP API). The reference's handle_update bug (writing ``domain``
-into ``as_address``, cli.rs:639-643) is deliberately not replicated.
+queue, HTTP API) with its durable state store (``protocol_tpu.store``)
+maintained by the ``store`` inspect/compact verbs. The reference's
+handle_update bug (writing ``domain`` into ``as_address``,
+cli.rs:639-643) is deliberately not replicated.
 """
 
 from __future__ import annotations
@@ -141,11 +143,29 @@ def build_parser() -> argparse.ArgumentParser:
                    help="circuit shape served by proof jobs")
     p.add_argument("--transcript", choices=["poseidon", "keccak"],
                    default=None, help="default et-proof transcript")
+    p.add_argument("--state-dir", default=None,
+                   help="durable state store root (attestation WAL, "
+                        "graph snapshots, proof artifacts, operator "
+                        "cache; default <assets>/service-state) — "
+                        "restarts replay it instead of re-fetching "
+                        "pre-cursor blocks")
     p.add_argument("--checkpoint-dir", default=None,
                    help="block-cursor checkpoint directory "
-                        "(default <assets>/service-cursor)")
+                        "(default <state-dir>/cursor)")
 
     sub.add_parser("show", help="print the current config")
+
+    p = sub.add_parser(
+        "store",
+        help="inspect or compact the serve daemon's durable state store")
+    p.add_argument("action", choices=["inspect", "compact"],
+                   help="inspect: WAL/snapshot/proof-artifact summary; "
+                        "compact: fold latest-wins duplicate "
+                        "attestations into a fresh WAL segment "
+                        "(run with the daemon stopped)")
+    p.add_argument("--state-dir", default=None,
+                   help="state store root (default "
+                        "<assets>/service-state)")
 
     p = sub.add_parser(
         "sparse-scores",
@@ -752,13 +772,26 @@ def handle_serve(args, files, config):
         poll_interval=args.poll_interval, tol=args.tol,
         max_iterations=args.max_iterations,
         queue_capacity=args.queue_capacity,
-        proof_shape=args.shape, transcript=args.transcript)
+        proof_shape=args.shape, transcript=args.transcript,
+        state_dir=args.state_dir)
+    if svc_config.state_dir:
+        state_dir = Path(svc_config.state_dir)
+        if not state_dir.is_absolute():
+            state_dir = files.assets / state_dir
+    else:
+        state_dir = files.service_state_dir()
     if args.checkpoint_dir:
         ck_dir = Path(args.checkpoint_dir)
         if not ck_dir.is_absolute():
             ck_dir = files.assets / ck_dir
     else:
-        ck_dir = files.assets / "service-cursor"
+        # always under the state dir. A pre-store deployment (cursor in
+        # assets/service-cursor, graph memory-only) deliberately does
+        # NOT resume that cursor: its pre-cursor attestations were never
+        # persisted, so resuming would lose them forever — re-tailing
+        # from 0 once rebuilds everything into the WAL (get_logs is
+        # idempotent, edges are latest-wins, the log dedups by content)
+        ck_dir = state_dir / "cursor"
     # batched_ingest=None → the Client's auto rule (batched signer
     # recovery on an accelerator from 32 lanes up); the batch verbs'
     # False default would pin the daemon to scalar recovery forever
@@ -770,14 +803,111 @@ def handle_serve(args, files, config):
         from ..service.tailer import FileBackedLocalChain
 
         client.chain = FileBackedLocalChain(files.chain_json())
-    service = TrustService(client, svc_config, str(ck_dir), files=files)
+    service = TrustService(client, svc_config, str(ck_dir), files=files,
+                           state_dir=str(state_dir))
     url = service.start()
     service.install_signal_handlers()
+    replayed = service.store.replayed_records if service.store else 0
     print(f"trust-scores service listening on {url} "
-          f"(chain: {config.node_url}, cursor: {service.tailer.cursor}); "
+          f"(chain: {config.node_url}, cursor: {service.tailer.cursor}, "
+          f"state: {state_dir}, replayed: {replayed}); "
           "SIGTERM drains", flush=True)
     service.wait()
-    print("service drained", flush=True)
+    if service.drain_clean:
+        print("service drained", flush=True)
+        return 0
+    # an overrun drain budget / cursor persist failure must surface to
+    # the supervisor (systemd restart-on-failure, the smoke's rc check)
+    print("service drained UNCLEAN (timeout or persist failure)",
+          flush=True)
+    return 1
+
+
+def handle_store(args, files, config):
+    """Offline maintenance of the serve daemon's state store: a
+    human-readable summary (``inspect``) and latest-wins WAL compaction
+    (``compact`` — duplicates folded by recovered (signer, about) key,
+    the chain store's own identity)."""
+    from pathlib import Path
+
+    from ..store import AttestationWAL, ProofArtifactStore
+
+    if args.state_dir:
+        state_dir = Path(args.state_dir)
+        if not state_dir.is_absolute():
+            state_dir = files.assets / state_dir
+    else:
+        state_dir = files.service_state_dir()
+    wal_dir = str(state_dir / "wal")
+
+    if args.action == "inspect":
+        # inspection must not mutate — and must be safe against a LIVE
+        # daemon: readonly WAL scan, sweep-free snapshot listing, and
+        # no directory creation anywhere
+        from ..store.snapshot import list_steps_readonly, read_meta_readonly
+
+        wal = AttestationWAL(wal_dir, readonly=True)
+        records = sum(1 for _ in wal.replay())
+        stats = wal.stats()
+        print(f"state dir: {state_dir}")
+        print(f"wal: {stats['segments']} segment(s), {stats['bytes']} "
+              f"bytes, {records} intact record(s), "
+              f"{stats['torn_skipped']} torn/corrupt scan stop(s)")
+        snap_dir = str(state_dir / "snapshots")
+        steps = list_steps_readonly(snap_dir)
+        if steps:
+            meta = read_meta_readonly(snap_dir, steps[-1]) or {}
+            print(f"snapshots: {len(steps)} (latest revision "
+                  f"{meta.get('revision')}, "
+                  f"{meta.get('n_attestations')} attestation(s), "
+                  f"wal position {meta.get('wal_segment')}:"
+                  f"{meta.get('wal_offset')})")
+        else:
+            print("snapshots: none")
+        # a CLI-launched daemon persists artifacts into the EigenFile
+        # assets layout (handle_serve passes files=); state_dir/proofs
+        # is the embedded/provers-injected fallback — report whichever
+        # actually exists
+        proofs_dir = files.proofs_dir()
+        if not proofs_dir.is_dir():
+            proofs_dir = state_dir / "proofs"
+        n_proofs = (ProofArtifactStore(str(proofs_dir)).count()
+                    if proofs_dir.is_dir() else 0)
+        print(f"proof artifacts: {n_proofs} ({proofs_dir})")
+        return 0
+
+    # compact: fold by the chain store's identity — (creator, about) —
+    # recovering each record's signer the way replay would; records that
+    # fail recovery are dropped (replay rejects them anyway)
+    from ..client.attestation import DOMAIN_PREFIX, SignedAttestationData
+    from ..client.eth import address_from_public_key
+
+    domain = bytes.fromhex(config.domain.removeprefix("0x"))
+    key = DOMAIN_PREFIX + domain
+
+    def fold_key(block, about, payload):
+        try:
+            signed = SignedAttestationData.from_log(about, key, payload)
+            signer = address_from_public_key(signed.recover_public_key())
+        except (EigenError, ValueError):
+            return None
+        return signer, about
+
+    from ..store.state_store import acquire_state_lock
+
+    lock = acquire_state_lock(str(state_dir))  # refuse a live daemon
+    try:
+        wal = AttestationWAL(wal_dir)
+        out = wal.compact(fold_key)
+        wal.close()
+    finally:
+        if lock is not None:
+            lock.close()
+    print(f"compacted: {out['records_in']} record(s) -> "
+          f"{out['records_out']} in segment {out['segment']} "
+          f"({out['dropped']} unrecoverable dropped, "
+          f"{out['segments_removed']} old segment(s) removed)")
+    return 0
 
 
 HANDLERS = {
@@ -793,6 +923,7 @@ HANDLERS = {
     "kzg-params": handle_kzg_params,
     "show": handle_show,
     "sparse-scores": handle_sparse_scores,
+    "store": handle_store,
     "th-proof": handle_th_proof,
     "th-proving-key": handle_th_pk,
     "th-verify": handle_th_verify,

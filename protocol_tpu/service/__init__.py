@@ -27,12 +27,19 @@ Components (one file each):
   zk layer's identity-keyed prover caches so steady-state proofs never
   re-pay device init.
 - ``http_api.py`` — stdlib ``http.server`` API: GET /scores,
-  GET /score/<addr>, POST /proofs, GET /proofs/<id>, GET /healthz,
-  GET /metrics (Prometheus text from ``utils/trace.py``).
+  GET /score/<addr>, POST /proofs, GET /proofs/<id>,
+  GET /proofs/<id>/proof.bin, GET /healthz, GET /metrics (Prometheus
+  text from ``utils/trace.py``).
 - :class:`TrustService` (``daemon.py``) — the supervisor: threads,
-  SIGTERM graceful drain, fault-injection seam (``faults.py``).
+  SIGTERM graceful drain, fault-injection seam (``faults.py``,
+  including ``PTPU_FAULT_DISK`` torn-write/fsync injection), and —
+  given a state dir — the durable state store (``protocol_tpu.store``:
+  attestation WAL, atomic graph snapshots, persisted proof artifacts),
+  making restarts lossless: snapshot restore + WAL replay + cursor
+  resume, with the refresher warm-starting from the restored vector.
 
-Wired to the CLI as the ``serve`` verb (``cli/main.py``).
+Wired to the CLI as the ``serve`` verb plus the ``store``
+inspect/compact verbs (``cli/main.py``).
 """
 
 from .config import ServiceConfig

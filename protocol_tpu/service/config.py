@@ -39,6 +39,23 @@ class ServiceConfig:
     # the opinion matrix changed (PAPERS.md, arXiv 2606.11956)
     cold_edit_fraction: float = 0.5  # edits since last cold / edge count
     cold_every: int = 64             # periodic cold resync regardless
+    # past this many edges the refresh routes through JaxRoutedBackend
+    # with a digest-keyed compiled-operator cache (memory + on-disk
+    # under <state-dir>/operators) instead of rebuilding the ELL
+    # operator per refresh; 0 disables the routed path entirely
+    routed_edge_threshold: int = 100_000
+
+    # --- durable state store ----------------------------------------------
+    # empty = memory-only (the block cursor is still checkpointed);
+    # set (or pass serve --state-dir) to make restarts lossless:
+    # attestation WAL + graph snapshots + persisted proof artifacts
+    state_dir: str = ""
+    wal_segment_bytes: int = 4 << 20  # WAL segment rotation size
+    wal_fsync: str = "always"       # "always": fsync per appended batch;
+                                    # "never": leave it to the OS (faster,
+                                    # loses the page-cache tail on power cut)
+    snapshot_every: int = 256       # graph edits between snapshots
+    snapshot_keep: int = 2          # snapshots retained (older pruned)
 
     # --- proof jobs -------------------------------------------------------
     queue_capacity: int = 8         # backpressure: submits beyond this 429

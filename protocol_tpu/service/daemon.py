@@ -1,4 +1,4 @@
-"""The service supervisor: threads, lifecycle, graceful drain.
+"""The service supervisor: threads, lifecycle, durability, drain.
 
 Thread layout (all daemon threads, all stopping on one event):
 
@@ -9,33 +9,60 @@ Thread layout (all daemon threads, all stopping on one event):
 - **HTTP** — ``ThreadingHTTPServer`` (its own accept loop + per-request
   threads; GETs only read immutable snapshots).
 
-The ingest sink is the only producer-side coupling: it recovers signer
-keys (batched TPU pipeline on an accelerator, scalar otherwise), folds
-the batch into the opinion graph AND the raw attestation buffer (the
-proof provers need the actual signed attestations, not just edges),
-then wakes the refresher.
+The ingest sink is the only producer-side coupling, and — with a state
+dir — the durability write path: it dedups the batch against everything
+already logged, appends it to the attestation WAL (**append-before-
+apply**: a failed append propagates, the cursor stays put, the tailer
+refetches), recovers signer keys (batched TPU pipeline on an
+accelerator, scalar otherwise), folds the batch into the opinion graph
+AND the raw attestation buffer (the proof provers need the actual
+signed attestations, not just edges), wakes the refresher, and every
+``snapshot_every`` edits commits an atomic graph snapshot (after which
+fully-covered WAL segments are pruned).
+
+Startup with a state dir is the reverse: restore the newest readable
+snapshot (graph + published score table + attestation buffer), replay
+the WAL from the snapshot's position, rehydrate persisted proof
+artifacts into the job history, and resume the block cursor — a
+SIGKILL'd daemon comes back serving identical scores without
+re-fetching a single pre-cursor block, and its first refresh
+warm-starts from the restored vector instead of a cold resync.
 
 SIGTERM/SIGINT → :meth:`TrustService.shutdown`: mark draining (POSTs
 503, health says so), stop the tailer/refresher, drain the job queue
-within ``drain_timeout``, persist the cursor one last time, then stop
-HTTP. The cursor is already persisted per poll, so even a SIGKILL loses
-at most one poll's worth of re-fetchable logs.
+within ``drain_timeout``, take a farewell snapshot (making the next
+start's replay trivial), persist the cursor one last time, then stop
+HTTP. The cursor is already persisted per poll and the WAL per batch,
+so even a SIGKILL loses at most one poll's worth of re-fetchable logs.
 """
 
 from __future__ import annotations
 
+import hashlib
 import threading
 import time
 
+from ..client.attestation import DOMAIN_PREFIX, SignedAttestationData
 from ..utils import trace
 from ..utils.checkpoint import CheckpointManager
 from ..utils.errors import EigenError
 from .config import ServiceConfig
 from .faults import FaultInjector
 from .jobs import ProofJobQueue
-from .refresh import ScoreRefresher
+from .refresh import ScoreRefresher, ScoreTable
 from .state import OpinionGraph, recover_signers
 from .tailer import ChainTailer
+
+
+def _att_digest(block: int, about: bytes, payload: bytes) -> bytes:
+    """Identity of one signed attestation AS LOGGED — block + about +
+    normalized payload. The dedup key makes WAL replay + cursor refetch
+    overlap harmless; the block number MUST be part of it because
+    deterministic (RFC 6979) signing makes a re-attestation of a
+    previously-seen value byte-identical in payload — only its block
+    distinguishes the genuine latest-wins revert from a refetch."""
+    return hashlib.sha256(block.to_bytes(8, "little") + about
+                          + payload).digest()
 
 
 class TrustService:
@@ -43,18 +70,47 @@ class TrustService:
 
     def __init__(self, client, config: ServiceConfig, checkpoint_dir: str,
                  provers: dict | None = None, backend=None,
-                 faults: FaultInjector | None = None, files=None):
+                 faults: FaultInjector | None = None, files=None,
+                 state_dir: str | None = None):
         """``client``: a ``client.Client`` (chain + domain + circuit
         hyperparameters); ``checkpoint_dir``: block-cursor durability;
         ``provers``: job registry (default: the production
-        EigenTrust/Threshold provers over ``files``' assets)."""
+        EigenTrust/Threshold provers over ``files``' assets);
+        ``state_dir`` (or ``config.state_dir``): root of the durable
+        state store — WAL, snapshots, proof artifacts, operator cache —
+        omitted, the graph and proof history are memory-only and only
+        the cursor survives a restart."""
         self.client = client
         self.config = config
         self.faults = faults or FaultInjector()
+        if not trace.TRACER.enabled:
+            # /metrics is part of the service contract, and restore
+            # (snapshot + WAL replay) emits spans before start()
+            trace.enable()
+        state_dir = state_dir or config.state_dir or None
+        self.store = None
+        if state_dir:
+            from ..store import StateStore
+
+            proofs_dir = (str(files.proofs_dir())
+                          if files is not None else None)
+            self.store = StateStore(
+                str(state_dir), segment_bytes=config.wal_segment_bytes,
+                fsync=config.wal_fsync, snapshot_keep=config.snapshot_keep,
+                faults=self.faults, proofs_dir=proofs_dir)
         self.graph = OpinionGraph()
-        self.refresher = ScoreRefresher(self.graph, config,
-                                        backend=backend,
-                                        faults=self.faults)
+        self.refresher = ScoreRefresher(
+            self.graph, config, backend=backend, faults=self.faults,
+            operator_cache_dir=(self.store.operators_dir
+                                if self.store else None))
+        self._attestations: list = []
+        self._att_blocks: list = []  # parallel: block number per entry
+        # (snapshots persist them so restart dedup keys stay exact)
+        self._att_lock = threading.Lock()
+        self._seen: set = set()
+        self._edits_since_snapshot = 0
+        if self.store is not None:
+            self._restore()
         self.tailer = ChainTailer(
             client.chain, client._domain_bytes(), self._sink,
             CheckpointManager(checkpoint_dir, keep=config.cursor_keep),
@@ -71,36 +127,197 @@ class TrustService:
             provers = make_provers(self, files,
                                    shape_name=config.proof_shape,
                                    transcript=config.transcript)
-        self.jobs = ProofJobQueue(provers, capacity=config.queue_capacity,
-                                  faults=self.faults)
-        self._attestations: list = []
-        self._att_lock = threading.Lock()
+        self.jobs = ProofJobQueue(
+            provers, capacity=config.queue_capacity, faults=self.faults,
+            artifacts=self.store.artifacts if self.store else None)
+        if self.store is not None:
+            rehydrated = self.jobs.rehydrate()
+            if rehydrated:
+                trace.event("service.jobs_rehydrated", n=rehydrated)
         self._stop = threading.Event()
         self._dirty = threading.Event()
+        if self.store is not None and self.refresher.stale():
+            self._dirty.set()  # replay outran the snapshot's table:
+            # warm-refresh the gap as soon as the refresher starts
         self._threads: list = []
         self._server = None
         self._server_thread = None
         self.started_at: float | None = None
         self.draining = False
+        self.drain_clean: bool | None = None  # set by shutdown()
+
+    # --- durability: restore ----------------------------------------------
+    def _decode_record(self, about: bytes, payload: bytes):
+        """WAL/snapshot record → SignedAttestationData via the exact
+        codec the tailer uses; None for undecodable bytes (never fatal:
+        the log can hold what an attacker emitted at our key)."""
+        key = DOMAIN_PREFIX + self.client._domain_bytes()
+        try:
+            return SignedAttestationData.from_log(about, key, payload)
+        except EigenError:
+            return None
+
+    def _restore(self) -> None:
+        """Snapshot restore + WAL replay (constructor path, before any
+        thread exists — no locks contended)."""
+        from ..store import decode_service_state
+
+        t0 = time.monotonic()
+        restored_revision = -1
+        loaded = self.store.snapshots.load_latest()
+        wal_start = None
+        if loaded is not None:
+            _, arrays, meta = loaded
+            st = decode_service_state(arrays, meta)
+            self.graph.restore_state(st["addrs"], st["edges"],
+                                     st["revision"],
+                                     st["edits_since_cold"],
+                                     st["invalid"])
+            score_n = len(st["scores"])
+            self.refresher.install(ScoreTable(
+                addresses=tuple(st["addrs"][:score_n]),
+                scores=st["scores"], revision=st["score_revision"],
+                iterations=st["iterations"], delta=st["delta"],
+                cold=st["cold"], computed_at=st["computed_at"]))
+            for blk, about, payload in st["att_records"]:
+                signed = self._decode_record(about, payload)
+                if signed is None:
+                    continue
+                self._attestations.append(signed)
+                self._att_blocks.append(blk)
+                self._seen.add(_att_digest(blk, about, payload))
+            restored_revision = st["revision"]
+            wal_start = st["wal_pos"]
+        # replay everything past the snapshot's position (after a
+        # compaction that position may be gone — then every surviving
+        # segment replays); dedup by content makes any overlap harmless
+        batch = []
+        batch_blocks = []
+        for blk, about, payload in self.store.wal.replay(wal_start):
+            digest = _att_digest(blk, about, payload)
+            if digest in self._seen:
+                continue
+            signed = self._decode_record(about, payload)
+            if signed is None:
+                continue
+            self._seen.add(digest)
+            batch.append(signed)
+            batch_blocks.append(blk)
+        if batch:
+            signers = recover_signers(
+                batch, batched=self.client.batched_ingest)
+            self.graph.apply(batch, signers)
+            self._attestations.extend(batch)
+            self._att_blocks.extend(batch_blocks)
+        self.store.replayed_records = len(batch)
+        trace.event("service.restored",
+                    snapshot_revision=restored_revision,
+                    replayed=len(batch), peers=self.graph.n,
+                    edges=self.graph.n_edges,
+                    seconds=round(time.monotonic() - t0, 3))
+
+    # --- durability: snapshot ---------------------------------------------
+    def _take_snapshot(self) -> bool:
+        """One consistent cut → atomic snapshot → prune covered WAL
+        segments. Runs on the sink thread (the only graph/buffer
+        mutator) or on the drain path after the sink stopped."""
+        from ..store import encode_service_state
+
+        n, src, dst, val, revision, edits = self.graph.snapshot()
+        addrs = self.graph.addresses()[:n]
+        invalid = self.graph.invalid
+        with self._att_lock:
+            atts = list(self._attestations)
+            att_blocks = list(self._att_blocks)
+        pos = self.store.wal.position()
+        arrays, meta = encode_service_state(
+            addrs, src, dst, val, revision, edits, invalid,
+            self.refresher.table, atts, att_blocks, pos)
+        try:
+            with trace.span("service.snapshot", revision=revision,
+                            n=len(addrs), attestations=len(atts)):
+                self.store.snapshots.save(revision, arrays, meta)
+        except (EigenError, OSError):
+            # OSError too: CheckpointManager raises raw ENOSPC/EIO, and
+            # the farewell snapshot on the drain path must degrade to
+            # "longer replay next start", never abort the shutdown
+            self.store.snapshot_failures += 1
+            trace.event("service.snapshot_failed", revision=revision)
+            return False
+        self._edits_since_snapshot = 0
+        self.store.wal.prune_below(pos[0])
+        trace.metric("service.snapshot_revision", revision)
+        return True
 
     # --- ingest sink ------------------------------------------------------
-    def _sink(self, batch: list, block: int) -> None:
+    def _sink(self, batch: list, block: int, blocks: list | None = None) \
+            -> None:
+        fresh = []
+        if self.store is not None:
+            for k, signed in enumerate(batch):
+                about = signed.attestation.about
+                payload = signed.to_payload()
+                blk = blocks[k] if blocks else block
+                digest = _att_digest(blk, about, payload)
+                if digest in self._seen:
+                    continue  # already logged (replayed batch whose
+                    # cursor checkpoint lost the race with the crash)
+                fresh.append((signed, digest, about, payload, blk))
+            if not fresh:
+                return
+            with trace.span("service.wal_append", n=len(fresh),
+                            block=block):
+                self.store.wal.append(
+                    [(blk, about, payload)
+                     for _, _, about, payload, blk in fresh])
+            batch = [signed for signed, _, _, _, _ in fresh]
         with trace.span("service.ingest", n=len(batch), block=block):
             signers = recover_signers(batch,
                                       batched=self.client.batched_ingest)
         with self._att_lock:
             self._attestations.extend(batch)
-        self.graph.apply(batch, signers)
+            if self.store is not None:
+                self._att_blocks.extend(blk for _, _, _, _, blk in fresh)
+        changed = self.graph.apply(batch, signers)
+        if self.store is not None:
+            # marked seen only now: if recovery/apply had failed after
+            # the append, the refetched batch must NOT be deduped away —
+            # it re-appends (replay folds the duplicate) and re-applies
+            for _, digest, _, _, _ in fresh:
+                self._seen.add(digest)
         self._dirty.set()
+        if self.store is not None and changed:
+            self._edits_since_snapshot += changed
+            if self._edits_since_snapshot >= self.config.snapshot_every:
+                self._take_snapshot()  # failure-tolerant: counted, and
+                # the edit counter keeps accruing so it retries soon
 
     def attestation_snapshot(self) -> list:
         with self._att_lock:
             return list(self._attestations)
 
+    # --- proof artifacts --------------------------------------------------
+    def proof_bytes(self, job_id: str) -> bytes | None:
+        """Raw proof for ``GET /proofs/<id>/proof.bin``: the persisted
+        artifact when a store is wired (survives MRU eviction and
+        restarts), else the in-memory result's proof hex."""
+        if self.store is not None:
+            data = self.store.artifacts.proof_bytes(job_id)
+            if data is not None:
+                return data
+        job = self.jobs.get(job_id)
+        if job is None or not isinstance((job.result or {}).get("proof"),
+                                         str):
+            return None
+        try:
+            return bytes.fromhex(job.result["proof"])
+        except ValueError:
+            return None
+
     # --- introspection ----------------------------------------------------
     def health(self) -> dict:
         table = self.refresher.table
-        return {
+        out = {
             "ok": True,
             "draining": self.draining,
             "block_cursor": self.tailer.cursor,
@@ -112,18 +329,35 @@ class TrustService:
             "uptime_s": (time.time() - self.started_at
                          if self.started_at else 0.0),
         }
+        if self.store is not None:
+            wal = self.store.wal.stats()
+            out["store"] = {
+                "wal_segments": wal["segments"],
+                "wal_bytes": wal["bytes"],
+                "snapshots": self.store.snapshots.count(),
+                "replayed_records": self.store.replayed_records,
+                "proof_artifacts": self.store.artifacts.count(),
+            }
+        return out
 
     def extra_metrics(self) -> dict:
         """Service-local gauges merged into /metrics (things the tracer
         does not carry because they are state, not samples)."""
-        return {
+        out = {
             "service.up": 0.0 if self.draining else 1.0,
             "service.queue_depth": float(self.jobs.depth()),
             "service.proof_completed": float(self.jobs.completed),
             "service.proof_failed": float(self.jobs.failed),
+            "service.operator_cache_hits": float(
+                self.refresher.operator_hits),
+            "service.operator_builds": float(
+                self.refresher.operator_builds),
             "service.uptime_seconds": (time.time() - self.started_at
                                        if self.started_at else 0.0),
         }
+        if self.store is not None:
+            out.update(self.store.metrics())
+        return out
 
     @property
     def url(self) -> str:
@@ -133,12 +367,12 @@ class TrustService:
     # --- lifecycle --------------------------------------------------------
     def start(self) -> str:
         """Start all threads + the HTTP listener; returns the base URL.
-        Tracing is force-enabled (in-memory) — /metrics is part of the
-        service contract, not an opt-in."""
+        Tracing is force-enabled (in-memory, since the constructor) —
+        /metrics is part of the service contract, not an opt-in."""
         from .http_api import make_server
 
         if not trace.TRACER.enabled:
-            trace.enable()
+            trace.enable()  # e.g. the CLI's --trace teardown ran between
         self.started_at = time.time()
         self.jobs.start()
         t = threading.Thread(
@@ -166,8 +400,9 @@ class TrustService:
         """Graceful drain; idempotent; returns True on a clean drain.
 
         Order: stop ingest/refresh producers → drain the proof queue
-        (finish in-flight within the budget) → persist the cursor →
-        stop HTTP last (health stays observable while draining)."""
+        (finish in-flight within the budget) → farewell snapshot →
+        persist the cursor → stop HTTP last (health stays observable
+        while draining)."""
         if self.draining:
             return True
         self.draining = True
@@ -181,10 +416,27 @@ class TrustService:
         clean = not any(t.is_alive() for t in self._threads)
         clean = self.jobs.drain(
             timeout=max(0.1, deadline - time.monotonic())) and clean
+        if self.store is not None and clean:
+            # farewell snapshot so the next start replays ~nothing;
+            # failure is not unclean — the WAL already covers everything
+            self._take_snapshot()
         try:
             self.tailer._persist_cursor()
-        except EigenError:
+        except (EigenError, OSError):
+            # OSError: CheckpointManager raises raw ENOSPC/EIO — a sick
+            # disk makes the drain UNCLEAN, it must not hang it (the
+            # HTTP stop below is what lets wait()/the serve verb exit)
             clean = False
+        if self.store is not None and clean:
+            # all writers joined: release the WAL handle + state lock
+            # (left open on an unclean drain — a still-live tailer
+            # thread must not find its log closed under it)
+            try:
+                self.store.close()
+            except OSError:
+                clean = False  # sick disk: unclean, but NEVER hang the
+                # drain thread — the HTTP stop below must still run
+        self.drain_clean = clean
         if self._server is not None:
             self._server.shutdown()
             self._server.server_close()

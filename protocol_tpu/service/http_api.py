@@ -5,12 +5,16 @@ Same dependency posture as the mock devnet (``client/mocknode.py``): a
 
 Routes:
 
-- ``GET /healthz``        liveness + cursor/peer/queue gauges
+- ``GET /healthz``        liveness + cursor/peer/queue/store gauges
 - ``GET /scores``         the full published score table (JSON)
 - ``GET /score/<addr>``   one peer's score (404 before first sighting)
 - ``POST /proofs``        submit a proof job ``{"kind", "params"}`` →
   202 + job id; 429 on queue backpressure; 503 while draining
-- ``GET /proofs/<id>``    job status/result
+- ``GET /proofs/<id>``    job status/result (falls back to the persisted
+  artifact store past the in-memory MRU / across restarts)
+- ``GET /proofs/<id>/proof.bin``  the raw proof bytes
+  (application/octet-stream) — byte-identical to the batch prover's
+  artifact file, served from the proof artifact store
 - ``GET /metrics``        Prometheus text (``service/metrics.py``)
 
 GETs are lock-free against the hot path: the score table is an
@@ -42,9 +46,12 @@ def make_server(service, host: str, port: int) -> ThreadingHTTPServer:
 
     class Handler(BaseHTTPRequestHandler):
         def _reply(self, status: int, obj, content_type="application/json"):
-            body = (json.dumps(obj).encode()
-                    if content_type == "application/json"
-                    else obj.encode())
+            if isinstance(obj, bytes):
+                body = obj
+            elif content_type == "application/json":
+                body = json.dumps(obj).encode()
+            else:
+                body = obj.encode()
             self.send_response(status)
             self.send_header("Content-Type", content_type)
             self.send_header("Content-Length", str(len(body)))
@@ -89,6 +96,14 @@ def make_server(service, host: str, port: int) -> ThreadingHTTPServer:
                     "score": score,
                     "revision": table.revision,
                 })
+            if path.startswith("/proofs/") and path.endswith("/proof.bin"):
+                job_id = path[len("/proofs/"):-len("/proof.bin")]
+                data = service.proof_bytes(job_id)
+                if data is None:
+                    return self._reply(
+                        404, {"error": "no proof artifact for this job"})
+                return self._reply(200, data,
+                                   content_type="application/octet-stream")
             if path.startswith("/proofs/"):
                 job = service.jobs.get(path[len("/proofs/"):])
                 if job is None:

@@ -15,7 +15,14 @@ job pins its setup). The queue therefore:
   re-paying device init per proof — the steady-state serving win the
   r5 battery measured at −23% per proof;
 - keeps terminal jobs (done/failed) in a bounded MRU history so
-  ``GET /proofs/<id>`` stays answerable after completion.
+  ``GET /proofs/<id>`` stays answerable after completion — and, when a
+  :class:`..store.ProofArtifactStore` is wired in, persists every job
+  record at ISSUE time and again on completion (proof bytes included),
+  so history survives both the MRU bound and a restart: lookups fall
+  back to the artifact store, and :meth:`ProofJobQueue.rehydrate`
+  reloads the newest artifacts into the MRU at startup, advancing the
+  id counter past every persisted id (no id reuse even for jobs killed
+  in flight — those rehydrate as ``failed: lost``).
 
 Provers are a registry ``kind -> fn(params: dict) -> dict`` so the
 daemon wires the real EigenTrust/Threshold provers (``provers.py``)
@@ -60,6 +67,7 @@ class ProofJob:
             "kind": self.kind,
             "status": self.status,
             "submitted_at": self.submitted_at,
+            "params": self.params,
         }
         if self.started_at is not None:
             out["started_at"] = self.started_at
@@ -71,15 +79,35 @@ class ProofJob:
             out["error"] = self.error
         return out
 
+    @classmethod
+    def from_json(cls, data: dict) -> "ProofJob":
+        """Inverse of :meth:`to_json` — the artifact-store rehydration
+        path. Tolerates records from older layouts (missing params)."""
+        return cls(
+            job_id=str(data["job_id"]),
+            kind=str(data.get("kind", "")),
+            params=dict(data.get("params") or {}),
+            status=str(data.get("status", "done")),
+            submitted_at=float(data.get("submitted_at", 0.0)),
+            started_at=data.get("started_at"),
+            finished_at=data.get("finished_at"),
+            result=data.get("result"),
+            error=data.get("error"),
+        )
+
 
 class ProofJobQueue:
     """Bounded FIFO + single worker thread + MRU result history."""
 
     def __init__(self, provers: dict, capacity: int = 8,
                  faults: FaultInjector | None = None,
-                 history: int = 256):
+                 history: int = 256, artifacts=None):
+        """``artifacts``: optional ``store.ProofArtifactStore`` —
+        terminal jobs are persisted there and lookups/rehydration fall
+        back to it, making proof history survive the MRU and restarts."""
         self.provers = dict(provers)
         self.capacity = capacity
+        self.artifacts = artifacts
         self.faults = faults or FaultInjector({"rpc": 0.0, "device": 0.0})
         self._pending: deque = deque()
         self._jobs: OrderedDict = OrderedDict()  # job_id -> ProofJob
@@ -108,27 +136,81 @@ class ProofJobQueue:
                 raise QueueFullError(self.capacity)
             job = ProofJob(job_id=f"job-{next(self._ids)}", kind=kind,
                            params=dict(params or {}))
-            self._pending.append(job)
             self._jobs[job.job_id] = job
             # bound the lookup table by evicting the OLDEST TERMINAL
-            # jobs (queued/running entries are never dropped)
-            excess = len(self._jobs) - (self._history + len(self._pending))
-            if excess > 0:
-                for jid in [j.job_id for j in self._jobs.values()
-                            if j.status in ("done", "failed", "cancelled")
-                            ][:excess]:
-                    del self._jobs[jid]
+            # jobs; the excess is sized off the terminal count alone, so
+            # queued/running entries can never shrink the history
+            # allowance (nor be dropped themselves). Evicted jobs remain
+            # reachable through the artifact store when one is wired.
+            terminal = [j.job_id for j in self._jobs.values()
+                        if j.status in ("done", "failed", "cancelled")]
+            for jid in terminal[:len(terminal) - self._history]:
+                del self._jobs[jid]
+        if self.artifacts is not None:
+            # persist the id at ISSUE time, OUTSIDE the lock (an fsync
+            # must not stall lookups/health/the worker) but BEFORE the
+            # job is runnable — it is not in _pending yet, so the worker
+            # cannot race a terminal record under this queued one. A
+            # daemon SIGKILLed with the job in flight must not reissue
+            # the id after restart: rehydrate() advances the counter
+            # past every PERSISTED id.
+            self.artifacts.persist(job)
+        with self._lock:
+            if self._draining or self._stop:
+                # drain began between the sections: this job was never
+                # runnable; its queued artifact rehydrates as failed/lost
+                job.status = "cancelled"
+                job.finished_at = time.time()
+                job.error = "cancelled: service shutdown"
+                raise EigenError("service_busy",
+                                 "service is draining; not accepting jobs")
+            self._pending.append(job)
             self._wake.notify()
             trace.metric("service.proof_queue_depth", len(self._pending))
             return job
 
     def get(self, job_id: str) -> ProofJob | None:
         with self._lock:
-            return self._jobs.get(job_id)
+            job = self._jobs.get(job_id)
+        if job is None and self.artifacts is not None:
+            data = self.artifacts.load(job_id)
+            if data is not None:
+                job = ProofJob.from_json(data)
+        return job
 
     def depth(self) -> int:
         with self._lock:
             return len(self._pending)
+
+    def rehydrate(self) -> int:
+        """Reload the newest persisted terminal jobs into the MRU (call
+        before :meth:`start`) and advance the id counter past every
+        persisted id; returns how many were loaded. Without an artifact
+        store this is a no-op. Residual window: an id whose artifact
+        persist FAILED (disk fault) can be reissued after a restart —
+        with a disk that broken, its result was already lost."""
+        if self.artifacts is None:
+            return 0
+        ids = self.artifacts.job_ids()
+        top = self.artifacts.max_numeric_id()
+        loaded = 0
+        with self._lock:
+            for jid in ids[-self._history:]:
+                data = self.artifacts.load(jid)
+                if data is None:
+                    continue
+                job = ProofJob.from_json(data)
+                if job.status in ("queued", "running"):
+                    # persisted at issue time, daemon died mid-job: give
+                    # the polling client an honest terminal answer
+                    job.status = "failed"
+                    job.error = "lost: daemon restarted mid-job"
+                    job.finished_at = time.time()
+                    self.artifacts.persist(job)
+                self._jobs[jid] = job
+                loaded += 1
+            self._ids = itertools.count(top + 1)
+        return loaded
 
     # --- worker -----------------------------------------------------------
     def start(self) -> None:
@@ -160,6 +242,11 @@ class ProofJobQueue:
                 self.failed += 1
             finally:
                 job.finished_at = time.time()
+                if self.artifacts is not None:
+                    # best-effort: persist() counts its own failures
+                    # (injected disk faults included) and never raises —
+                    # a lost artifact must not take the worker down
+                    self.artifacts.persist(job)
                 trace.metric("service.proofs_done", self.completed)
                 trace.metric("service.proofs_failed", self.failed)
 
@@ -179,13 +266,20 @@ class ProofJobQueue:
             time.sleep(0.05)
         with self._lock:
             clean = not self._pending
-            for job in self._pending:
+            cancelled = list(self._pending)
+            for job in cancelled:
                 job.status = "cancelled"
                 job.finished_at = time.time()
                 job.error = "cancelled: service shutdown"
             self._pending.clear()
             self._stop = True
             self._wake.notify_all()
+        if self.artifacts is not None:
+            # cancelled ids must be persisted too: rehydrate() advances
+            # the id counter past persisted ids only, and a restarted
+            # daemon must never reissue an id a client is still polling
+            for job in cancelled:
+                self.artifacts.persist(job)
         if self._thread is not None:
             self._thread.join(timeout=max(0.0,
                                           deadline - time.monotonic()) + 1.0)

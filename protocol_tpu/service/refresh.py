@@ -23,10 +23,27 @@ is skipped and the iteration runs cold from uniform, re-anchoring the
 vector. Warm and cold converge to the same fixed point on ergodic
 graphs; the periodic cold resync bounds the error for adversarially
 disconnected ones.
+
+Two scale/restart seams on top:
+
+- **restored tables** (:meth:`ScoreRefresher.install`): the daemon's
+  snapshot restore hands the last persisted table straight back, so the
+  first post-restart refresh warm-starts from the old fixed point
+  instead of a forced cold resync;
+- **routed refresh** (``routed_edge_threshold``): past the threshold
+  the snapshot-and-rebuild-the-ELL-operator-per-refresh pattern stops
+  scaling, so the refresh routes through ``JaxRoutedBackend`` with a
+  digest-keyed compiled-operator cache (in-memory slot + on-disk under
+  the state dir), warm vectors entering through the operator's
+  ``scores_from_nodes`` path. Cache hits — the warm→cold fallback, the
+  periodic cold resync, and every post-restart refresh of an unchanged
+  graph — skip the rebuild entirely (``operator_hits`` proves it).
 """
 
 from __future__ import annotations
 
+import hashlib
+import os
 import time
 from dataclasses import dataclass
 
@@ -71,7 +88,8 @@ class ScoreRefresher:
     """Owns the backend + the published table; one refresh at a time."""
 
     def __init__(self, graph: OpinionGraph, config: ServiceConfig,
-                 backend=None, faults: FaultInjector | None = None):
+                 backend=None, faults: FaultInjector | None = None,
+                 operator_cache_dir: str | None = None):
         self.graph = graph
         self.config = config
         self.faults = faults or FaultInjector({"rpc": 0.0, "device": 0.0})
@@ -84,6 +102,19 @@ class ScoreRefresher:
         self.refreshes = 0
         self.cold_refreshes = 0
         self.warm_iterations = 0  # cumulative, warm refreshes only
+        # routed-operator cache (the at-scale path): one in-memory slot
+        # keyed by edge-list digest + optional on-disk spill
+        self.operator_cache_dir = operator_cache_dir
+        self._routed_backend = None
+        self._op = None
+        self._op_digest = None
+        self.operator_hits = 0
+        self.operator_builds = 0
+
+    def install(self, table: ScoreTable) -> None:
+        """Adopt a restored table (snapshot restore): the next refresh
+        warm-starts from it instead of running a forced cold resync."""
+        self.table = table
 
     def stale(self) -> bool:
         return self.graph.revision != self.table.revision
@@ -91,10 +122,98 @@ class ScoreRefresher:
     def _want_cold(self, n_edges: int, edits: int) -> bool:
         if self.table.revision < 0:
             return True  # nothing to warm-start from
-        if self.config.cold_every and (
+        # self.refreshes == 0 with a live table means a RESTORED table
+        # (snapshot restore): warm-start from it, don't force the
+        # periodic resync on the very first post-restart refresh
+        if self.config.cold_every and self.refreshes and (
                 self.refreshes % self.config.cold_every == 0):
             return True
         return edits > self.config.cold_edit_fraction * max(n_edges, 1)
+
+    # --- routed-operator cache (refresh at scale) -------------------------
+    def _routed_operator(self, n, src, dst, val, valid):
+        """The compiled RoutedOperator for this exact edge list: from
+        the in-memory slot, else the on-disk cache, else a fresh build
+        (saved back when a cache dir is configured). Digest-keyed on the
+        edge content, so a changed graph can never load a stale plan."""
+        h = hashlib.sha256()
+        h.update(f"routed:v1:n={n}".encode())
+        for a in (src, dst, val, valid):
+            # valid included: a mask-only change (future peer bans)
+            # must never reuse an operator compiled under another mask
+            h.update(np.ascontiguousarray(a).tobytes())
+        digest = h.hexdigest()
+        if self._op is not None and self._op_digest == digest:
+            self.operator_hits += 1
+            return self._op
+        from ..ops.routed import RoutedOperator, build_routed_operator
+
+        path = None
+        if self.operator_cache_dir:
+            os.makedirs(self.operator_cache_dir, exist_ok=True)
+            path = os.path.join(self.operator_cache_dir,
+                                f"routed_{digest[:24]}.npz")
+            if os.path.exists(path):
+                try:
+                    with trace.span("service.operator_load", path=path):
+                        op = RoutedOperator.load(path)
+                    self._op, self._op_digest = op, digest
+                    self.operator_hits += 1
+                    return op
+                except Exception:  # noqa: BLE001 - corrupt cache entry:
+                    # rebuild rather than brick the refresh loop
+                    trace.event("service.operator_cache_unreadable",
+                                path=path)
+        with trace.span("service.operator_build", n=n, edges=len(src)):
+            op = build_routed_operator(n, src, dst, val, valid)
+        self.operator_builds += 1
+        if path is not None:
+            try:
+                op.save(path)
+                self._prune_operator_cache(keep=4)
+            except OSError:
+                trace.event("service.operator_cache_write_failed",
+                            path=path)
+        self._op, self._op_digest = op, digest
+        return op
+
+    def _prune_operator_cache(self, keep: int) -> None:
+        """Drop all but the newest ``keep`` cached operators: under
+        continuous ingest every refresh has a new digest, and the
+        cache's value is restart / unchanged-graph hits — only the
+        recent entries matter, the tail is just disk growth."""
+        entries = []
+        for name in os.listdir(self.operator_cache_dir):
+            if name.startswith("routed_") and name.endswith(".npz"):
+                p = os.path.join(self.operator_cache_dir, name)
+                try:
+                    entries.append((os.path.getmtime(p), p))
+                except OSError:
+                    continue
+        for _, p in sorted(entries)[:-keep]:
+            try:
+                os.remove(p)
+            except OSError:
+                pass
+
+    def _converge_call(self, n, src, dst, val, valid):
+        """(backend, extra-kwargs) for this refresh: the routed path
+        with a cached operator past the edge threshold, the configured
+        backend otherwise."""
+        threshold = self.config.routed_edge_threshold
+        if not threshold or len(src) < threshold:
+            return self.backend, {}
+        from ..backend import JaxRoutedBackend
+
+        if isinstance(self.backend, JaxRoutedBackend):
+            be = self.backend
+        else:
+            if self._routed_backend is None:
+                self._routed_backend = JaxRoutedBackend(
+                    dtype=getattr(self.backend, "dtype", None))
+            be = self._routed_backend
+        op = self._routed_operator(n, src, dst, val, valid)
+        return be, {"operator": op}
 
     def refresh(self, force_cold: bool = False) -> ScoreTable:
         """Converge the current graph and publish; returns the table
@@ -118,25 +237,31 @@ class ScoreRefresher:
         if not cold:
             from ..ops.converge import warm_start_scores
 
+            # node-order warm vector; the routed backend translates it
+            # to state-slot order via the operator's scores_from_nodes
             s0 = warm_start_scores(self.table.scores, n, valid,
                                    self.config.initial_score)
         self.faults.check("device")
+        backend, extra = self._converge_call(n, src, dst, val, valid)
         with trace.span("service.refresh", n=n, edges=len(src),
                         cold=cold):
-            scores, iters, delta = self.backend.converge_edges(
+            scores, iters, delta = backend.converge_edges(
                 n, src, dst, val, valid, self.config.initial_score,
                 self.config.max_iterations, tol=self.config.tol,
-                alpha=self.config.alpha, s0=s0)
+                alpha=self.config.alpha, s0=s0, **extra)
         if not cold and (delta > self.config.tol
                          or not np.isfinite(scores).all()):
             # warm start failed to converge inside the budget (graph
-            # drifted further than the bound assumed): re-anchor cold
+            # drifted further than the bound assumed): re-anchor cold.
+            # The routed fallback reuses the operator just built/loaded
+            # — a cache hit, not a second compilation.
+            backend, extra = self._converge_call(n, src, dst, val, valid)
             with trace.span("service.refresh", n=n, edges=len(src),
                             cold=True, fallback=True):
-                scores, iters, delta = self.backend.converge_edges(
+                scores, iters, delta = backend.converge_edges(
                     n, src, dst, val, valid, self.config.initial_score,
                     self.config.max_iterations, tol=self.config.tol,
-                    alpha=self.config.alpha)
+                    alpha=self.config.alpha, **extra)
             cold = True
 
         self.refreshes += 1
@@ -152,6 +277,8 @@ class ScoreRefresher:
         trace.metric("service.refresh_cold_total", self.cold_refreshes)
         trace.metric("service.refresh_iterations", int(iters))
         trace.metric("service.refresh_delta", float(delta))
+        trace.metric("service.operator_cache_hits", self.operator_hits)
+        trace.metric("service.operator_builds", self.operator_builds)
         return self.table
 
     def run(self, stop_event, dirty_event, refresh_interval: float) -> None:

@@ -109,6 +109,20 @@ class OpinionGraph:
         with self._lock:
             self.edits_since_cold = 0
 
+    # --- durability (protocol_tpu.store snapshots) ------------------------
+    def restore_state(self, addrs, edges, revision: int,
+                      edits_since_cold: int, invalid: int = 0) -> None:
+        """Adopt a snapshot's cut wholesale (restart path). Interning
+        order is reproduced exactly, so ids — and therefore any restored
+        score vector — keep their meaning."""
+        with self._lock:
+            self._addrs = list(addrs)
+            self._ids = {a: i for i, a in enumerate(self._addrs)}
+            self._edges = dict(edges)
+            self.revision = int(revision)
+            self.edits_since_cold = int(edits_since_cold)
+            self.invalid = int(invalid)
+
     # --- snapshots --------------------------------------------------------
     @property
     def n(self) -> int:

@@ -75,9 +75,11 @@ class ChainTailer:
                  faults: FaultInjector | None = None,
                  backoff_base: float = 0.5, backoff_max: float = 30.0):
         """``chain``: any AttestationStation (RpcChain, LocalChain, …);
-        ``sink(attestations, block)``: called with each decoded batch —
-        must complete (or raise) before the cursor advances;
-        ``checkpoints``: a CheckpointManager for cursor durability."""
+        ``sink(attestations, block, blocks)``: called with each decoded
+        batch, the top block of the poll, and the per-attestation block
+        numbers (the WAL records them) — must complete (or raise)
+        before the cursor advances; ``checkpoints``: a
+        CheckpointManager for cursor durability."""
         if len(domain) != 20:
             raise EigenError("config_error", "domain must be 20 bytes")
         self.chain = chain
@@ -120,6 +122,7 @@ class ChainTailer:
             return 0
         expected_key = DOMAIN_PREFIX + self.domain
         batch = []
+        blocks = []
         top = self.cursor
         for log in logs:
             top = max(top, log.block_number)
@@ -128,10 +131,11 @@ class ChainTailer:
             try:
                 batch.append(SignedAttestationData.from_log(
                     log.about, log.key, log.val))
+                blocks.append(log.block_number)
             except EigenError:
                 self.skipped += 1
         if batch:
-            self.sink(batch, top)
+            self.sink(batch, top, blocks)
             self.batches += 1
             self.attestations += len(batch)
         # blocks with only foreign/undecodable logs still advance the

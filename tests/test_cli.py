@@ -31,8 +31,11 @@ def test_parser_accepts_all_verbs():
         ("kzg-params", ["--k", "10"]),
         ("local-scores", []),
         ("scores", ["--backend", "jax"]),
-        ("serve", ["--port", "0", "--poll-interval", "0.5"]),
+        ("serve", ["--port", "0", "--poll-interval", "0.5",
+                   "--state-dir", "svc-state"]),
         ("show", []),
+        ("store", ["inspect"]),
+        ("store", ["compact", "--state-dir", "svc-state"]),
         ("th-proof", ["--peer", "0xaa", "--threshold", "500"]),
         ("th-proving-key", []),
         ("th-verify", []),
@@ -121,6 +124,60 @@ def test_bandada_threshold_gate(tmp_path, capsys, monkeypatch):
     )
     assert code == 1
     assert "below band threshold" in capsys.readouterr().err
+
+
+def test_store_inspect_and_compact(tmp_path, capsys, monkeypatch):
+    """The store maintenance verbs over a WAL of REAL signed
+    attestations: inspect summarizes it, compact folds the re-attested
+    duplicate by recovered (signer, about) down to the latest record."""
+    import json
+
+    from protocol_tpu.client.chain import LocalChain
+    from protocol_tpu.client.eth import ecdsa_keypairs_from_mnemonic
+    from protocol_tpu.cli.fs import INSECURE_MNEMONIC
+    from protocol_tpu.store import AttestationWAL
+
+    m2 = ("legal winner thank year wave sausage worth useful legal "
+          "winner thank yellow")
+    addr1 = ecdsa_keypairs_from_mnemonic(
+        INSECURE_MNEMONIC, 1)[0].public_key.to_address_bytes()
+    addr2 = ecdsa_keypairs_from_mnemonic(
+        m2, 1)[0].public_key.to_address_bytes()
+    # peer1 attests peer2 TWICE (latest-wins duplicate), peer2 once
+    assert run(tmp_path, "attest", "--to", "0x" + addr2.hex(),
+               "--score", "10") == 0
+    assert run(tmp_path, "attest", "--to", "0x" + addr2.hex(),
+               "--score", "7") == 0
+    monkeypatch.setenv("MNEMONIC", m2)
+    assert run(tmp_path, "attest", "--to", "0x" + addr1.hex(),
+               "--score", "9") == 0
+    monkeypatch.delenv("MNEMONIC")
+
+    # build the WAL the way the daemon's sink would, from the chain log
+    with open(tmp_path / "chain.json") as f:
+        chain = LocalChain.from_json(json.load(f))
+    logs = chain.get_logs(0)
+    wal = AttestationWAL(str(tmp_path / "service-state" / "wal"))
+    wal.append([(log.block_number, log.about, log.val) for log in logs])
+    wal.close()
+
+    capsys.readouterr()
+    assert run(tmp_path, "store", "inspect") == 0
+    out = capsys.readouterr().out
+    assert "3 intact record(s)" in out
+    assert "snapshots: none" in out
+
+    assert run(tmp_path, "store", "compact") == 0
+    out = capsys.readouterr().out
+    assert "3 record(s) -> 2" in out
+
+    ro = AttestationWAL(str(tmp_path / "service-state" / "wal"),
+                        readonly=True)
+    records = list(ro.replay())
+    assert len(records) == 2
+    # the surviving (peer1 -> peer2) record carries the LATEST value (7)
+    vals = {about: payload[65] for _, about, payload in records}
+    assert vals[addr2] == 7 and vals[addr1] == 9
 
 
 def test_kzg_params_writes_artifact(tmp_path):

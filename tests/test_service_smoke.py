@@ -24,3 +24,20 @@ def test_serve_smoke_tool():
         f"serve_smoke failed\nstdout:\n{proc.stdout}\n"
         f"stderr:\n{proc.stderr}")
     assert "SERVE_SMOKE_OK" in proc.stdout
+
+
+@pytest.mark.slow
+def test_serve_smoke_restart():
+    """The kill-restart durability phase (two real CLI daemons, SIGKILL
+    + replay + oracle re-check) — slow-marked: it boots two full jax
+    processes; ``tools/check.sh`` runs it on every one-command check."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "serve_smoke.py"),
+         "--restart"],
+        capture_output=True, text=True, timeout=560, env=env, cwd=REPO)
+    assert proc.returncode == 0, (
+        f"serve_smoke --restart failed\nstdout:\n{proc.stdout}\n"
+        f"stderr:\n{proc.stderr}")
+    assert "SERVE_SMOKE_OK" in proc.stdout
+    assert "drained cleanly" in proc.stdout

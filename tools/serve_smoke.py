@@ -6,14 +6,28 @@ The one-command liveness check for ``protocol_tpu.service`` (CI hook:
 
 1. start an in-repo mock devnet (``client/mocknode.py``) and deploy the
    real AttestationStation bytecode,
-2. start the service (ephemeral port) with its SIGTERM handler
-   installed — the same wiring the ``serve`` CLI verb uses,
+2. start the service (ephemeral port, durable state dir) with its
+   SIGTERM handler installed — the same wiring the ``serve`` CLI verb
+   uses,
 3. submit signed attestations over raw JSON-RPC transactions,
 4. poll ``GET /score/<addr>`` until the scores reflect them and match
    the batch ``local-scores`` oracle,
 5. assert ``GET /metrics`` serves non-empty Prometheus text with the
-   service counters,
+   service counters AND the store gauges (``store_snapshot_age_seconds``,
+   ``store_wal_segments``, ``store_wal_bytes``),
 6. ``kill -TERM $$`` and verify the drain completes cleanly.
+
+``--restart`` adds the kill-restart durability phase, driving the REAL
+CLI daemon as a subprocess:
+
+7. spawn ``python -m protocol_tpu.cli serve --state-dir ...`` against
+   the same devnet with ``PTPU_FAULT_DISK`` active, attest, wait until
+   the served scores match the batch oracle,
+8. SIGKILL it mid-tail, attest more while it is down,
+9. restart on the same state dir (faults off) and assert the full score
+   table matches the oracle again WITHOUT re-fetching pre-cursor blocks
+   (the ingest counter stays at the catch-up delta), then SIGTERM and
+   expect a clean exit.
 
 Exit code 0 = all of the above held.
 """
@@ -26,53 +40,53 @@ import time
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
 
+MNEMONIC = ("test test test test test test test test test test test "
+            "junk")
 
-def main() -> int:
-    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+def _get_json(url, path):
     import json
     import urllib.request
 
+    with urllib.request.urlopen(url + path, timeout=10) as r:
+        body = r.read()
+    return json.loads(body) if path != "/metrics" else body.decode()
+
+
+def _metric_value(metrics_text, name):
+    for line in metrics_text.splitlines():
+        if line.startswith(name + " "):
+            return float(line.split()[1])
+    return None
+
+
+def inprocess_phase(node_url, chain, step) -> None:
+    import tempfile
+
     from protocol_tpu.client import Client, ClientConfig
-    from protocol_tpu.client.chain import RpcChain
     from protocol_tpu.client.eth import (
         address_from_public_key,
         ecdsa_keypairs_from_mnemonic,
     )
-    from protocol_tpu.client.mocknode import MockNode
     from protocol_tpu.service import FaultInjector, ServiceConfig, TrustService
-
-    mnemonic = ("test test test test test test test test test test test "
-                "junk")
-    t0 = time.monotonic()
-
-    def step(msg):
-        print(f"[{time.monotonic() - t0:6.1f}s] {msg}", flush=True)
-
-    node = MockNode()
-    node_url = node.start()
-    step(f"mock devnet at {node_url}")
-    deployer = ecdsa_keypairs_from_mnemonic(mnemonic, 1)[0]
-    chain = RpcChain.deploy_signed(node_url, deployer)
-    step(f"AttestationStation at 0x{chain.contract_address.hex()}")
 
     config = ClientConfig(as_address="0x" + chain.contract_address.hex(),
                           node_url=node_url, domain="0x" + "00" * 20)
-    client = Client(config, mnemonic)
-    import tempfile
-
+    client = Client(config, MNEMONIC)
     with tempfile.TemporaryDirectory(prefix="ptpu-smoke-") as tmp:
         service = TrustService(
             client, ServiceConfig(port=0, poll_interval=0.1,
                                   refresh_interval=0.1, tol=1e-10,
-                                  drain_timeout=15.0),
+                                  snapshot_every=2, drain_timeout=15.0),
             os.path.join(tmp, "cursor"),
             provers={"noop": lambda p: {"ok": True}},
-            faults=FaultInjector({"rpc": 0.0, "device": 0.0}))
+            faults=FaultInjector({"rpc": 0.0, "device": 0.0, "disk": 0.0}),
+            state_dir=os.path.join(tmp, "state"))
         url = service.start()
         service.install_signal_handlers()
-        step(f"service at {url}")
+        step(f"service at {url} (state dir: {tmp}/state)")
 
-        kps = ecdsa_keypairs_from_mnemonic(mnemonic, 2)
+        kps = ecdsa_keypairs_from_mnemonic(MNEMONIC, 2)
         addrs = [address_from_public_key(kp.public_key) for kp in kps]
         for i, values in ((0, 7), (1, 9)):
             client.keypairs[0] = kps[i]
@@ -84,44 +98,208 @@ def main() -> int:
                   for s in client.calculate_scores(
                       client.get_attestations())}
 
-        def get(path):
-            with urllib.request.urlopen(url + path, timeout=10) as r:
-                body = r.read()
-            return (json.loads(body) if path != "/metrics"
-                    else body.decode())
-
         deadline = time.monotonic() + 120
         scored = None
         while time.monotonic() < deadline:
             try:
-                scored = get(f"/score/0x{addrs[0].hex()}")
+                scored = _get_json(url, f"/score/0x{addrs[0].hex()}")
                 break
-            except urllib.error.HTTPError:
+            except Exception:
                 time.sleep(0.2)
         assert scored is not None, "score never appeared over HTTP"
         for addr in addrs:
-            got = get(f"/score/0x{addr.hex()}")["score"]
+            got = _get_json(url, f"/score/0x{addr.hex()}")["score"]
             ref = oracle[addr]
             assert abs(got - ref) <= 1e-3 * max(abs(ref), 1.0), \
                 f"0x{addr.hex()}: served {got} vs oracle {ref}"
         step(f"scores match the local-scores oracle ({oracle})")
 
-        metrics = get("/metrics")
+        metrics = _get_json(url, "/metrics")
         assert metrics.strip(), "/metrics is empty"
         for needle in ("ptpu_service_ingest_attestations",
                        "ptpu_service_refresh_total",
-                       "ptpu_service_block_cursor"):
+                       "ptpu_service_block_cursor",
+                       "ptpu_store_snapshot_age_seconds",
+                       "ptpu_store_wal_segments",
+                       "ptpu_store_wal_bytes"):
             assert needle in metrics, f"/metrics missing {needle}"
-        health = get("/healthz")
+        assert _metric_value(metrics, "ptpu_store_wal_segments") >= 1
+        assert _metric_value(metrics, "ptpu_store_wal_bytes") > 0
+        health = _get_json(url, "/healthz")
         assert health["ok"] and health["peers"] == 2
+        assert health["store"]["wal_segments"] >= 1
         step(f"/metrics ok ({len(metrics.splitlines())} lines), "
-             f"cursor={health['block_cursor']}")
+             f"cursor={health['block_cursor']}, "
+             f"wal_bytes={_metric_value(metrics, 'ptpu_store_wal_bytes')}")
 
         os.kill(os.getpid(), signal.SIGTERM)
         step("sent SIGTERM to self")
         service.wait()
         assert service.draining
         step("drain complete")
+
+
+def _spawn_daemon(assets, extra_env, step, tag):
+    """Start the real CLI serve verb; returns (proc, url, lines)."""
+    import re
+    import subprocess
+    import threading
+
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               PTPU_SERVE_REFRESH_INTERVAL="0.1", **extra_env)
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "protocol_tpu.cli", "--assets", assets,
+         "serve", "--port", "0", "--state-dir", "state",
+         "--poll-interval", "0.1"],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+        cwd=REPO, env=env)
+    lines = []
+
+    def reader():
+        for line in proc.stdout:
+            lines.append(line.rstrip())
+
+    threading.Thread(target=reader, daemon=True).start()
+    deadline = time.monotonic() + 180
+    url = None
+    while time.monotonic() < deadline and url is None:
+        for line in lines:
+            m = re.search(r"listening on (http://\S+)", line)
+            if m:
+                url = m.group(1)
+                break
+        if proc.poll() is not None:
+            raise AssertionError(
+                f"{tag} died at startup:\n" + "\n".join(lines))
+        time.sleep(0.1)
+    assert url is not None, f"{tag} never printed its URL:\n" + \
+        "\n".join(lines)
+    step(f"{tag} at {url}")
+    return proc, url, lines
+
+
+def restart_phase(node_url, chain, step) -> None:
+    import signal as _signal
+    import tempfile
+
+    from protocol_tpu.client import Client, ClientConfig
+    from protocol_tpu.client.eth import (
+        address_from_public_key,
+        ecdsa_keypairs_from_mnemonic,
+    )
+    from protocol_tpu.client.storage import JSONFileStorage
+
+    config = ClientConfig(as_address="0x" + chain.contract_address.hex(),
+                          node_url=node_url, domain="0x" + "00" * 20)
+    client = Client(config, MNEMONIC)
+    kps = ecdsa_keypairs_from_mnemonic(MNEMONIC, 3)
+    addrs = [address_from_public_key(kp.public_key) for kp in kps]
+
+    def oracle():
+        client.keypairs[0] = kps[0]
+        return {s.address: float(s.ratio)
+                for s in client.calculate_scores(client.get_attestations())}
+
+    def wait_for_oracle(url, tag):
+        ref = oracle()
+        deadline = time.monotonic() + 120
+        while time.monotonic() < deadline:
+            try:
+                ok = all(
+                    abs(_get_json(url, f"/score/0x{a.hex()}")["score"] - r)
+                    <= 1e-3 * max(abs(r), 1.0)
+                    for a, r in ref.items())
+                if ok:
+                    return ref
+            except Exception:
+                pass
+            time.sleep(0.2)
+        raise AssertionError(f"{tag}: scores never matched the oracle")
+
+    with tempfile.TemporaryDirectory(prefix="ptpu-smoke-cli-") as assets:
+        JSONFileStorage(os.path.join(assets, "config.json")).save(
+            config.to_dict())
+
+        # --- first daemon, disk faults ACTIVE -----------------------------
+        proc, url, lines = _spawn_daemon(
+            assets, {"PTPU_FAULT_DISK": "0.2", "PTPU_FAULT_SEED": "11",
+                     "PTPU_SERVE_SNAPSHOT_EVERY": "2"},
+            step, "daemon#1 (PTPU_FAULT_DISK=0.2)")
+        for i in range(3):
+            client.keypairs[0] = kps[i]
+            for j in range(3):
+                if i != j:
+                    client.attest(addrs[j], 4 + (i + 2 * j) % 5)
+        step("posted 6 attestations")
+        wait_for_oracle(url, "daemon#1")
+        metrics = _get_json(url, "/metrics")
+        assert _metric_value(metrics, "ptpu_store_wal_segments") >= 1
+        step("daemon#1 serves oracle scores despite injected disk faults")
+
+        # mid-tail SIGKILL: post more, kill without letting it settle
+        client.keypairs[0] = kps[0]
+        client.attest(addrs[1], 9)
+        client.keypairs[0] = kps[1]
+        client.attest(addrs[2], 3)
+        proc.kill()
+        proc.wait(timeout=30)
+        step("SIGKILLed daemon#1 mid-tail (2 attestations in flight)")
+
+        # --- second daemon, same state dir, faults OFF --------------------
+        proc2, url2, lines2 = _spawn_daemon(
+            assets, {}, step, "daemon#2 (restarted)")
+        wait_for_oracle(url2, "daemon#2")
+        metrics = _get_json(url2, "/metrics")
+        ingested = _metric_value(
+            metrics, "ptpu_service_ingest_attestations") or 0.0
+        # catch-up only: the 2 in-flight attestations (+ at most one
+        # refetched poll batch) — never the 6 pre-cursor ones
+        assert ingested <= 4, \
+            f"restart re-fetched pre-cursor blocks ({ingested} ingested)"
+        assert _metric_value(metrics, "ptpu_store_replayed_records") \
+            is not None
+        health = _get_json(url2, "/healthz")
+        assert health["peers"] == 3
+        step(f"daemon#2 matches the oracle after replay "
+             f"(ingested {int(ingested)} catch-up attestation(s), "
+             f"replayed {int(_metric_value(metrics, 'ptpu_store_replayed_records'))})")
+
+        proc2.send_signal(_signal.SIGTERM)
+        rc = proc2.wait(timeout=60)
+        assert rc == 0, \
+            f"daemon#2 did not drain cleanly (rc={rc}):\n" + \
+            "\n".join(lines2)
+        step("daemon#2 drained cleanly on SIGTERM")
+
+
+def main(argv=None) -> int:
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    argv = sys.argv[1:] if argv is None else argv
+    restart = "--restart" in argv
+
+    from protocol_tpu.client.chain import RpcChain
+    from protocol_tpu.client.eth import ecdsa_keypairs_from_mnemonic
+    from protocol_tpu.client.mocknode import MockNode
+
+    t0 = time.monotonic()
+
+    def step(msg):
+        print(f"[{time.monotonic() - t0:6.1f}s] {msg}", flush=True)
+
+    node = MockNode()
+    node_url = node.start()
+    step(f"mock devnet at {node_url}")
+    deployer = ecdsa_keypairs_from_mnemonic(MNEMONIC, 1)[0]
+    chain = RpcChain.deploy_signed(node_url, deployer)
+    step(f"AttestationStation at 0x{chain.contract_address.hex()}")
+
+    inprocess_phase(node_url, chain, step)
+    if restart:
+        # a fresh contract so phase 1's attestations don't bleed in
+        chain2 = RpcChain.deploy_signed(node_url, deployer)
+        step(f"restart phase: AttestationStation at "
+             f"0x{chain2.contract_address.hex()}")
+        restart_phase(node_url, chain2, step)
     node.stop()
     print("SERVE_SMOKE_OK", flush=True)
     return 0
