@@ -153,6 +153,19 @@ def build_parser() -> argparse.ArgumentParser:
                    help="block-cursor checkpoint directory "
                         "(default <state-dir>/cursor)")
 
+    p = sub.add_parser(
+        "obs",
+        help="observability tooling: validate a JSONL trace stream "
+             "(PROTOCOL_TPU_TRACE=<path> / --trace PATH / the serve "
+             "daemon's stream) and render its span-aggregate summary")
+    p.add_argument("path", help="JSONL trace stream to read")
+    p.add_argument("--follow", action="store_true",
+                   help="tail the stream, printing records as they land "
+                        "(Ctrl-C to stop)")
+    p.add_argument("--trace-id", dest="trace_id",
+                   help="print the span/event chain for one trace id "
+                        "(attestation digest prefix, job id, request id)")
+
     sub.add_parser("show", help="print the current config")
 
     p = sub.add_parser(
@@ -823,6 +836,117 @@ def handle_serve(args, files, config):
     return 1
 
 
+def handle_obs(args, files, config):
+    """Offline observability: parse + validate a JSONL trace stream
+    (the ``PROTOCOL_TPU_TRACE`` / ``serve`` daemon output), render the
+    span-aggregate summary table, optionally follow the stream or print
+    one trace id's end-to-end chain. Exit 1 when invalid records were
+    seen — the stream is a machine-readable contract, not best-effort
+    logging."""
+    import time as _time
+
+    from ..utils.trace import validate_record
+
+    def parse(line, lineno, invalid):
+        line = line.strip()
+        if not line:
+            return None
+        try:
+            obj = json.loads(line)
+        except ValueError:
+            invalid.append(f"line {lineno}: not JSON")
+            return None
+        err = validate_record(obj)
+        if err is not None:
+            invalid.append(f"line {lineno}: {err}")
+            return None
+        return obj
+
+    def matches(obj, trace_id):
+        return (obj.get("trace_id") == trace_id
+                or trace_id in (obj.get("trace_ids") or ()))
+
+    invalid: list = []
+    agg: dict = {}
+    counts = {"span": 0, "event": 0, "metric": 0}
+    chain: list = []
+    try:
+        f = open(args.path)
+    except OSError as e:
+        raise EigenError("file_io_error",
+                         f"cannot open trace stream: {e}") from e
+    with f:
+        lineno = 0
+        for line in f:
+            lineno += 1
+            obj = parse(line, lineno, invalid)
+            if obj is None:
+                continue
+            counts[obj["type"]] += 1
+            if obj["type"] == "span":
+                a = agg.setdefault(obj["name"],
+                                   {"count": 0, "total_s": 0.0,
+                                    "max_s": 0.0})
+                a["count"] += 1
+                a["total_s"] += obj["duration_s"]
+                a["max_s"] = max(a["max_s"], obj["duration_s"])
+            if args.trace_id and matches(obj, args.trace_id):
+                chain.append(obj)
+
+        print(f"{args.path}: {counts['span']} span(s), "
+              f"{counts['event']} event(s), {counts['metric']} "
+              f"metric(s), {len(invalid)} invalid record(s)")
+        for msg in invalid[:20]:
+            print(f"  invalid: {msg}", file=sys.stderr)
+        if agg:
+            width = max(len(n) for n in agg)
+            print(f"{'span':<{width}}  {'n':>8}  {'total_s':>10}  "
+                  f"{'mean_ms':>9}  {'max_s':>9}")
+            for name, a in sorted(agg.items(),
+                                  key=lambda kv: -kv[1]["total_s"]):
+                mean_ms = 1000.0 * a["total_s"] / a["count"]
+                print(f"{name:<{width}}  {a['count']:>8}  "
+                      f"{a['total_s']:>10.3f}  {mean_ms:>9.3f}  "
+                      f"{a['max_s']:>9.3f}")
+        if args.trace_id:
+            print(f"\ntrace {args.trace_id}: {len(chain)} record(s)")
+            for obj in sorted(chain, key=lambda o: o.get("ts", 0.0)):
+                dur = (f" {obj['duration_s'] * 1000:.3f}ms"
+                       if obj["type"] == "span" else "")
+                ids = ""
+                if obj["type"] == "span":
+                    ids = (f" span={obj.get('span_id', '?')}"
+                           + (f" parent={obj['parent_id']}"
+                              if obj.get("parent_id") else ""))
+                print(f"  {obj.get('ts', 0.0):.6f} {obj['type']:<6} "
+                      f"{obj['name']}{dur}{ids}")
+
+        if args.follow:
+            print("following (Ctrl-C to stop)...", file=sys.stderr)
+            try:
+                while True:
+                    line = f.readline()
+                    if not line:
+                        _time.sleep(0.2)
+                        continue
+                    lineno += 1
+                    if not line.strip():
+                        continue  # blank: skipped, not invalid
+                    before = len(invalid)
+                    obj = parse(line, lineno, invalid)
+                    if obj is None:
+                        if len(invalid) > before:
+                            print(f"  invalid: {invalid[-1]}",
+                                  file=sys.stderr)
+                        continue
+                    if args.trace_id and not matches(obj, args.trace_id):
+                        continue
+                    print(json.dumps(obj), flush=True)
+            except KeyboardInterrupt:
+                pass
+    return 1 if invalid else 0
+
+
 def handle_store(args, files, config):
     """Offline maintenance of the serve daemon's state store: a
     human-readable summary (``inspect``) and latest-wins WAL compaction
@@ -921,6 +1045,7 @@ HANDLERS = {
     "et-proving-key": handle_et_pk,
     "et-verify": handle_et_verify,
     "kzg-params": handle_kzg_params,
+    "obs": handle_obs,
     "show": handle_show,
     "sparse-scores": handle_sparse_scores,
     "store": handle_store,

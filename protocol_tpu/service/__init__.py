@@ -28,8 +28,10 @@ Components (one file each):
   re-pay device init.
 - ``http_api.py`` — stdlib ``http.server`` API: GET /scores,
   GET /score/<addr>, POST /proofs, GET /proofs/<id>,
-  GET /proofs/<id>/proof.bin, GET /healthz, GET /metrics (Prometheus
-  text from ``utils/trace.py``).
+  GET /proofs/<id>/proof.bin, GET /healthz, GET /status (operator
+  JSON: uptime, cursor, freshness, queue, last refresh), GET /metrics
+  (Prometheus text from ``utils/trace.py`` typed instruments), with
+  per-request trace ids and a per-route latency histogram.
 - :class:`TrustService` (``daemon.py``) — the supervisor: threads,
   SIGTERM graceful drain, fault-injection seam (``faults.py``,
   including ``PTPU_FAULT_DISK`` torn-write/fsync injection), and —

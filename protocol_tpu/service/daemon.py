@@ -38,7 +38,6 @@ so even a SIGKILL loses at most one poll's worth of re-fetchable logs.
 
 from __future__ import annotations
 
-import hashlib
 import threading
 import time
 
@@ -50,19 +49,13 @@ from .config import ServiceConfig
 from .faults import FaultInjector
 from .jobs import ProofJobQueue
 from .refresh import ScoreRefresher, ScoreTable
-from .state import OpinionGraph, recover_signers
+from .state import OpinionGraph, att_digest, recover_signers, trace_id_of
 from .tailer import ChainTailer
 
-
-def _att_digest(block: int, about: bytes, payload: bytes) -> bytes:
-    """Identity of one signed attestation AS LOGGED — block + about +
-    normalized payload. The dedup key makes WAL replay + cursor refetch
-    overlap harmless; the block number MUST be part of it because
-    deterministic (RFC 6979) signing makes a re-attestation of a
-    previously-seen value byte-identical in payload — only its block
-    distinguishes the genuine latest-wins revert from a refetch."""
-    return hashlib.sha256(block.to_bytes(8, "little") + about
-                          + payload).digest()
+# the dedup key (see state.att_digest: block + about + normalized
+# payload — the block matters because RFC 6979 re-attestations are
+# byte-identical in payload)
+_att_digest = att_digest
 
 
 class TrustService:
@@ -99,16 +92,29 @@ class TrustService:
                 fsync=config.wal_fsync, snapshot_keep=config.snapshot_keep,
                 faults=self.faults, proofs_dir=proofs_dir)
         self.graph = OpinionGraph()
+        # trace join seam: the sink records each applied attestation's
+        # trace id at its graph revision; the refresher takes everything
+        # at-or-below the revision it publishes, stamping the refresh
+        # span that first reflects those attestations in served scores
+        self.pending_traces = trace.PendingTraces()
         self.refresher = ScoreRefresher(
             self.graph, config, backend=backend, faults=self.faults,
             operator_cache_dir=(self.store.operators_dir
-                                if self.store else None))
+                                if self.store else None),
+            pending_traces=self.pending_traces)
         self._attestations: list = []
         self._att_blocks: list = []  # parallel: block number per entry
         # (snapshots persist them so restart dedup keys stay exact)
         self._att_lock = threading.Lock()
         self._seen: set = set()
         self._edits_since_snapshot = 0
+        # freshness tracking: (graph revision after apply, wall-clock of
+        # the newest attestation in that batch). score_freshness_seconds
+        # = now − the newest timestamp whose revision the published
+        # table covers — the end-to-end ingest→served-scores lag
+        self._fresh_lock = threading.Lock()
+        self._fresh_pending: list = []
+        self._fresh_anchor: float | None = None
         if self.store is not None:
             self._restore()
         self.tailer = ChainTailer(
@@ -278,13 +284,24 @@ class TrustService:
             self._attestations.extend(batch)
             if self.store is not None:
                 self._att_blocks.extend(blk for _, _, _, _, blk in fresh)
-        changed = self.graph.apply(batch, signers)
+        with trace.span("service.graph_apply", n=len(batch), block=block):
+            changed = self.graph.apply(batch, signers)
         if self.store is not None:
             # marked seen only now: if recovery/apply had failed after
             # the append, the refetched batch must NOT be deduped away —
             # it re-appends (replay folds the duplicate) and re-applies
             for _, digest, _, _, _ in fresh:
                 self._seen.add(digest)
+            tids = [trace_id_of(digest) for _, digest, _, _, _ in fresh]
+        else:
+            # memory-only: the tailer's context carries the batch ids
+            tids = list(trace.current_trace_ids())
+        if tids:
+            self.pending_traces.add(self.graph.revision, tids)
+        with self._fresh_lock:
+            self._fresh_pending.append((self.graph.revision, time.time()))
+            if len(self._fresh_pending) > 4096:
+                del self._fresh_pending[0]
         self._dirty.set()
         if self.store is not None and changed:
             self._edits_since_snapshot += changed
@@ -315,6 +332,77 @@ class TrustService:
             return None
 
     # --- introspection ----------------------------------------------------
+    def score_freshness_seconds(self) -> float:
+        """Now − arrival time of the newest attestation REFLECTED in the
+        served score table (the chain clients carry no block timestamps,
+        so sink wall-clock is the block-time proxy): the end-to-end
+        ingest→refresh→served lag. -1.0 until the first attestation is
+        both ingested and published (the gauge is always present but
+        clearly 'never')."""
+        revision = self.refresher.table.revision
+        now = time.time()
+        with self._fresh_lock:
+            while (self._fresh_pending
+                   and self._fresh_pending[0][0] <= revision):
+                self._fresh_anchor = self._fresh_pending.pop(0)[1]
+            if self._fresh_anchor is None:
+                return -1.0
+            return now - self._fresh_anchor
+
+    def status(self) -> dict:
+        """``GET /status``: one JSON page an operator (or a dashboard's
+        sidecar) reads instead of joining five /metrics series —
+        uptime, cursor position, graph size, score freshness, queue
+        depths, and the last refresh's convergence stats."""
+        table = self.refresher.table
+        out = {
+            "ok": True,
+            "draining": self.draining,
+            "uptime_seconds": (time.time() - self.started_at
+                               if self.started_at else 0.0),
+            "block_cursor": self.tailer.cursor,
+            "tailer": {
+                "batches": self.tailer.batches,
+                "attestations": self.tailer.attestations,
+                "skipped": self.tailer.skipped,
+                "retries": self.tailer.retries,
+                "consecutive_failures": self.tailer.consecutive_failures,
+            },
+            "graph": {
+                "peers": self.graph.n,
+                "edges": self.graph.n_edges,
+                "revision": self.graph.revision,
+                "invalid_attestations": self.graph.invalid,
+            },
+            "score_freshness_seconds": self.score_freshness_seconds(),
+            "last_refresh": {
+                "revision": table.revision,
+                "iterations": table.iterations,
+                "delta": table.delta,
+                "cold": table.cold,
+                "computed_at": table.computed_at,
+                "refreshes": self.refresher.refreshes,
+                "cold_refreshes": self.refresher.cold_refreshes,
+            },
+            "queue": {
+                "depth": self.jobs.depth(),
+                "completed": self.jobs.completed,
+                "failed": self.jobs.failed,
+            },
+        }
+        if self.store is not None:
+            wal = self.store.wal.stats()
+            out["store"] = {
+                "wal_segments": wal["segments"],
+                "wal_bytes": wal["bytes"],
+                "snapshots": self.store.snapshots.count(),
+                "snapshot_age_seconds":
+                    self.store.snapshots.age_seconds(),
+                "replayed_records": self.store.replayed_records,
+                "proof_artifacts": self.store.artifacts.count(),
+            }
+        return out
+
     def health(self) -> dict:
         table = self.refresher.table
         out = {
@@ -343,6 +431,10 @@ class TrustService:
     def extra_metrics(self) -> dict:
         """Service-local gauges merged into /metrics (things the tracer
         does not carry because they are state, not samples)."""
+        # refreshed per scrape: the typed gauge is what dashboards
+        # alert on (ptpu_score_freshness_seconds)
+        trace.gauge("score_freshness_seconds").set(
+            self.score_freshness_seconds())
         out = {
             "service.up": 0.0 if self.draining else 1.0,
             "service.queue_depth": float(self.jobs.depth()),

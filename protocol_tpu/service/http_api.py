@@ -6,6 +6,8 @@ Same dependency posture as the mock devnet (``client/mocknode.py``): a
 Routes:
 
 - ``GET /healthz``        liveness + cursor/peer/queue/store gauges
+- ``GET /status``         operator JSON: uptime, cursor, graph size,
+  score freshness, queue depths, last refresh stats, store summary
 - ``GET /scores``         the full published score table (JSON)
 - ``GET /score/<addr>``   one peer's score (404 before first sighting)
 - ``POST /proofs``        submit a proof job ``{"kind", "params"}`` →
@@ -17,6 +19,12 @@ Routes:
   artifact file, served from the proof artifact store
 - ``GET /metrics``        Prometheus text (``service/metrics.py``)
 
+Middleware (every request): a per-request trace id (``X-Request-Id``
+response header, ``trace_id`` on the request span in the JSONL stream)
+and a ``ptpu_http_request_seconds`` latency histogram labeled by route
+template + status — route templates, not raw paths, so the label
+cardinality is the route table's, not the address space's.
+
 GETs are lock-free against the hot path: the score table is an
 immutable object swapped by the refresher, so a read races at worst
 into the previous table, never a torn one.
@@ -25,8 +33,10 @@ into the previous table, never a torn one.
 from __future__ import annotations
 
 import json
+import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
+from ..utils import trace
 from ..utils.errors import EigenError
 from .jobs import QueueFullError
 from .metrics import render_prometheus
@@ -40,11 +50,30 @@ def _parse_address(text: str) -> bytes | None:
     return raw if len(raw) == 20 else None
 
 
+def _route_template(method: str, path: str) -> str:
+    """Stable-cardinality route label: the template, never the raw
+    path (addresses and job ids would explode the label space)."""
+    if path in ("/healthz", "/status", "/scores", "/metrics"):
+        return path
+    if path.startswith("/score/"):
+        return "/score/{addr}"
+    if path.startswith("/proofs/") and path.endswith("/proof.bin"):
+        return "/proofs/{id}/proof.bin"
+    if path.startswith("/proofs/"):
+        return "/proofs/{id}"
+    if path == "/proofs" and method == "POST":
+        return "/proofs"
+    return "other"
+
+
 def make_server(service, host: str, port: int) -> ThreadingHTTPServer:
     """Bind (not start) the API server for ``service``; ``port=0``
     picks an ephemeral port (``server_address[1]`` has the real one)."""
 
     class Handler(BaseHTTPRequestHandler):
+        _status = 0
+        _request_id = None
+
         def _reply(self, status: int, obj, content_type="application/json"):
             if isinstance(obj, bytes):
                 body = obj
@@ -52,17 +81,42 @@ def make_server(service, host: str, port: int) -> ThreadingHTTPServer:
                 body = json.dumps(obj).encode()
             else:
                 body = obj.encode()
+            self._status = status
             self.send_response(status)
             self.send_header("Content-Type", content_type)
             self.send_header("Content-Length", str(len(body)))
+            if self._request_id:
+                self.send_header("X-Request-Id", self._request_id)
             self.end_headers()
             self.wfile.write(body)
 
+        def _instrumented(self, method: str, handler) -> None:
+            """Per-request middleware: assign the request id, bind it as
+            the trace context, time the handler, record the
+            route/status latency histogram."""
+            path = self.path.split("?", 1)[0].rstrip("/") or "/"
+            route = _route_template(method, path)
+            self._request_id = f"req-{trace.new_id()}"
+            t0 = time.perf_counter()
+            try:
+                with trace.context(trace_id=self._request_id):
+                    with trace.span("service.http", method=method,
+                                    route=route):
+                        handler(path)
+            finally:
+                trace.histogram("http_request_seconds").observe(
+                    time.perf_counter() - t0, endpoint=route,
+                    status=str(self._status or 500))
+
         # --- GET ----------------------------------------------------------
         def do_GET(self):  # noqa: N802 - BaseHTTPRequestHandler API
-            path = self.path.split("?", 1)[0].rstrip("/") or "/"
+            self._instrumented("GET", self._handle_get)
+
+        def _handle_get(self, path: str):
             if path == "/healthz":
                 return self._reply(200, service.health())
+            if path == "/status":
+                return self._reply(200, service.status())
             if path == "/metrics":
                 return self._reply(
                     200, render_prometheus(service.extra_metrics()),
@@ -113,7 +167,9 @@ def make_server(service, host: str, port: int) -> ThreadingHTTPServer:
 
         # --- POST ---------------------------------------------------------
         def do_POST(self):  # noqa: N802
-            path = self.path.split("?", 1)[0].rstrip("/")
+            self._instrumented("POST", self._handle_post)
+
+        def _handle_post(self, path: str):
             if path != "/proofs":
                 return self._reply(404, {"error": f"no route {path}"})
             try:

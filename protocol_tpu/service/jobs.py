@@ -167,6 +167,8 @@ class ProofJobQueue:
             self._pending.append(job)
             self._wake.notify()
             trace.metric("service.proof_queue_depth", len(self._pending))
+            trace.event("service.job_submitted", trace_id=job.job_id,
+                        kind=kind, depth=len(self._pending))
             return job
 
     def get(self, job_id: str) -> ProofJob | None:
@@ -228,10 +230,17 @@ class ProofJobQueue:
                 job = self._pending.popleft()
                 job.status = "running"
                 job.started_at = time.time()
+            # queue wait vs prove time: the two halves of a client's
+            # submit→done latency a single total would conflate
+            trace.histogram("proof_wait_seconds").observe(
+                job.started_at - job.submitted_at, kind=job.kind)
             try:
                 self.faults.check("device")
-                with trace.span("service.proof", kind=job.kind):
-                    result = self.provers[job.kind](job.params)
+                # the job id IS the trace id: /proofs/<id> polls and
+                # the JSONL stream join on the same string
+                with trace.context(trace_id=job.job_id):
+                    with trace.span("service.proof", kind=job.kind):
+                        result = self.provers[job.kind](job.params)
                 job.result = result
                 job.status = "done"
                 self.completed += 1
@@ -242,6 +251,9 @@ class ProofJobQueue:
                 self.failed += 1
             finally:
                 job.finished_at = time.time()
+                trace.histogram("proof_run_seconds").observe(
+                    job.finished_at - job.started_at, kind=job.kind,
+                    status=job.status)
                 if self.artifacts is not None:
                     # best-effort: persist() counts its own failures
                     # (injected disk faults included) and never raises —

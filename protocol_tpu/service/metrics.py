@@ -1,18 +1,34 @@
-"""Prometheus text rendering of ``utils/trace.py`` counters + spans.
+"""Prometheus text rendering of ``utils/trace.py`` instruments + spans.
 
 The tracer is the repo's single observability sink (every hot path
 already emits spans/metrics into it); the service turns it outward:
 ``GET /metrics`` serves the text exposition format (version 0.0.4 — the
 one every Prometheus scraper speaks) rendered from
 
-- ``TRACER.metrics_latest()`` → one gauge per metric name
-  (``service.block_cursor`` → ``ptpu_service_block_cursor``), and
-- ``TRACER.summary()`` → per-span-name ``_count`` / ``_seconds_total``
-  / ``_seconds_max`` series with the span name as a label (stable
-  cardinality: span names are static strings in code).
+- the tracer's **typed instruments** — ``counter`` (``_total`` suffix,
+  ``# TYPE counter``), ``gauge``, and ``histogram``
+  (``_bucket``/``_sum``/``_count`` with cumulative ``le`` buckets) —
+  all label-aware;
+- ``TRACER.metrics_latest()`` → one series per legacy scalar metric
+  (``service.block_cursor`` → ``ptpu_service_block_cursor``).
+  Monotonic legacy series (ingest/refresh/proof/retry counts) are
+  rendered as REAL counters with a ``_total`` suffix; the old
+  gauge-typed names are kept for one release as deprecated aliases so
+  existing dashboards keep scraping;
+- ``TRACER.summary()`` → per-span-name ``ptpu_span_total`` (counter) /
+  ``ptpu_span_seconds_total`` (counter) / ``ptpu_span_seconds_max``
+  (gauge) series with the span name as a label (stable cardinality:
+  span names are static strings in code). ``ptpu_span_count`` remains
+  as the deprecated gauge alias of ``ptpu_span_total``.
 
 Metric names are sanitized to the Prometheus grammar
-``[a-zA-Z_:][a-zA-Z0-9_:]*`` — dots become underscores.
+``[a-zA-Z_:][a-zA-Z0-9_:]*`` — dots become underscores. Label values
+are escaped per the exposition format (backslash, quote, newline).
+
+``lint_exposition`` is the matching pure-python validator —
+``tools/serve_smoke.py`` (and through it ``tools/check.sh``) runs it
+against a live ``/metrics`` page so a malformed exposition fails CI,
+not the first real scraper.
 """
 
 from __future__ import annotations
@@ -22,6 +38,33 @@ import re
 from ..utils import trace
 
 _NAME_OK = re.compile(r"[^a-zA-Z0-9_:]")
+_NAME_RE = re.compile(r"[a-zA-Z_:][a-zA-Z0-9_:]*$")
+_LABEL_RE = re.compile(r"[a-zA-Z_][a-zA-Z0-9_]*$")
+
+# legacy scalar metrics that are monotonically non-decreasing by
+# construction (counts of things that happened): rendered as counters
+# with a _total suffix. Names already ending in _total migrate in
+# place (the TYPE lie was the bug); the rest keep their old gauge name
+# as a one-release deprecated alias.
+MONOTONIC_METRICS = frozenset({
+    "service.ingest_batches",
+    "service.ingest_attestations",
+    "service.invalid_attestations",
+    "service.rpc_retries",
+    "service.refresh_total",
+    "service.refresh_cold_total",
+    "service.proofs_done",
+    "service.proofs_failed",
+    "service.proof_completed",
+    "service.proof_failed",
+    "service.operator_cache_hits",
+    "service.operator_builds",
+    "store.wal_records_appended",
+    "store.wal_torn_skipped",
+    "store.snapshot_failures",
+    "store.replayed_records",
+    "store.proof_persist_failures",
+})
 
 
 def _sanitize(name: str) -> str:
@@ -31,11 +74,58 @@ def _sanitize(name: str) -> str:
     return name
 
 
+def _escape_label(value: str) -> str:
+    return (value.replace("\\", "\\\\").replace('"', '\\"')
+            .replace("\n", "\\n"))
+
+
+def _labels_text(items, extra: str | None = None) -> str:
+    parts = [f'{_sanitize(k)}="{_escape_label(v)}"' for k, v in items]
+    if extra:
+        parts.append(extra)
+    return "{" + ",".join(parts) + "}" if parts else ""
+
+
 def _fmt(value: float) -> str:
     # integers render bare (Prometheus accepts both; bare reads better
     # for counters), non-integers as repr floats
     f = float(value)
     return str(int(f)) if f == int(f) else repr(f)
+
+
+def _fmt_le(bound: float) -> str:
+    return f"{bound:.6g}"
+
+
+def _render_instruments(lines: list) -> None:
+    for inst in trace.TRACER.instruments():
+        name = _sanitize(f"ptpu_{inst.name}")
+        if inst.kind == "counter":
+            if not name.endswith("_total"):
+                name += "_total"
+            lines.append(f"# TYPE {name} counter")
+            for items, value in inst.samples():
+                lines.append(f"{name}{_labels_text(items)} {_fmt(value)}")
+        elif inst.kind == "gauge":
+            lines.append(f"# TYPE {name} gauge")
+            for items, value in inst.samples():
+                lines.append(f"{name}{_labels_text(items)} {_fmt(value)}")
+        else:  # histogram
+            lines.append(f"# TYPE {name} histogram")
+            for items, s in inst.series():
+                running = 0
+                for bound, n in zip(inst.buckets, s["counts"]):
+                    running += n
+                    le = 'le="' + _fmt_le(bound) + '"'
+                    lines.append(f"{name}_bucket"
+                                 f"{_labels_text(items, le)} {running}")
+                inf = 'le="+Inf"'
+                lines.append(f"{name}_bucket{_labels_text(items, inf)} "
+                             f"{s['count']}")
+                lines.append(
+                    f"{name}_sum{_labels_text(items)} {repr(s['sum'])}")
+                lines.append(
+                    f"{name}_count{_labels_text(items)} {s['count']}")
 
 
 def render_prometheus(extra: dict | None = None) -> str:
@@ -45,19 +135,38 @@ def render_prometheus(extra: dict | None = None) -> str:
     gauges = dict(trace.TRACER.metrics_latest())
     if extra:
         gauges.update(extra)
+    counters = {}
     for name in sorted(gauges):
         metric = _sanitize(f"ptpu_{name}")
+        if name in MONOTONIC_METRICS:
+            total = metric if metric.endswith("_total") \
+                else metric + "_total"
+            counters[total] = gauges[name]
+            if metric.endswith("_total"):
+                continue  # migrated in place: counter only, no alias
+            # deprecated gauge alias (one release) falls through
         lines.append(f"# TYPE {metric} gauge")
         lines.append(f"{metric} {_fmt(gauges[name])}")
+    for total in sorted(counters):
+        lines.append(f"# TYPE {total} counter")
+        lines.append(f"{total} {_fmt(counters[total])}")
+
+    _render_instruments(lines)
 
     summary = trace.TRACER.summary()
     if summary:
+        lines.append("# TYPE ptpu_span_total counter")
+        for name in sorted(summary):
+            lines.append(
+                f'ptpu_span_total{{span="{_sanitize(name)}"}} '
+                f'{summary[name]["count"]}')
+        # deprecated alias of ptpu_span_total (one release)
         lines.append("# TYPE ptpu_span_count gauge")
         for name in sorted(summary):
             lines.append(
                 f'ptpu_span_count{{span="{_sanitize(name)}"}} '
                 f'{summary[name]["count"]}')
-        lines.append("# TYPE ptpu_span_seconds_total gauge")
+        lines.append("# TYPE ptpu_span_seconds_total counter")
         for name in sorted(summary):
             lines.append(
                 f'ptpu_span_seconds_total{{span="{_sanitize(name)}"}} '
@@ -68,3 +177,123 @@ def render_prometheus(extra: dict | None = None) -> str:
                 f'ptpu_span_seconds_max{{span="{_sanitize(name)}"}} '
                 f'{summary[name]["max_s"]:.6f}')
     return "\n".join(lines) + "\n"
+
+
+# --- exposition-format lint (pure python, no scraper needed) ---------------
+
+_SAMPLE_RE = re.compile(
+    r"^([a-zA-Z_:][a-zA-Z0-9_:]*)"          # metric name
+    r"(?:\{(.*)\})?"                         # optional label block
+    r" (-?(?:[0-9.eE+-]+|Inf|NaN))$")        # value
+_LABEL_PAIR_RE = re.compile(
+    r'([a-zA-Z_][a-zA-Z0-9_]*)="((?:[^"\\]|\\.)*)"')
+
+
+def _family(name: str, types: dict) -> str | None:
+    """The declared family a sample name belongs to (histogram samples
+    use the base name's TYPE declaration)."""
+    if name in types:
+        return name
+    for suffix in ("_bucket", "_sum", "_count"):
+        if name.endswith(suffix) and name[: -len(suffix)] in types:
+            return name[: -len(suffix)]
+    return None
+
+
+def lint_exposition(text: str) -> list:
+    """Validate a text-exposition page; returns a list of error strings
+    (empty = clean). Checks: name/label grammar, float-parseable values,
+    one TYPE per family declared before its samples, counter names
+    ending in ``_total``, no duplicate series, and histogram internal
+    consistency (cumulative buckets, ``+Inf`` == ``_count``, ``_sum``
+    present)."""
+    errors = []
+    types: dict = {}
+    seen: set = set()
+    values: dict = {}  # (name, labelkey) -> float
+    hist: dict = {}    # family -> labelkey(no le) -> [(le, count)]
+    for lineno, line in enumerate(text.splitlines(), 1):
+        if not line.strip():
+            continue
+        if line.startswith("#"):
+            parts = line.split()
+            if len(parts) >= 2 and parts[1] == "TYPE":
+                if len(parts) != 4 or parts[3] not in (
+                        "counter", "gauge", "histogram", "summary",
+                        "untyped"):
+                    errors.append(f"line {lineno}: malformed TYPE line")
+                    continue
+                name = parts[2]
+                if name in types:
+                    errors.append(
+                        f"line {lineno}: duplicate TYPE for {name}")
+                if not _NAME_RE.match(name):
+                    errors.append(
+                        f"line {lineno}: bad metric name {name!r}")
+                if parts[3] == "counter" and not name.endswith("_total"):
+                    errors.append(
+                        f"line {lineno}: counter {name} lacks _total "
+                        "suffix")
+                types[name] = parts[3]
+            continue  # HELP/comments: free-form
+        m = _SAMPLE_RE.match(line)
+        if m is None:
+            errors.append(f"line {lineno}: unparseable sample {line!r}")
+            continue
+        name, label_blob, value = m.groups()
+        fvalue = None
+        try:
+            fvalue = float(value)
+        except ValueError:
+            errors.append(f"line {lineno}: bad value {value!r}")
+        labels = []
+        if label_blob:
+            consumed = _LABEL_PAIR_RE.sub("", label_blob).strip(", ")
+            if consumed:
+                errors.append(
+                    f"line {lineno}: bad label syntax {label_blob!r}")
+            labels = _LABEL_PAIR_RE.findall(label_blob)
+            for k, _ in labels:
+                if not _LABEL_RE.match(k):
+                    errors.append(f"line {lineno}: bad label name {k!r}")
+        family = _family(name, types)
+        if family is None:
+            errors.append(f"line {lineno}: sample {name} has no "
+                          "preceding TYPE declaration")
+        series_key = (name, tuple(sorted(labels)))
+        if series_key in seen:
+            errors.append(f"line {lineno}: duplicate series "
+                          f"{name}{dict(labels)}")
+        seen.add(series_key)
+        if fvalue is not None:
+            values[series_key] = fvalue
+        if family is not None and types[family] == "histogram" \
+                and name.endswith("_bucket"):
+            key = tuple(sorted((k, v) for k, v in labels if k != "le"))
+            le = dict(labels).get("le")
+            if le is None:
+                errors.append(f"line {lineno}: _bucket without le label")
+            elif fvalue is not None:  # a bad value was already reported
+                hist.setdefault(family, {}).setdefault(
+                    key, []).append((le, fvalue))
+    # histogram consistency per label set
+    for family, by_labels in hist.items():
+        for key, buckets in by_labels.items():
+            counts = [c for _, c in buckets]
+            if counts != sorted(counts):
+                errors.append(f"{family}{dict(key)}: bucket counts are "
+                              "not cumulative")
+            if buckets[-1][0] != "+Inf":
+                errors.append(f"{family}{dict(key)}: last bucket is "
+                              f"{buckets[-1][0]!r}, not +Inf")
+            for suffix in ("_sum", "_count"):
+                if (family + suffix, key) not in seen:
+                    errors.append(
+                        f"{family}{dict(key)}: missing {family}{suffix}")
+            count = values.get((family + "_count", key))
+            if buckets[-1][0] == "+Inf" and count is not None \
+                    and buckets[-1][1] != count:
+                errors.append(
+                    f"{family}{dict(key)}: +Inf bucket "
+                    f"{buckets[-1][1]} != _count {count}")
+    return errors

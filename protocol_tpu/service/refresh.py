@@ -89,9 +89,16 @@ class ScoreRefresher:
 
     def __init__(self, graph: OpinionGraph, config: ServiceConfig,
                  backend=None, faults: FaultInjector | None = None,
-                 operator_cache_dir: str | None = None):
+                 operator_cache_dir: str | None = None,
+                 pending_traces=None):
+        """``pending_traces``: optional ``trace.PendingTraces`` — the
+        ingest sink records applied attestations' trace ids there; each
+        refresh takes the ids at-or-below the revision it publishes and
+        stamps them on its span, closing the tailer → WAL → apply →
+        refresh trace chain."""
         self.graph = graph
         self.config = config
+        self.pending_traces = pending_traces
         self.faults = faults or FaultInjector({"rpc": 0.0, "device": 0.0})
         if backend is None:
             from ..backend import JaxSparseBackend
@@ -226,9 +233,18 @@ class ScoreRefresher:
         addresses = self.graph.addresses()[:n]
         if n < 2 or not len(src):
             # no scorable graph yet: publish the empty/zero table so
-            # /scores reflects "seen but unscored" peers honestly
+            # /scores reflects "seen but unscored" peers honestly. The
+            # pending trace ids ARE reflected by this publish — drain
+            # them here (stamped on an event, there is no converge
+            # span) or they would be misattributed to a later refresh
+            tids = (self.pending_traces.take(revision)
+                    if self.pending_traces is not None else [])
             self.table = ScoreTable(addresses, np.zeros(n), revision,
                                     0, 0.0, True, time.time())
+            if tids:
+                with trace.context(trace_ids=tids):
+                    trace.event("service.refresh_trivial", n=n,
+                                revision=revision)
             return self.table
 
         cold = force_cold or self._want_cold(len(src), edits)
@@ -243,26 +259,23 @@ class ScoreRefresher:
                                    self.config.initial_score)
         self.faults.check("device")
         backend, extra = self._converge_call(n, src, dst, val, valid)
-        with trace.span("service.refresh", n=n, edges=len(src),
-                        cold=cold):
-            scores, iters, delta = backend.converge_edges(
-                n, src, dst, val, valid, self.config.initial_score,
-                self.config.max_iterations, tol=self.config.tol,
-                alpha=self.config.alpha, s0=s0, **extra)
-        if not cold and (delta > self.config.tol
-                         or not np.isfinite(scores).all()):
-            # warm start failed to converge inside the budget (graph
-            # drifted further than the bound assumed): re-anchor cold.
-            # The routed fallback reuses the operator just built/loaded
-            # — a cache hit, not a second compilation.
-            backend, extra = self._converge_call(n, src, dst, val, valid)
-            with trace.span("service.refresh", n=n, edges=len(src),
-                            cold=True, fallback=True):
-                scores, iters, delta = backend.converge_edges(
-                    n, src, dst, val, valid, self.config.initial_score,
-                    self.config.max_iterations, tol=self.config.tol,
-                    alpha=self.config.alpha, **extra)
-            cold = True
+        # the refresh span carries the trace ids of every attestation
+        # it is about to make visible in served scores (the last hop of
+        # the tailer → WAL → apply → refresh chain)
+        tids = (self.pending_traces.take(revision)
+                if self.pending_traces is not None else [])
+        t0 = time.perf_counter()
+        try:
+            scores, iters, delta, cold = self._converge_traced(
+                n, src, dst, val, valid, s0, cold, tids, backend, extra)
+        except Exception:
+            # a failed refresh publishes nothing: the ids go back so
+            # the retry's span still closes the trace chain
+            if self.pending_traces is not None and tids:
+                self.pending_traces.add(revision, tids)
+            raise
+        trace.histogram("refresh_seconds").observe(
+            time.perf_counter() - t0, mode="cold" if cold else "warm")
 
         self.refreshes += 1
         if cold:
@@ -280,6 +293,35 @@ class ScoreRefresher:
         trace.metric("service.operator_cache_hits", self.operator_hits)
         trace.metric("service.operator_builds", self.operator_builds)
         return self.table
+
+    def _converge_traced(self, n, src, dst, val, valid, s0, cold,
+                         tids, backend, extra) -> tuple:
+        """The converge (+ warm→cold fallback) under the batch's trace
+        context; returns ``(scores, iters, delta, cold)``."""
+        with trace.context(trace_ids=tids):
+            with trace.span("service.refresh", n=n, edges=len(src),
+                            cold=cold):
+                scores, iters, delta = backend.converge_edges(
+                    n, src, dst, val, valid, self.config.initial_score,
+                    self.config.max_iterations, tol=self.config.tol,
+                    alpha=self.config.alpha, s0=s0, **extra)
+            if not cold and (delta > self.config.tol
+                             or not np.isfinite(scores).all()):
+                # warm start failed to converge inside the budget (graph
+                # drifted further than the bound assumed): re-anchor
+                # cold. The routed fallback reuses the operator just
+                # built/loaded — a cache hit, not a second compilation.
+                backend, extra = self._converge_call(n, src, dst, val,
+                                                     valid)
+                with trace.span("service.refresh", n=n, edges=len(src),
+                                cold=True, fallback=True):
+                    scores, iters, delta = backend.converge_edges(
+                        n, src, dst, val, valid,
+                        self.config.initial_score,
+                        self.config.max_iterations, tol=self.config.tol,
+                        alpha=self.config.alpha, **extra)
+                cold = True
+        return scores, iters, delta, cold
 
     def run(self, stop_event, dirty_event, refresh_interval: float) -> None:
         """Refresher loop: wake on new data (or the interval), refresh,

@@ -24,6 +24,7 @@ skipped, never fatal: one forged attestation must not stall the tail.
 
 from __future__ import annotations
 
+import hashlib
 import threading
 
 import numpy as np
@@ -32,6 +33,31 @@ from ..client.client import _device_present
 from ..client.eth import address_from_public_key
 from ..utils import trace
 from ..utils.errors import EigenError
+
+
+def att_digest(block: int, about: bytes, payload: bytes) -> bytes:
+    """Identity of one signed attestation AS LOGGED — block + about +
+    normalized payload. The daemon's dedup key AND the trace-context
+    id derive from it; the block number MUST be part of it because
+    deterministic (RFC 6979) signing makes a re-attestation of a
+    previously-seen value byte-identical in payload — only its block
+    distinguishes the genuine latest-wins revert from a refetch."""
+    return hashlib.sha256(block.to_bytes(8, "little") + about
+                          + payload).digest()
+
+
+def trace_id_of(digest: bytes) -> str:
+    """digest → trace id: the one place the prefix length/encoding is
+    defined, so every deriver (tailer, daemon sink, smoke join) agrees."""
+    return digest.hex()[:16]
+
+
+def att_trace_id(block: int, about: bytes, payload: bytes) -> str:
+    """The trace id stamped on every span an attestation flows through
+    (tailer → WAL append → graph apply → the refresh that publishes
+    it): a short prefix of the same digest the dedup key uses, so the
+    id is computable from the raw log record alone."""
+    return trace_id_of(att_digest(block, about, payload))
 
 
 def recover_signers(attestations, batched: bool | None = None):

@@ -31,6 +31,7 @@ from ..client.attestation import DOMAIN_PREFIX, SignedAttestationData
 from ..utils import trace
 from ..utils.errors import EigenError
 from .faults import FaultInjector
+from .state import att_trace_id
 
 
 class FileBackedLocalChain:
@@ -119,6 +120,7 @@ class ChainTailer:
             logs = self.faults.call("rpc", self.chain.get_logs,
                                     self.cursor + 1)
         if not logs:
+            trace.gauge("tailer_blocks_behind").set(0.0)
             return 0
         expected_key = DOMAIN_PREFIX + self.domain
         batch = []
@@ -134,8 +136,20 @@ class ChainTailer:
                 blocks.append(log.block_number)
             except EigenError:
                 self.skipped += 1
+        # blocks this poll must still fold in before the cursor catches
+        # the chain head it just observed — the catch-up depth gauge
+        trace.gauge("tailer_blocks_behind").set(
+            float(max(0, top - self.cursor)))
         if batch:
-            self.sink(batch, top, blocks)
+            # trace context: each attestation's digest-derived id rides
+            # every downstream span (WAL append, graph apply, and — via
+            # the daemon's PendingTraces — the refresh that publishes it)
+            tids = [att_trace_id(blk, s.attestation.about, s.to_payload())
+                    for blk, s in zip(blocks, batch)]
+            with trace.context(trace_ids=tids):
+                with trace.span("service.tail_batch", n=len(batch),
+                                block=top):
+                    self.sink(batch, top, blocks)
             self.batches += 1
             self.attestations += len(batch)
         # blocks with only foreign/undecodable logs still advance the

@@ -23,6 +23,9 @@ from __future__ import annotations
 import json
 import os
 import re
+import time
+
+from ..utils import trace
 
 _SAFE_ID = re.compile(r"[A-Za-z0-9][A-Za-z0-9_.-]{0,128}$")
 _JOB_NUM = re.compile(r"job-(\d+)$")
@@ -63,6 +66,7 @@ class ProofArtifactStore:
         if d is None:
             self.persist_failures += 1
             return False
+        t0 = time.perf_counter()
         try:
             shape = (self.faults.disk_fault()
                      if self.faults is not None else None)
@@ -92,6 +96,8 @@ class ProofArtifactStore:
                         json.dumps(job.to_json()).encode())
             if fresh:
                 self._count += 1
+            trace.histogram("proof_persist_seconds").observe(
+                time.perf_counter() - t0)
             return True
         except OSError:
             self.persist_failures += 1

@@ -31,6 +31,7 @@ import time
 
 import numpy as np
 
+from ..utils import trace
 from ..utils.checkpoint import CheckpointManager
 from ..utils.errors import EigenError
 from .wal import encode_record, iter_frames, decode_body
@@ -64,7 +65,10 @@ class SnapshotStore:
         if shape == "fsync":
             raise EigenError("injected_fault",
                              "injected snapshot fsync failure")
+        t0 = time.perf_counter()
         path = self._mgr.save(step, arrays, meta)
+        trace.histogram("snapshot_save_seconds").observe(
+            time.perf_counter() - t0)
         self.last_saved_at = time.time()
         self._count = len(self._mgr.steps())  # writer thread: safe
         return path
@@ -146,6 +150,7 @@ def encode_service_state(addrs, src, dst, val, revision, edits_since_cold,
     the block, since deterministic signing makes a re-attested value
     byte-identical in payload); ``wal_pos`` the WAL high-water mark the
     snapshot covers."""
+    t0 = time.perf_counter()
     n = len(addrs)
     src = np.asarray(src, dtype=np.int64)
     dst = np.asarray(dst, dtype=np.int64)
@@ -176,6 +181,10 @@ def encode_service_state(addrs, src, dst, val, revision, edits_since_cold,
         "wal_segment": int(wal_pos[0]),
         "wal_offset": int(wal_pos[1]),
     }
+    # the O(attestation history) re-serialization the ROADMAP flags as
+    # a scale gap — the histogram makes its growth visible per deploy
+    trace.histogram("snapshot_encode_seconds").observe(
+        time.perf_counter() - t0)
     return arrays, meta
 
 

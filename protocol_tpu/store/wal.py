@@ -36,9 +36,11 @@ from __future__ import annotations
 
 import os
 import struct
+import time
 import zlib
 from collections import OrderedDict
 
+from ..utils import trace
 from ..utils.errors import EigenError
 
 SEGMENT_MAGIC = b"PTPUWAL1"
@@ -188,6 +190,7 @@ class AttestationWAL:
         data = b"".join(encode_record(b, a, p) for b, a, p in records)
         shape = self.faults.disk_fault() if self.faults is not None else None
         f = self._file
+        t0 = time.perf_counter()
         # pessimistic: marked dirty for the WHOLE write window and
         # cleared only on full commit, so a REAL write/flush/fsync error
         # (ENOSPC, EIO), not just the injected shapes, leaves the tail
@@ -203,8 +206,15 @@ class AttestationWAL:
         if shape == "fsync":
             raise EigenError("injected_fault", "injected WAL fsync failure")
         if self.fsync == "always":
+            t_fs = time.perf_counter()
             os.fsync(f.fileno())
+            trace.histogram("wal_fsync_seconds").observe(
+                time.perf_counter() - t_fs)
         self._need_heal = False
+        # committed appends only: a faulted append raised above, and
+        # mixing its partial timing in would skew the latency tail
+        trace.histogram("wal_append_seconds").observe(
+            time.perf_counter() - t0)
         self._pos += len(data)
         self.appended += len(records)
         pos = (self._segment, self._pos)

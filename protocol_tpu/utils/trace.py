@@ -1,13 +1,25 @@
-"""Structured tracing + metrics.
+"""Structured tracing + typed metrics: the repo's instrumentation layer.
 
 The reference has no observability beyond ad-hoc ``Instant`` timers
 printed to the log (eigentrust/src/lib.rs:549-555, utils.rs:264-267,
 dynamic_sets/native.rs:1121-1127) — SURVEY.md §5 marks real tracing as
 net-new for this framework. This module provides:
 
-- ``span(name, **fields)``: nested wall-clock spans (context manager),
+- ``span(name, **fields)``: nested wall-clock spans (context manager)
+  carrying ``span_id``/``parent_id`` and, when a trace context is
+  active, the ``trace_id``(s) of the work items flowing through them;
+- ``context(trace_id=...)`` / ``context(trace_ids=[...])``: thread-local
+  trace-context propagation — a cheap id (attestation digest, job id,
+  HTTP request id) stamped on every span/event emitted inside, so one
+  work item's end-to-end path is joinable from the JSONL stream;
+- **typed instruments** with Prometheus semantics, rendered by
+  ``service/metrics.py`` with correct ``# TYPE`` metadata:
+  ``counter(name)`` (monotonic, ``_total``), ``gauge(name)``, and
+  ``histogram(name)`` (fixed log-spaced buckets, exact count/sum,
+  ``_bucket``/``_sum``/``_count``), all label-aware (labels must be
+  static strings in code — stable cardinality is the caller's contract);
 - ``event(name, **fields)``: point events with arbitrary fields,
-- counters/gauges via ``metric(name, value)``,
+- legacy scalar samples via ``metric(name, value)`` (gauge view),
 - a process-global ``Tracer`` with JSONL export and a summary table,
 - ``device_trace(log_dir)``: optional passthrough to the JAX profiler
   (xprof) for device-side timelines.
@@ -16,30 +28,190 @@ Tracing is off unless enabled — ``enable()`` in code or the
 ``PROTOCOL_TPU_TRACE`` env var (set to a path to also stream JSONL
 there; set to ``1`` for in-memory only). Overhead when disabled is one
 attribute check per call site.
+
+Thread-safety contract: recording, JSONL emission, and ``dump_jsonl``
+are all safe against concurrent mutation — emits are serialized under a
+dedicated lock (no interleaved lines), and dumps snapshot the buffers
+under the collector lock before touching the file.
 """
 
 from __future__ import annotations
 
+import bisect
 import contextlib
+import itertools
 import json
 import os
 import threading
 import time
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 
 
 # per-name metric history bound (samples kept for dump_jsonl); the
 # latest value is never dropped — see Tracer.metric
 METRIC_HISTORY_CAP = 4096
 
+# default histogram buckets: log-spaced (factor √10) from 100 µs to
+# 100 s — WAL appends sit at the bottom, cold converges and proof jobs
+# at the top (beyond lands in +Inf). Fixed in code so every scrape of a
+# given series has identical bucket boundaries.
+DEFAULT_BUCKETS = tuple(10.0 ** (e / 2.0) for e in range(-8, 5))
+
+
+def _label_key(labels: dict) -> tuple:
+    """Canonical (sorted, stringified) label identity for one series."""
+    return tuple(sorted((str(k), str(v)) for k, v in labels.items()))
+
+
+class Counter:
+    """Monotonic counter (Prometheus ``counter``): only ever goes up.
+    Survives :meth:`Tracer.reset` — a scraper must never see a counter
+    move backwards short of a process restart."""
+
+    kind = "counter"
+
+    def __init__(self, name: str, tracer: "Tracer"):
+        self.name = name
+        self._tracer = tracer
+        self._lock = threading.Lock()
+        self._values: dict = {}
+
+    def inc(self, amount: float = 1.0, **labels) -> None:
+        if not self._tracer.enabled:
+            return
+        if amount < 0:
+            raise ValueError(f"counter {self.name}: negative inc {amount}")
+        key = _label_key(labels)
+        with self._lock:
+            self._values[key] = self._values.get(key, 0.0) + float(amount)
+
+    def set_total(self, value: float, **labels) -> None:
+        """Adopt an externally-tracked running total (e.g. an existing
+        ``self.retries`` attribute); clamped monotonic — the stored
+        value never decreases."""
+        if not self._tracer.enabled:
+            return
+        key = _label_key(labels)
+        with self._lock:
+            self._values[key] = max(self._values.get(key, 0.0),
+                                    float(value))
+
+    def value(self, **labels) -> float:
+        with self._lock:
+            return self._values.get(_label_key(labels), 0.0)
+
+    def samples(self) -> list:
+        """[(label_items, value)] — a consistent copy for rendering."""
+        with self._lock:
+            return sorted(self._values.items())
+
+
+class Gauge:
+    """Last-write-wins scalar (Prometheus ``gauge``)."""
+
+    kind = "gauge"
+
+    def __init__(self, name: str, tracer: "Tracer"):
+        self.name = name
+        self._tracer = tracer
+        self._lock = threading.Lock()
+        self._values: dict = {}
+
+    def set(self, value: float, **labels) -> None:
+        if not self._tracer.enabled:
+            return
+        with self._lock:
+            self._values[_label_key(labels)] = float(value)
+
+    def value(self, **labels) -> float:
+        with self._lock:
+            return self._values.get(_label_key(labels), 0.0)
+
+    def samples(self) -> list:
+        with self._lock:
+            return sorted(self._values.items())
+
+
+class Histogram:
+    """Fixed-bucket latency histogram with exact count/sum (Prometheus
+    ``histogram``): per label set, one non-cumulative count per bucket
+    plus an overflow (+Inf) slot — rendering cumulates. Buckets are
+    fixed at first registration; later ``histogram(name)`` calls reuse
+    them."""
+
+    kind = "histogram"
+
+    def __init__(self, name: str, tracer: "Tracer", buckets=None):
+        self.name = name
+        self._tracer = tracer
+        self.buckets = tuple(sorted(buckets or DEFAULT_BUCKETS))
+        self._lock = threading.Lock()
+        self._series: dict = {}
+
+    def observe(self, value: float, **labels) -> None:
+        if not self._tracer.enabled:
+            return
+        value = float(value)
+        idx = bisect.bisect_left(self.buckets, value)
+        key = _label_key(labels)
+        with self._lock:
+            s = self._series.get(key)
+            if s is None:
+                s = {"counts": [0] * (len(self.buckets) + 1),
+                     "sum": 0.0, "count": 0}
+                self._series[key] = s
+            s["counts"][idx] += 1
+            s["sum"] += value
+            s["count"] += 1
+
+    def series(self) -> list:
+        """[(label_items, {counts, sum, count})] — deep-copied so the
+        renderer never races an observe."""
+        with self._lock:
+            return sorted(
+                (key, {"counts": list(s["counts"]), "sum": s["sum"],
+                       "count": s["count"]})
+                for key, s in self._series.items())
+
+
+class PendingTraces:
+    """Trace ids handed from one pipeline stage to a later asynchronous
+    one, keyed by a monotonically-increasing revision: the ingest sink
+    ``add``s the ids it applied at graph revision R, and the refresher
+    ``take``s everything at-or-below the revision it is about to
+    publish — stamping the refresh span that first reflects those work
+    items. Bounded (oldest dropped) so a stalled consumer is a gap in
+    the trace stream, not a leak."""
+
+    def __init__(self, cap: int = 4096):
+        self._lock = threading.Lock()
+        self._items: list = []  # [(revision, trace_id)]
+        self._cap = cap
+
+    def add(self, revision: int, trace_ids) -> None:
+        with self._lock:
+            self._items.extend((revision, t) for t in trace_ids)
+            if len(self._items) > self._cap:
+                del self._items[: len(self._items) - self._cap]
+
+    def take(self, revision: int) -> list:
+        """Drain every id recorded at-or-below ``revision``."""
+        with self._lock:
+            taken = [t for r, t in self._items if r <= revision]
+            self._items = [(r, t) for r, t in self._items if r > revision]
+        return taken
+
 
 @dataclass
 class SpanRecord:
     name: str
-    start: float
-    duration: float
-    depth: int
+    start: float           # EPOCH seconds (time.time at span open) —
+    duration: float        # alignable with event timestamps; duration
+    depth: int             # is measured on the monotonic clock
     fields: dict
+    span_id: str = ""
+    parent_id: str | None = None
+    trace_ids: tuple = ()
 
 
 class Tracer:
@@ -48,11 +220,14 @@ class Tracer:
     def __init__(self):
         self.enabled = False
         self._lock = threading.Lock()
+        self._emit_lock = threading.Lock()
         self._local = threading.local()
         self._stream = None
         self.spans: list = []
         self.events: list = []
         self.metrics: dict = {}
+        self._instruments: dict = {}
+        self._span_ids = itertools.count(1)
         # exact running aggregates per span name: summary() stays
         # correct even after the bounded spans list drops old records
         # (a daemon emits spans indefinitely)
@@ -71,11 +246,79 @@ class Tracer:
             self._stream = None
 
     def reset(self) -> None:
+        """Clear spans/events/metric histories. Typed instruments are
+        deliberately KEPT: counters are monotonic for the process
+        lifetime (a /metrics scrape must never see one go backwards);
+        use :meth:`reset_instruments` for a full teardown (tests)."""
         with self._lock:
             self.spans.clear()
             self.events.clear()
             self.metrics.clear()
             self._span_agg.clear()
+
+    def reset_instruments(self) -> None:
+        with self._lock:
+            self._instruments.clear()
+
+    # --- typed instruments ------------------------------------------------
+    def _instrument(self, name: str, cls, **kwargs):
+        with self._lock:
+            inst = self._instruments.get(name)
+            if inst is None:
+                inst = cls(name, self, **kwargs)
+                self._instruments[name] = inst
+            elif not isinstance(inst, cls):
+                raise ValueError(
+                    f"instrument {name!r} already registered as "
+                    f"{inst.kind}, not {cls.kind}")
+            return inst
+
+    def counter(self, name: str) -> Counter:
+        return self._instrument(name, Counter)
+
+    def gauge(self, name: str) -> Gauge:
+        return self._instrument(name, Gauge)
+
+    def histogram(self, name: str, buckets=None) -> Histogram:
+        return self._instrument(name, Histogram, buckets=buckets)
+
+    def instruments(self) -> list:
+        """Registered instruments, name-sorted (a consistent copy)."""
+        with self._lock:
+            return [inst for _, inst in sorted(self._instruments.items())]
+
+    # --- trace context ----------------------------------------------------
+    def new_id(self) -> str:
+        """A process-unique short id (HTTP request ids, span ids)."""
+        return f"{next(self._span_ids):08x}"
+
+    def current_trace_ids(self) -> tuple:
+        return getattr(self._local, "trace", ())
+
+    @contextlib.contextmanager
+    def context(self, trace_id: str | None = None, trace_ids=None):
+        """Bind trace id(s) to this thread: every span/event emitted
+        inside carries them (``trace_id`` when single, ``trace_ids``
+        list otherwise). Nesting replaces, exit restores."""
+        if not self.enabled:
+            yield
+            return
+        ids = tuple(trace_ids) if trace_ids is not None else (
+            (trace_id,) if trace_id else ())
+        prev = getattr(self._local, "trace", ())
+        self._local.trace = ids or prev
+        try:
+            yield
+        finally:
+            self._local.trace = prev
+
+    def _trace_fields(self) -> dict:
+        ids = getattr(self._local, "trace", ())
+        if not ids:
+            return {}
+        if len(ids) == 1:
+            return {"trace_id": ids[0]}
+        return {"trace_ids": list(ids)}
 
     # --- recording --------------------------------------------------------
     def _depth(self) -> int:
@@ -87,14 +330,23 @@ class Tracer:
             yield
             return
         depth = self._depth()
+        stack = getattr(self._local, "stack", ())
+        parent = stack[-1] if stack else None
+        span_id = self.new_id()
         self._local.depth = depth + 1
+        self._local.stack = stack + (span_id,)
+        wall = time.time()
         t0 = time.perf_counter()
         try:
             yield
         finally:
             dt = time.perf_counter() - t0
             self._local.depth = depth
-            rec = SpanRecord(name, t0, dt, depth, fields)
+            self._local.stack = stack
+            trace_ids = getattr(self._local, "trace", ())
+            rec = SpanRecord(name, wall, dt, depth, fields,
+                             span_id=span_id, parent_id=parent,
+                             trace_ids=trace_ids)
             with self._lock:
                 self.spans.append(rec)
                 if len(self.spans) > METRIC_HISTORY_CAP:
@@ -104,17 +356,26 @@ class Tracer:
                 agg["count"] += 1
                 agg["total_s"] += dt
                 agg["max_s"] = max(agg["max_s"], dt)
-            self._emit({"type": "span", "name": name, "duration_s": dt,
-                        "depth": depth, **fields})
+            obj = {"type": "span", "name": name, "ts": wall,
+                   "duration_s": dt, "depth": depth, "span_id": span_id}
+            if parent is not None:
+                obj["parent_id"] = parent
+            obj.update(self._trace_fields())
+            obj.update(fields)
+            self._emit(obj)
 
     def event(self, name: str, **fields) -> None:
         if not self.enabled:
             return
+        ts = time.time()
         with self._lock:
-            self.events.append((time.time(), name, fields))
+            self.events.append((ts, name, fields))
             if len(self.events) > METRIC_HISTORY_CAP:
                 del self.events[: len(self.events) - METRIC_HISTORY_CAP]
-        self._emit({"type": "event", "name": name, **fields})
+        obj = {"type": "event", "ts": ts, "name": name}
+        obj.update(self._trace_fields())
+        obj.update(fields)
+        self._emit(obj)
 
     def metric(self, name: str, value) -> None:
         """Record a gauge/counter sample (last-write-wins + history).
@@ -137,8 +398,16 @@ class Tracer:
             return {k: v[-1] for k, v in self.metrics.items() if v}
 
     def _emit(self, obj: dict) -> None:
-        if self._stream is not None:
-            self._stream.write(json.dumps(obj) + "\n")
+        stream = self._stream
+        if stream is not None:
+            line = json.dumps(obj) + "\n"
+            # one lock, one write: concurrent emitters must never
+            # interleave partial JSONL lines
+            with self._emit_lock:
+                try:
+                    stream.write(line)
+                except ValueError:  # stream closed under us (disable
+                    pass            # racing a daemon thread's emit)
 
     # --- reporting --------------------------------------------------------
     def summary(self) -> dict:
@@ -150,18 +419,59 @@ class Tracer:
                     for name, agg in self._span_agg.items()}
 
     def dump_jsonl(self, path: str) -> None:
+        # snapshot under the lock FIRST: a daemon thread appending
+        # mid-dump must not mutate the lists we iterate
+        with self._lock:
+            spans = list(self.spans)
+            events = list(self.events)
+            metrics = {k: list(v) for k, v in self.metrics.items()}
         with open(path, "w") as f:
-            for rec in self.spans:
-                f.write(json.dumps({
-                    "type": "span", "name": rec.name, "start": rec.start,
-                    "duration_s": rec.duration, "depth": rec.depth,
-                    **rec.fields}) + "\n")
-            for ts, name, fields in self.events:
+            for rec in spans:
+                obj = {"type": "span", "name": rec.name, "ts": rec.start,
+                       "duration_s": rec.duration, "depth": rec.depth,
+                       "span_id": rec.span_id}
+                if rec.parent_id is not None:
+                    obj["parent_id"] = rec.parent_id
+                if len(rec.trace_ids) == 1:
+                    obj["trace_id"] = rec.trace_ids[0]
+                elif rec.trace_ids:
+                    obj["trace_ids"] = list(rec.trace_ids)
+                obj.update(rec.fields)
+                f.write(json.dumps(obj) + "\n")
+            for ts, name, fields in events:
                 f.write(json.dumps(
-                    {"type": "event", "ts": ts, "name": name, **fields}) + "\n")
-            for name, values in self.metrics.items():
+                    {"type": "event", "ts": ts, "name": name, **fields})
+                    + "\n")
+            for name, values in metrics.items():
                 f.write(json.dumps(
-                    {"type": "metric", "name": name, "values": values}) + "\n")
+                    {"type": "metric", "name": name, "values": values})
+                    + "\n")
+
+
+def validate_record(obj) -> str | None:
+    """Schema check for one JSONL trace record (the ``obs`` CLI verb's
+    stream validator); returns an error string or None when valid."""
+    if not isinstance(obj, dict):
+        return "record is not a JSON object"
+    kind = obj.get("type")
+    if kind not in ("span", "event", "metric"):
+        return f"unknown record type {kind!r}"
+    name = obj.get("name")
+    if not isinstance(name, str) or not name:
+        return "missing/empty name"
+    if kind == "span":
+        if not isinstance(obj.get("duration_s"), (int, float)):
+            return f"span {name!r} without numeric duration_s"
+        if "span_id" in obj and not isinstance(obj["span_id"], str):
+            return f"span {name!r} with non-string span_id"
+    if kind == "metric":
+        value = obj.get("value", obj.get("values"))
+        if isinstance(value, list):
+            if not all(isinstance(v, (int, float)) for v in value):
+                return f"metric {name!r} with non-numeric values"
+        elif not isinstance(value, (int, float)):
+            return f"metric {name!r} without numeric value"
+    return None
 
 
 TRACER = Tracer()
@@ -193,6 +503,30 @@ def event(name: str, **fields) -> None:
 
 def metric(name: str, value) -> None:
     TRACER.metric(name, value)
+
+
+def counter(name: str) -> Counter:
+    return TRACER.counter(name)
+
+
+def gauge(name: str) -> Gauge:
+    return TRACER.gauge(name)
+
+
+def histogram(name: str, buckets=None) -> Histogram:
+    return TRACER.histogram(name, buckets=buckets)
+
+
+def context(trace_id: str | None = None, trace_ids=None):
+    return TRACER.context(trace_id=trace_id, trace_ids=trace_ids)
+
+
+def current_trace_ids() -> tuple:
+    return TRACER.current_trace_ids()
+
+
+def new_id() -> str:
+    return TRACER.new_id()
 
 
 def summary() -> dict:

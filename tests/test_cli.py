@@ -30,6 +30,7 @@ def test_parser_accepts_all_verbs():
         ("et-verifier", ["--check"]),
         ("kzg-params", ["--k", "10"]),
         ("local-scores", []),
+        ("obs", ["trace.jsonl", "--trace-id", "abc"]),
         ("scores", ["--backend", "jax"]),
         ("serve", ["--port", "0", "--poll-interval", "0.5",
                    "--state-dir", "svc-state"]),
@@ -198,6 +199,42 @@ def test_trace_flag_prints_summary(tmp_path, capsys):
     from protocol_tpu.utils import trace
 
     trace.disable()
+
+
+def test_obs_verb_summary_and_validation(tmp_path, capsys):
+    """The ``obs`` verb renders the span-aggregate table from a JSONL
+    trace stream, prints one trace id's chain, and exits 1 when the
+    stream carries invalid records (the stream is a contract)."""
+    from protocol_tpu.utils import trace
+
+    stream = tmp_path / "trace.jsonl"
+    trace.enable(str(stream))
+    with trace.context(trace_id="cafe0123"):
+        with trace.span("service.tail_batch", n=2):
+            with trace.span("service.wal_append", n=2):
+                pass
+    trace.metric("service.block_cursor", 7)
+    trace.disable()
+    trace.TRACER.reset()
+
+    assert run(tmp_path, "obs", str(stream)) == 0
+    out = capsys.readouterr().out
+    assert "2 span(s)" in out and "0 invalid" in out
+    assert "service.tail_batch" in out and "service.wal_append" in out
+
+    assert run(tmp_path, "obs", str(stream), "--trace-id", "cafe0123") == 0
+    out = capsys.readouterr().out
+    assert "trace cafe0123: 2 record(s)" in out
+    assert "parent=" in out  # the chain is joinable, not just filtered
+
+    with open(stream, "a") as f:
+        f.write("this is not json\n")
+        f.write('{"type": "span", "name": "broken"}\n')  # no duration
+    assert run(tmp_path, "obs", str(stream)) == 1
+    assert "2 invalid" in capsys.readouterr().out
+
+    assert run(tmp_path, "obs", str(tmp_path / "missing.jsonl")) == 1
+    assert "cannot open trace stream" in capsys.readouterr().err
 
 
 def test_batched_ingest_flag_parses(tmp_path):

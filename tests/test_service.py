@@ -682,6 +682,100 @@ def test_refresher_routed_operator_cache(tmp_path):
     assert not t3.cold, "the single-edit refresh should warm-start"
 
 
+def test_status_endpoint_schema_and_scrape_lint(tmp_path, devnet):
+    """``GET /status`` serves the operator JSON (uptime, cursor, graph,
+    freshness, queue, last refresh) and ``/metrics`` passes the pure-
+    python exposition lint with the typed observability series."""
+    from protocol_tpu.service.metrics import lint_exposition
+
+    _, node_url = devnet
+    svc, client = _make_service(tmp_path, node_url,
+                                state_dir=str(tmp_path / "state"))
+    url = svc.start()
+    try:
+        kps = ecdsa_keypairs_from_mnemonic(MNEMONIC, 2)
+        addrs = [address_from_public_key(kp.public_key) for kp in kps]
+        _attest_round(client, kps, addrs, {(0, 1): 5, (1, 0): 7})
+        _wait(lambda: svc.graph.n_edges == 2
+              and svc.refresher.table.revision == svc.graph.revision,
+              what="scores published")
+
+        code, status = _get(f"{url}/status")
+        assert code == 200
+        assert status["ok"] and not status["draining"]
+        assert status["uptime_seconds"] > 0
+        assert status["block_cursor"] == svc.tailer.cursor
+        assert status["graph"]["peers"] == 2
+        assert status["graph"]["edges"] == 2
+        assert status["tailer"]["attestations"] == 2
+        assert 0.0 <= status["score_freshness_seconds"] < 60.0
+        last = status["last_refresh"]
+        assert last["revision"] == svc.graph.revision
+        assert last["iterations"] >= 1 and last["refreshes"] >= 1
+        assert isinstance(last["cold"], bool)
+        assert status["queue"] == {"depth": 0, "completed": 0,
+                                   "failed": 0}
+        assert status["store"]["wal_segments"] >= 1
+
+        metrics = _get_text(f"{url}/metrics")
+        errors = lint_exposition(metrics)
+        assert not errors, "scrape lint failed:\n" + "\n".join(errors)
+        for needle in ("ptpu_http_request_seconds_bucket",
+                       "ptpu_wal_append_seconds_bucket",
+                       "ptpu_refresh_seconds_bucket",
+                       "ptpu_score_freshness_seconds",
+                       "ptpu_service_ingest_attestations_total",
+                       "ptpu_span_total"):
+            assert needle in metrics, f"/metrics missing {needle}"
+        # per-request middleware: the request id comes back as a header
+        with urllib.request.urlopen(f"{url}/healthz", timeout=10) as r:
+            assert r.headers.get("X-Request-Id", "").startswith("req-")
+    finally:
+        assert svc.shutdown() is True
+
+
+def test_score_freshness_drops_after_refresh(tmp_path, devnet):
+    """``ptpu_score_freshness_seconds`` measures ingest→served lag: a
+    pending (unrefreshed) batch leaves the gauge anchored at the OLD
+    newest-reflected attestation, and the refresh that publishes the
+    new batch snaps it down to the new one's arrival time."""
+    _, node_url = devnet
+    svc, client = _make_service(tmp_path, node_url)
+    # no threads: drive the tailer + refresher by hand for determinism
+    kps = ecdsa_keypairs_from_mnemonic(MNEMONIC, 2)
+    addrs = [address_from_public_key(kp.public_key) for kp in kps]
+    assert svc.score_freshness_seconds() == -1.0, \
+        "freshness must be the 'never' sentinel before any ingest"
+
+    _attest_round(client, kps, addrs, {(0, 1): 5, (1, 0): 7})
+    svc.tailer.poll_once()
+    assert svc.score_freshness_seconds() == -1.0, \
+        "an ingested-but-unpublished batch is not reflected yet"
+    svc.refresher.refresh()
+    first = svc.score_freshness_seconds()
+    assert 0.0 <= first < 10.0
+
+    time.sleep(0.3)
+    aged = svc.score_freshness_seconds()
+    assert aged >= first + 0.25, "freshness must age with wall time"
+
+    # a new attestation arrives but is NOT yet refreshed: the gauge
+    # stays anchored at the old batch (still aging)...
+    client.keypairs[0] = kps[0]
+    client.attest(addrs[1], 9)
+    svc.tailer.poll_once()
+    before = svc.score_freshness_seconds()
+    assert before >= aged
+    # ... and the refresh that publishes it drops the gauge
+    svc.refresher.refresh()
+    after = svc.score_freshness_seconds()
+    assert after < before, \
+        f"freshness did not drop after the refresh ({after} vs {before})"
+    assert 0.0 <= after < 1.0
+    if svc.store is not None:
+        svc.store.close()
+
+
 def test_warm_start_scores_projection():
     """The projection contract: new peers seeded at initial_score,
     invalid zeroed, total mass rescaled to n_valid·initial."""

@@ -1,0 +1,251 @@
+"""Unit tests for the instrumentation layer (``utils/trace.py``) and
+its Prometheus rendering (``service/metrics.py``): typed instruments,
+histogram bucket math, label sanitization, counter monotonicity across
+``reset()``, trace-context propagation, the thread-safety fixes
+(serialized emits, locked dumps, epoch span starts), and the
+exposition-format lint."""
+
+import json
+import threading
+
+import pytest
+
+from protocol_tpu.service.metrics import lint_exposition, render_prometheus
+from protocol_tpu.utils import trace
+
+
+@pytest.fixture()
+def tracer():
+    """A clean, enabled process tracer; full teardown afterwards so no
+    instrument or span leaks into other tests."""
+    trace.TRACER.reset()
+    trace.TRACER.reset_instruments()
+    was_enabled = trace.TRACER.enabled
+    trace.TRACER.enable()
+    yield trace.TRACER
+    trace.TRACER.reset()
+    trace.TRACER.reset_instruments()
+    if not was_enabled:
+        trace.TRACER.disable()
+
+
+# --- typed instruments ------------------------------------------------------
+
+
+def test_histogram_bucket_math(tracer):
+    h = trace.histogram("bucket_math_seconds", buckets=(0.001, 0.01, 0.1))
+    # boundary value lands in ITS bucket (le is inclusive), overflow in
+    # +Inf, and count/sum are exact — not bucket-approximated
+    for v in (0.0005, 0.001, 0.002, 0.05, 99.0):
+        h.observe(v)
+    ((_, s),) = h.series()
+    assert s["counts"] == [2, 1, 1, 1]  # ≤1ms: 2, ≤10ms: 1, ≤100ms: 1, +Inf: 1
+    assert s["count"] == 5
+    assert s["sum"] == pytest.approx(0.0005 + 0.001 + 0.002 + 0.05 + 99.0)
+
+    page = render_prometheus()
+    assert "# TYPE ptpu_bucket_math_seconds histogram" in page
+    # cumulative buckets with the +Inf terminator equal to _count
+    assert 'ptpu_bucket_math_seconds_bucket{le="0.001"} 2' in page
+    assert 'ptpu_bucket_math_seconds_bucket{le="0.01"} 3' in page
+    assert 'ptpu_bucket_math_seconds_bucket{le="0.1"} 4' in page
+    assert 'ptpu_bucket_math_seconds_bucket{le="+Inf"} 5' in page
+    assert "ptpu_bucket_math_seconds_count 5" in page
+    assert lint_exposition(page) == []
+
+
+def test_histogram_default_buckets_are_log_spaced():
+    b = trace.DEFAULT_BUCKETS
+    assert b[0] == pytest.approx(1e-4)
+    assert b[-1] == pytest.approx(100.0)
+    ratios = [b[i + 1] / b[i] for i in range(len(b) - 1)]
+    for r in ratios:
+        assert r == pytest.approx(10 ** 0.5, rel=1e-6)
+
+
+def test_label_sanitization_and_escaping(tracer):
+    c = trace.counter("weird.name-x")
+    c.inc(1, **{"end point": '/score/"0x\nabc"'})
+    page = render_prometheus()
+    # dots/dashes/spaces become underscores; quote + newline escape
+    assert "# TYPE ptpu_weird_name_x_total counter" in page
+    assert 'end_point="/score/\\"0x\\nabc\\""' in page
+    assert lint_exposition(page) == []
+
+
+def test_counter_monotonic_across_reset(tracer):
+    c = trace.counter("monotonic_things")
+    c.inc()
+    c.inc(2)
+    assert c.value() == 3.0
+    trace.TRACER.reset()  # clears spans/events/metric histories...
+    assert c.value() == 3.0, "reset() must not rewind a counter"
+    c.inc()
+    assert c.value() == 4.0
+    assert trace.counter("monotonic_things") is c  # registry survives
+    with pytest.raises(ValueError):
+        c.inc(-1)
+    with pytest.raises(ValueError):
+        trace.gauge("monotonic_things")  # type conflict is an error
+
+
+def test_counter_set_total_clamps(tracer):
+    c = trace.counter("adopted_total")
+    c.set_total(7)
+    c.set_total(5)  # external totals may be re-read out of order
+    assert c.value() == 7.0
+
+
+def test_monotonic_legacy_metrics_render_as_counters(tracer):
+    trace.metric("service.rpc_retries", 3)
+    trace.metric("service.refresh_total", 5)
+    trace.metric("service.block_cursor", 9)
+    page = render_prometheus()
+    # cumulative series: a real counter with _total...
+    assert "# TYPE ptpu_service_rpc_retries_total counter" in page
+    # ... with the old gauge name kept as a deprecated alias
+    assert "# TYPE ptpu_service_rpc_retries gauge" in page
+    # names already ending _total migrate IN PLACE (no _total_total)
+    assert "# TYPE ptpu_service_refresh_total counter" in page
+    assert "ptpu_service_refresh_total_total" not in page
+    # genuinely instantaneous series stay gauges
+    assert "# TYPE ptpu_service_block_cursor gauge" in page
+    assert "ptpu_service_block_cursor_total" not in page
+    # span aggregates: counts/cumulative-seconds are counters now
+    with trace.span("x"):
+        pass
+    page = render_prometheus()
+    assert "# TYPE ptpu_span_total counter" in page
+    assert "# TYPE ptpu_span_seconds_total counter" in page
+    assert "# TYPE ptpu_span_count gauge" in page  # alias, one release
+    assert lint_exposition(page) == []
+
+
+# --- trace-context propagation ----------------------------------------------
+
+
+def test_trace_context_propagation(tmp_path, tracer):
+    stream = tmp_path / "trace.jsonl"
+    trace.TRACER.enable(str(stream))
+    with trace.context(trace_id="att-0123456789abcdef"):
+        with trace.span("stage.a"):
+            with trace.span("stage.b"):
+                trace.event("stage.mark", note=1)
+    with trace.span("unrelated"):
+        pass
+    trace.TRACER.disable()
+    trace.TRACER.enabled = True  # keep the fixture's enabled state
+
+    records = [json.loads(line) for line in
+               stream.read_text().splitlines()]
+    assert all(trace.validate_record(r) is None for r in records)
+    spans = {r["name"]: r for r in records if r["type"] == "span"}
+    a, b = spans["stage.a"], spans["stage.b"]
+    # one synthetic work item → a joinable chain: shared trace id,
+    # parent/child span ids
+    assert a["trace_id"] == b["trace_id"] == "att-0123456789abcdef"
+    assert b["parent_id"] == a["span_id"]
+    assert "parent_id" not in a
+    event = next(r for r in records if r["type"] == "event")
+    assert event["trace_id"] == "att-0123456789abcdef"
+    assert "trace_id" not in spans["unrelated"]
+    # epoch start: span ts aligns with the event's wall-clock timeline
+    assert abs(a["ts"] - event["ts"]) < 60.0
+
+
+def test_trace_context_batch_ids(tracer):
+    with trace.context(trace_ids=["id1", "id2"]):
+        assert trace.current_trace_ids() == ("id1", "id2")
+        with trace.span("batch.stage"):
+            pass
+    assert trace.current_trace_ids() == ()
+    rec = trace.TRACER.spans[-1]
+    assert rec.trace_ids == ("id1", "id2")
+
+
+def test_pending_traces_revision_handoff():
+    p = trace.PendingTraces(cap=8)
+    p.add(1, ["a"])
+    p.add(2, ["b", "c"])
+    p.add(5, ["d"])
+    assert p.take(2) == ["a", "b", "c"]
+    assert p.take(2) == []  # drained
+    assert p.take(10) == ["d"]
+    # bounded: overflow drops oldest, never grows without bound
+    for r in range(20):
+        p.add(r, [f"x{r}"])
+    assert len(p.take(100)) <= 8
+
+
+def test_dump_and_emit_are_thread_safe(tmp_path, tracer):
+    """Concurrent span emission during dump_jsonl must neither crash
+    nor interleave partial JSONL lines in the stream."""
+    stream = tmp_path / "stream.jsonl"
+    trace.TRACER.enable(str(stream))
+    stop = threading.Event()
+
+    def hammer(k):
+        while not stop.is_set():
+            with trace.span(f"hammer.{k}", payload="x" * 64):
+                pass
+
+    threads = [threading.Thread(target=hammer, args=(k,), daemon=True)
+               for k in range(4)]
+    for t in threads:
+        t.start()
+    try:
+        for _ in range(20):
+            trace.TRACER.dump_jsonl(str(tmp_path / "dump.jsonl"))
+    finally:
+        stop.set()
+        for t in threads:
+            t.join(timeout=5)
+    trace.TRACER.disable()
+    trace.TRACER.enabled = True
+    for path in (stream, tmp_path / "dump.jsonl"):
+        for line in path.read_text().splitlines():
+            json.loads(line)  # raises on an interleaved/torn line
+
+
+# --- exposition lint --------------------------------------------------------
+
+
+def test_lint_exposition_catches_malformations():
+    assert lint_exposition(
+        "# TYPE ok_total counter\nok_total 3\n") == []
+    # counter without _total suffix
+    assert any("_total" in e for e in lint_exposition(
+        "# TYPE bad counter\nbad 3\n"))
+    # sample without a TYPE declaration
+    assert any("TYPE" in e for e in lint_exposition("orphan 1\n"))
+    # duplicate series
+    assert any("duplicate" in e for e in lint_exposition(
+        "# TYPE g gauge\ng 1\ng 2\n"))
+    # non-cumulative histogram buckets
+    page = ("# TYPE h histogram\n"
+            'h_bucket{le="0.1"} 5\n'
+            'h_bucket{le="+Inf"} 3\n'
+            "h_sum 1.0\nh_count 3\n")
+    assert any("cumulative" in e for e in lint_exposition(page))
+    # +Inf bucket disagreeing with _count
+    page = ("# TYPE h histogram\n"
+            'h_bucket{le="0.1"} 1\n'
+            'h_bucket{le="+Inf"} 2\n'
+            "h_sum 1.0\nh_count 3\n")
+    assert any("_count" in e for e in lint_exposition(page))
+    # unparseable garbage
+    assert any("unparseable" in e for e in lint_exposition(
+        "# TYPE g gauge\ng{ 1\n"))
+
+
+def test_validate_record():
+    ok = {"type": "span", "name": "a", "duration_s": 0.1}
+    assert trace.validate_record(ok) is None
+    assert trace.validate_record({"type": "nope", "name": "a"})
+    assert trace.validate_record({"type": "span", "name": ""})
+    assert trace.validate_record(
+        {"type": "span", "name": "a", "duration_s": "fast"})
+    assert trace.validate_record(
+        {"type": "metric", "name": "m", "value": "high"})
+    assert trace.validate_record(
+        {"type": "metric", "name": "m", "values": [1, 2]}) is None

@@ -7,10 +7,17 @@
 #   2. the service smoke INCLUDING the kill-restart durability phase
 #      (tools/serve_smoke.py --restart: mock devnet, real CLI daemons,
 #      PTPU_FAULT_DISK active, SIGKILL mid-tail, replay, oracle
-#      re-check, clean SIGTERM drain).
+#      re-check, clean SIGTERM drain);
+#   3. the scrape-lint phase inside the smoke: a pure-python
+#      exposition-format validator (service/metrics.py lint_exposition)
+#      runs against the live /metrics page and asserts the typed
+#      observability series (http/WAL latency histograms, the
+#      score-freshness gauge, real counters) exist and parse — the
+#      SCRAPE_LINT_OK + TRACE_JOIN_OK markers below prove both the
+#      lint and the end-to-end JSONL trace join actually ran.
 #
-# Exit 0 iff the smoke passed and tier-1 exited 0 or with its known
-# timeout rc. Usage: tools/check.sh
+# Exit 0 iff the smoke (including scrape lint + trace join) passed and
+# tier-1 exited 0 or with its known timeout rc. Usage: tools/check.sh
 set -u
 cd "$(dirname "$0")/.."
 
@@ -23,12 +30,21 @@ t1_rc=${PIPESTATUS[0]}
 dots=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -cd . | wc -c)
 echo "tier1: rc=${t1_rc} DOTS_PASSED=${dots}"
 
-env JAX_PLATFORMS=cpu python tools/serve_smoke.py --restart
-smoke_rc=$?
+rm -f /tmp/_smoke.log
+env JAX_PLATFORMS=cpu python tools/serve_smoke.py --restart 2>&1 \
+    | tee /tmp/_smoke.log
+smoke_rc=${PIPESTATUS[0]}
 echo "serve_smoke --restart: rc=${smoke_rc}"
 
-echo "CHECK_SUMMARY tier1_rc=${t1_rc} dots=${dots} smoke_rc=${smoke_rc}"
-if [ "${smoke_rc}" -ne 0 ]; then
+# scrape-lint + trace-join phases must have actually run, not been
+# skipped by an early exit path
+lint_rc=1
+grep -q SCRAPE_LINT_OK /tmp/_smoke.log \
+    && grep -q TRACE_JOIN_OK /tmp/_smoke.log && lint_rc=0
+echo "scrape-lint + trace-join: rc=${lint_rc}"
+
+echo "CHECK_SUMMARY tier1_rc=${t1_rc} dots=${dots} smoke_rc=${smoke_rc} lint_rc=${lint_rc}"
+if [ "${smoke_rc}" -ne 0 ] || [ "${lint_rc}" -ne 0 ]; then
     exit 1
 fi
 if [ "${t1_rc}" -ne 0 ] && [ "${t1_rc}" -ne 124 ]; then

@@ -69,11 +69,16 @@ def inprocess_phase(node_url, chain, step) -> None:
         ecdsa_keypairs_from_mnemonic,
     )
     from protocol_tpu.service import FaultInjector, ServiceConfig, TrustService
+    from protocol_tpu.utils import trace
 
     config = ClientConfig(as_address="0x" + chain.contract_address.hex(),
                           node_url=node_url, domain="0x" + "00" * 20)
     client = Client(config, MNEMONIC)
     with tempfile.TemporaryDirectory(prefix="ptpu-smoke-") as tmp:
+        # JSONL trace stream: the end-to-end trace-join assertion below
+        # reads this file back
+        trace_path = os.path.join(tmp, "trace.jsonl")
+        trace.enable(trace_path)
         service = TrustService(
             client, ServiceConfig(port=0, poll_interval=0.1,
                                   refresh_interval=0.1, tol=1e-10,
@@ -132,11 +137,98 @@ def inprocess_phase(node_url, chain, step) -> None:
              f"cursor={health['block_cursor']}, "
              f"wal_bytes={_metric_value(metrics, 'ptpu_store_wal_bytes')}")
 
+        # --- scrape lint: the exposition must parse, with the typed
+        # series of the observability layer present -----------------------
+        scrape_lint_phase(_get_json(url, "/metrics"), step)
+
+        # --- /status: the operator JSON view ------------------------------
+        status = _get_json(url, "/status")
+        for key in ("uptime_seconds", "block_cursor", "graph",
+                    "score_freshness_seconds", "last_refresh", "queue"):
+            assert key in status, f"/status missing {key!r}"
+        assert status["graph"]["peers"] == 2
+        fresh = status["score_freshness_seconds"]
+        assert 0.0 <= fresh < 120.0, \
+            f"score freshness {fresh} outside the sane window"
+        step(f"/status ok (freshness {fresh:.2f}s, "
+             f"uptime {status['uptime_seconds']:.1f}s)")
+
+        # --- end-to-end trace join over the JSONL stream ------------------
+        trace_join_phase(trace_path, chain, step)
+
         os.kill(os.getpid(), signal.SIGTERM)
         step("sent SIGTERM to self")
         service.wait()
         assert service.draining
         step("drain complete")
+        trace.disable()
+
+
+def scrape_lint_phase(metrics_text, step) -> None:
+    """Pure-python exposition lint + presence of the key typed series
+    (the tools/check.sh scrape-lint phase)."""
+    from protocol_tpu.service.metrics import lint_exposition
+
+    errors = lint_exposition(metrics_text)
+    assert not errors, "scrape lint failed:\n" + "\n".join(errors)
+    for needle in ("ptpu_http_request_seconds_bucket",
+                   "ptpu_wal_append_seconds_bucket",
+                   "ptpu_score_freshness_seconds",
+                   "ptpu_refresh_seconds_bucket",
+                   "ptpu_service_ingest_attestations_total",
+                   "ptpu_span_total"):
+        assert needle in metrics_text, \
+            f"/metrics missing typed series {needle}"
+    step(f"SCRAPE_LINT_OK ({len(metrics_text.splitlines())} lines, "
+         "0 errors)")
+
+
+def trace_join_phase(trace_path, chain, step) -> None:
+    """One attestation's digest-derived trace id must appear on the
+    tailer, WAL-append, graph-apply, AND refresh spans in the JSONL
+    stream — the end-to-end join the tracing layer promises."""
+    import json
+
+    from protocol_tpu.client.attestation import (
+        DOMAIN_PREFIX,
+        SignedAttestationData,
+    )
+    from protocol_tpu.service.state import att_trace_id
+
+    expected_key = DOMAIN_PREFIX + b"\x00" * 20
+    tids = []
+    for log in chain.get_logs(0):
+        if log.key != expected_key:
+            continue
+        signed = SignedAttestationData.from_log(log.about, log.key,
+                                                log.val)
+        tids.append(att_trace_id(log.block_number, log.about,
+                                 signed.to_payload()))
+    assert tids, "no attestations on-chain to join against"
+
+    spans_by_tid = {}
+    with open(trace_path) as f:
+        for line in f:
+            try:
+                obj = json.loads(line)
+            except ValueError as e:
+                raise AssertionError(
+                    f"corrupt JSONL trace line: {line!r} ({e})") from e
+            if obj.get("type") != "span":
+                continue
+            ids = obj.get("trace_ids") or (
+                [obj["trace_id"]] if "trace_id" in obj else [])
+            for tid in ids:
+                spans_by_tid.setdefault(tid, set()).add(obj["name"])
+    joined = [t for t in tids if {
+        "service.tail_batch", "service.wal_append",
+        "service.graph_apply", "service.refresh",
+    } <= spans_by_tid.get(t, set())]
+    got = {t: sorted(spans_by_tid.get(t, set())) for t in tids}
+    assert joined, ("no attestation trace id joins "
+                    f"tailer→WAL→apply→refresh; per-id spans: {got}")
+    step(f"TRACE_JOIN_OK ({len(joined)}/{len(tids)} attestation(s) "
+         f"joinable end-to-end, e.g. {joined[0]})")
 
 
 def _spawn_daemon(assets, extra_env, step, tag):
