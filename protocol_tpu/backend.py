@@ -133,6 +133,7 @@ class JaxSparseBackend(ConvergeBackend):
             converge_sparse_adaptive,
             converge_sparse_fixed,
             operator_arrays,
+            timed_converge,
         )
 
         op = build_operator(n, src, dst, val, valid)
@@ -141,11 +142,22 @@ class JaxSparseBackend(ConvergeBackend):
             s0 = jnp.asarray(op.valid, dtype=self.dtype) * float(initial_score)
         else:
             s0 = jnp.asarray(np.asarray(s0), dtype=self.dtype)
+        # the jit-cache identity of the converge call: bucket geometry +
+        # dtype + static loop bound. A compile for a signature already
+        # compiled once is a shape leak (steady-state recompile).
+        sig = ("sparse", n, tuple(b.shape for b in op.bucket_idx),
+               str(s0.dtype), "fixed" if tol is None else "adaptive",
+               int(num_iterations))
         if tol is None:
-            return np.asarray(converge_sparse_fixed(arrs, s0, num_iterations))
-        scores, iters, delta = converge_sparse_adaptive(
-            arrs, s0, tol=tol, max_iterations=num_iterations
-        )
+            scores = timed_converge(
+                "jax-sparse", n, len(src), sig,
+                lambda: converge_sparse_fixed(arrs, s0, num_iterations),
+                fixed_iterations=num_iterations)
+            return np.asarray(scores)
+        scores, iters, delta = timed_converge(
+            "jax-sparse", n, len(src), sig,
+            lambda: converge_sparse_adaptive(
+                arrs, s0, tol=tol, max_iterations=num_iterations))
         return np.asarray(scores), int(iters), float(delta)
 
 
@@ -162,6 +174,7 @@ class JaxRoutedBackend(JaxSparseBackend):
     ):
         import jax.numpy as jnp
 
+        from .ops.converge import timed_converge
         from .ops.routed import (
             build_routed_operator,
             converge_routed_adaptive,
@@ -180,11 +193,20 @@ class JaxRoutedBackend(JaxSparseBackend):
             # node-order warm start → state-slot order
             s0 = jnp.asarray(op.scores_from_nodes(np.asarray(s0),
                                                   dtype=self.dtype))
+        # the static tuple IS the routed jit cache key (hashable by
+        # construction) — plus dtype and the static loop bound
+        sig = ("routed", static, str(s0.dtype),
+               "fixed" if tol is None else "adaptive", int(num_iterations))
         if tol is None:
-            out = converge_routed_fixed(arrs, static, s0, num_iterations)
-            return op.scores_for_nodes(np.asarray(out))
-        scores, iters, delta = converge_routed_adaptive(
-            arrs, static, s0, tol=tol, max_iterations=num_iterations
-        )
+            scores = timed_converge(
+                "jax-routed", n, int(op.nnz), sig,
+                lambda: converge_routed_fixed(arrs, static, s0,
+                                              num_iterations),
+                fixed_iterations=num_iterations)
+            return op.scores_for_nodes(np.asarray(scores))
+        scores, iters, delta = timed_converge(
+            "jax-routed", n, int(op.nnz), sig,
+            lambda: converge_routed_adaptive(
+                arrs, static, s0, tol=tol, max_iterations=num_iterations))
         return (op.scores_for_nodes(np.asarray(scores)), int(iters),
                 float(delta))

@@ -157,14 +157,59 @@ def build_parser() -> argparse.ArgumentParser:
         "obs",
         help="observability tooling: validate a JSONL trace stream "
              "(PROTOCOL_TPU_TRACE=<path> / --trace PATH / the serve "
-             "daemon's stream) and render its span-aggregate summary")
+             "daemon's stream) and render its span-aggregate summary "
+             "(count/p50/p95 per stage)")
     p.add_argument("path", help="JSONL trace stream to read")
     p.add_argument("--follow", action="store_true",
                    help="tail the stream, printing records as they land "
                         "(Ctrl-C to stop)")
     p.add_argument("--trace-id", dest="trace_id",
                    help="print the span/event chain for one trace id "
-                        "(attestation digest prefix, job id, request id)")
+                        "(attestation digest prefix, job id — including "
+                        "its prover-stage spans, request id)")
+
+    p = sub.add_parser(
+        "profile",
+        help="run a workload under sync-span tracing (+ optional xprof "
+             "capture) and emit a merged per-stage report")
+    p.add_argument("--workload", choices=["prove", "refresh", "daemon"],
+                   default="refresh",
+                   help="prove: synthetic circuit through prove_auto "
+                        "(stage-attributed host or TPU path); refresh: "
+                        "synthetic trust-graph converge through the "
+                        "ConvergeBackend seam; daemon: capture window "
+                        "on a LIVE serve daemon via its job queue")
+    p.add_argument("--k", type=int, default=7,
+                   help="prove: domain exponent (synthetic circuit)")
+    p.add_argument("--gates", type=int, default=64,
+                   help="prove: synthetic gate count")
+    p.add_argument("--n", type=int, default=2000,
+                   help="refresh: peer count")
+    p.add_argument("--edges-per-node", type=int, default=4,
+                   help="refresh: BA attachment degree")
+    p.add_argument("--engine", choices=["gather", "routed"],
+                   default="gather", help="refresh: SpMV engine")
+    p.add_argument("--tol", type=float, default=1e-6,
+                   help="refresh: stopping tolerance")
+    p.add_argument("--repeat", type=int, default=1,
+                   help="run the workload N times (warm steady-state)")
+    p.add_argument("--url", help="daemon: base URL of the live daemon")
+    p.add_argument("--seconds", type=float, default=5.0,
+                   help="daemon: capture window length")
+    p.add_argument("--xprof", metavar="DIR",
+                   help="capture a jax.profiler (xprof) device timeline "
+                        "into DIR, joinable with the span stream by "
+                        "trace id")
+    p.add_argument("--jsonl", metavar="PATH",
+                   help="stream spans as JSONL to PATH (obs-verb food)")
+    p.add_argument("--json", metavar="PATH",
+                   help="write the per-stage report as JSON")
+    p.add_argument("--no-sync", action="store_true",
+                   help="keep async dispatch (production overlap) "
+                        "instead of sync-span attribution")
+    p.add_argument("--min-coverage", type=float, default=0.0,
+                   help="exit 1 unless the named prover stages cover at "
+                        "least this fraction of the prove wall time")
 
     sub.add_parser("show", help="print the current config")
 
@@ -844,6 +889,7 @@ def handle_obs(args, files, config):
     seen — the stream is a machine-readable contract, not best-effort
     logging."""
     import time as _time
+    from collections import deque
 
     from ..utils.trace import validate_record
 
@@ -868,6 +914,7 @@ def handle_obs(args, files, config):
 
     invalid: list = []
     agg: dict = {}
+    durations: dict = {}  # per-stage duration samples for p50/p95
     counts = {"span": 0, "event": 0, "metric": 0}
     chain: list = []
     try:
@@ -890,6 +937,12 @@ def handle_obs(args, files, config):
                 a["count"] += 1
                 a["total_s"] += obj["duration_s"]
                 a["max_s"] = max(a["max_s"], obj["duration_s"])
+                # bounded per-name sample window for the percentile
+                # columns (a daemon stream can hold millions of spans;
+                # deque(maxlen) keeps the append O(1))
+                if obj["name"] not in durations:
+                    durations[obj["name"]] = deque(maxlen=16384)
+                durations[obj["name"]].append(obj["duration_s"])
             if args.trace_id and matches(obj, args.trace_id):
                 chain.append(obj)
 
@@ -899,14 +952,23 @@ def handle_obs(args, files, config):
         for msg in invalid[:20]:
             print(f"  invalid: {msg}", file=sys.stderr)
         if agg:
+            from ..utils.trace import percentile
+
             width = max(len(n) for n in agg)
             print(f"{'span':<{width}}  {'n':>8}  {'total_s':>10}  "
-                  f"{'mean_ms':>9}  {'max_s':>9}")
+                  f"{'mean_ms':>9}  {'p50_ms':>9}  {'p95_ms':>9}  "
+                  f"{'max_s':>9}")
             for name, a in sorted(agg.items(),
                                   key=lambda kv: -kv[1]["total_s"]):
                 mean_ms = 1000.0 * a["total_s"] / a["count"]
+                # agg and durations are filled in lockstep in the span
+                # branch above, so the window is always present
+                d = durations[name]
+                p50_ms = 1000.0 * percentile(d, 0.50)
+                p95_ms = 1000.0 * percentile(d, 0.95)
                 print(f"{name:<{width}}  {a['count']:>8}  "
                       f"{a['total_s']:>10.3f}  {mean_ms:>9.3f}  "
+                      f"{p50_ms:>9.3f}  {p95_ms:>9.3f}  "
                       f"{a['max_s']:>9.3f}")
         if args.trace_id:
             print(f"\ntrace {args.trace_id}: {len(chain)} record(s)")
@@ -1034,9 +1096,16 @@ def handle_store(args, files, config):
     return 0
 
 
+def handle_profile(args, files, config):
+    from .profilecmd import handle_profile as _handle
+
+    return _handle(args, files, config)
+
+
 HANDLERS = {
     "attest": handle_attest,
     "serve": handle_serve,
+    "profile": handle_profile,
     "attestations": handle_attestations,
     "bandada": handle_bandada,
     "deploy": handle_deploy,

@@ -1,0 +1,310 @@
+"""The ``profile`` CLI verb: run a workload under the instrument layer
+and emit a merged per-stage report.
+
+Closes the ROADMAP "device-side (xprof) timeline correlation" remainder:
+one command runs a chosen workload — a synthetic prove (host or TPU
+path, whichever ``prove_auto`` picks), a synthetic score refresh, or a
+capture window on a LIVE serve daemon via its proof job queue — with
+
+- **sync-span mode** on by default (``trace.sync_spans()``), so stage
+  spans attribute device work accurately instead of dispatch-skewed;
+- an optional **xprof capture** (``--xprof DIR`` →
+  ``trace.device_trace``) whose start/stop events share the workload's
+  trace id with the JSONL span stream (``--jsonl PATH``) — the offline
+  xprof timeline joins the span stream by trace id + wall clock;
+- **XLA compile tracking** installed, so the report separates compile
+  from execute;
+
+and then prints the per-stage table from the
+``ptpu_prover_stage_seconds``/span aggregates: count, total, share of
+the prove wall time. ``--min-coverage`` turns the report into an
+assertion that the named stages account for at least that fraction of
+the total — the "stage times sum to the prove wall time" honesty check
+``tools/perf_gate.py`` and the test suite reuse.
+"""
+
+from __future__ import annotations
+
+import json
+import random
+import sys
+import time
+
+from ..utils.errors import EigenError
+
+
+# --- workload runners (shared with tools/perf_gate.py) ---------------------
+
+def run_prove_workload(k: int = 7, gates: int = 64, repeat: int = 1,
+                       seed: int = 7) -> dict:
+    """Keygen + prove a synthetic circuit on a 2^k domain through
+    ``prove_auto`` (host path on a jax-less/CPU box, TPU path on an
+    accelerator — both are stage-attributed). Returns workload metadata;
+    timings land in the process tracer."""
+    from .. import native
+    from ..zk import prover_fast as pf
+    from ..zk.plonk import ConstraintSystem, verify
+
+    if not native.available():
+        raise EigenError("config_error",
+                         "the prove workload needs the native toolchain")
+    rng = random.Random(seed)
+    cs = ConstraintSystem(lookup_bits=6)
+    from ..utils.fields import BN254_FR_MODULUS as R
+
+    for _ in range(gates):
+        a, b = rng.randrange(50), rng.randrange(50)
+        cs.add_row([a, b, (a * b + a) % R], q_a=1, q_mul_ab=1, q_c=R - 1)
+    lk = cs.lookup_row(37)
+    row = cs.add_row([37], q_a=1, q_const=R - 37)
+    cs.copy(lk, (0, row))
+    cs.public_input(12345)
+    params = pf.setup_params_fast(k, seed=b"profile")
+    pk = pf.keygen_fast(params, cs, k=k, eval_pk="auto")
+    proof = b""
+    for _ in range(max(1, repeat)):
+        proof = pf.prove_auto(params, pk, cs)
+    if not verify(params, pk, cs.public_values(), proof):
+        raise EigenError("verification_error",
+                         "profile workload produced an invalid proof")
+    return {"workload": "prove", "k": k, "gates": gates,
+            "repeat": repeat, "rows": cs.num_rows}
+
+
+def run_refresh_workload(n: int = 2000, m: int = 4,
+                         engine: str = "gather", tol: float = 1e-6,
+                         repeat: int = 1, seed: int = 11) -> dict:
+    """Adaptive converge of a synthetic Barabási–Albert trust graph
+    through the ConvergeBackend seam (the serve daemon's refresh path):
+    exercises operator build, the converge sweeps, and the iteration/
+    residual gauges."""
+    from ..backend import JaxRoutedBackend, JaxSparseBackend
+    from ..graph import barabasi_albert_edges
+
+    import numpy as np
+
+    src, dst, val = barabasi_albert_edges(n, m, seed=seed)
+    valid = np.ones(n, dtype=bool)
+    backend = (JaxRoutedBackend() if engine == "routed"
+               else JaxSparseBackend())
+    iters = delta = None
+    for _ in range(max(1, repeat)):
+        _, iters, delta = backend.converge_edges(
+            n, src, dst, val, valid, 1000.0, 500, tol=tol)
+    return {"workload": "refresh", "n": n, "edges": len(src),
+            "engine": engine, "iterations": int(iters),
+            "residual": float(delta), "repeat": repeat}
+
+
+def run_daemon_capture(url: str, seconds: float) -> dict:
+    """Submit a ``profile`` job to a live daemon and wait for the
+    capture window to close; returns the job result (xprof log dir on
+    the daemon's filesystem)."""
+    import urllib.error
+    import urllib.request
+
+    def call(method, path, body=None):
+        req = urllib.request.Request(
+            url.rstrip("/") + path, method=method,
+            data=(json.dumps(body).encode() if body is not None
+                  else None),
+            headers={"Content-Type": "application/json"})
+        try:
+            with urllib.request.urlopen(req, timeout=30) as r:
+                return json.loads(r.read())
+        except urllib.error.HTTPError as e:
+            # 429 = queue backpressure, 503 = draining — structured
+            # errors, not tracebacks
+            raise EigenError(
+                "service_busy",
+                f"daemon rejected {method} {path}: HTTP {e.code} "
+                f"{e.read()[:200].decode(errors='replace')}") from e
+        except urllib.error.URLError as e:
+            raise EigenError(
+                "connection_error",
+                f"cannot reach daemon at {url}: {e.reason}") from e
+
+    job = call("POST", "/proofs",
+               {"kind": "profile", "params": {"seconds": seconds}})
+    job_id = job["job_id"]
+    deadline = time.monotonic() + seconds + 120.0
+    while time.monotonic() < deadline:
+        job = call("GET", f"/proofs/{job_id}")
+        if job["status"] in ("done", "failed", "cancelled"):
+            break
+        time.sleep(min(1.0, seconds / 4 + 0.2))
+    if job["status"] != "done":
+        raise EigenError(
+            "service_busy",
+            f"daemon capture job {job_id} ended {job['status']}: "
+            f"{job.get('error')}")
+    return {"workload": "daemon", "url": url, "job_id": job_id,
+            **(job.get("result") or {})}
+
+
+# --- report ----------------------------------------------------------------
+
+def fold_prover_stages() -> dict:
+    """``ptpu_prover_stage_seconds`` series folded per stage label:
+    ``{stage: {count, total_s}}``. The ONE aggregation both the
+    ``profile`` report and ``tools/perf_gate.py`` read, so the verb's
+    report and the gate's committed baseline cannot drift if the label
+    scheme changes."""
+    from ..utils import trace
+
+    stages: dict = {}
+    for items, s in trace.histogram("prover_stage_seconds").series():
+        labels = dict(items)
+        key = labels.get("stage", "?")
+        entry = stages.setdefault(key, {"count": 0, "total_s": 0.0})
+        entry["count"] += s["count"]
+        entry["total_s"] += s["sum"]
+    return stages
+
+
+def collect_stage_report(meta: dict, total_wall: float) -> dict:
+    """Merge the tracer's per-stage instruments into one report dict:
+    prover stages (from ``ptpu_prover_stage_seconds``), the prove/
+    converge totals, converge gauges, and compile stats. ``coverage``
+    is sum(stage seconds)/prove total — under sync-span mode the stages
+    are serialized and exhaustive, so it should sit near 1.0."""
+    from ..utils import trace
+
+    stages = fold_prover_stages()
+    prove_total = 0.0
+    for _, s in trace.histogram("prover_total_seconds").series():
+        prove_total += s["sum"]
+    converge = {}
+    for name in ("converge.edges", "routed.plan_build",
+                 "service.operator_build"):
+        agg = trace.summary().get(name)
+        if agg:
+            converge[name] = {"count": agg["count"],
+                              "total_s": round(agg["total_s"], 6)}
+    sweep = {}
+    for items, s in trace.histogram("converge_sweep_seconds").series():
+        labels = dict(items)
+        sweep[labels.get("backend", "?")] = {
+            "sweeps": s["count"],
+            "mean_sweep_s": (s["sum"] / s["count"]) if s["count"] else 0.0,
+        }
+    stage_total = sum(e["total_s"] for e in stages.values())
+    coverage = (stage_total / prove_total) if prove_total > 0 else None
+    return {
+        "schema": "ptpu-profile-v1",
+        "meta": meta,
+        "total_wall_s": round(total_wall, 6),
+        "prove_total_s": round(prove_total, 6),
+        "stages": {k: {"count": v["count"],
+                       "total_s": round(v["total_s"], 6)}
+                   for k, v in sorted(stages.items())},
+        "stage_total_s": round(stage_total, 6),
+        "coverage": round(coverage, 4) if coverage is not None else None,
+        "converge": converge,
+        "sweep": sweep,
+        "compile": trace.compile_stats(),
+        "sync_spans": trace.sync_enabled(),
+    }
+
+
+def print_report(report: dict, out=None) -> None:
+    # resolve stdout at CALL time (a def-time default would capture a
+    # test harness's swapped-out stream)
+    out = out if out is not None else sys.stdout
+    meta = report["meta"]
+    print(f"profile: workload={meta.get('workload')} "
+          f"wall={report['total_wall_s']:.3f}s "
+          f"sync_spans={report['sync_spans']}", file=out)
+    if report["stages"]:
+        width = max(len(s) for s in report["stages"])
+        denom = report["prove_total_s"] or report["total_wall_s"]
+        print(f"{'stage':<{width}}  {'n':>5}  {'total_s':>9}  "
+              f"{'share':>6}", file=out)
+        for name, e in sorted(report["stages"].items(),
+                              key=lambda kv: -kv[1]["total_s"]):
+            share = e["total_s"] / denom if denom else 0.0
+            print(f"{name:<{width}}  {e['count']:>5}  "
+                  f"{e['total_s']:>9.3f}  {share:>5.1%}", file=out)
+        print(f"prove total {report['prove_total_s']:.3f}s, stage sum "
+              f"{report['stage_total_s']:.3f}s", file=out)
+    for name, e in report["converge"].items():
+        print(f"{name}: n={e['count']} total={e['total_s']:.3f}s",
+              file=out)
+    for backend, e in report["sweep"].items():
+        print(f"converge sweeps[{backend}]: {e['sweeps']} observed, "
+              f"mean {e['mean_sweep_s'] * 1000:.3f}ms", file=out)
+    c = report["compile"]
+    print(f"xla: {c['compiles']} compile(s), "
+          f"{c['compile_seconds']:.3f}s compiling, "
+          f"{c['steady_recompiles']} steady-state recompile(s)",
+          file=out)
+    if report["coverage"] is not None:
+        print(f"STAGE_COVERAGE={report['coverage']:.4f}", file=out)
+
+
+def handle_profile(args, files, config) -> int:
+    """Run the chosen workload under sync-span tracing (+ optional
+    xprof capture) and print/write the merged per-stage report."""
+    from ..utils import trace
+    from ..utils.platform import honor_jax_platforms_env
+
+    honor_jax_platforms_env()
+
+    if args.jsonl or not trace.TRACER.enabled:
+        # enable() closes any previously-opened stream before swapping
+        trace.enable(args.jsonl)
+    trace.sync_spans(not args.no_sync)
+    trace.install_compile_tracking()
+    trace_id = f"profile-{trace.new_id()}"
+
+    def run():
+        if args.workload == "prove":
+            return run_prove_workload(k=args.k, gates=args.gates,
+                                      repeat=args.repeat)
+        if args.workload == "refresh":
+            return run_refresh_workload(n=args.n, m=args.edges_per_node,
+                                        engine=args.engine, tol=args.tol,
+                                        repeat=args.repeat)
+        if not args.url:
+            raise EigenError("config_error",
+                             "--workload daemon needs --url (a live "
+                             "serve daemon)")
+        return run_daemon_capture(args.url, args.seconds)
+
+    # a local capture around the daemon workload would time an HTTP
+    # polling loop: the device work (and its xprof log dir) lives on
+    # the daemon's side, reported back in the job result
+    local_xprof = args.xprof if args.workload != "daemon" else None
+    if args.xprof and not local_xprof:
+        print("note: --workload daemon captures xprof on the daemon's "
+              "filesystem (xprof_dir in the report); local --xprof "
+              "ignored", file=sys.stderr)
+
+    t0 = time.perf_counter()
+    with trace.context(trace_id=trace_id):
+        if local_xprof:
+            with trace.device_trace(local_xprof):
+                meta = run()
+        else:
+            meta = run()
+    total_wall = time.perf_counter() - t0
+    meta["trace_id"] = trace_id
+    if local_xprof:
+        meta["xprof"] = local_xprof
+
+    report = collect_stage_report(meta, total_wall)
+    print_report(report)
+    if args.json:
+        with open(args.json, "w") as f:
+            json.dump(report, f, indent=2, sort_keys=True)
+        print(f"wrote {args.json}")
+    if args.min_coverage:
+        if report["coverage"] is None:
+            print("error: --min-coverage needs a prove workload "
+                  "(no prover total recorded)", file=sys.stderr)
+            return 1
+        if report["coverage"] < args.min_coverage:
+            print(f"error: stage coverage {report['coverage']:.4f} < "
+                  f"{args.min_coverage}", file=sys.stderr)
+            return 1
+    return 0

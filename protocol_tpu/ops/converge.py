@@ -23,6 +23,7 @@ static (unrolled loop bounds) or carried as while_loop state.
 
 from __future__ import annotations
 
+import time
 from functools import partial
 
 import jax
@@ -31,6 +32,74 @@ import numpy as np
 from jax import lax
 
 from ..graph import EllOperator
+from ..utils import trace
+
+
+def record_converge_stats(backend: str, iters: int, delta, seconds: float,
+                          n: int | None = None) -> None:
+    """Shared converge observability: every backend (gather, routed,
+    sharded) reports its exit through this one seam so the instruments
+    cannot diverge. Emits
+
+    - ``ptpu_converge_iterations{backend}`` — the iteration count the
+      power method actually ran: the convergence signal the EigenTrust
+      analyses (arXiv:1603.00589, 2606.11956) say governs score
+      quality, previously observable nowhere;
+    - ``ptpu_converge_residual{backend}`` — the final relative-L1 delta
+      (adaptive runs only; fixed-iteration runs pass ``delta=None``);
+    - ``ptpu_converge_sweep_seconds{backend}`` — mean per-sweep
+      (operator-apply) wall time, total/iters. The sweeps run inside a
+      jitted ``while_loop``, so per-sweep timing cannot be observed
+      in-loop without breaking compilation — the mean is the honest
+      host-side view.
+    """
+    iters = int(iters)
+    trace.gauge("converge_iterations").set(iters, backend=backend)
+    if delta is not None:
+        trace.gauge("converge_residual").set(float(delta), backend=backend)
+    if iters > 0:
+        trace.histogram("converge_sweep_seconds").observe(
+            seconds / iters, backend=backend)
+    trace.event("converge.done", backend=backend, iterations=iters,
+                seconds=round(seconds, 6),
+                **({} if n is None else {"n": n}),
+                **({} if delta is None else {"residual": float(delta)}))
+
+
+def timed_converge(backend: str, n: int, edges: int, signature, call,
+                   fixed_iterations: int | None = None):
+    """The one instrumentation wrapper every ConvergeBackend runs its
+    converge through (span + compile watch + stats — a single seam so
+    the two backends cannot drift): executes ``call`` under the
+    ``converge.edges`` span and a ``compile_watch`` keyed on
+    ``signature`` (the jit-cache identity — a second compile for the
+    same signature is a steady-state recompile), and BLOCKS on the
+    result before closing the timer: the converge functions are jitted
+    and return at dispatch, so an unblocked wall time would record
+    dispatch cost, not compute. The caller blocks immediately
+    afterwards anyway (``np.asarray``), so this costs nothing.
+
+    ``call`` returns device ``scores`` in fixed-iteration mode (pass
+    ``fixed_iterations``) or ``(scores, iters, delta)`` in adaptive
+    mode; returns ``call``'s result unchanged."""
+    t0 = time.perf_counter()
+    c0 = trace.thread_compile_seconds()
+    with trace.span("converge.edges", backend=backend, n=n, edges=edges):
+        with trace.compile_watch("converge", signature=signature):
+            out = call()
+            jax.block_until_ready(out)
+    # carve the XLA compile out of the window (the listener runs on
+    # this thread): a cold shape would otherwise inflate the per-sweep
+    # mean by the whole compile, which ptpu_xla_compile_seconds
+    # already measures on its own
+    compile_dt = trace.thread_compile_seconds() - c0
+    dt = max(time.perf_counter() - t0 - compile_dt, 0.0)
+    if fixed_iterations is not None:
+        record_converge_stats(backend, fixed_iterations, None, dt, n=n)
+    else:
+        _, iters, delta = out
+        record_converge_stats(backend, int(iters), float(delta), dt, n=n)
+    return out
 
 
 def warm_start_scores(prev, n: int, valid, initial_score: float):

@@ -497,7 +497,24 @@ def build_routed_operator(
 
     Semantics of ``filter_edges`` (the reference's opinion filter,
     ``dynamic_sets/native.rs:234-283``) are shared with the gather path.
+
+    The build is the converge path's one-time compilation cost (minutes
+    at 10M peers) — spanned and recorded as
+    ``ptpu_routed_plan_build_seconds`` so operator-cache misses are
+    attributable in the serve daemon's refresh latency.
     """
+    from ..utils import trace as _trace
+
+    with _trace.timed("routed_plan_build_seconds", "routed.plan_build",
+                      n=n, edges=len(src)):
+        op = _build_routed_operator(n, src, dst, val, valid, min_width,
+                                    prefer_native)
+    return op
+
+
+def _build_routed_operator(
+    n, src, dst, val, valid, min_width: int, prefer_native: bool,
+) -> RoutedOperator:
     src, dst, weight, valid_mask, dangling = filter_edges(n, src, dst, val, valid)
 
     out_side = _bucketize_blocked(n, src, dst, weight, min_width)

@@ -49,6 +49,7 @@ from .mesh import shard_map_norep
 
 from ..ops import fieldops2 as f2
 from ..ops import ntt_tpu
+from ..utils import trace
 from ..zk import prover_tpu as ptpu
 
 L, L6 = f2.L, f2.L6
@@ -87,11 +88,16 @@ class ShardedRound3:
             return jax.device_put(_grid(packed16, self.A, self.B),
                                   self._sh)
 
-        self.coset_pows = [place(t) for t in dp.coset_pows]
-        self.xs_fs = [place(t) for t in dp.xs_fs]
-        self.l0_fs = [place(t) for t in dp.l0_fs]
-        self.we_neg_pows = [place(t) for t in dp.we_neg_pows]
-        self.s_neg_pows = place(dp.s_neg_pows)
+        # mesh placement of the DeviceProver's static tables — the
+        # sharded pipeline's init cost, attributed like a prover stage
+        with trace.span("parallel.r3_place_tables", k=dp.k,
+                        shards=self.D):
+            self.coset_pows = [place(t) for t in dp.coset_pows]
+            self.xs_fs = [place(t) for t in dp.xs_fs]
+            self.l0_fs = [place(t) for t in dp.l0_fs]
+            self.we_neg_pows = [place(t) for t in dp.we_neg_pows]
+            self.s_neg_pows = place(dp.s_neg_pows)
+            trace.device_sync(self.s_neg_pows)
         self.plan = dp.plan
         # jitted shard_map callables, built once per instance: a fresh
         # closure per call would re-trace and re-compile every dispatch
@@ -232,9 +238,13 @@ class ShardedRound3:
                 in_specs=(spec, spec, rep2, rep2,
                           *([spec] * (4 + 25))),
                 out_specs=spec))
-        return fn(self.xs_fs[j], self.l0_fs[j], ch_planes,
-                  dp.zh_inv_planes[j], z_e, phi_e, m_e, pi_e,
-                  *wires_e, *uv_e, *fixed, *sigma)
+        with trace.span("parallel.r3_quotient_chunk", j=j,
+                        shards=self.D):
+            out = fn(self.xs_fs[j], self.l0_fs[j], ch_planes,
+                     dp.zh_inv_planes[j], z_e, phi_e, m_e, pi_e,
+                     *wires_e, *uv_e, *fixed, *sigma)
+            trace.device_sync(out)
+        return out
 
     def _reshard_table(self, key, packed16) -> jnp.ndarray:
         # keyed by (table_kind, column, chunk); each entry pins a strong
@@ -298,6 +308,12 @@ class ShardedRound3:
     def intt_ext(self, t_chunks: list) -> list:
         """Sharded twin of ``DeviceProver.intt_ext``: per-chunk sharded
         iNTTs + the pointwise radix-4 cross-chunk combine."""
+        with trace.span("parallel.r3_intt_ext", shards=self.D):
+            out = self._intt_ext(t_chunks)
+            trace.device_sync(out)
+        return out
+
+    def _intt_ext(self, t_chunks: list) -> list:
         dp = self.dp
         hats = []
         for j in range(EXT_COSETS):

@@ -487,8 +487,17 @@ def sharded_routed_converge_fixed(
         pallas = _use_pallas()
     meta, arrs = _resolve_routed(op, mesh, dtype, alpha)
     s = _place_scores(mesh, jnp.asarray(s0, dtype))
-    out = _fixed_fn(mesh, float(meta.n_valid), int(num_iterations),
-                    _cfg(meta, pallas))(arrs, s)
+    cfg = _cfg(meta, pallas)
+    from ..ops.converge import timed_converge
+
+    # the lru_cache key of _fixed_fn IS the jit-cache identity here
+    out = timed_converge(
+        "sharded-routed", meta.n, int(meta.nnz),
+        ("sharded-fixed", mesh, cfg, str(jnp.dtype(dtype)),
+         int(num_iterations)),
+        lambda: _fixed_fn(mesh, float(meta.n_valid), int(num_iterations),
+                          cfg)(arrs, s),
+        fixed_iterations=num_iterations)
     return out.reshape(-1)
 
 
@@ -504,7 +513,16 @@ def sharded_routed_converge_adaptive(
         pallas = _use_pallas()
     meta, arrs = _resolve_routed(op, mesh, dtype, alpha)
     s = _place_scores(mesh, jnp.asarray(s0, dtype))
-    scores, iters, delta = _adaptive_fn(
-        mesh, float(meta.n_valid), float(tol), int(max_iterations),
-        _cfg(meta, pallas))(arrs, s)
+    cfg = _cfg(meta, pallas)
+    from ..ops.converge import timed_converge
+
+    # tol joins the signature here (unlike the single-device backends,
+    # where it is traced): _adaptive_fn bakes it into the shmapped
+    # function, so a new tol legitimately compiles
+    scores, iters, delta = timed_converge(
+        "sharded-routed", meta.n, int(meta.nnz),
+        ("sharded-adaptive", mesh, cfg, str(jnp.dtype(dtype)),
+         float(tol), int(max_iterations)),
+        lambda: _adaptive_fn(mesh, float(meta.n_valid), float(tol),
+                             int(max_iterations), cfg)(arrs, s))
     return scores.reshape(-1), iters, delta

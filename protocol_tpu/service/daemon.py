@@ -80,6 +80,13 @@ class TrustService:
             # /metrics is part of the service contract, and restore
             # (snapshot + WAL replay) emits spans before start()
             trace.enable()
+        # instrument families declared up front (# TYPE from the first
+        # scrape) and the XLA compile listener installed: a steady-state
+        # recompile in the daemon is a shape leak we latch and surface
+        from .metrics import declare_instruments
+
+        declare_instruments()
+        trace.install_compile_tracking()
         state_dir = state_dir or config.state_dir or None
         self.store = None
         if state_dir:
@@ -389,6 +396,11 @@ class TrustService:
                 "completed": self.jobs.completed,
                 "failed": self.jobs.failed,
             },
+            # device-layer observability: compile counts and the
+            # steady-state recompile latch (a warning here means a
+            # shape leak in the refresh or prover cache — see
+            # trace.CompileTracker)
+            "xla": trace.compile_stats(),
         }
         if self.store is not None:
             wal = self.store.wal.stats()

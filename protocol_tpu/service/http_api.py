@@ -17,6 +17,10 @@ Routes:
 - ``GET /proofs/<id>/proof.bin``  the raw proof bytes
   (application/octet-stream) — byte-identical to the batch prover's
   artifact file, served from the proof artifact store
+- ``GET /stages``         per-stage duration summary (count, total,
+  max, p50, p95 per span name — ``trace.stage_summary()``): the live
+  twin of the ``obs`` verb's offline stream summary, covering prover
+  stages and converge sweeps once work has flowed through them
 - ``GET /metrics``        Prometheus text (``service/metrics.py``)
 
 Middleware (every request): a per-request trace id (``X-Request-Id``
@@ -53,7 +57,7 @@ def _parse_address(text: str) -> bytes | None:
 def _route_template(method: str, path: str) -> str:
     """Stable-cardinality route label: the template, never the raw
     path (addresses and job ids would explode the label space)."""
-    if path in ("/healthz", "/status", "/scores", "/metrics"):
+    if path in ("/healthz", "/status", "/scores", "/metrics", "/stages"):
         return path
     if path.startswith("/score/"):
         return "/score/{addr}"
@@ -117,6 +121,11 @@ def make_server(service, host: str, port: int) -> ThreadingHTTPServer:
                 return self._reply(200, service.health())
             if path == "/status":
                 return self._reply(200, service.status())
+            if path == "/stages":
+                return self._reply(200, {
+                    "stages": trace.stage_summary(),
+                    "xla": trace.compile_stats(),
+                })
             if path == "/metrics":
                 return self._reply(
                     200, render_prometheus(service.extra_metrics()),

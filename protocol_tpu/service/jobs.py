@@ -121,6 +121,12 @@ class ProofJobQueue:
         self.completed = 0
         self.failed = 0
 
+    def _record_depth(self, depth: int) -> None:
+        """Legacy metric and typed gauge in lockstep: dashboards scrape
+        both series, so every depth change must land on both."""
+        trace.metric("service.proof_queue_depth", depth)
+        trace.gauge("proof_queue_depth").set(depth)
+
     # --- submission / lookup ---------------------------------------------
     def submit(self, kind: str, params: dict | None = None) -> ProofJob:
         if kind not in self.provers:
@@ -166,7 +172,7 @@ class ProofJobQueue:
                                  "service is draining; not accepting jobs")
             self._pending.append(job)
             self._wake.notify()
-            trace.metric("service.proof_queue_depth", len(self._pending))
+            self._record_depth(len(self._pending))
             trace.event("service.job_submitted", trace_id=job.job_id,
                         kind=kind, depth=len(self._pending))
             return job
@@ -230,6 +236,10 @@ class ProofJobQueue:
                 job = self._pending.popleft()
                 job.status = "running"
                 job.started_at = time.time()
+                # keep the depth honest on the DRAIN side too: a
+                # submit-only gauge would report a stale backlog forever
+                # after the queue empties
+                self._record_depth(len(self._pending))
             # queue wait vs prove time: the two halves of a client's
             # submit→done latency a single total would conflate
             trace.histogram("proof_wait_seconds").observe(
@@ -237,7 +247,10 @@ class ProofJobQueue:
             try:
                 self.faults.check("device")
                 # the job id IS the trace id: /proofs/<id> polls and
-                # the JSONL stream join on the same string
+                # the JSONL stream join on the same string. Prover
+                # stage spans (prove_tpu.* / prove.*) run on THIS
+                # thread inside the context, so `obs --trace-id <job>`
+                # shows the job's full per-stage decomposition.
                 with trace.context(trace_id=job.job_id):
                     with trace.span("service.proof", kind=job.kind):
                         result = self.provers[job.kind](job.params)
@@ -284,6 +297,8 @@ class ProofJobQueue:
                 job.finished_at = time.time()
                 job.error = "cancelled: service shutdown"
             self._pending.clear()
+            self._record_depth(0)  # drained/cancelled: scrapes during
+            # the drain window must not report a backlog
             self._stop = True
             self._wake.notify_all()
         if self.artifacts is not None:

@@ -67,6 +67,51 @@ MONOTONIC_METRICS = frozenset({
 })
 
 
+# every latency histogram the instrument layer emits, with the label
+# keys its quantiles aggregate over. One authoritative list: it drives
+# (a) declare_instruments() — the families appear on /metrics with
+# # TYPE metadata from the FIRST scrape, before the first sample — and
+# (b) the Prometheus recording rules (tools/prometheus/ptpu_rules.yml),
+# whose structural test cross-checks every rule against this set.
+HISTOGRAM_FAMILIES = {
+    "wal_append_seconds": (),
+    "wal_fsync_seconds": (),
+    "snapshot_encode_seconds": (),
+    "snapshot_save_seconds": (),
+    "proof_persist_seconds": (),
+    "refresh_seconds": ("mode",),
+    "proof_wait_seconds": ("kind",),
+    "proof_run_seconds": ("kind", "status"),
+    "http_request_seconds": ("endpoint", "status"),
+    "prover_stage_seconds": ("stage", "k", "path"),
+    "prover_total_seconds": ("k", "path"),
+    "converge_sweep_seconds": ("backend",),
+    "routed_plan_build_seconds": (),
+    "xla_compile_seconds": ("site",),
+}
+
+# typed counters/gauges of the device-observability layer, declared up
+# front for the same reason (the serve-smoke asserts a steady-state
+# recompile count of 0 — the series must exist to be assertable)
+DECLARED_COUNTERS = ("xla_compiles", "xla_steady_recompiles")
+DECLARED_GAUGES = ("converge_iterations", "converge_residual",
+                   "proof_queue_depth")
+
+
+def declare_instruments() -> None:
+    """Pre-register the instrument families above so ``/metrics``
+    carries their ``# TYPE`` declarations from daemon start. Histograms
+    with no samples render as a bare TYPE line; counters/gauges render
+    a zero default series only once touched — so the counters are
+    touched with a no-op ``inc(0)`` here (monotonicity unaffected)."""
+    for name in HISTOGRAM_FAMILIES:
+        trace.histogram(name)
+    for name in DECLARED_COUNTERS:
+        trace.counter(name).inc(0.0)
+    for name in DECLARED_GAUGES:
+        trace.gauge(name)
+
+
 def _sanitize(name: str) -> str:
     name = _NAME_OK.sub("_", name)
     if not name or not (name[0].isalpha() or name[0] in "_:"):

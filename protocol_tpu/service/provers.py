@@ -14,7 +14,55 @@ from __future__ import annotations
 
 import threading
 
+from ..utils import trace
 from ..utils.errors import EigenError
+
+
+def make_profile_prover(out_root) -> "callable":
+    """The live-daemon capture window (``profile`` job kind): hold a
+    ``jax.profiler`` (xprof) capture open for ``params["seconds"]``
+    while the daemon's other threads keep refreshing and serving —
+    device activity in the window lands in the xprof log, and the
+    capture's start/stop events carry the job id as trace id, so the
+    timeline is joinable against the JSONL span stream. Runs on the
+    proof worker, so it serializes with device proves (by design: the
+    device is a serially-owned resource) but NOT with refreshes or
+    HTTP. Trust model: the same as every other job kind — the API
+    already hands its (operator-trusted, loopback-bound by default)
+    clients minutes of device time per eigentrust/threshold prove, so
+    a capture window adds no new starvation class; still, the window
+    is clamped to 60 s per job and old capture dirs are pruned to the
+    newest 8, so repeated captures bound disk instead of growing it."""
+    import shutil
+    import time as _time
+
+    def _prune(profiles_root, keep: int = 8) -> None:
+        try:
+            entries = sorted((p.stat().st_mtime, p)
+                             for p in profiles_root.iterdir()
+                             if p.is_dir())
+        except OSError:
+            return
+        for _, p in entries[:-keep]:
+            shutil.rmtree(p, ignore_errors=True)
+
+    def profile(params: dict) -> dict:
+        try:
+            seconds = float(params.get("seconds", 5.0))
+        except (TypeError, ValueError) as e:
+            raise EigenError("validation_error",
+                             "profile jobs take {'seconds': float}") from e
+        seconds = min(max(seconds, 0.1), 60.0)
+        ids = trace.current_trace_ids()
+        tag = ids[0] if ids else "adhoc"
+        log_dir = str(out_root / "profiles" / tag)
+        with trace.device_trace(log_dir):
+            _time.sleep(seconds)
+        _prune(out_root / "profiles")
+        return {"log_dir": log_dir, "seconds": seconds,
+                "xla": trace.compile_stats()}
+
+    return profile
 
 
 class ArtifactCache:
@@ -96,4 +144,5 @@ def make_provers(service, files, shape_name: str = "default",
             "threshold_check": bool(setup.pub_inputs.threshold_check),
         }
 
-    return {"eigentrust": eigentrust, "threshold": threshold}
+    return {"eigentrust": eigentrust, "threshold": threshold,
+            "profile": make_profile_prover(files.assets)}

@@ -300,7 +300,8 @@ class ScoreRefresher:
         context; returns ``(scores, iters, delta, cold)``."""
         with trace.context(trace_ids=tids):
             with trace.span("service.refresh", n=n, edges=len(src),
-                            cold=cold):
+                            cold=cold,
+                            backend=type(backend).__name__):
                 scores, iters, delta = backend.converge_edges(
                     n, src, dst, val, valid, self.config.initial_score,
                     self.config.max_iterations, tol=self.config.tol,

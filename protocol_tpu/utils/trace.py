@@ -22,7 +22,23 @@ net-new for this framework. This module provides:
 - legacy scalar samples via ``metric(name, value)`` (gauge view),
 - a process-global ``Tracer`` with JSONL export and a summary table,
 - ``device_trace(log_dir)``: optional passthrough to the JAX profiler
-  (xprof) for device-side timelines.
+  (xprof) for device-side timelines — it emits start/stop events into
+  the JSONL stream carrying the log dir and the active trace ids, so an
+  xprof capture is joinable against the span stream offline;
+- **sync-span mode** (``sync_spans()`` / ``PTPU_TRACE_SYNC=1``): device
+  dispatch is asynchronous, so a span around a dispatch-only call
+  attributes the compute cost to whichever later span happens to block.
+  With sync mode on, ``device_sync(x)`` drains the device queue at span
+  boundaries, making per-stage attribution accurate (at the cost of the
+  production overlap — a profiling mode, not a serving default);
+- **XLA compile tracking** (:class:`CompileTracker`): a
+  ``jax.monitoring`` event listener recording every backend compile as
+  ``ptpu_xla_compiles_total{site}`` + ``ptpu_xla_compile_seconds``,
+  with ``compile_watch(site, signature)`` marking a code region — a
+  compile inside a region whose signature was already compiled once is
+  a *steady-state recompile* (a shape leak in a cache that should have
+  hit), counted and latched as a warning the service surfaces on
+  ``/status``.
 
 Tracing is off unless enabled — ``enable()`` in code or the
 ``PROTOCOL_TPU_TRACE`` env var (set to a path to also stream JSONL
@@ -41,6 +57,7 @@ import bisect
 import contextlib
 import itertools
 import json
+import math
 import os
 import threading
 import time
@@ -50,6 +67,11 @@ from dataclasses import dataclass
 # per-name metric history bound (samples kept for dump_jsonl); the
 # latest value is never dropped — see Tracer.metric
 METRIC_HISTORY_CAP = 4096
+
+# per-span-name duration window for percentile estimates (p50/p95 on
+# /stages and stage_summary): bounded per NAME so busy spans cannot
+# evict quiet ones
+DURATION_WINDOW_CAP = 512
 
 # default histogram buckets: log-spaced (factor √10) from 100 µs to
 # 100 s — WAL appends sit at the bottom, cold converges and proof jobs
@@ -202,6 +224,144 @@ class PendingTraces:
         return taken
 
 
+class CompileTracker:
+    """XLA compile observability: one ``jax.monitoring`` listener for
+    the process, feeding typed instruments and a steady-state recompile
+    detector.
+
+    Steady-state semantics: legitimate compiles happen whenever a new
+    shape reaches a jitted entry point (a grown graph, a new circuit
+    k). A compile for a (site, signature) pair that was ALREADY
+    compiled once in this process means a cache that should have hit
+    missed — a shape/weak-type leak in the refresh or prover cache —
+    so it increments ``xla_steady_recompiles`` and latches
+    :attr:`recompile_warning`. Callers pick the signature to mirror
+    the jit cache key they expect to hit (shapes + static args).
+
+    Thread model: ``jax.monitoring`` invokes listeners on the thread
+    that runs the compile (the dispatching thread), so the per-thread
+    compile count a :meth:`watch` reads cannot be inflated by a
+    concurrent thread's compiles."""
+
+    EVENT = "/jax/core/compile/backend_compile_duration"
+    SEEN_CAP = 4096  # signature memory bound (long-lived daemons)
+
+    def __init__(self, tracer: "Tracer"):
+        self._tracer = tracer
+        self._lock = threading.Lock()
+        self._local = threading.local()
+        self.installed = False
+        self.compiles = 0
+        self.compile_seconds = 0.0
+        self.steady_recompiles = 0
+        self.recompile_warning = False
+        self.last_site: str | None = None
+        self._seen: set = set()
+
+    def install(self) -> bool:
+        """Register the listener (idempotent); False when jax is
+        unavailable — compile tracking degrades to a no-op, never an
+        import error on jax-less hosts."""
+        if self.installed:
+            return True
+        try:
+            import jax.monitoring
+        except Exception:  # pragma: no cover - jax-less host
+            return False
+        jax.monitoring.register_event_duration_secs_listener(
+            self._on_event)
+        self.installed = True
+        return True
+
+    def _on_event(self, event: str, duration: float, **kw) -> None:
+        if event != self.EVENT or not self._tracer.enabled:
+            return
+        site = getattr(self._local, "site", None) or "other"
+        with self._lock:
+            self.compiles += 1
+            self.compile_seconds += float(duration)
+            self.last_site = site
+        self._local.count = getattr(self._local, "count", 0) + 1
+        self._local.seconds = (getattr(self._local, "seconds", 0.0)
+                               + float(duration))
+        self._tracer.counter("xla_compiles").inc(site=site)
+        self._tracer.histogram("xla_compile_seconds").observe(
+            float(duration), site=site)
+
+    def thread_compiles(self) -> int:
+        return getattr(self._local, "count", 0)
+
+    def thread_compile_seconds(self) -> float:
+        """Seconds THIS thread spent in backend compiles (the listener
+        runs on the dispatching thread) — lets a timed region carve
+        compile time out of its wall clock."""
+        return getattr(self._local, "seconds", 0.0)
+
+    @contextlib.contextmanager
+    def watch(self, site: str, signature=None):
+        """Attribute compiles inside the block to ``site``; with a
+        ``signature``, latch the steady-state warning when this exact
+        signature compiles a second time."""
+        if not self._tracer.enabled:
+            yield
+            return
+        self.install()
+        prev = getattr(self._local, "site", None)
+        self._local.site = site
+        before = self.thread_compiles()
+        try:
+            yield
+        finally:
+            self._local.site = prev
+            # > 0, not truthy: a concurrent reset() swaps the
+            # thread-local store out from under an in-flight watch and
+            # the delta goes negative — never latch or inc on that
+            compiled = self.thread_compiles() - before
+            if signature is not None and compiled > 0:
+                key = (site, signature)
+                with self._lock:
+                    seen = key in self._seen
+                    if not seen:
+                        if len(self._seen) >= self.SEEN_CAP:
+                            # bounded memory: dropping old signatures
+                            # can only under-report, never false-latch
+                            self._seen.pop()
+                        self._seen.add(key)
+                    else:
+                        self.steady_recompiles += compiled
+                        self.recompile_warning = True
+                if seen:
+                    self._tracer.counter("xla_steady_recompiles").inc(
+                        compiled, site=site)
+                    self._tracer.event("trace.steady_recompile",
+                                       site=site, compiles=compiled)
+
+    def reset(self) -> None:
+        """Clear counters, the seen-signature set, and the warning
+        latch (the listener stays installed). Test teardown seam —
+        the latch is process-global, so a test that deliberately
+        trips it must not leak the warning into later tests."""
+        with self._lock:
+            self.compiles = 0
+            self.compile_seconds = 0.0
+            self.steady_recompiles = 0
+            self.recompile_warning = False
+            self.last_site = None
+            self._seen.clear()
+        self._local = threading.local()
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {
+                "installed": self.installed,
+                "compiles": self.compiles,
+                "compile_seconds": round(self.compile_seconds, 6),
+                "steady_recompiles": self.steady_recompiles,
+                "recompile_warning": self.recompile_warning,
+                "last_site": self.last_site,
+            }
+
+
 @dataclass
 class SpanRecord:
     name: str
@@ -219,12 +379,22 @@ class Tracer:
 
     def __init__(self):
         self.enabled = False
+        # sync-span mode: device_sync() drains the device queue at span
+        # boundaries for accurate stage attribution (PTPU_TRACE_SYNC's
+        # first-class form; see module docstring)
+        self.sync = False
+        self.compile_tracker = CompileTracker(self)
         self._lock = threading.Lock()
         self._emit_lock = threading.Lock()
         self._local = threading.local()
         self._stream = None
         self.spans: list = []
         self.events: list = []
+        # per-name duration windows for percentile estimates: bounded
+        # PER NAME (unlike the shared spans list) so a high-frequency
+        # span (HTTP requests) cannot evict every sample of a rare but
+        # important one (refresh, prover stages) out of /stages' p50/p95
+        self._durations: dict = {}
         self.metrics: dict = {}
         self._instruments: dict = {}
         self._span_ids = itertools.count(1)
@@ -237,7 +407,14 @@ class Tracer:
     def enable(self, stream_path: str | None = None) -> None:
         self.enabled = True
         if stream_path:
+            # re-enabling onto a new path must not leak the previous
+            # stream's fd (e.g. PROTOCOL_TPU_TRACE env stream replaced
+            # by a CLI --jsonl flag)
+            old = self._stream
             self._stream = open(stream_path, "a", buffering=1)
+            if old is not None:
+                with contextlib.suppress(OSError):
+                    old.close()
 
     def disable(self) -> None:
         self.enabled = False
@@ -255,6 +432,7 @@ class Tracer:
             self.events.clear()
             self.metrics.clear()
             self._span_agg.clear()
+            self._durations.clear()
 
     def reset_instruments(self) -> None:
         with self._lock:
@@ -356,6 +534,10 @@ class Tracer:
                 agg["count"] += 1
                 agg["total_s"] += dt
                 agg["max_s"] = max(agg["max_s"], dt)
+                window = self._durations.setdefault(name, [])
+                window.append(dt)
+                if len(window) > DURATION_WINDOW_CAP:
+                    del window[: len(window) - DURATION_WINDOW_CAP]
             obj = {"type": "span", "name": name, "ts": wall,
                    "duration_s": dt, "depth": depth, "span_id": span_id}
             if parent is not None:
@@ -418,6 +600,15 @@ class Tracer:
             return {name: dict(agg)
                     for name, agg in self._span_agg.items()}
 
+    def span_durations(self) -> dict:
+        """{name: [duration, ...]} from the PER-NAME bounded windows
+        (newest ``DURATION_WINDOW_CAP`` per span name) — the percentile
+        source for :func:`stage_summary`. Estimates over the retained
+        window, unlike :meth:`summary` whose aggregates are exact."""
+        with self._lock:
+            return {name: list(window)
+                    for name, window in self._durations.items()}
+
     def dump_jsonl(self, path: str) -> None:
         # snapshot under the lock FIRST: a daemon thread appending
         # mid-dump must not mutate the lists we iterate
@@ -475,6 +666,9 @@ def validate_record(obj) -> str | None:
 
 
 TRACER = Tracer()
+
+if os.environ.get("PTPU_TRACE_SYNC") == "1":
+    TRACER.sync = True
 
 _env = os.environ.get("PROTOCOL_TPU_TRACE")
 if _env:
@@ -534,20 +728,135 @@ def summary() -> dict:
 
 
 @contextlib.contextmanager
+def timed(histogram_name: str, span_name: str, labels: dict | None = None,
+          **fields):
+    """A span that also feeds a latency histogram: the one timing idiom
+    behind every stage instrument (prover stages, prove totals, the
+    routed plan build), so the span/observe pairing cannot drift per
+    site. ``labels`` go to the histogram series; ``fields`` to the
+    span. The observation lands even when the body raises — a failed
+    stage must stay visible to the histograms (and their count must
+    keep matching the span count)."""
+    t0 = time.perf_counter()
+    try:
+        with TRACER.span(span_name, **fields):
+            yield
+    finally:
+        TRACER.histogram(histogram_name).observe(
+            time.perf_counter() - t0, **(labels or {}))
+
+
+# --- sync-span mode ---------------------------------------------------------
+
+def sync_spans(enable: bool = True) -> None:
+    """Turn sync-span mode on/off: :func:`device_sync` then drains the
+    device queue at span boundaries, so per-stage spans attribute the
+    device compute they dispatched instead of skewing it onto whichever
+    later span happens to block. Profiling mode — it serializes stages,
+    so totals read slightly worse than the production overlap.
+    ``PTPU_TRACE_SYNC=1`` in the environment enables it at import."""
+    TRACER.sync = bool(enable)
+
+
+def sync_enabled() -> bool:
+    return TRACER.sync
+
+
+def device_sync(x):
+    """Block until ``x`` (a device array / pytree) is ready when
+    sync-span mode is active; returns ``x`` either way. Safe on
+    jax-less hosts and on host-side values."""
+    if TRACER.sync and TRACER.enabled and x is not None:
+        try:
+            import jax
+
+            jax.block_until_ready(x)
+        except Exception:  # noqa: BLE001 - host value / jax-less box
+            pass
+    return x
+
+
+# --- XLA compile tracking ---------------------------------------------------
+
+def install_compile_tracking() -> bool:
+    """Register the process-wide compile listener (idempotent); returns
+    False on a jax-less host."""
+    return TRACER.compile_tracker.install()
+
+
+def compile_watch(site: str, signature=None):
+    """Context manager: attribute XLA compiles inside to ``site``
+    (labels ``ptpu_xla_compiles_total``); with ``signature``, a compile
+    for an already-seen signature counts as a steady-state recompile
+    and latches the warning (see :class:`CompileTracker`)."""
+    return TRACER.compile_tracker.watch(site, signature)
+
+
+def thread_compile_seconds() -> float:
+    """Seconds this thread spent in XLA backend compiles; diff across a
+    timed region to separate compile from execute wall time."""
+    return TRACER.compile_tracker.thread_compile_seconds()
+
+
+def compile_stats() -> dict:
+    return TRACER.compile_tracker.stats()
+
+
+# --- percentile stage summaries ---------------------------------------------
+
+def percentile(values, q: float) -> float:
+    """Nearest-rank percentile (q in [0, 1]) of a non-empty list:
+    the smallest value with at least ``q`` of the mass at or below it."""
+    ordered = sorted(values)
+    if not ordered:
+        raise ValueError("percentile of empty list")
+    rank = math.ceil(q * len(ordered))
+    return ordered[max(rank, 1) - 1]
+
+
+def stage_summary() -> dict:
+    """Per-span-name durations with percentiles:
+    ``{name: {count, total_s, max_s, p50_s, p95_s}}``. Counts/totals
+    are the exact running aggregates; p50/p95 come from the bounded
+    span window (daemon-safe estimates)."""
+    exact = TRACER.summary()
+    windows = TRACER.span_durations()
+    out = {}
+    for name, agg in exact.items():
+        durations = windows.get(name) or []
+        out[name] = {
+            "count": agg["count"],
+            "total_s": agg["total_s"],
+            "max_s": agg["max_s"],
+            "p50_s": percentile(durations, 0.50) if durations else 0.0,
+            "p95_s": percentile(durations, 0.95) if durations else 0.0,
+        }
+    return out
+
+
+@contextlib.contextmanager
 def device_trace(log_dir: str):
     """JAX profiler (xprof) passthrough for device-side timelines; pair
     with ``tensorboard --logdir`` offline. No-op context on failure so
-    production paths never die on profiler availability."""
-    import jax
+    production paths never die on profiler availability.
 
+    Start/stop events land in the JSONL stream carrying ``log_dir`` and
+    the active trace context, so an offline xprof timeline is joinable
+    against the span stream by trace id + wall-clock window."""
     try:
+        import jax
+
         jax.profiler.start_trace(log_dir)
         started = True
-    except Exception:  # pragma: no cover - profiler unavailable
-        started = False
+    except Exception:  # pragma: no cover - jax-less host / profiler
+        started = False  # unavailable: no-op context, never an error
+    event("trace.device_trace_start", log_dir=str(log_dir),
+          started=started)
     try:
         yield
     finally:
         if started:
             with contextlib.suppress(Exception):
                 jax.profiler.stop_trace()
+        event("trace.device_trace_stop", log_dir=str(log_dir),
+              started=started)

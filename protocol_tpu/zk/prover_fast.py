@@ -626,7 +626,20 @@ def prove_fast(params: KZGParams, pk: FastProvingKey, cs: ConstraintSystem,
     """``plonk.prove`` on native kernels; transcript-identical, so the
     output verifies under ``plonk.verify``/``succinct_verify`` and
     aggregates under the aggregator chipset. ``randint`` overrides the
-    blinding sampler (deterministic fixtures)."""
+    blinding sampler (deterministic fixtures).
+
+    Stage-attributed like the TPU path: every section reports into
+    ``ptpu_prover_stage_seconds{stage,k,path="host"}``. The host path
+    is synchronous, so its stage spans are exact without sync mode —
+    which makes it the reference workload for the ``profile`` verb's
+    coverage check (stage times must sum to ~the prove wall time)."""
+    with _prove_total(pk.k, "host"):
+        return _prove_fast_host(params, pk, cs, public_inputs, randint,
+                                transcript)
+
+
+def _prove_fast_host(params, pk, cs, public_inputs, randint,
+                     transcript) -> bytes:
     if randint is None:
         randint = lambda: secrets.randbelow(R)  # noqa: E731
     fk = _kernel()
@@ -636,93 +649,102 @@ def prove_fast(params: KZGParams, pk: FastProvingKey, cs: ConstraintSystem,
         raise EigenError("proving_error", "circuit larger than key domain")
     pubs = (list(public_inputs) if public_inputs is not None
             else cs.public_values())
-    tr = make_transcript(transcript)
-    for v in pubs:
-        tr.absorb_fr(v)
+    with _stage("transcript", pk.k, "host"):
+        tr = make_transcript(transcript)
+        for v in pubs:
+            tr.absorb_fr(v)
 
     use_lagrange = (params.g1_lagrange is not None
                     and len(params.g1_lagrange) == n)
 
     # round 1: wires + lookup multiplicities
-    wire_vals = np.zeros((NUM_WIRES, n, 4), dtype="<u8")
-    for w in range(NUM_WIRES):
-        col = cs.wires[w]
-        if col:
-            wire_vals[w, : len(col)] = native.ints_to_limbs(col)
-    wire_coeffs = []
-    wire_blinds = []
-    for w in range(NUM_WIRES):
-        c = wire_vals[w].copy()
-        fk.ntt(c, d.omega, inverse=True)
-        blinded, blinds = _blind_arr(c, n, 2, randint)
-        wire_coeffs.append(blinded)
-        wire_blinds.append(blinds)
-    if use_lagrange:
-        wire_commits = [
-            _commit_blinded_evals(params, wire_vals[w], wire_blinds[w])
-            for w in range(NUM_WIRES)
-        ]
-    else:
-        wire_commits = [commit_limbs(params, c) for c in wire_coeffs]
-    for cm in wire_commits:
-        tr.absorb_point(cm)
+    with _stage("witness_build", pk.k, "host"):
+        wire_vals = np.zeros((NUM_WIRES, n, 4), dtype="<u8")
+        for w in range(NUM_WIRES):
+            col = cs.wires[w]
+            if col:
+                wire_vals[w, : len(col)] = native.ints_to_limbs(col)
+        wire_coeffs = []
+        wire_blinds = []
+        for w in range(NUM_WIRES):
+            c = wire_vals[w].copy()
+            fk.ntt(c, d.omega, inverse=True)
+            blinded, blinds = _blind_arr(c, n, 2, randint)
+            wire_coeffs.append(blinded)
+            wire_blinds.append(blinds)
+    with _stage("r1_commits", pk.k, "host"):
+        if use_lagrange:
+            wire_commits = [
+                _commit_blinded_evals(params, wire_vals[w], wire_blinds[w])
+                for w in range(NUM_WIRES)
+            ]
+        else:
+            wire_commits = [commit_limbs(params, c) for c in wire_coeffs]
+        for cm in wire_commits:
+            tr.absorb_point(cm)
 
-    table_size = 1 << pk.lookup_bits if pk.lookup_bits else 1
-    m_vals = _lookup_multiplicities(cs, n, table_size)
-    m_coeffs_base = m_vals.copy()
-    fk.ntt(m_coeffs_base, d.omega, inverse=True)
-    m_coeffs, m_blinds = _blind_arr(m_coeffs_base, n, 2, randint)
-    m_commit = (_commit_blinded_evals(params, m_vals, m_blinds)
-                if use_lagrange else commit_limbs(params, m_coeffs))
-    tr.absorb_point(m_commit)
+    with _stage("lookup_commit", pk.k, "host"):
+        table_size = 1 << pk.lookup_bits if pk.lookup_bits else 1
+        m_vals = _lookup_multiplicities(cs, n, table_size)
+        m_coeffs_base = m_vals.copy()
+        fk.ntt(m_coeffs_base, d.omega, inverse=True)
+        m_coeffs, m_blinds = _blind_arr(m_coeffs_base, n, 2, randint)
+        m_commit = (_commit_blinded_evals(params, m_vals, m_blinds)
+                    if use_lagrange else commit_limbs(params, m_coeffs))
+        tr.absorb_point(m_commit)
 
-    beta = tr.challenge()
-    gamma = tr.challenge()
-    beta_lk = tr.challenge()
+    with _stage("transcript", pk.k, "host"):
+        beta = tr.challenge()
+        gamma = tr.challenge()
+        beta_lk = tr.challenge()
 
     # round 2a: permutation grand product (native kernel)
-    omegas = np.zeros((n, 4), dtype="<u8")
-    omegas[:, 0] = 1
-    fk.coset_scale(omegas, d.omega)
-    z_vals = fk.perm_grand_product(wire_vals, pk.sigma_eval_limbs,
-                                   pk.shifts, omegas, beta, gamma)
-    z_base = z_vals.copy()
-    fk.ntt(z_base, d.omega, inverse=True)
-    z_coeffs, z_blinds = _blind_arr(z_base, n, 3, randint)
-    z_commit = (_commit_blinded_evals(params, z_vals, z_blinds)
-                if use_lagrange else commit_limbs(params, z_coeffs))
-    tr.absorb_point(z_commit)
+    with _stage("grand_product", pk.k, "host"):
+        omegas = np.zeros((n, 4), dtype="<u8")
+        omegas[:, 0] = 1
+        fk.coset_scale(omegas, d.omega)
+        z_vals = fk.perm_grand_product(wire_vals, pk.sigma_eval_limbs,
+                                       pk.shifts, omegas, beta, gamma)
+        z_base = z_vals.copy()
+        fk.ntt(z_base, d.omega, inverse=True)
+        z_coeffs, z_blinds = _blind_arr(z_base, n, 3, randint)
+        z_commit = (_commit_blinded_evals(params, z_vals, z_blinds)
+                    if use_lagrange else commit_limbs(params, z_coeffs))
+        tr.absorb_point(z_commit)
 
     # round 2b: LogUp running sum (native kernel)
-    table_limbs = np.zeros((n, 4), dtype="<u8")
-    table_limbs[:table_size, 0] = np.arange(table_size, dtype=np.uint64)
-    phi_vals = fk.logup_running_sum(wire_vals[LOOKUP_WIRE], table_limbs,
-                                    m_vals, beta_lk)
-    phi_base = phi_vals.copy()
-    fk.ntt(phi_base, d.omega, inverse=True)
-    phi_coeffs, phi_blinds = _blind_arr(phi_base, n, 3, randint)
-    phi_commit = (_commit_blinded_evals(params, phi_vals, phi_blinds)
-                  if use_lagrange else commit_limbs(params, phi_coeffs))
-    tr.absorb_point(phi_commit)
+    with _stage("logup_sum", pk.k, "host"):
+        table_limbs = np.zeros((n, 4), dtype="<u8")
+        table_limbs[:table_size, 0] = np.arange(table_size, dtype=np.uint64)
+        phi_vals = fk.logup_running_sum(wire_vals[LOOKUP_WIRE], table_limbs,
+                                        m_vals, beta_lk)
+        phi_base = phi_vals.copy()
+        fk.ntt(phi_base, d.omega, inverse=True)
+        phi_coeffs, phi_blinds = _blind_arr(phi_base, n, 3, randint)
+        phi_commit = (_commit_blinded_evals(params, phi_vals, phi_blinds)
+                      if use_lagrange else commit_limbs(params, phi_coeffs))
+        tr.absorb_point(phi_commit)
 
     # round 2c: z-split partial products (u1, u2, v1, v2)
-    uv_vals = _perm_partial_vals(fk, wire_vals, pk.sigma_eval_limbs,
-                                 pk.shifts, omegas, z_vals, beta, gamma)
-    uv_coeffs = []
-    uv_blinds = []
-    uv_commits = []
-    for vals in uv_vals:
-        base = vals.copy()
-        fk.ntt(base, d.omega, inverse=True)
-        c, blinds = _blind_arr(base, n, 2, randint)
-        uv_coeffs.append(c)
-        uv_blinds.append(blinds)
-        uv_commits.append(_commit_blinded_evals(params, vals, blinds)
-                          if use_lagrange else commit_limbs(params, c))
-    for cm in uv_commits:
-        tr.absorb_point(cm)
+    with _stage("partials", pk.k, "host"):
+        uv_vals = _perm_partial_vals(fk, wire_vals, pk.sigma_eval_limbs,
+                                     pk.shifts, omegas, z_vals, beta, gamma)
+        uv_coeffs = []
+        uv_blinds = []
+        uv_commits = []
+        for vals in uv_vals:
+            base = vals.copy()
+            fk.ntt(base, d.omega, inverse=True)
+            c, blinds = _blind_arr(base, n, 2, randint)
+            uv_coeffs.append(c)
+            uv_blinds.append(blinds)
+            uv_commits.append(_commit_blinded_evals(params, vals, blinds)
+                              if use_lagrange else commit_limbs(params, c))
+        for cm in uv_commits:
+            tr.absorb_point(cm)
 
-    alpha = tr.challenge()
+    with _stage("transcript", pk.k, "host"):
+        alpha = tr.challenge()
 
     # round 3: quotient over the 4n extension coset (z-split)
     de = EvaluationDomain(pk.k + 2)
@@ -736,106 +758,116 @@ def prove_fast(params: KZGParams, pk: FastProvingKey, cs: ConstraintSystem,
         fk.ntt(out, de.omega)
         return out
 
-    wires_e = np.empty((NUM_WIRES, ext_n, 4), dtype="<u8")
-    for w in range(NUM_WIRES):
-        wires_e[w] = ext(wire_coeffs[w])
-    z_e = ext(z_coeffs)
-    zw_coeffs = z_coeffs.copy()
-    fk.coset_scale(zw_coeffs, d.omega)  # z(ωX): cᵢ ← cᵢ·ωⁱ
-    zw_e = ext(zw_coeffs)
-    m_e = ext(m_coeffs)
-    phi_e = ext(phi_coeffs)
-    phiw_coeffs = phi_coeffs.copy()
-    fk.coset_scale(phiw_coeffs, d.omega)
-    phiw_e = ext(phiw_coeffs)
-    uv_e = np.empty((NUM_PERM_PARTIALS, ext_n, 4), dtype="<u8")
-    for j in range(NUM_PERM_PARTIALS):
-        uv_e[j] = ext(uv_coeffs[j])
-    pk_fixed_c, pk_sigma_c = pk.coeff_forms()
-    fixed_e = np.empty((len(FIXED_NAMES), ext_n, 4), dtype="<u8")
-    for idx in range(len(FIXED_NAMES)):
-        fixed_e[idx] = ext(pk_fixed_c[idx])
-    sigma_e = np.empty((NUM_WIRES, ext_n, 4), dtype="<u8")
-    for w in range(NUM_WIRES):
-        sigma_e[w] = ext(pk_sigma_c[w])
-    pi_vals = np.zeros((n, 4), dtype="<u8")
-    for row, value in zip(pk.public_rows, pubs):
-        _set_int(pi_vals, row, (-int(value)) % R)
-    fk.ntt(pi_vals, d.omega, inverse=True)
-    pi_e = ext(pi_vals)
+    with _stage("ext_build", pk.k, "host"):
+        wires_e = np.empty((NUM_WIRES, ext_n, 4), dtype="<u8")
+        for w in range(NUM_WIRES):
+            wires_e[w] = ext(wire_coeffs[w])
+        z_e = ext(z_coeffs)
+        zw_coeffs = z_coeffs.copy()
+        fk.coset_scale(zw_coeffs, d.omega)  # z(ωX): cᵢ ← cᵢ·ωⁱ
+        zw_e = ext(zw_coeffs)
+        m_e = ext(m_coeffs)
+        phi_e = ext(phi_coeffs)
+        phiw_coeffs = phi_coeffs.copy()
+        fk.coset_scale(phiw_coeffs, d.omega)
+        phiw_e = ext(phiw_coeffs)
+        uv_e = np.empty((NUM_PERM_PARTIALS, ext_n, 4), dtype="<u8")
+        for j in range(NUM_PERM_PARTIALS):
+            uv_e[j] = ext(uv_coeffs[j])
+        pk_fixed_c, pk_sigma_c = pk.coeff_forms()
+        fixed_e = np.empty((len(FIXED_NAMES), ext_n, 4), dtype="<u8")
+        for idx in range(len(FIXED_NAMES)):
+            fixed_e[idx] = ext(pk_fixed_c[idx])
+        sigma_e = np.empty((NUM_WIRES, ext_n, 4), dtype="<u8")
+        for w in range(NUM_WIRES):
+            sigma_e[w] = ext(pk_sigma_c[w])
+        pi_vals = np.zeros((n, 4), dtype="<u8")
+        for row, value in zip(pk.public_rows, pubs):
+            _set_int(pi_vals, row, (-int(value)) % R)
+        fk.ntt(pi_vals, d.omega, inverse=True)
+        pi_e = ext(pi_vals)
 
-    # xs = shift·ω_e^i; Z_H(xs) has period 8 on the extension coset:
-    # xs^n = shift^n·(ω_e^n)^i and ω_e has order 8n
-    xs = np.zeros((ext_n, 4), dtype="<u8")
-    _shift_limb = np.frombuffer(int(shift).to_bytes(32, "little"),
-                                dtype="<u8")
-    xs[:] = _shift_limb
-    fk.coset_scale(xs, de.omega)
-    # Z_H on the 4n coset has period 4: xsⁿ = shiftⁿ·(ω_eⁿ)ⁱ, ω_e order 4n
-    w4 = pow(de.omega, n, R)
-    shift_n = pow(shift, n, R)
-    zh4 = [(shift_n * pow(w4, i, R) - 1) % R for i in range(4)]
-    zh4_inv = [pow(v, -1, R) for v in zh4]
-    reps = ext_n // 4
-    zh_inv = np.tile(native.ints_to_limbs(zh4_inv), (reps, 1))
-    zh_tiled = np.tile(native.ints_to_limbs(zh4), (reps, 1))
-    # l0 = Z_H(x) / (n·(x−1))
-    l0_den = fk.scalar_mul(fk.scalar_sub(xs, 1), n % R)
-    fk.batch_inverse(l0_den)
-    l0 = fk.vec_mul(zh_tiled, l0_den)
+        # xs = shift·ω_e^i; Z_H(xs) has period 8 on the extension coset:
+        # xs^n = shift^n·(ω_e^n)^i and ω_e has order 8n
+        xs = np.zeros((ext_n, 4), dtype="<u8")
+        _shift_limb = np.frombuffer(int(shift).to_bytes(32, "little"),
+                                    dtype="<u8")
+        xs[:] = _shift_limb
+        fk.coset_scale(xs, de.omega)
+        # Z_H on the 4n coset has period 4: xsⁿ = shiftⁿ·(ω_eⁿ)ⁱ, ω_e
+        # order 4n
+        w4 = pow(de.omega, n, R)
+        shift_n = pow(shift, n, R)
+        zh4 = [(shift_n * pow(w4, i, R) - 1) % R for i in range(4)]
+        zh4_inv = [pow(v, -1, R) for v in zh4]
+        reps = ext_n // 4
+        zh_inv = np.tile(native.ints_to_limbs(zh4_inv), (reps, 1))
+        zh_tiled = np.tile(native.ints_to_limbs(zh4), (reps, 1))
+        # l0 = Z_H(x) / (n·(x−1))
+        l0_den = fk.scalar_mul(fk.scalar_sub(xs, 1), n % R)
+        fk.batch_inverse(l0_den)
+        l0 = fk.vec_mul(zh_tiled, l0_den)
 
-    t_ext = fk.quotient_eval(wires_e, z_e, zw_e, m_e, phi_e, phiw_e, uv_e,
-                             fixed_e, sigma_e, pi_e, xs, zh_inv, l0,
-                             beta, gamma, beta_lk, alpha, pk.shifts)
+    with _stage("quotient", pk.k, "host"):
+        t_ext = fk.quotient_eval(wires_e, z_e, zw_e, m_e, phi_e, phiw_e,
+                                 uv_e, fixed_e, sigma_e, pi_e, xs, zh_inv,
+                                 l0, beta, gamma, beta_lk, alpha, pk.shifts)
     del wires_e, zw_e, m_e, phiw_e, uv_e, fixed_e, sigma_e, pi_e, xs, zh_inv
     del zh_tiled, l0_den, l0, z_e, phi_e
 
-    fk.ntt(t_ext, de.omega, inverse=True)
-    fk.coset_scale(t_ext, shift, invert=True)
-    if t_ext[QUOTIENT_CHUNKS * n :].any():
-        raise EigenError(
-            "proving_error",
-            "quotient degree overflow — witness does not satisfy the circuit",
-        )
-    chunks = [np.ascontiguousarray(t_ext[i * n : (i + 1) * n])
-              for i in range(QUOTIENT_CHUNKS)]
-    t_commits = [commit_limbs(params, ch) for ch in chunks]
-    for cm in t_commits:
-        tr.absorb_point(cm)
-    zeta = tr.challenge()
+    with _stage("intt_ext", pk.k, "host"):
+        fk.ntt(t_ext, de.omega, inverse=True)
+        fk.coset_scale(t_ext, shift, invert=True)
+        if t_ext[QUOTIENT_CHUNKS * n :].any():
+            raise EigenError(
+                "proving_error",
+                "quotient degree overflow — witness does not satisfy the "
+                "circuit",
+            )
+        chunks = [np.ascontiguousarray(t_ext[i * n : (i + 1) * n])
+                  for i in range(QUOTIENT_CHUNKS)]
+    with _stage("t_commits", pk.k, "host"):
+        t_commits = [commit_limbs(params, ch) for ch in chunks]
+        for cm in t_commits:
+            tr.absorb_point(cm)
+    with _stage("transcript", pk.k, "host"):
+        zeta = tr.challenge()
 
     # round 4: evaluations via one stacked Horner pass per point
     npp = NUM_PERM_PARTIALS
-    all_polys = (wire_coeffs + [m_coeffs, z_coeffs, phi_coeffs] + uv_coeffs
-                 + chunks
-                 + [pk_fixed_c[i] for i in range(len(FIXED_NAMES))]
-                 + [pk_sigma_c[w] for w in range(NUM_WIRES)])
-    max_len = max(len(p) for p in all_polys)
-    stacked = np.zeros((len(all_polys), max_len, 4), dtype="<u8")
-    for i, p in enumerate(all_polys):
-        stacked[i, : len(p)] = p
-    evals = fk.poly_eval_many(stacked, zeta)
-    nw = NUM_WIRES
-    wire_evals = evals[:nw]
-    m_eval = evals[nw]
-    z_eval = evals[nw + 1]
-    phi_eval = evals[nw + 2]
-    uv_evals = evals[nw + 3 : nw + 3 + npp]
-    qb = nw + 3 + npp
-    t_evals = evals[qb : qb + QUOTIENT_CHUNKS]
-    fixed_evals = evals[qb + QUOTIENT_CHUNKS :
-                        qb + QUOTIENT_CHUNKS + len(FIXED_NAMES)]
-    sigma_zeta = evals[qb + QUOTIENT_CHUNKS + len(FIXED_NAMES) :]
-    zeta_w = zeta * d.omega % R
-    shifted_pair = np.zeros((2, n + 3, 4), dtype="<u8")
-    shifted_pair[0, : len(z_coeffs)] = z_coeffs
-    shifted_pair[1, : len(phi_coeffs)] = phi_coeffs
-    z_next, phi_next = fk.poly_eval_many(shifted_pair, zeta_w)
-    for v in (wire_evals + [m_eval, z_eval, z_next, phi_eval, phi_next]
-              + uv_evals + t_evals + fixed_evals + sigma_zeta):
-        tr.absorb_fr(v)
-    v_ch = tr.challenge()
-    tr.challenge()  # u — verifier-side fold; keep transcripts in lockstep
+    with _stage("evals", pk.k, "host"):
+        all_polys = (wire_coeffs + [m_coeffs, z_coeffs, phi_coeffs]
+                     + uv_coeffs + chunks
+                     + [pk_fixed_c[i] for i in range(len(FIXED_NAMES))]
+                     + [pk_sigma_c[w] for w in range(NUM_WIRES)])
+        max_len = max(len(p) for p in all_polys)
+        stacked = np.zeros((len(all_polys), max_len, 4), dtype="<u8")
+        for i, p in enumerate(all_polys):
+            stacked[i, : len(p)] = p
+        evals = fk.poly_eval_many(stacked, zeta)
+        nw = NUM_WIRES
+        wire_evals = evals[:nw]
+        m_eval = evals[nw]
+        z_eval = evals[nw + 1]
+        phi_eval = evals[nw + 2]
+        uv_evals = evals[nw + 3 : nw + 3 + npp]
+        qb = nw + 3 + npp
+        t_evals = evals[qb : qb + QUOTIENT_CHUNKS]
+        fixed_evals = evals[qb + QUOTIENT_CHUNKS :
+                            qb + QUOTIENT_CHUNKS + len(FIXED_NAMES)]
+        sigma_zeta = evals[qb + QUOTIENT_CHUNKS + len(FIXED_NAMES) :]
+        zeta_w = zeta * d.omega % R
+        shifted_pair = np.zeros((2, n + 3, 4), dtype="<u8")
+        shifted_pair[0, : len(z_coeffs)] = z_coeffs
+        shifted_pair[1, : len(phi_coeffs)] = phi_coeffs
+        z_next, phi_next = fk.poly_eval_many(shifted_pair, zeta_w)
+        for v in (wire_evals + [m_eval, z_eval, z_next, phi_eval,
+                                phi_next]
+                  + uv_evals + t_evals + fixed_evals + sigma_zeta):
+            tr.absorb_fr(v)
+    with _stage("transcript", pk.k, "host"):
+        v_ch = tr.challenge()
+        tr.challenge()  # u — verifier-side fold; lockstep transcripts
 
     # batched openings at ζ and ωζ: fold with γ powers, divide, commit
     def open_group(polys: list, at: int):
@@ -849,8 +881,9 @@ def prove_fast(params: KZGParams, pk: FastProvingKey, cs: ConstraintSystem,
         quotient = fk.poly_divide_linear(folded, at)
         return commit_limbs(params, quotient)
 
-    w_x = open_group(all_polys, zeta)
-    w_wx = open_group([z_coeffs, phi_coeffs], zeta_w)
+    with _stage("openings", pk.k, "host"):
+        w_x = open_group(all_polys, zeta)
+        w_wx = open_group([z_coeffs, phi_coeffs], zeta_w)
 
     proof = Proof(wire_commits, m_commit, z_commit, phi_commit, uv_commits,
                   t_commits, wire_evals, m_eval, z_eval, z_next, phi_eval,
@@ -877,16 +910,48 @@ def _dp_cache_cap() -> int:
 
 
 def _sync_if_tracing(x) -> None:
-    """PTPU_TRACE_SYNC=1 turns the trace spans in ``prove_fast_tpu``
-    into accurate per-stage attribution by draining the device queue at
-    span boundaries. Device dispatch is async through the tunnel, so
-    without this the round-3 compute cost all surfaces at the blocking
-    t-chunk download. Profiling aid only — it serializes stages, so the
-    total is slightly worse than the production overlap."""
+    """Sync-span mode turns the trace spans in ``prove_fast_tpu`` into
+    accurate per-stage attribution by draining the device queue at span
+    boundaries. Device dispatch is async through the tunnel, so without
+    this the round-3 compute cost all surfaces at the blocking t-chunk
+    download. First-class form: ``trace.sync_spans()`` (the ``profile``
+    CLI verb's default); the historical ``PTPU_TRACE_SYNC=1`` env aid
+    still works and forces the drain regardless of tracer state.
+    Profiling aid only — it serializes stages, so the total is slightly
+    worse than the production overlap."""
     if os.environ.get("PTPU_TRACE_SYNC") == "1":
         import jax
 
         jax.block_until_ready(x)
+        return
+    trace.device_sync(x)
+
+
+def _stage(stage: str, k: int, path: str, span_name: str | None = None,
+           **fields):
+    """One named prover stage: a trace span plus a
+    ``ptpu_prover_stage_seconds{stage,k,path}`` histogram observation —
+    the label-aware instrument the service renders on ``/metrics``.
+    Under sync-span mode the caller drains the device queue before the
+    block exits, so the recorded duration is the stage's true cost, not
+    its dispatch time. Default span names are per-path (``prove.`` /
+    ``prove_tpu.``): a process that runs both paths must not merge
+    their durations under one span name."""
+    return trace.timed("prover_stage_seconds",
+                       span_name or ("prove_tpu." if path == "tpu"
+                                     else "prove.") + stage,
+                       {"stage": stage, "k": str(k), "path": path},
+                       stage=stage, k=k, **fields)
+
+
+def _prove_total(k: int, path: str):
+    """Whole-prove span + ``ptpu_prover_total_seconds{path,k}`` — the
+    denominator per-stage shares are reported against. Span names are
+    per-path like :func:`_stage`'s."""
+    return trace.timed("prover_total_seconds",
+                       "prove_tpu.total" if path == "tpu"
+                       else "prove.total",
+                       {"k": str(k), "path": path}, k=k, path=path)
 
 
 def _device_prover(pk: FastProvingKey):
@@ -946,7 +1011,27 @@ def prove_fast_tpu(params: KZGParams, pk: FastProvingKey,
     LOCKSTEP WARNING: rounds 1-2 here mirror ``prove_fast``'s absorb and
     blinding-draw ORDER exactly — any edit to one path's transcript
     sequence must be mirrored in the other or the two provers' proofs
-    (and the verifier) silently diverge."""
+    (and the verifier) silently diverge.
+
+    Every stage reports into ``ptpu_prover_stage_seconds{stage,k,
+    path="tpu"}``; run under sync-span mode (``trace.sync_spans()`` /
+    ``PTPU_TRACE_SYNC=1``) for accurate attribution — device dispatch
+    is async, so without it the round-3 cost surfaces at whichever
+    stage blocks first."""
+    with _prove_total(pk.k, "tpu"):
+        # site attribution only, NO steady-state signature: DeviceProver
+        # cache eviction (PTPU_DP_CACHE, >cap pks, same-k alternation)
+        # legitimately recompiles after a suspend/evict, and a pk-id
+        # signature could be recycled by the allocator — either way a
+        # false "shape leak" latch. The converge path, whose jit key IS
+        # reconstructible, keeps the detector.
+        with trace.compile_watch("prove"):
+            return _prove_fast_tpu_impl(params, pk, cs, public_inputs,
+                                        randint, transcript)
+
+
+def _prove_fast_tpu_impl(params, pk, cs, public_inputs, randint,
+                         transcript) -> bytes:
     from . import prover_tpu as ptpu
 
     if not pk.eval_form:
@@ -961,13 +1046,15 @@ def prove_fast_tpu(params: KZGParams, pk: FastProvingKey,
     if (params.g1_lagrange is None or len(params.g1_lagrange) != n):
         raise EigenError("proving_error",
                          "prove_fast_tpu needs a matching Lagrange basis")
-    with trace.span("prove_tpu.device_prover_init"):
+    with _stage("device_init", pk.k, "tpu",
+                span_name="prove_tpu.device_prover_init"):
         dp = _device_prover(pk)
     pubs = (list(public_inputs) if public_inputs is not None
             else cs.public_values())
-    tr = make_transcript(transcript)
-    for v in pubs:
-        tr.absorb_fr(v)
+    with _stage("transcript", pk.k, "tpu"):
+        tr = make_transcript(transcript)
+        for v in pubs:
+            tr.absorb_fr(v)
 
     # round 1: wires + lookup multiplicities (commits from evals; the
     # blinding stream consumption order matches _blind_arr exactly)
@@ -1008,45 +1095,55 @@ def prove_fast_tpu(params: KZGParams, pk: FastProvingKey,
         return [ptpu._pack16_impl(e)
                 for e in dp.ext_chunks(coeff_dev, blinds)]
 
-    with trace.span("prove_tpu.r1_upload_intt"):
+    with _stage("witness_upload", pk.k, "tpu",
+                span_name="prove_tpu.r1_upload_intt"):
         wire_coeff_dev = [dp.upload_intt_packed(wire_vals[w])
                           for w in range(NUM_WIRES)]
-        _sync_if_tracing(wire_coeff_dev[-1])
-    wire_blinds = [[randint() for _ in range(2)] for _ in range(NUM_WIRES)]
-    pi_vals = np.zeros((n, 4), dtype="<u8")
-    for row, value in zip(pk.public_rows, pubs):
-        _set_int(pi_vals, row, (-int(value)) % R)
-    pi_coeff_dev = dp.upload_intt_packed(pi_vals)
-    if pre:
-        wire_ext = [ext8(wire_coeff_dev[w], wire_blinds[w])
-                    for w in range(NUM_WIRES)]
-        pi_ext = ext8(pi_coeff_dev)
-    with trace.span("prove_tpu.r1_wire_commits"):
+        wire_blinds = [[randint() for _ in range(2)]
+                       for _ in range(NUM_WIRES)]
+        pi_vals = np.zeros((n, 4), dtype="<u8")
+        for row, value in zip(pk.public_rows, pubs):
+            _set_int(pi_vals, row, (-int(value)) % R)
+        pi_coeff_dev = dp.upload_intt_packed(pi_vals)
+        if pre:
+            wire_ext = [ext8(wire_coeff_dev[w], wire_blinds[w])
+                        for w in range(NUM_WIRES)]
+            pi_ext = ext8(pi_coeff_dev)
+        # sync the LAST work dispatched in this stage: blocking on an
+        # earlier array would let the pre-dispatched ext8 compute skew
+        # onto whichever later stage blocks first
+        _sync_if_tracing((wire_ext, pi_ext) if pre else pi_coeff_dev)
+    with _stage("r1_commits", pk.k, "tpu",
+                span_name="prove_tpu.r1_wire_commits"):
         wire_commits = [
             _commit_blinded_evals(params, wire_vals[w], wire_blinds[w])
             for w in range(NUM_WIRES)
         ]
-    for cm in wire_commits:
-        tr.absorb_point(cm)
+        for cm in wire_commits:
+            tr.absorb_point(cm)
 
-    table_size = 1 << pk.lookup_bits if pk.lookup_bits else 1
-    m_vals = _lookup_multiplicities(cs, n, table_size)
-    m_coeff_dev = dp.upload_intt_packed(m_vals)
-    m_blinds = [randint() for _ in range(2)]
-    if pre:
-        m_ext = ext8(m_coeff_dev, m_blinds)
-    m_commit = _commit_blinded_evals(params, m_vals, m_blinds)
-    tr.absorb_point(m_commit)
+    with _stage("lookup_commit", pk.k, "tpu",
+                span_name="prove_tpu.r1_lookup_commit"):
+        table_size = 1 << pk.lookup_bits if pk.lookup_bits else 1
+        m_vals = _lookup_multiplicities(cs, n, table_size)
+        m_coeff_dev = dp.upload_intt_packed(m_vals)
+        m_blinds = [randint() for _ in range(2)]
+        if pre:
+            m_ext = ext8(m_coeff_dev, m_blinds)
+        m_commit = _commit_blinded_evals(params, m_vals, m_blinds)
+        tr.absorb_point(m_commit)
 
-    beta = tr.challenge()
-    gamma = tr.challenge()
-    beta_lk = tr.challenge()
+    with _stage("transcript", pk.k, "tpu"):
+        beta = tr.challenge()
+        gamma = tr.challenge()
+        beta_lk = tr.challenge()
 
     # round 2: grand products on host kernels, commits from evals
     omegas = np.zeros((n, 4), dtype="<u8")
     omegas[:, 0] = 1
     fk.coset_scale(omegas, d.omega)
-    with trace.span("prove_tpu.r2_grand_products"):
+    with _stage("grand_product", pk.k, "tpu",
+                span_name="prove_tpu.r2_grand_products"):
         z_vals = fk.perm_grand_product(wire_vals, pk.sigma_eval_limbs,
                                        pk.shifts, omegas, beta, gamma)
         z_coeff_dev = dp.upload_intt_packed(z_vals)
@@ -1054,22 +1151,26 @@ def prove_fast_tpu(params: KZGParams, pk: FastProvingKey,
         if pre:
             z_ext = ext8(z_coeff_dev, z_blinds)
         z_commit = _commit_blinded_evals(params, z_vals, z_blinds)
-    tr.absorb_point(z_commit)
+        tr.absorb_point(z_commit)
 
-    table_limbs = np.zeros((n, 4), dtype="<u8")
-    table_limbs[:table_size, 0] = np.arange(table_size, dtype=np.uint64)
-    phi_vals = fk.logup_running_sum(wire_vals[LOOKUP_WIRE], table_limbs,
-                                    m_vals, beta_lk)
-    phi_coeff_dev = dp.upload_intt_packed(phi_vals)
-    phi_blinds = [randint() for _ in range(3)]
-    if pre:
-        phi_ext = ext8(phi_coeff_dev, phi_blinds)
-    phi_commit = _commit_blinded_evals(params, phi_vals, phi_blinds)
-    tr.absorb_point(phi_commit)
+    with _stage("logup_sum", pk.k, "tpu",
+                span_name="prove_tpu.r2_logup_sum"):
+        table_limbs = np.zeros((n, 4), dtype="<u8")
+        table_limbs[:table_size, 0] = np.arange(table_size,
+                                                dtype=np.uint64)
+        phi_vals = fk.logup_running_sum(wire_vals[LOOKUP_WIRE],
+                                        table_limbs, m_vals, beta_lk)
+        phi_coeff_dev = dp.upload_intt_packed(phi_vals)
+        phi_blinds = [randint() for _ in range(3)]
+        if pre:
+            phi_ext = ext8(phi_coeff_dev, phi_blinds)
+        phi_commit = _commit_blinded_evals(params, phi_vals, phi_blinds)
+        tr.absorb_point(phi_commit)
 
     # round 2c: z-split partial products — values on host kernels (the
     # lockstep twin of prove_fast's round 2c), ext chunks on device
-    with trace.span("prove_tpu.r2c_partials"):
+    with _stage("partials", pk.k, "tpu",
+                span_name="prove_tpu.r2c_partials"):
         uv_vals = _perm_partial_vals(fk, wire_vals, pk.sigma_eval_limbs,
                                      pk.shifts, omegas, z_vals, beta,
                                      gamma)
@@ -1088,11 +1189,13 @@ def prove_fast_tpu(params: KZGParams, pk: FastProvingKey,
     for cm in uv_commits:
         tr.absorb_point(cm)
 
-    alpha = tr.challenge()
+    with _stage("transcript", pk.k, "tpu"):
+        alpha = tr.challenge()
 
     # round 3 (device): ext chunks → quotient → 4n inverse → chunks
     ch_planes = dp.challenge_planes(beta, gamma, beta_lk, alpha, pk.shifts)
-    with trace.span("prove_tpu.r3_quotient"):
+    with _stage("quotient_chunks", pk.k, "tpu",
+                span_name="prove_tpu.r3_quotient"):
         t_chunks_fs = []
         for j in range(ptpu.EXT_COSETS):
             with trace.span("prove_tpu.r3_chunk", j=j):
@@ -1125,7 +1228,8 @@ def prove_fast_tpu(params: KZGParams, pk: FastProvingKey,
                         col[j] = None
                     z_ext[j] = m_ext[j] = phi_ext[j] = pi_ext[j] = None
                 _sync_if_tracing(t_chunks_fs[-1])
-    with trace.span("prove_tpu.r3_intt_ext"):
+    with _stage("intt_ext", pk.k, "tpu",
+                span_name="prove_tpu.r3_intt_ext"):
         t_coeff_chunks = dp.intt_ext(t_chunks_fs)
         _sync_if_tracing(t_coeff_chunks[-1])
     # the degree check pins the full device pipeline; the remaining
@@ -1143,7 +1247,8 @@ def prove_fast_tpu(params: KZGParams, pk: FastProvingKey,
                 "quotient degree overflow — witness does not satisfy "
                 "the circuit",
             )
-    with trace.span("prove_tpu.r3_t_commits"):
+    with _stage("t_commits", pk.k, "tpu",
+                span_name="prove_tpu.r3_t_commits"):
         t_commits = []
         with ThreadPoolExecutor(max_workers=1) as pool:
             fut = pool.submit(ptpu.download_std, t_coeff_chunks[0])
@@ -1154,9 +1259,10 @@ def prove_fast_tpu(params: KZGParams, pk: FastProvingKey,
                                       t_coeff_chunks[u + 1])
                 t_commits.append(commit_limbs(params, arr))
                 del arr  # ~32 MB each; t_evals run on-device now
-    for cm in t_commits:
-        tr.absorb_point(cm)
-    zeta = tr.challenge()
+        for cm in t_commits:
+            tr.absorb_point(cm)
+    with _stage("transcript", pk.k, "tpu"):
+        zeta = tr.challenge()
 
     # round 4: ζ evaluations — barycentric on device + blind corrections
     zh_zeta = (pow(zeta, n, R) - 1) % R
@@ -1172,38 +1278,42 @@ def prove_fast_tpu(params: KZGParams, pk: FastProvingKey,
         return b * zh % R
 
     npp = NUM_PERM_PARTIALS
-    with trace.span("prove_tpu.r4_evals"):
+    with _stage("evals", pk.k, "tpu", span_name="prove_tpu.r4_evals"):
         base_evals = dp.eval_coeffs_at_many(
             wire_coeff_dev + [m_coeff_dev, z_coeff_dev, phi_coeff_dev]
             + uv_coeff_dev + dp.fixed_coeffs + dp.sigma_coeffs, zeta)
-    wire_evals = [
-        (base_evals[w] + blind_corr(wire_blinds[w], zeta, zh_zeta)) % R
-        for w in range(NUM_WIRES)
-    ]
-    m_eval = (base_evals[6] + blind_corr(m_blinds, zeta, zh_zeta)) % R
-    z_eval = (base_evals[7] + blind_corr(z_blinds, zeta, zh_zeta)) % R
-    phi_eval = (base_evals[8] + blind_corr(phi_blinds, zeta, zh_zeta)) % R
-    uv_evals = [
-        (base_evals[9 + i] + blind_corr(uv_blinds[i], zeta, zh_zeta)) % R
-        for i in range(npp)
-    ]
-    fixed_evals = base_evals[9 + npp : 9 + npp + len(FIXED_NAMES)]
-    sigma_zeta = base_evals[9 + npp + len(FIXED_NAMES) :]
-    shifted_evals = dp.eval_coeffs_at_many([z_coeff_dev, phi_coeff_dev],
-                                           zeta_w)
-    z_next = (shifted_evals[0] + blind_corr(z_blinds, zeta_w, zh_zeta_w)) % R
-    phi_next = (shifted_evals[1]
-                + blind_corr(phi_blinds, zeta_w, zh_zeta_w)) % R
-    # t chunks are device-resident coefficient arrays — ζ-power dots
-    # there instead of a 3×2^20 host Horner pass
-    t_evals = dp.eval_coeffs_at_many(
-        [t_coeff_chunks[u] for u in range(QUOTIENT_CHUNKS)], zeta)
-
-    for v in (wire_evals + [m_eval, z_eval, z_next, phi_eval, phi_next]
-              + uv_evals + t_evals + fixed_evals + sigma_zeta):
-        tr.absorb_fr(v)
-    v_ch = tr.challenge()
-    tr.challenge()  # u — verifier-side fold
+        wire_evals = [
+            (base_evals[w] + blind_corr(wire_blinds[w], zeta, zh_zeta)) % R
+            for w in range(NUM_WIRES)
+        ]
+        m_eval = (base_evals[6] + blind_corr(m_blinds, zeta, zh_zeta)) % R
+        z_eval = (base_evals[7] + blind_corr(z_blinds, zeta, zh_zeta)) % R
+        phi_eval = (base_evals[8]
+                    + blind_corr(phi_blinds, zeta, zh_zeta)) % R
+        uv_evals = [
+            (base_evals[9 + i] + blind_corr(uv_blinds[i], zeta,
+                                            zh_zeta)) % R
+            for i in range(npp)
+        ]
+        fixed_evals = base_evals[9 + npp : 9 + npp + len(FIXED_NAMES)]
+        sigma_zeta = base_evals[9 + npp + len(FIXED_NAMES) :]
+        shifted_evals = dp.eval_coeffs_at_many(
+            [z_coeff_dev, phi_coeff_dev], zeta_w)
+        z_next = (shifted_evals[0]
+                  + blind_corr(z_blinds, zeta_w, zh_zeta_w)) % R
+        phi_next = (shifted_evals[1]
+                    + blind_corr(phi_blinds, zeta_w, zh_zeta_w)) % R
+        # t chunks are device-resident coefficient arrays — ζ-power dots
+        # there instead of a 3×2^20 host Horner pass
+        t_evals = dp.eval_coeffs_at_many(
+            [t_coeff_chunks[u] for u in range(QUOTIENT_CHUNKS)], zeta)
+        for v in (wire_evals + [m_eval, z_eval, z_next, phi_eval,
+                                phi_next]
+                  + uv_evals + t_evals + fixed_evals + sigma_zeta):
+            tr.absorb_fr(v)
+    with _stage("transcript", pk.k, "tpu"):
+        v_ch = tr.challenge()
+        tr.challenge()  # u — verifier-side fold
 
     # batched openings: fold base coeffs on device, patch blinds on host
     base_polys = (wire_coeff_dev + [m_coeff_dev, z_coeff_dev, phi_coeff_dev]
@@ -1237,7 +1347,8 @@ def prove_fast_tpu(params: KZGParams, pk: FastProvingKey,
             quotient = fk.poly_divide_linear(folded, at)
             return commit_limbs(params, quotient)
 
-    with trace.span("prove_tpu.r4_openings"):
+    with _stage("openings", pk.k, "tpu",
+                span_name="prove_tpu.r4_openings"):
         # both folds dispatch up front; the ωζ fold downloads on a side
         # thread while the ζ group divides+commits on the host (the
         # fold itself is device work, the MSM releases the GIL)

@@ -42,6 +42,7 @@ import jax.numpy as jnp
 
 from ..ops import fieldops2 as f2
 from ..ops import ntt_tpu
+from ..utils import trace
 from ..utils.fields import BN254_FR_MODULUS as P
 
 L, L6 = f2.L, f2.L6
@@ -690,16 +691,20 @@ class DeviceProver:
         # (eval_coeffs_at_many), and dropping the 15 eval arrays saves
         # ~1.3 GB of HBM at k=20 (the difference between fitting and
         # RESOURCE_EXHAUSTED on a 16 GB chip).
-        self.fixed_coeffs = []
-        for a in fixed_evals_u64:
-            ev = upload_mont(a)
-            self.fixed_coeffs.append(_pack16_impl(self.intt_natural(ev)))
-            del ev
-        self.sigma_coeffs = []
-        for a in sigma_evals_u64:
-            ev = upload_mont(a)
-            self.sigma_coeffs.append(_pack16_impl(self.intt_natural(ev)))
-            del ev
+        with trace.span("prove_tpu.pk_upload", k=k):
+            self.fixed_coeffs = []
+            for a in fixed_evals_u64:
+                ev = upload_mont(a)
+                self.fixed_coeffs.append(
+                    _pack16_impl(self.intt_natural(ev)))
+                del ev
+            self.sigma_coeffs = []
+            for a in sigma_evals_u64:
+                ev = upload_mont(a)
+                self.sigma_coeffs.append(
+                    _pack16_impl(self.intt_natural(ev)))
+                del ev
+            trace.device_sync(self.sigma_coeffs)
 
         self._bary: dict = {}
         # resident packed ext-chunk tables per mode — built from the
@@ -765,6 +770,7 @@ class DeviceProver:
         host scalars on resume for a few cheap dispatches."""
         if deep is None:
             deep = os.environ.get("PTPU_DP_SUSPEND", "deep") != "shallow"
+        trace.event("prove_tpu.suspend", k=self.k, deep=bool(deep))
         self.fixed_ext = []
         self.sigma_ext = []
         self._bary = {}
@@ -783,17 +789,24 @@ class DeviceProver:
         the streaming quotient already proves from packed-coeff NTTs
         (test_stream_prove_matches_host)."""
         if not self._tables_live:
-            self._build_static_tables()
+            with trace.span("prove_tpu.static_tables_build", k=self.k):
+                self._build_static_tables()
         if self.fixed_ext_resident and not self.fixed_ext:
-            self.fixed_ext = [
-                [_pack16_impl(self.ext_chunk(cf, j))
-                 for j in range(EXT_COSETS)]
-                for cf in self.fixed_coeffs]
+            with trace.span("prove_tpu.pk_ext_build", k=self.k,
+                            which="fixed"):
+                self.fixed_ext = [
+                    [_pack16_impl(self.ext_chunk(cf, j))
+                     for j in range(EXT_COSETS)]
+                    for cf in self.fixed_coeffs]
+                trace.device_sync(self.fixed_ext)
         if self.ext_resident and not self.sigma_ext:
-            self.sigma_ext = [
-                [_pack16_impl(self.ext_chunk(cf, j))
-                 for j in range(EXT_COSETS)]
-                for cf in self.sigma_coeffs]
+            with trace.span("prove_tpu.pk_ext_build", k=self.k,
+                            which="sigma"):
+                self.sigma_ext = [
+                    [_pack16_impl(self.ext_chunk(cf, j))
+                     for j in range(EXT_COSETS)]
+                    for cf in self.sigma_coeffs]
+                trace.device_sync(self.sigma_ext)
 
     # --- transforms -------------------------------------------------------
 

@@ -31,6 +31,11 @@ def test_parser_accepts_all_verbs():
         ("kzg-params", ["--k", "10"]),
         ("local-scores", []),
         ("obs", ["trace.jsonl", "--trace-id", "abc"]),
+        ("profile", ["--workload", "refresh", "--n", "500"]),
+        ("profile", ["--workload", "prove", "--k", "7",
+                     "--min-coverage", "0.9", "--xprof", "xp"]),
+        ("profile", ["--workload", "daemon",
+                     "--url", "http://127.0.0.1:1", "--seconds", "2"]),
         ("scores", ["--backend", "jax"]),
         ("serve", ["--port", "0", "--poll-interval", "0.5",
                    "--state-dir", "svc-state"]),

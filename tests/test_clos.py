@@ -143,7 +143,16 @@ def test_routed_converge_matches_gather_and_conserves():
                                            max_iterations=300)
     sr, itr, dr = converge_routed_adaptive(rarrs, rstatic, s0r, tol=1e-6,
                                            max_iterations=300)
-    assert int(itr) == int(itg)
+    # The two engines compute the same per-iteration operator but with
+    # different f32 reduction ORDERS (blocked einsum contractions over
+    # the padded state vector vs gather row sums), so the stopping
+    # delta differs in the last few ulps. On this graph the iteration-
+    # 86 deltas straddle tol: gather 9.76e-7 < 1e-6 < 1.07e-6 routed —
+    # the routed engine legitimately runs ONE more sweep to the same
+    # fixed point. Exact iteration-count equality at the tolerance
+    # boundary is therefore not a property either engine promises;
+    # ±1 is (both shared-loop semantics, same spectral contraction).
+    assert abs(int(itr) - int(itg)) <= 1
     assert float(dr) <= 1e-6
     srn = rop.scores_for_nodes(np.asarray(sr))
     np.testing.assert_allclose(srn, np.asarray(sg), rtol=1e-4, atol=0.5)

@@ -792,3 +792,90 @@ def test_warm_start_scores_projection():
     # degenerate all-zero carry-over falls back to cold uniform
     s2 = warm_start_scores(np.zeros(2), 3, np.ones(3, dtype=bool), 10.0)
     np.testing.assert_allclose(s2, [10.0, 10.0, 10.0])
+
+
+def test_stages_route_and_xla_status(tmp_path, devnet):
+    """Device-layer observability on the live service: ``GET /stages``
+    serves the per-stage p50/p95 summary, ``/status`` carries the XLA
+    compile stats with the steady-state recompile latch unfired, and
+    the declared stage/converge instrument families render on
+    ``/metrics`` (converge series with real samples from the
+    refreshes)."""
+    _, node_url = devnet
+    svc, client = _make_service(tmp_path, node_url)
+    url = svc.start()
+    try:
+        kps = ecdsa_keypairs_from_mnemonic(MNEMONIC, 2)
+        addrs = [address_from_public_key(kp.public_key) for kp in kps]
+        _attest_round(client, kps, addrs, {(0, 1): 4, (1, 0): 9})
+        _wait(lambda: svc.refresher.table.revision == svc.graph.revision
+              and svc.refresher.refreshes >= 1,
+              what="first refresh published")
+
+        code, stages = _get(f"{url}/stages")
+        assert code == 200
+        ref = stages["stages"].get("service.refresh")
+        assert ref is not None and ref["count"] >= 1
+        assert 0.0 <= ref["p50_s"] <= ref["p95_s"] <= ref["max_s"]
+        assert stages["xla"]["steady_recompiles"] == 0
+
+        # the service runs in-process, so /status must mirror the
+        # process-global tracker; bracket the GET so a compile racing
+        # the request cannot flake the equality
+        from protocol_tpu.utils import trace
+        before = trace.TRACER.compile_tracker.stats()["compiles"]
+        code, status = _get(f"{url}/status")
+        after = trace.TRACER.compile_tracker.stats()["compiles"]
+        assert code == 200
+        xla = status["xla"]
+        assert xla["recompile_warning"] is False
+        assert xla["steady_recompiles"] == 0
+        assert before <= xla["compiles"] <= after
+
+        metrics = _get_text(f"{url}/metrics")
+        for needle in ("# TYPE ptpu_prover_stage_seconds histogram",
+                       "# TYPE ptpu_converge_sweep_seconds histogram",
+                       "# TYPE ptpu_xla_compiles_total counter",
+                       "ptpu_converge_iterations"):
+            assert needle in metrics, f"/metrics missing {needle!r}"
+        # steady recompiles: sum EVERY series of the family (real
+        # latches land on {site=...}-labeled series; the unlabeled
+        # declare_instruments zero alone would prove nothing)
+        steady = [float(line.split()[-1])
+                  for line in metrics.splitlines()
+                  if line.startswith("ptpu_xla_steady_recompiles_total")]
+        assert steady and sum(steady) == 0.0, steady
+        assert "ptpu_converge_sweep_seconds_bucket" in metrics
+    finally:
+        assert svc.shutdown() is True
+
+
+def test_profile_job_capture_window(tmp_path, devnet):
+    """The ``profile`` job kind (the live-daemon capture window the
+    ``profile --workload daemon`` verb submits): runs on the proof
+    worker, holds a device_trace open for the clamped window, and
+    returns the xprof log dir with the job id as the directory tag —
+    the trace-id join key against the JSONL stream."""
+    from protocol_tpu.service.provers import make_profile_prover
+
+    _, node_url = devnet
+    out_root = tmp_path / "assets"
+    out_root.mkdir()
+    svc, _ = _make_service(
+        tmp_path, node_url,
+        provers={"profile": make_profile_prover(out_root)})
+    url = svc.start()
+    try:
+        code, job = _post(f"{url}/proofs",
+                          {"kind": "profile",
+                           "params": {"seconds": 0.2}})
+        assert code == 202
+        job_id = job["job_id"]
+        _wait(lambda: (svc.jobs.get(job_id) or job).status == "done",
+              what="profile capture window")
+        result = svc.jobs.get(job_id).result
+        assert result["seconds"] == pytest.approx(0.2)
+        assert result["log_dir"].endswith(f"profiles/{job_id}")
+        assert "steady_recompiles" in result["xla"]
+    finally:
+        assert svc.shutdown() is True

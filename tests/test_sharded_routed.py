@@ -98,7 +98,12 @@ def test_sharded_routed_matches_gather(num_shards):
     scores, iters, delta = sharded_routed_converge_adaptive(
         op, s0, mesh, tol=1e-6, max_iterations=300, alpha=0.1)
     sg, itg, dg = _gather_reference(n, src, dst, val, None, 0.1, 1e-6, 300)
-    assert int(iters) == int(itg)
+    # engines compute the same operator with different f32 reduction
+    # ORDERS (per-shard psum trees vs gather row sums), so the stopping
+    # delta differs in its last ulps and the tolerance crossing can land
+    # one sweep apart — the same boundary effect diagnosed in
+    # tests/test_clos.py::test_routed_converge_matches_gather_and_conserves
+    assert abs(int(iters) - int(itg)) <= 1
     assert float(delta) <= 1e-6
     routed = op.scores_for_nodes(np.asarray(scores))
     np.testing.assert_allclose(routed, np.asarray(sg), rtol=1e-4, atol=0.5)
@@ -144,7 +149,9 @@ def test_sharded_routed_matches_single_device_routed():
         rarrs, rstatic, jnp.asarray(rop.initial_scores(1000.0)),
         tol=1e-6, max_iterations=300)
 
-    assert int(s_iters) == int(r_iters)
+    # ±1: stopping-boundary rounding across different reduction orders
+    # (see test_clos.py diagnosis); both engines share adaptive_loop
+    assert abs(int(s_iters) - int(r_iters)) <= 1
     np.testing.assert_allclose(
         sop.scores_for_nodes(np.asarray(s_scores)),
         rop.scores_for_nodes(np.asarray(r_scores)),

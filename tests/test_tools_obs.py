@@ -1,0 +1,247 @@
+"""Observability tooling tests: the Prometheus recording rules'
+structural validator (the ROADMAP "quantile recording rules" closer),
+the perf-regression gate round-trip, the ``profile`` CLI verb (per-stage
+report with the stage-sum-vs-wall coverage assertion the acceptance
+criteria name), and the ``obs`` verb's p50/p95 stage summary."""
+
+import json
+import os
+import re
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+RULES_PATH = os.path.join(REPO, "tools", "prometheus", "ptpu_rules.yml")
+
+_RECORD_RE = re.compile(r"^ptpu_[a-zA-Z0-9_]+:p(50|95|99)$")
+
+
+# --- recording rules ---------------------------------------------------------
+
+
+def _load_rules():
+    yaml = pytest.importorskip("yaml")
+    with open(RULES_PATH) as f:
+        return yaml.safe_load(f)
+
+
+def test_recording_rules_structure():
+    """Pure-python structural validation: groups/interval/rules present,
+    record names follow the ``family:quantile`` convention, every expr
+    is a histogram_quantile over the family's ``_bucket`` rate."""
+    doc = _load_rules()
+    assert isinstance(doc, dict) and "groups" in doc
+    groups = doc["groups"]
+    assert groups and all("name" in g and "rules" in g for g in groups)
+    for g in groups:
+        assert re.match(r"^[a-zA-Z_][a-zA-Z0-9_]*$", g["name"])
+        for rule in g["rules"]:
+            assert set(rule) == {"record", "expr"}, rule
+            assert _RECORD_RE.match(rule["record"]), rule["record"]
+            family = rule["record"].split(":")[0]
+            expr = " ".join(rule["expr"].split())
+            assert expr.startswith("histogram_quantile("), expr
+            assert f"rate({family}_bucket[" in expr, expr
+            assert "sum by (" in expr and "le" in expr, expr
+
+
+def test_recording_rules_cover_every_histogram_family():
+    """Every histogram the instrument layer emits has p50/p95/p99
+    rules, and every rule points at a real family with its real labels
+    — the yml and HISTOGRAM_FAMILIES cannot drift apart silently."""
+    from protocol_tpu.service.metrics import HISTOGRAM_FAMILIES
+
+    doc = _load_rules()
+    by_family: dict = {}
+    for g in doc["groups"]:
+        for rule in g["rules"]:
+            family, q = rule["record"].rsplit(":", 1)
+            assert family.startswith("ptpu_")
+            by_family.setdefault(family[len("ptpu_"):], []).append(
+                (q, rule["expr"]))
+    assert set(by_family) == set(HISTOGRAM_FAMILIES), (
+        "rules/instruments drift: regenerate tools/prometheus/"
+        "ptpu_rules.yml from HISTOGRAM_FAMILIES")
+    for family, rules in by_family.items():
+        assert sorted(q for q, _ in rules) == ["p50", "p95", "p99"]
+        labels = HISTOGRAM_FAMILIES[family]
+        for _, expr in rules:
+            by_clause = re.search(r"sum by \(([^)]*)\)",
+                                  " ".join(expr.split()))
+            assert by_clause is not None
+            got = {part.strip() for part in by_clause.group(1).split(",")}
+            assert got == {"le", *labels}, (family, got, labels)
+
+
+# --- perf gate ---------------------------------------------------------------
+
+
+@pytest.mark.slow
+def test_perf_gate_roundtrip(tmp_path):
+    """Record a baseline, compare against it (pass), then tamper the
+    baseline 1000x tighter and expect the gate to fail — the full CI
+    contract of tools/perf_gate.py in one pass."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    baseline = tmp_path / "baseline.json"
+
+    def gate(*args):
+        return subprocess.run(
+            [sys.executable, os.path.join(REPO, "tools", "perf_gate.py"),
+             "--runs", "1", *args],
+            cwd=REPO, env=env, capture_output=True, text=True,
+            timeout=600)
+
+    rec = gate("--write-baseline", "--out", str(baseline))
+    assert rec.returncode == 0, rec.stdout + rec.stderr
+    data = json.loads(baseline.read_text())
+    assert data["schema"] == "ptpu-perf-gate-v1"
+    stages = data["workloads"]["prove"]["stages"]
+    # the named prover stages all made it into the record
+    for stage in ("r1_commits", "grand_product", "quotient", "openings",
+                  "transcript"):
+        assert stage in stages, sorted(stages)
+
+    ok = gate("--baseline", str(baseline))
+    assert ok.returncode == 0, ok.stdout + ok.stderr
+    assert "PERF_GATE_OK" in ok.stdout
+
+    for w in data["workloads"].values():
+        w["total_s"] /= 1000.0
+        w["stages"] = {k: v / 1000.0 for k, v in w["stages"].items()}
+    baseline.write_text(json.dumps(data))
+    bad = gate("--baseline", str(baseline))
+    assert bad.returncode == 1, bad.stdout + bad.stderr
+    assert "REGRESSION" in bad.stderr
+
+
+def test_committed_baseline_is_loadable():
+    path = os.path.join(REPO, "tools", "perf_baseline.json")
+    with open(path) as f:
+        data = json.load(f)
+    assert data["schema"] == "ptpu-perf-gate-v1"
+    assert set(data["workloads"]) == {"prove", "refresh"}
+
+
+# --- profile verb ------------------------------------------------------------
+
+
+@pytest.fixture()
+def clean_tracer():
+    from protocol_tpu.utils import trace
+
+    trace.TRACER.reset()
+    trace.TRACER.reset_instruments()
+    was = trace.TRACER.enabled
+    yield trace
+    trace.sync_spans(False)
+    trace.TRACER.disable()
+    trace.TRACER.reset()
+    trace.TRACER.reset_instruments()
+    trace.TRACER.compile_tracker.reset()
+    if was:
+        trace.TRACER.enable()
+
+
+def test_profile_verb_prove_coverage(tmp_path, capsys, clean_tracer):
+    """The acceptance check: one ``profile`` command produces a
+    per-stage report whose prover stage times sum to within 10% of the
+    total prove wall time under sync-spans."""
+    from protocol_tpu.cli.main import main
+
+    report_path = tmp_path / "report.json"
+    rc = main(["--assets", str(tmp_path), "profile",
+               "--workload", "prove", "--k", "7", "--gates", "24",
+               "--min-coverage", "0.9", "--json", str(report_path)])
+    out = capsys.readouterr().out
+    assert rc == 0, out
+    assert "STAGE_COVERAGE=" in out
+    report = json.loads(report_path.read_text())
+    assert report["schema"] == "ptpu-profile-v1"
+    assert report["sync_spans"] is True
+    assert report["coverage"] >= 0.9
+    assert abs(report["stage_total_s"] - report["prove_total_s"]) \
+        <= 0.1 * report["prove_total_s"]
+    for stage in ("witness_build", "r1_commits", "grand_product",
+                  "quotient", "evals", "openings", "transcript"):
+        assert stage in report["stages"], stage
+
+
+def test_profile_verb_refresh_workload(tmp_path, capsys, clean_tracer):
+    from protocol_tpu.cli.main import main
+
+    rc = main(["--assets", str(tmp_path), "profile",
+               "--workload", "refresh", "--n", "300",
+               "--edges-per-node", "3"])
+    out = capsys.readouterr().out
+    assert rc == 0, out
+    assert "converge.edges" in out
+    assert "converge sweeps[jax-sparse]" in out
+    assert "xla:" in out
+
+
+def test_profile_verb_daemon_needs_url(tmp_path, capsys, clean_tracer):
+    from protocol_tpu.cli.main import main
+
+    rc = main(["--assets", str(tmp_path), "profile",
+               "--workload", "daemon"])
+    assert rc == 1
+    assert "--url" in capsys.readouterr().err
+
+
+def test_profile_verb_xprof_and_jsonl_join(tmp_path, capsys,
+                                           clean_tracer):
+    """--xprof + --jsonl: the capture start/stop events land in the
+    JSONL stream stamped with the workload's trace id — the offline
+    xprof↔span-stream correlation seam."""
+    from protocol_tpu.cli.main import main
+
+    jsonl = tmp_path / "spans.jsonl"
+    rc = main(["--assets", str(tmp_path), "profile",
+               "--workload", "refresh", "--n", "200",
+               "--edges-per-node", "3",
+               "--xprof", str(tmp_path / "xprof"),
+               "--jsonl", str(jsonl)])
+    assert rc == 0, capsys.readouterr().out
+    start = stop = None
+    trace_ids = set()
+    with open(jsonl) as f:
+        for line in f:
+            obj = json.loads(line)
+            if obj.get("name") == "trace.device_trace_start":
+                start = obj
+            if obj.get("name") == "trace.device_trace_stop":
+                stop = obj
+            if "trace_id" in obj:
+                trace_ids.add(obj["trace_id"])
+    assert start is not None and stop is not None
+    assert start["trace_id"].startswith("profile-")
+    assert start["trace_id"] == stop["trace_id"]
+    # the converge spans share the same trace id: joinable offline
+    assert start["trace_id"] in trace_ids
+
+
+# --- obs verb percentiles ----------------------------------------------------
+
+
+def test_obs_verb_stage_percentiles(tmp_path, capsys):
+    from protocol_tpu.cli.main import main
+
+    stream = tmp_path / "t.jsonl"
+    with open(stream, "w") as f:
+        for i in range(20):
+            f.write(json.dumps({
+                "type": "span", "name": "prove.quotient",
+                "ts": 1000.0 + i, "duration_s": (i + 1) / 100.0,
+                "depth": 0, "span_id": f"{i:08x}"}) + "\n")
+    rc = main(["--assets", str(tmp_path), "obs", str(stream)])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "p50_ms" in out and "p95_ms" in out
+    row = next(line for line in out.splitlines()
+               if line.startswith("prove.quotient"))
+    cols = row.split()
+    # nearest-rank over 10ms..200ms: p50=100ms, p95=190ms
+    assert float(cols[4]) == pytest.approx(100.0)
+    assert float(cols[5]) == pytest.approx(190.0)

@@ -25,6 +25,9 @@ def tracer():
     yield trace.TRACER
     trace.TRACER.reset()
     trace.TRACER.reset_instruments()
+    # the compile tracker (and its steady-recompile latch) is
+    # process-global state some tests deliberately trip
+    trace.TRACER.compile_tracker.reset()
     if not was_enabled:
         trace.TRACER.disable()
 
@@ -249,3 +252,214 @@ def test_validate_record():
         {"type": "metric", "name": "m", "value": "high"})
     assert trace.validate_record(
         {"type": "metric", "name": "m", "values": [1, 2]}) is None
+
+
+# --- sync-span mode ----------------------------------------------------------
+
+
+def test_sync_spans_mode_toggles_and_syncs(tracer):
+    jnp = pytest.importorskip("jax.numpy")
+
+    assert not trace.sync_enabled()
+    trace.sync_spans(True)
+    try:
+        assert trace.sync_enabled()
+        x = jnp.arange(8) * 2
+        # must block-and-return the value, never raise — host values and
+        # pytrees included
+        assert trace.device_sync(x) is x
+        assert trace.device_sync([x, x]) is not None
+        assert trace.device_sync(None) is None
+        assert trace.device_sync("host value") == "host value"
+    finally:
+        trace.sync_spans(False)
+    assert not trace.sync_enabled()
+
+
+def test_device_sync_noop_when_disabled(tracer):
+    # sync mode off: no jax import, no blocking — identity passthrough
+    sentinel = object()
+    assert trace.device_sync(sentinel) is sentinel
+
+
+# --- percentile stage summaries ---------------------------------------------
+
+
+def test_percentile_nearest_rank():
+    vals = [0.1, 0.2, 0.3, 0.4, 1.0]
+    assert trace.percentile(vals, 0.5) == 0.3
+    assert trace.percentile(vals, 0.95) == 1.0
+    assert trace.percentile([7.0], 0.5) == 7.0
+    with pytest.raises(ValueError):
+        trace.percentile([], 0.5)
+
+
+def test_stage_summary_percentiles(tracer):
+    import time as _time
+
+    for _ in range(4):
+        with trace.span("stage.sleepy"):
+            _time.sleep(0.002)
+    s = trace.stage_summary()["stage.sleepy"]
+    assert s["count"] == 4
+    assert s["total_s"] >= 0.008
+    assert 0.0 < s["p50_s"] <= s["p95_s"] <= s["max_s"]
+
+
+# --- XLA compile tracking ----------------------------------------------------
+
+
+def test_compile_tracking_counts_and_steady_recompile_latch(tracer):
+    jax = pytest.importorskip("jax")
+    jnp = pytest.importorskip("jax.numpy")
+
+    tracker = trace.TRACER.compile_tracker
+    assert trace.install_compile_tracking()
+
+    def fresh_jit():
+        # a NEW jit wrapper each call: same shapes, yet XLA must
+        # recompile — the model of a leaking jit cache
+        @jax.jit
+        def f(x):
+            return x * 3 + 1
+
+        return f
+
+    sig = ("test-steady", 8)
+    base = tracker.stats()["steady_recompiles"]
+    with trace.compile_watch("testsite", signature=sig):
+        fresh_jit()(jnp.ones(8)).block_until_ready()
+    first = tracker.stats()
+    assert trace.TRACER.counter("xla_compiles").value(site="testsite") >= 1
+    # first sighting of the signature: compiles are legit, no latch
+    assert first["steady_recompiles"] == base
+
+    with trace.compile_watch("testsite", signature=sig):
+        fresh_jit()(jnp.ones(8)).block_until_ready()
+    second = tracker.stats()
+    assert second["steady_recompiles"] > base
+    assert second["recompile_warning"] is True
+    assert trace.TRACER.counter("xla_steady_recompiles").value(
+        site="testsite") >= 1
+
+
+def test_compile_watch_cache_hit_does_not_latch(tracer):
+    jax = pytest.importorskip("jax")
+    jnp = pytest.importorskip("jax.numpy")
+
+    trace.install_compile_tracking()
+
+    @jax.jit
+    def g(x):
+        return x + 2
+
+    sig = ("test-hit", 16)
+    tracker = trace.TRACER.compile_tracker
+    with trace.compile_watch("hitsite", signature=sig):
+        g(jnp.ones(16)).block_until_ready()
+    before = tracker.stats()["steady_recompiles"]
+    with trace.compile_watch("hitsite", signature=sig):
+        # same jitted callable, same shape: jit cache hit, no compile,
+        # and crucially NO steady-recompile latch
+        g(jnp.ones(16)).block_until_ready()
+    after = tracker.stats()
+    assert after["steady_recompiles"] == before
+
+
+def test_compile_stats_shape(tracer):
+    stats = trace.compile_stats()
+    for key in ("installed", "compiles", "compile_seconds",
+                "steady_recompiles", "recompile_warning", "last_site"):
+        assert key in stats
+
+
+# --- converge instrumentation ------------------------------------------------
+
+
+def test_record_converge_stats_instruments(tracer):
+    from protocol_tpu.ops.converge import record_converge_stats
+
+    record_converge_stats("test-backend", 10, 1e-7, 2.0, n=100)
+    assert trace.TRACER.gauge("converge_iterations").value(
+        backend="test-backend") == 10
+    assert trace.TRACER.gauge("converge_residual").value(
+        backend="test-backend") == pytest.approx(1e-7)
+    series = trace.TRACER.histogram("converge_sweep_seconds").series()
+    assert series and series[0][1]["count"] == 1
+    assert series[0][1]["sum"] == pytest.approx(0.2)  # 2.0s / 10 iters
+    # fixed-iteration runs pass delta=None: iterations recorded,
+    # residual untouched
+    record_converge_stats("fixed-backend", 5, None, 1.0)
+    assert trace.TRACER.gauge("converge_iterations").value(
+        backend="fixed-backend") == 5
+
+
+def test_converge_edges_records_gauges_and_watch(tracer):
+    np = pytest.importorskip("numpy")
+    pytest.importorskip("jax")
+    from protocol_tpu.backend import JaxSparseBackend
+    from protocol_tpu.graph import barabasi_albert_edges
+
+    n = 120
+    src, dst, val = barabasi_albert_edges(n, 3, seed=3)
+    scores, iters, delta = JaxSparseBackend().converge_edges(
+        n, src, dst, val, np.ones(n, dtype=bool), 1000.0, 200, tol=1e-6)
+    assert iters > 0
+    assert trace.TRACER.gauge("converge_iterations").value(
+        backend="jax-sparse") == iters
+    assert trace.TRACER.gauge("converge_residual").value(
+        backend="jax-sparse") == pytest.approx(delta)
+    sweeps = trace.TRACER.histogram("converge_sweep_seconds").series()
+    assert any(dict(items).get("backend") == "jax-sparse"
+               for items, _ in sweeps)
+    # rendering: the stage/converge families land on /metrics typed
+    page = render_prometheus()
+    assert "# TYPE ptpu_converge_sweep_seconds histogram" in page
+    assert "ptpu_converge_iterations" in page
+    assert lint_exposition(page) == []
+
+
+def test_declared_instrument_families_render(tracer):
+    from protocol_tpu.service.metrics import (
+        HISTOGRAM_FAMILIES,
+        declare_instruments,
+    )
+
+    declare_instruments()
+    page = render_prometheus()
+    for family in HISTOGRAM_FAMILIES:
+        assert f"# TYPE ptpu_{family} histogram" in page, family
+    assert "# TYPE ptpu_xla_compiles_total counter" in page
+    assert "ptpu_xla_steady_recompiles_total 0" in page
+    assert lint_exposition(page) == []
+
+
+def test_prover_stage_histogram_renders(tracer):
+    from protocol_tpu.zk.prover_fast import _stage
+
+    with _stage("unit_stage", 7, "host"):
+        pass
+    page = render_prometheus()
+    assert "# TYPE ptpu_prover_stage_seconds histogram" in page
+    assert 'stage="unit_stage"' in page and 'path="host"' in page
+    assert lint_exposition(page) == []
+
+
+def test_device_trace_events_carry_trace_context(tracer, tmp_path):
+    pytest.importorskip("jax")
+    stream = tmp_path / "events.jsonl"
+    trace.TRACER.disable()
+    trace.TRACER.enable(str(stream))
+    with trace.context(trace_id="prof-1"):
+        with trace.device_trace(str(tmp_path / "xprof")):
+            pass
+    trace.TRACER.disable()
+    trace.TRACER.enable()
+    names = []
+    with open(stream) as f:
+        for line in f:
+            obj = json.loads(line)
+            if obj.get("type") == "event":
+                names.append((obj["name"], obj.get("trace_id")))
+    assert ("trace.device_trace_start", "prof-1") in names
+    assert ("trace.device_trace_stop", "prof-1") in names

@@ -36,15 +36,31 @@ env JAX_PLATFORMS=cpu python tools/serve_smoke.py --restart 2>&1 \
 smoke_rc=${PIPESTATUS[0]}
 echo "serve_smoke --restart: rc=${smoke_rc}"
 
-# scrape-lint + trace-join phases must have actually run, not been
-# skipped by an early exit path
+# scrape-lint + trace-join + device-observability phases must have
+# actually run, not been skipped by an early exit path. DEVICE_OBS_OK
+# asserts the stage/converge histogram families and a steady-state XLA
+# recompile count of 0 on the live daemon's /metrics.
 lint_rc=1
 grep -q SCRAPE_LINT_OK /tmp/_smoke.log \
-    && grep -q TRACE_JOIN_OK /tmp/_smoke.log && lint_rc=0
-echo "scrape-lint + trace-join: rc=${lint_rc}"
+    && grep -q TRACE_JOIN_OK /tmp/_smoke.log \
+    && grep -q DEVICE_OBS_OK /tmp/_smoke.log && lint_rc=0
+echo "scrape-lint + trace-join + device-obs: rc=${lint_rc}"
 
-echo "CHECK_SUMMARY tier1_rc=${t1_rc} dots=${dots} smoke_rc=${smoke_rc} lint_rc=${lint_rc}"
-if [ "${smoke_rc}" -ne 0 ] || [ "${lint_rc}" -ne 0 ]; then
+# opt-in perf-regression gate (PTPU_PERF_GATE=1): per-stage timings of
+# the instrumented prove/refresh workloads vs tools/perf_baseline.json.
+# The committed baseline is wall-clock from the box that recorded it —
+# on a much slower machine record a local one (perf_gate.py
+# --write-baseline --out <path>) and point PTPU_PERF_BASELINE at it.
+gate_rc=0
+if [ "${PTPU_PERF_GATE:-0}" = "1" ]; then
+    env JAX_PLATFORMS=cpu python tools/perf_gate.py \
+        --baseline "${PTPU_PERF_BASELINE:-tools/perf_baseline.json}"
+    gate_rc=$?
+    echo "perf-gate: rc=${gate_rc}"
+fi
+
+echo "CHECK_SUMMARY tier1_rc=${t1_rc} dots=${dots} smoke_rc=${smoke_rc} lint_rc=${lint_rc} gate_rc=${gate_rc}"
+if [ "${smoke_rc}" -ne 0 ] || [ "${lint_rc}" -ne 0 ] || [ "${gate_rc}" -ne 0 ]; then
     exit 1
 fi
 if [ "${t1_rc}" -ne 0 ] && [ "${t1_rc}" -ne 124 ]; then

@@ -1,0 +1,195 @@
+"""Perf-regression gate: per-stage timings vs a committed baseline.
+
+Runs the instrumented workloads the ``profile`` CLI verb exposes (a
+synthetic prove through ``prove_auto`` — host path on CPU, TPU path on
+an accelerator — and a synthetic score refresh through the
+ConvergeBackend seam), collects per-stage wall times from the
+``ptpu_prover_stage_seconds`` / span instruments, and compares them
+against a BENCH-style JSON baseline with per-stage tolerances.
+
+Usage:
+
+    python tools/perf_gate.py --write-baseline [--out PATH]
+    python tools/perf_gate.py [--baseline tools/perf_baseline.json]
+                              [--tolerance 2.5] [--runs 2]
+
+Comparison rules (regressions only — speedups always pass):
+
+- a stage fails when ``current > tolerance * baseline`` AND the
+  absolute growth exceeds ``--min-delta`` seconds (sub-millisecond
+  stages are noise, not signal);
+- workload totals are gated the same way;
+- stages present only in the baseline warn (instrumentation drift —
+  fix the baseline); new stages are reported, never fatal.
+
+``--runs N`` takes the BEST of N runs per workload (the standard
+noise-floor defense for wall-clock gates on shared boxes).
+Opt-in in CI: ``PTPU_PERF_GATE=1 tools/check.sh`` runs it as an extra
+phase. Exit 0 = no regression; 1 = regression or unreadable baseline.
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+DEFAULT_BASELINE = os.path.join(REPO, "tools", "perf_baseline.json")
+
+# small-but-real workload shapes: big enough that stage times are
+# meaningful, small enough for a CI phase (~10 s total on a 2-core box)
+PROVE_KW = {"k": 7, "gates": 64, "repeat": 1}
+REFRESH_KW = {"n": 1500, "m": 4, "engine": "gather", "tol": 1e-6,
+              "repeat": 1}
+
+
+def _run_once() -> dict:
+    """One measured pass of both workloads in a fresh tracer state;
+    returns {workload: {"total_s", "stages": {name: seconds}}}."""
+    from protocol_tpu.cli.profilecmd import (
+        fold_prover_stages,
+        run_prove_workload,
+        run_refresh_workload,
+    )
+    from protocol_tpu.utils import trace
+
+    out = {}
+
+    def measure(tag, fn, stage_filter):
+        trace.TRACER.reset()
+        trace.TRACER.reset_instruments()
+        t0 = time.perf_counter()
+        fn()
+        total = time.perf_counter() - t0
+        stages = {k: v["total_s"]
+                  for k, v in fold_prover_stages().items()}
+        for name, agg in trace.summary().items():
+            if name in stage_filter:
+                stages[name] = stages.get(name, 0.0) + agg["total_s"]
+        out[tag] = {"total_s": round(total, 6),
+                    "stages": {k: round(v, 6)
+                               for k, v in sorted(stages.items())}}
+
+    measure("prove", lambda: run_prove_workload(**PROVE_KW), ())
+    measure("refresh", lambda: run_refresh_workload(**REFRESH_KW),
+            ("converge.edges",))
+    return out
+
+
+def run_workloads(runs: int) -> dict:
+    """Best-of-``runs`` per workload (per-stage minimum: each stage's
+    best observation is the least-noisy estimate of its true cost)."""
+    from protocol_tpu.utils import trace
+
+    trace.enable()
+    trace.sync_spans(True)
+    best: dict = {}
+    for _ in range(max(1, runs)):
+        result = _run_once()
+        for tag, data in result.items():
+            cur = best.setdefault(tag, data)
+            if data["total_s"] < cur["total_s"]:
+                cur["total_s"] = data["total_s"]
+            for stage, v in data["stages"].items():
+                prev = cur["stages"].get(stage)
+                cur["stages"][stage] = v if prev is None else min(prev, v)
+    return {
+        "schema": "ptpu-perf-gate-v1",
+        "workload_params": {"prove": PROVE_KW, "refresh": REFRESH_KW},
+        "runs": runs,
+        "workloads": best,
+    }
+
+
+def compare(current: dict, baseline: dict, tolerance: float,
+            min_delta: float) -> list:
+    """Regression messages (empty = pass)."""
+    problems = []
+    base_w = baseline.get("workloads", {})
+    for tag, cur in current["workloads"].items():
+        base = base_w.get(tag)
+        if base is None:
+            print(f"note: workload {tag!r} absent from baseline "
+                  "(new — re-record with --write-baseline)")
+            continue
+        if (cur["total_s"] > tolerance * base["total_s"]
+                and cur["total_s"] - base["total_s"] > min_delta):
+            problems.append(
+                f"{tag}: total {cur['total_s']:.3f}s > {tolerance}x "
+                f"baseline {base['total_s']:.3f}s")
+        for stage, b in base["stages"].items():
+            c = cur["stages"].get(stage)
+            if c is None:
+                print(f"warning: stage {tag}/{stage} in baseline but "
+                      "not measured (instrumentation drift?)")
+                continue
+            if c > tolerance * b and c - b > min_delta:
+                problems.append(
+                    f"{tag}/{stage}: {c:.3f}s > {tolerance}x baseline "
+                    f"{b:.3f}s")
+        for stage in sorted(set(cur["stages"]) - set(base["stages"])):
+            print(f"note: new stage {tag}/{stage} "
+                  f"({cur['stages'][stage]:.3f}s) not in baseline")
+    return problems
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        description="per-stage perf-regression gate")
+    parser.add_argument("--baseline", default=DEFAULT_BASELINE)
+    parser.add_argument("--write-baseline", action="store_true",
+                        help="record the current timings as the "
+                             "baseline instead of comparing")
+    parser.add_argument("--out", default=None,
+                        help="baseline output path (with "
+                             "--write-baseline; default --baseline)")
+    parser.add_argument("--tolerance", type=float, default=2.5,
+                        help="fail when current > tolerance x baseline "
+                             "(default 2.5 — wall-clock on shared CI "
+                             "boxes is noisy; the gate is for order-of-"
+                             "magnitude regressions, not percent drift)")
+    parser.add_argument("--min-delta", type=float, default=0.05,
+                        help="ignore regressions smaller than this many "
+                             "seconds absolute (noise floor)")
+    parser.add_argument("--runs", type=int, default=2,
+                        help="best-of-N runs per workload")
+    args = parser.parse_args(argv)
+
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    current = run_workloads(args.runs)
+
+    if args.write_baseline:
+        path = args.out or args.baseline
+        with open(path, "w") as f:
+            json.dump(current, f, indent=2, sort_keys=True)
+            f.write("\n")
+        print(f"wrote baseline {path}")
+        return 0
+
+    try:
+        with open(args.baseline) as f:
+            baseline = json.load(f)
+    except (OSError, ValueError) as e:
+        print(f"error: unreadable baseline {args.baseline}: {e}",
+              file=sys.stderr)
+        return 1
+    print(json.dumps(current["workloads"], indent=2, sort_keys=True))
+    problems = compare(current, baseline, args.tolerance, args.min_delta)
+    for msg in problems:
+        print(f"REGRESSION: {msg}", file=sys.stderr)
+    if problems:
+        print("hint: the baseline is absolute wall-clock from the box "
+              "that recorded it — on a slower machine, record a local "
+              "one (--write-baseline --out <path>) and compare against "
+              "that (PTPU_PERF_BASELINE=<path> for tools/check.sh)",
+              file=sys.stderr)
+        return 1
+    print("PERF_GATE_OK")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

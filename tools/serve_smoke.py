@@ -60,6 +60,18 @@ def _metric_value(metrics_text, name):
     return None
 
 
+def _series_sum(metrics_text, name):
+    """Sum every series of one metric family (labeled or not);
+    None when the family has no samples at all."""
+    total, seen = 0.0, False
+    for line in metrics_text.splitlines():
+        if line.startswith(name) and len(line) > len(name) \
+                and line[len(name)] in " {":
+            total += float(line.split()[-1])
+            seen = True
+    return total if seen else None
+
+
 def inprocess_phase(node_url, chain, step) -> None:
     import tempfile
 
@@ -153,6 +165,10 @@ def inprocess_phase(node_url, chain, step) -> None:
         step(f"/status ok (freshness {fresh:.2f}s, "
              f"uptime {status['uptime_seconds']:.1f}s)")
 
+        # --- device-layer observability on the live daemon ----------------
+        device_obs_phase(_get_json(url, "/metrics"), status,
+                         _get_json(url, "/stages"), step)
+
         # --- end-to-end trace join over the JSONL stream ------------------
         trace_join_phase(trace_path, chain, step)
 
@@ -181,6 +197,40 @@ def scrape_lint_phase(metrics_text, step) -> None:
             f"/metrics missing typed series {needle}"
     step(f"SCRAPE_LINT_OK ({len(metrics_text.splitlines())} lines, "
          "0 errors)")
+
+
+def device_obs_phase(metrics_text, status, stages, step) -> None:
+    """Device-layer observability assertions on the LIVE daemon:
+    the stage/converge histogram families are declared on /metrics,
+    the converge instruments carry real samples from the refreshes,
+    and the steady-state XLA recompile count is ZERO — a nonzero value
+    means a shape leak in the refresh or prover cache."""
+    for needle in ("# TYPE ptpu_prover_stage_seconds histogram",
+                   "# TYPE ptpu_converge_sweep_seconds histogram",
+                   "# TYPE ptpu_xla_compile_seconds histogram",
+                   "# TYPE ptpu_xla_compiles_total counter",
+                   "# TYPE ptpu_converge_iterations gauge"):
+        assert needle in metrics_text, f"/metrics missing {needle!r}"
+    # the refreshes ran through the ConvergeBackend seam, so the sweep
+    # histogram and iteration gauge must carry real samples
+    assert "ptpu_converge_sweep_seconds_bucket" in metrics_text, \
+        "no converge sweep samples on /metrics"
+    iters = _series_sum(metrics_text, "ptpu_converge_iterations")
+    assert iters is not None and iters > 0, \
+        f"converge iteration gauge absent/zero ({iters})"
+    steady = _series_sum(metrics_text, "ptpu_xla_steady_recompiles_total")
+    assert steady == 0.0, \
+        f"steady-state XLA recompiles on the live daemon: {steady}"
+    xla = status.get("xla")
+    assert xla is not None and xla["recompile_warning"] is False, \
+        f"/status xla section wrong: {xla}"
+    assert "service.refresh" in stages["stages"], \
+        f"/stages missing the refresh stage: {sorted(stages['stages'])}"
+    ref = stages["stages"]["service.refresh"]
+    assert ref["count"] >= 1 and ref["p95_s"] >= ref["p50_s"] >= 0.0
+    step(f"DEVICE_OBS_OK (compiles={int(xla['compiles'])}, "
+         f"steady_recompiles=0, converge samples present, "
+         f"/stages p50/p95 ok)")
 
 
 def trace_join_phase(trace_path, chain, step) -> None:
