@@ -59,6 +59,14 @@ def main():
                         default="auto")
     parser.add_argument("--cache-dir", default="bench_cache",
                         help="routed-operator cache ('' disables)")
+    parser.add_argument("--churn", action="store_true",
+                        help="measure the steady-state edge-churn cost "
+                             "(delta-apply per batch through "
+                             "protocol_tpu.incremental) against the "
+                             "full routing-plan build it replaces")
+    parser.add_argument("--churn-batches", type=int, default=20)
+    parser.add_argument("--churn-edges", type=int, default=500,
+                        help="weight revisions per churn batch")
     args = parser.parse_args()
 
     if args.ingest:
@@ -129,6 +137,9 @@ def main():
             print("bench: native Clos planner unavailable; "
                   "falling back to gather backend", file=sys.stderr)
             backend = "gather"
+
+    if args.churn:
+        return bench_churn(args)
 
     t0 = time.perf_counter()
     rop = None
@@ -234,6 +245,68 @@ def main():
     if not meta["converged"]:
         print("BENCH FAILED: did not converge to tolerance", file=sys.stderr)
         return 1
+    return 0
+
+
+def bench_churn(args) -> int:
+    """Steady-state churn cost: with a DeltaEngine anchored on one full
+    routed build, a batch of weight revisions costs O(batch) host work
+    plus O(dirty) device scatters — measured here against the full
+    plan build the pre-PR 6 write path would have paid per change.
+    ``vs_baseline`` = full_build_s / delta_apply_s (>1 means a churn
+    window is cheaper than the rebuild it replaces)."""
+    import jax
+
+    from protocol_tpu.graph import barabasi_albert_edges, filter_edges
+    from protocol_tpu.incremental import DeltaEngine, revision_batch
+    from protocol_tpu.ops.routed import build_routed_operator
+
+    rng = np.random.default_rng(7)
+    src, dst, val = barabasi_albert_edges(args.n, args.m, seed=0)
+    valid = np.ones(args.n, dtype=bool)
+    fsrc, fdst, _, _, _, raw, _ = filter_edges(
+        args.n, src, dst, val, valid, return_raw=True)
+    cur = raw.copy()
+
+    t0 = time.perf_counter()
+    rop = build_routed_operator(args.n, src, dst, val, valid)
+    build_s = time.perf_counter() - t0
+
+    eng = DeltaEngine.anchor(args.n, src, dst, val, valid, rop)
+    # one converge to settle jit caches; churn timing is host+scatter
+    scores, iters, delta = eng.converge(
+        eng.initial_node_scores(1000.0), args.max_iters, args.tol)
+
+    apply_s = []
+    for _ in range(args.churn_batches):
+        deltas = revision_batch(rng, fsrc, fdst, cur, args.churn_edges)
+        t1 = time.perf_counter()
+        if not eng.apply_deltas(deltas):
+            print("BENCH FAILED: delta batch rejected", file=sys.stderr)
+            return 1
+        apply_s.append(time.perf_counter() - t1)
+    wall = float(np.median(apply_s))
+
+    meta = {
+        "mode": "churn",
+        "n_peers": args.n,
+        "edges": len(fsrc),
+        "batch_edges": args.churn_edges,
+        "batches": args.churn_batches,
+        "full_build_s": round(build_s, 3),
+        "delta_apply_s": [round(t, 5) for t in apply_s],
+        "converge_iterations": int(iters),
+        "device": str(jax.devices()[0]),
+    }
+    print(json.dumps(meta), file=sys.stderr)
+    print(json.dumps({
+        "metric": f"{_fmt_peers(args.n)}-peer steady churn: delta-apply "
+                  f"per {args.churn_edges}-revision batch "
+                  f"(vs full plan rebuild)",
+        "value": round(wall, 5),
+        "unit": "s",
+        "vs_baseline": round(build_s / wall, 1),
+    }))
     return 0
 
 

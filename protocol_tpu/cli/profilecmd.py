@@ -96,6 +96,46 @@ def run_refresh_workload(n: int = 2000, m: int = 4,
             "residual": float(delta), "repeat": repeat}
 
 
+def run_delta_workload(n: int = 4000, m: int = 4, batches: int = 10,
+                       batch_edges: int = 200, seed: int = 17) -> dict:
+    """The serve daemon's write path at churn: one full routed build
+    (``routed.plan_build`` span), a DeltaEngine anchor, then weight-
+    revision batches absorbed in place (``delta.classify`` /
+    ``delta.revise`` / ``delta.structural`` / ``delta.renorm`` spans)
+    and one partial refresh over the dirty frontier. Timings land in
+    the process tracer; tools/perf_gate.py gates the delta-apply
+    stages against the full-build stage."""
+    import numpy as np
+
+    from ..graph import barabasi_albert_edges, filter_edges
+    from ..incremental import DeltaEngine, partial_refresh, revision_batch
+    from ..ops.routed import build_routed_operator
+
+    rng = np.random.default_rng(seed)
+    src, dst, val = barabasi_albert_edges(n, m, seed=seed)
+    valid = np.ones(n, dtype=bool)
+    fsrc, fdst, _, _, _, raw, _ = filter_edges(n, src, dst, val, valid,
+                                               return_raw=True)
+    cur = raw.copy()
+    op = build_routed_operator(n, src, dst, val, valid)
+    eng = DeltaEngine.anchor(n, src, dst, val, valid, op)
+    s_pub, iters, delta = eng.converge(
+        eng.initial_node_scores(1000.0), 300, 1e-6)
+    eng.take_frontier()
+    for _ in range(max(1, batches)):
+        deltas = revision_batch(rng, fsrc, fdst, cur, batch_edges)
+        if not eng.apply_deltas(deltas):
+            raise EigenError("internal_error",
+                             f"delta batch rejected: {eng.stats}")
+    frontier, _ = eng.take_frontier()
+    res = partial_refresh(eng, s_pub, frontier, 1e-6, 300,
+                          frontier_limit=n)
+    return {"workload": "delta", "n": n, "edges": len(fsrc),
+            "batches": batches, "batch_edges": batch_edges,
+            "tail": len(eng.tail_index),
+            "partial_sweeps": None if res is None else res.sweeps}
+
+
 def run_daemon_capture(url: str, seconds: float) -> dict:
     """Submit a ``profile`` job to a live daemon and wait for the
     capture window to close; returns the job result (xprof log dir on

@@ -79,6 +79,7 @@ def filter_edges(
     dst: np.ndarray,
     val: np.ndarray,
     valid: np.ndarray | None = None,
+    return_raw: bool = False,
 ):
     """Apply the reference's opinion-filter semantics to an edge list.
 
@@ -86,6 +87,13 @@ def filter_edges(
     row-normalized. Duplicate (src, dst) edges are summed (matching the
     reference where each truster has one score per peer — dedup keeps the
     builder total-order independent).
+
+    ``return_raw=True`` appends ``(raw_val, row_sum)`` to the tuple: the
+    deduped UN-normalized edge values (same order as the filtered edges —
+    sorted by ``src * n + dst``) and the per-row sums they normalize by.
+    The incremental delta engine (``protocol_tpu.incremental``) keys its
+    edge index off this exact ordering, so the raw view lives here rather
+    than being re-derived with subtly different sort semantics.
     """
     src = np.asarray(src, dtype=np.int64)
     dst = np.asarray(dst, dtype=np.int64)
@@ -112,6 +120,8 @@ def filter_edges(
     row_sum = np.bincount(src, weights=val, minlength=n)
     dangling = valid & (row_sum == 0)
     weight = val / row_sum[src] if len(src) else val
+    if return_raw:
+        return src, dst, weight, valid, dangling, val, row_sum
     return src, dst, weight, valid, dangling
 
 

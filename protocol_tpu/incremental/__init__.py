@@ -1,0 +1,39 @@
+"""Incremental operator maintenance — edge churn without O(graph) work.
+
+BENCH_r01→r05 left the converge loop solved (~1.4 s steady at 10M
+peers) and moved the scale wall to the operator (re)build: every
+edge-content change paid a full routing-plan build (~19.7 s warm-cache,
+915 s cold at 10M peers / 159M edges). This package sits between the
+service's opinion graph and the converge backends and absorbs churn in
+O(dirty) instead:
+
+- :class:`engine.DeltaEngine` — anchors on one full routed build and
+  classifies every edge change as **weight revision** (patch the
+  bucketed-ELL value buffer in place), **structural insert/remove**
+  (a bounded COO overflow tail the matvec folds in), or **row
+  dirtying** (re-normalize only the dirty rows through a per-source
+  ``inv_row_scale`` vector) — the routing plan itself never changes
+  until the tail outgrows its budget, at which point a full rebuild is
+  a rare, amortized event;
+- :mod:`partial` — the partial-refresh mode: power-iteration sweeps
+  restricted to the dirty frontier plus its fan-in, warm-started from
+  the published vector, falling back to a full (patched-operator,
+  still rebuild-free) device sweep on a residual bound. The
+  convergence footing is the partially-observed-matvec analysis named
+  in PAPERS.md (arXiv 2606.11956).
+
+The service wiring lives in ``protocol_tpu.service.refresh``; the
+patched-matvec seams (``inv_row_scale``, the ``tail_*`` COO arrays,
+``RoutedOperator.out_edge_slot``) live in ``ops/routed.py``.
+"""
+
+from .engine import DeltaEngine, DeltaStats, revision_batch
+from .partial import PartialResult, partial_refresh
+
+__all__ = [
+    "DeltaEngine",
+    "DeltaStats",
+    "PartialResult",
+    "partial_refresh",
+    "revision_batch",
+]

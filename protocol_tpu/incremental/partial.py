@@ -1,0 +1,194 @@
+"""Partial refresh: power-iteration sweeps restricted to the dirty
+frontier plus its fan-in.
+
+The footing ("Analysis of Power Iteration Algorithm with Partially
+Observed Matrix-vector Products", PAPERS.md): when only a small slice
+of the opinion matrix changed, the published vector is a near-fixed-
+point of the new operator *except on the nodes downstream of the dirty
+rows*. A full sweep would recompute every coordinate only to reproduce
+the old value almost everywhere; the partial sweep recomputes exactly
+the coordinates whose inputs changed and propagates outward along
+fan-out edges, so a churn window costs O(dirty · degree) host numpy
+instead of an O(E) device matvec — and O(dirty) is precisely what the
+delta engine already tracks.
+
+One term is genuinely global: the dangling-mass rank-1 correction adds
+``d_mass / (n_valid − 1)`` to every valid coordinate, so a change in
+``d_mass`` shifts ALL of them uniformly. The sweep tracks that shift
+as a lazily-materialized scalar (``uni``) — O(1) per sweep — rather
+than exploding the frontier to the whole graph. The shift's own
+*onward propagation* through the matrix is the one thing the partial
+sweep does not compute; since a uniform perturbation of L1 mass
+``|g|·n_valid`` stays L1-non-expanding under the mass-conserving
+operator, the accumulated ``Σ|g|·n_valid`` is an upper bound on the
+neglected error, and blowing a ``tol``-sized budget of it falls back
+to the full sweep. On the dominant churn shape — weight revisions
+with a stable dangling set — every ``g`` is exactly zero and the
+sweeps are exact. The damping term (α > 0) needs no tracking at all:
+total mass is conserved by the operator, so ``α·p·total`` is constant
+per coordinate.
+
+Honesty bounds (all falling back to a FULL device sweep on the patched
+operator — still zero plan rebuilds):
+
+- the frontier outgrowing ``frontier_limit`` (propagation reached too
+  much of the graph for partial to win);
+- failing to reach ``tol`` within ``max_sweeps``;
+- a peer-set change since publish (the warm vector is then not a
+  near-fixed-point anywhere — the engine reports ``partial_ok=False``).
+
+The reported residual has full-sweep semantics: the L1 change of the
+COMPLETE vector per sweep (frontier exact part + the uniform shift on
+everyone else) over the warm-start norm — directly comparable to the
+device ``adaptive_loop`` residual, which the parity test asserts.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import numpy as np
+
+from .engine import expand_csr
+
+
+@dataclass
+class PartialResult:
+    scores: np.ndarray   # node order, float64
+    sweeps: int
+    residual: float
+    frontier_peak: int   # widest frontier reached (observability)
+
+
+def _fanin(eng, F: np.ndarray, s: np.ndarray):
+    """(base, in_wsum) over the frontier: Σ w·s[src] and Σ w per
+    frontier node, built CSR + overflow tail. Weights are the TRUE
+    current normalized weights raw/row_sum_now (removed edges carry
+    raw 0 and vanish)."""
+    base = np.zeros(len(F))
+    in_wsum = np.zeros(len(F))
+    Fb = F[F < eng.n0]
+    if len(Fb):
+        rows, pos = expand_csr(eng.in_ptr, Fb)
+        total = len(pos)
+        if total:
+            eids = eng.in_order[pos]
+            srcs = eng.fsrc[eids]
+            denom = eng.row_sum_now[srcs]
+            w = np.divide(eng.raw_val[eids], denom,
+                          out=np.zeros(total), where=denom > 0)
+            bb = np.bincount(rows, weights=w * s[srcs],
+                             minlength=len(Fb))
+            ww = np.bincount(rows, weights=w, minlength=len(Fb))
+            # Fb is a prefix-filtered subset of the sorted F: map back
+            pos = np.searchsorted(F, Fb)
+            base[pos] += bb
+            in_wsum[pos] += ww
+    if len(eng.tail_raw_np):
+        live = eng.tail_raw_np > 0
+        tdst = eng.tail_dst_np[live]
+        pos = np.searchsorted(F, tdst)
+        hit = (pos < len(F)) & (F[np.minimum(pos, len(F) - 1)] == tdst)
+        if hit.any():
+            tsrc = eng.tail_src_np[live][hit]
+            denom = eng.row_sum_now[tsrc]
+            w = np.divide(eng.tail_raw_np[live][hit], denom,
+                          out=np.zeros(int(hit.sum())), where=denom > 0)
+            np.add.at(base, pos[hit], w * s[tsrc])
+            np.add.at(in_wsum, pos[hit], w)
+    return base, in_wsum
+
+
+def _fanout(eng, nodes: np.ndarray) -> np.ndarray:
+    """Out-neighbors of ``nodes`` (built CSR + tail), unique."""
+    parts = []
+    nb = nodes[nodes < eng.n0]
+    if len(nb):
+        _, pos = expand_csr(eng.out_ptr, nb)
+        if len(pos):
+            parts.append(eng.fdst[pos])
+    if len(eng.tail_raw_np):
+        live = eng.tail_raw_np > 0
+        m = live & np.isin(eng.tail_src_np, nodes)
+        if m.any():
+            parts.append(eng.tail_dst_np[m])
+    if not parts:
+        return np.zeros(0, dtype=np.int64)
+    return np.unique(np.concatenate(parts))
+
+
+def partial_refresh(eng, s0, frontier, tol: float, max_sweeps: int,
+                    frontier_limit: int) -> PartialResult | None:
+    """Frontier-restricted sweeps from ``s0`` (node order, the warm
+    vector); ``frontier`` is the engine's dirty set (nodes whose
+    fan-in changed since publish). None = no footing / out of budget —
+    run a full sweep instead."""
+    n = eng.n_now
+    valid = eng.valid_np.astype(np.float64)
+    dangling = eng.dangling_np.astype(np.float64)
+    n_valid = float(eng.n_valid)
+    denom = max(n_valid - 1.0, 1.0)
+    alpha = eng.alpha
+    keep = 1.0 - alpha
+
+    s = np.asarray(s0, dtype=np.float64).copy()
+    if s.shape != (n,):
+        return None
+    norm = max(float(np.sum(np.abs(s))), 1.0)
+    total = float(np.sum(s * valid))   # conserved by the operator
+    uni = 0.0                          # pending uniform add on valid
+    d_arr = float(np.sum(s * dangling))
+    dang_count = float(dangling.sum())
+    d_prev = d_arr                     # d_mass of the previous iterate
+
+    F = np.unique(np.fromiter((int(x) for x in frontier),
+                              dtype=np.int64, count=len(frontier)))
+    F = F[(F >= 0) & (F < n)]
+    if not len(F):
+        return PartialResult(s, 0, 0.0, 0)
+
+    peak = len(F)
+    residual = np.inf
+    uni_budget = 0.0   # L1 bound on neglected uniform-shift propagation
+    # expansion threshold: changes this small may skip fan-out — their
+    # total neglected propagation stays under tol·norm/4 (mass bound)
+    drop_eps = 0.25 * tol * norm / max(n_valid, 1.0)
+    for sweep in range(1, max_sweeps + 1):
+        if len(F) > frontier_limit:
+            return None
+        peak = max(peak, len(F))
+        d_now = d_arr + uni * dang_count
+        g = keep * (d_now - d_prev) / denom  # uniform shift this sweep
+        d_prev = d_now
+        base, in_wsum = _fanin(eng, F, s)
+        base_true = base + uni * in_wsum  # all srcs valid: s_true=s+uni
+        s_true_F = s[F] + uni * valid[F]
+        corr = (d_now - dangling[F] * s_true_F) / denom
+        new_true = base_true + corr * valid[F]
+        if alpha:
+            new_true = keep * new_true + alpha * (
+                valid[F] / max(n_valid, 1.0)) * total
+        uni += g
+        uni_budget += abs(g) * n_valid / norm
+        if uni_budget > tol:
+            return None  # dangling mass drifted too far for partial
+        # store representation: true = s + uni*valid
+        old_arr = s[F].copy()
+        s[F] = new_true - uni * valid[F]
+        d_arr += float(np.sum(dangling[F] * (s[F] - old_arr)))
+        # full-vector per-sweep L1 change: exact on the frontier,
+        # uniform |g| on every other valid coordinate
+        changed = new_true - s_true_F
+        l1 = float(np.sum(np.abs(changed))) + abs(g) * max(
+            n_valid - float(valid[F].sum()), 0.0)
+        residual = l1 / norm
+        if residual <= tol:
+            break
+        moved = F[np.abs(changed) > drop_eps]
+        if len(moved):
+            F = np.unique(np.concatenate([F, _fanout(eng, moved)]))
+    else:
+        return None
+    if uni != 0.0:
+        s = s + uni * valid
+    return PartialResult(s, sweep, residual, peak)

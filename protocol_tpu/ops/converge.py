@@ -66,6 +66,21 @@ def record_converge_stats(backend: str, iters: int, delta, seconds: float,
                 **({} if delta is None else {"residual": float(delta)}))
 
 
+def record_refresh_scope(mode: str) -> None:
+    """The one seam that says HOW a refresh swept the graph:
+    ``mode="partial"`` — host sweeps restricted to the dirty frontier
+    plus its fan-in (O(dirty), the delta engine's fast path);
+    ``mode="full"`` — whole-operator device sweeps on the patched
+    operator; ``mode="rebuild"`` — served by a fresh operator build
+    (the initial anchor, or a re-anchor after a capacity wall / lost
+    delta log). Emits ``ptpu_refresh_sweep_scope_total{mode}`` so an
+    operator can see the ratio drift (a rising full share means churn
+    windows outgrow the partial-refresh bound; a rising rebuild share
+    means the delta engine is thrashing on re-anchors)."""
+    trace.counter("refresh_sweep_scope").inc(mode=mode)
+    trace.event("refresh.sweep_scope", mode=mode)
+
+
 def timed_converge(backend: str, n: int, edges: int, signature, call,
                    fixed_iterations: int | None = None):
     """The one instrumentation wrapper every ConvergeBackend runs its
@@ -176,7 +191,11 @@ def dangling_and_damping(arrs: dict, s: jnp.ndarray, base: jnp.ndarray
     the pure reference semantics; for α>0, pretrust is scaled by the
     current total mass so the conservation invariant holds for any α.
     Both the gather path here and ops.routed share this function so the
-    semantics cannot desynchronize.
+    semantics cannot desynchronize. One twin CANNOT share it: the
+    host-side partial refresher (``protocol_tpu/incremental/partial.py``)
+    applies this same correction frontier-restricted, with ``d_mass``
+    tracked incrementally across sweeps — change the math here and
+    mirror it there (the residual-parity test catches drift).
     """
     d_mass = jnp.sum(s * arrs["dangling"])
     denom = jnp.maximum(arrs["n_valid"] - 1.0, 1.0)

@@ -42,13 +42,14 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 
-from .clos import _apply_route_jit, _use_pallas, plan_route
+from .clos import _apply_route_jit, _use_pallas, plan_route, plan_routes
 from .converge import adaptive_loop, dangling_and_damping
 from ..graph import filter_edges, stable_argsort_bounded
 
 __all__ = [
     "RoutedOperator",
     "build_routed_operator",
+    "ensure_edge_slots",
     "routed_arrays",
     "RoutedStatic",
     "spmv_routed",
@@ -255,6 +256,8 @@ def save_operator_npz(op, path) -> None:
     payload = {"fmt_version": np.asarray(2, dtype=np.int64)}
     for f in dataclasses.fields(op):
         v = getattr(op, f.name)
+        if v is None:
+            continue  # optional field left unset: loaders default it
         if isinstance(v, (int, np.integer)):
             payload[f"int_{f.name}"] = np.asarray(v, dtype=np.int64)
         elif isinstance(v, tuple):
@@ -297,6 +300,8 @@ def save_operator_dir(op, path) -> None:
                 "lists": {}}
         for f in dataclasses.fields(op):
             v = getattr(op, f.name)
+            if v is None:
+                continue  # optional field left unset: loaders default it
             if isinstance(v, (int, np.integer)):
                 meta["ints"][f.name] = int(v)
             elif isinstance(v, tuple):
@@ -375,6 +380,8 @@ def load_operator_dir(cls, path, mmap: bool = True):
                         mmap_mode=mode)
                 for i in range(meta["lists"][f.name])
             ]
+        elif f.default is not dataclasses.MISSING:
+            kwargs[f.name] = f.default  # optional field, older cache
         else:
             raise ValueError(f"operator dir is missing field {f.name}")
     return cls(**kwargs)
@@ -395,6 +402,8 @@ def load_operator_npz(cls, z):
         elif f"cnt_{f.name}" in z:
             kwargs[f.name] = [z[f"lst_{f.name}_{i}"]
                               for i in range(int(z[f"cnt_{f.name}"]))]
+        elif f.default is not dataclasses.MISSING:
+            kwargs[f.name] = f.default  # optional field, older cache
         else:
             raise ValueError(f"operator file is missing field {f.name}")
     return cls(**kwargs)
@@ -423,6 +432,19 @@ class RoutedOperator:
     state_stages: list
     valid: np.ndarray      # [2^state_e] f32
     dangling: np.ndarray
+    # flat out-side slot per FILTERED edge (the order filter_edges
+    # returns — sorted by src*n+dst). The seam the incremental delta
+    # engine patches through: slot -> (bucket, lane-row, lane) addresses
+    # one value in the out_weight buffers. None on operators built (or
+    # cached) before the delta engine existed; ensure_edge_slots
+    # upgrades those in O(E) without a plan rebuild.
+    out_edge_slot: np.ndarray | None = None
+    # the bucket-width floor the build ran with — persisted because the
+    # slot math is a function of it: ensure_edge_slots re-deriving
+    # slots under a different min_width would scatter patches into the
+    # wrong (row, lane) positions. Caches from before this field
+    # load as 8 (the only default any cached operator was built with).
+    min_width: int = 8
 
     @property
     def n_state(self) -> int:
@@ -505,10 +527,29 @@ def build_routed_operator(
     """
     from ..utils import trace as _trace
 
+    # every full routing-plan compilation anywhere in the process —
+    # the write-path cost the delta engine exists to amortize away; the
+    # serve smoke asserts this stays FLAT under weight-revision churn
+    _trace.counter("operator_full_builds").inc()
     with _trace.timed("routed_plan_build_seconds", "routed.plan_build",
                       n=n, edges=len(src)):
         op = _build_routed_operator(n, src, dst, val, valid, min_width,
                                     prefer_native)
+    return op
+
+
+def ensure_edge_slots(op: RoutedOperator, src, dst, weight) \
+        -> RoutedOperator:
+    """Upgrade a pre-delta-engine operator (cached without
+    ``out_edge_slot``) in place: recompute the out-side bucketization —
+    O(E) numpy, NO routing-plan rebuild — for the same filtered edge
+    arrays the operator was built from. Deterministic: the slot math is
+    the exact ``_bucketize_blocked`` pass the build ran, under the
+    ``min_width`` the operator persists."""
+    if op.out_edge_slot is None:
+        op.out_edge_slot = _bucketize_blocked(
+            n=op.n, key=np.asarray(src), other=np.asarray(dst),
+            weight=np.asarray(weight), min_width=op.min_width).edge_slot
     return op
 
 
@@ -517,8 +558,22 @@ def _build_routed_operator(
 ) -> RoutedOperator:
     src, dst, weight, valid_mask, dangling = filter_edges(n, src, dst, val, valid)
 
-    out_side = _bucketize_blocked(n, src, dst, weight, min_width)
-    in_side = _bucketize_blocked(n, dst, src, weight, min_width)
+    # the two sides bucketize independently — overlap them on threads
+    # (numpy's big sorts release the GIL), like the two plan builds
+    # below; PTPU_PLAN_SERIAL=1 restores single-thread scheduling
+    import os as _os
+    from concurrent.futures import ThreadPoolExecutor
+
+    if _os.environ.get("PTPU_PLAN_SERIAL", "0") != "1":
+        with ThreadPoolExecutor(max_workers=2) as pool:
+            out_f = pool.submit(_bucketize_blocked, n, src, dst, weight,
+                                min_width)
+            in_f = pool.submit(_bucketize_blocked, n, dst, src, weight,
+                               min_width)
+            out_side, in_side = out_f.result(), in_f.result()
+    else:
+        out_side = _bucketize_blocked(n, src, dst, weight, min_width)
+        in_side = _bucketize_blocked(n, dst, src, weight, min_width)
 
     # state order: source-row positions first (column-major grids, dead
     # pad slots included), then out-edge-less nodes
@@ -555,7 +610,6 @@ def _build_routed_operator(
     free_src = np.nonzero(~src_used)[0]   # out-ELL pads + tail: all zeros
     need = np.nonzero(perm < 0)[0]        # in-ELL pads + tail
     perm[need] = free_src[: len(need)]
-    plan = plan_route(perm, prefer_native=prefer_native)
 
     # --- state route: state slot <- z position ---------------------------
     # z = concatenated per-bucket in-row sums (column-major positions)
@@ -577,7 +631,10 @@ def _build_routed_operator(
     free_zero = np.nonzero(~sp_used)[0]   # z pads + tail: all zeros
     need = np.nonzero(sperm < 0)[0]
     sperm[need] = free_zero[: len(need)]
-    splan = plan_route(sperm, prefer_native=prefer_native)
+    # both plans at once: the state plan (2^state_e, typically 16x
+    # smaller) rides in the edge plan's shadow — the threaded plan
+    # build is the DEFAULT full-rebuild fast path
+    plan, splan = plan_routes((perm, sperm), prefer_native=prefer_native)
 
     valid_state = np.zeros(N2, dtype=np.float32)
     valid_state[live_slots] = valid_mask[live_nodes].astype(np.float32)
@@ -604,6 +661,8 @@ def _build_routed_operator(
         state_stages=splan.stages,
         valid=valid_state,
         dangling=dangling_state,
+        out_edge_slot=out_side.edge_slot,
+        min_width=min_width,
     )
 
 
@@ -679,8 +738,26 @@ _PREC = lax.Precision.HIGHEST
 
 def spmv_routed(arrs: dict, static: RoutedStatic, s: jnp.ndarray) -> jnp.ndarray:
     """One application of the normalized trust operator (state order):
-    broadcast → route → reduce → route-back → dangling + damping."""
-    x = blocked_broadcast(arrs, s, static.out_widths, static.out_xs,
+    broadcast → route → reduce → route-back → dangling + damping.
+
+    Two optional keys turn this into the delta engine's PATCHED matvec
+    (both branches are trace-time — present/absent splits the jit
+    cache, never recompiles within a mode):
+
+    - ``inv_row_scale`` ([2^state_e]): per-source-row normalization
+      correction. The weight buffers store ``val / row_sum_at_build``;
+      after in-place value patches the true row sum drifts, and scaling
+      the *source score* by ``row_sum_at_build / row_sum_now`` restores
+      exact normalization without rescattering O(out-degree) slots per
+      revision.
+    - ``tail_src``/``tail_dst``/``tail_w`` (fixed-capacity COO, state
+      order): structural inserts applied since the last plan build —
+      folded in with one scatter-add; unused capacity carries weight 0.
+      The routing program itself never changes, so edge churn costs
+      O(batch), not O(graph).
+    """
+    s_b = s * arrs["inv_row_scale"] if "inv_row_scale" in arrs else s
+    x = blocked_broadcast(arrs, s_b, static.out_widths, static.out_xs,
                           1 << static.edge_e)
     y = _apply_route_jit(x, arrs["edge_stages"], static.edge_e,
                          static.edge_bits, static.pallas)
@@ -688,6 +765,11 @@ def spmv_routed(arrs: dict, static: RoutedStatic, s: jnp.ndarray) -> jnp.ndarray
                        static.in_n_pos, 1 << static.state_e)
     base = _apply_route_jit(z, arrs["state_stages"], static.state_e,
                             static.state_bits, static.pallas)
+    if "tail_w" in arrs:
+        # tail weights are TRUE normalized weights (val / row_sum_now,
+        # maintained host-side per batch) — no inv_row_scale here
+        base = base + jnp.zeros_like(base).at[arrs["tail_dst"]].add(
+            arrs["tail_w"] * s[arrs["tail_src"]])
     return dangling_and_damping(arrs, s, base)
 
 

@@ -45,6 +45,25 @@ class ServiceConfig:
     # operator per refresh; 0 disables the routed path entirely
     routed_edge_threshold: int = 100_000
 
+    # --- incremental delta engine (protocol_tpu.incremental) --------------
+    # 1 (default): once the routed path has built an operator, edge
+    # churn is absorbed by delta-patching it in place — weight
+    # revisions patch the value buffers, structural inserts ride a
+    # bounded COO overflow tail, dirty rows re-normalize through
+    # inv_row_scale — and full routing-plan rebuilds become a rare,
+    # amortized event. 0 restores rebuild-per-digest-change.
+    delta_updates: int = 1
+    # overflow-tail budget: a full rebuild is scheduled when the tail
+    # exceeds delta_tail_max entries or delta_tail_fraction of the
+    # anchored edge count, whichever is smaller
+    delta_tail_max: int = 65_536
+    delta_tail_fraction: float = 0.25
+    # partial refresh: warm sweeps restricted to the dirty frontier +
+    # fan-in; past this fraction of the peer set the frontier is no
+    # longer "partial" and the refresh runs a full (still rebuild-free)
+    # device sweep instead. 0 disables partial refresh.
+    partial_frontier_fraction: float = 0.25
+
     # --- durable state store ----------------------------------------------
     # empty = memory-only (the block cursor is still checkpointed);
     # set (or pass serve --state-dir) to make restarts lossless:
@@ -56,6 +75,14 @@ class ServiceConfig:
                                     # loses the page-cache tail on power cut)
     snapshot_every: int = 256       # graph edits between snapshots
     snapshot_keep: int = 2          # snapshots retained (older pruned)
+    # format-2 snapshots make the WAL the attestation history (it is no
+    # longer pruned on snapshot): once it holds at least this many
+    # segments, the daemon folds latest-wins duplicates per recovered
+    # (signer, about) into a fresh segment — at startup before
+    # restoring AND from the periodic snapshot cadence, so a
+    # long-lived daemon's log stays bounded too. The daemon-side twin
+    # of the offline `store compact` verb. 0 disables auto-compaction.
+    wal_compact_segments: int = 8
 
     # --- proof jobs -------------------------------------------------------
     queue_capacity: int = 8         # backpressure: submits beyond this 429

@@ -17,8 +17,12 @@ refetches), recovers signer keys (batched TPU pipeline on an
 accelerator, scalar otherwise), folds the batch into the opinion graph
 AND the raw attestation buffer (the proof provers need the actual
 signed attestations, not just edges), wakes the refresher, and every
-``snapshot_every`` edits commits an atomic graph snapshot (after which
-fully-covered WAL segments are pruned).
+``snapshot_every`` edits commits an atomic graph snapshot. The WAL is
+NOT pruned on snapshot — format-2 snapshots persist only the WAL
+coverage position and restore rebuilds the buffer from the log, so the
+log is the attestation history; its growth is bounded by latest-wins
+compaction instead (``store compact`` offline, or automatically at
+startup once the log exceeds ``wal_compact_segments``).
 
 Startup with a state dir is the reverse: restore the newest readable
 snapshot (graph + published score table + attestation buffer), replay
@@ -129,6 +133,12 @@ class TrustService:
             CheckpointManager(checkpoint_dir, keep=config.cursor_keep),
             faults=self.faults, backoff_base=config.backoff_base,
             backoff_max=config.backoff_max)
+        if self.store is not None:
+            # after restore (the in-memory _seen covers the whole
+            # uncompacted log, so the suffix the tailer will refetch
+            # dedups either way) and after the tailer restored the
+            # persisted cursor (the fold floor)
+            self._compact_wal(self.tailer.persisted_cursor)
         if provers is None:
             if files is None:
                 raise EigenError(
@@ -170,6 +180,70 @@ class TrustService:
         except EigenError:
             return None
 
+    def _compact_wal(self, cursor_floor: int) -> None:
+        """WAL compaction — the daemon-side twin of the offline
+        ``store compact`` verb, since format-2 snapshots stopped
+        pruning the log (it IS the attestation history now): once the
+        WAL holds ``wal_compact_segments`` segments, fold latest-wins
+        duplicates per recovered ``(signer, about)`` into a fresh
+        segment. Runs after restore (constructor path, once the tailer
+        holds the persisted cursor — compacting BEFORE restore would
+        pay the full-log signer recovery twice, since every folded
+        record lands past the snapshot's covered position) AND from
+        the periodic snapshot cadence (sink thread — the only WAL
+        writer, so no append can race the fold), bounding log growth
+        for long-lived daemons. The fresh segment's index is past
+        every old one, so a snapshot position into a removed segment
+        simply re-applies the folded records — latest-wins and
+        order-preserving, identical state.
+
+        ``cursor_floor``: records with ``block > cursor_floor`` are
+        NEVER folded (each keeps a unique key). The tailer refetches
+        blocks past the persisted cursor after a crash, deduping them
+        against ``_seen`` — which a future restart rebuilds from this
+        log. Folding a superseded record above the floor would delete
+        exactly the digest that dedups its refetch: the stale value
+        would re-apply while the surviving newer record is skipped,
+        silently reverting the edge. Below the floor the tailer can
+        never refetch, so folding is safe.
+
+        Signer recovery batches through the ingest pipeline (the same
+        cost class one restore pass pays). Never fatal: a failed
+        compaction degrades to a bigger log."""
+        lim = self.config.wal_compact_segments
+        if lim <= 0 or len(self.store.wal.segments()) < lim:
+            return
+        try:
+            records = [(blk, about, payload,
+                        self._decode_record(about, payload))
+                       for blk, about, payload in self.store.wal.replay()]
+            decoded = [r[3] for r in records if r[3] is not None]
+            signers = recover_signers(
+                decoded, batched=self.client.batched_ingest)
+            it = iter(signers)
+            key_map = {}
+            for blk, about, payload, signed in records:
+                if signed is None:
+                    continue
+                signer = next(it)
+                if signer is None:
+                    continue  # unrecoverable: replay rejects it anyway
+                if blk > cursor_floor:  # refetchable: keep verbatim
+                    key_map[(blk, about, payload)] = (
+                        "nofold", blk, about, payload)
+                else:
+                    key_map[(blk, about, payload)] = (signer, about)
+            with trace.span("service.wal_compact", records=len(records),
+                            cursor_floor=cursor_floor):
+                out = self.store.wal.compact(
+                    lambda b, a, p: key_map.get((b, a, p)))
+            trace.event("service.wal_compacted",
+                        records_in=out["records_in"],
+                        records_out=out["records_out"],
+                        segments_removed=out["segments_removed"])
+        except (EigenError, OSError):
+            trace.event("service.wal_compact_failed")
+
     def _restore(self) -> None:
         """Snapshot restore + WAL replay (constructor path, before any
         thread exists — no locks contended)."""
@@ -179,6 +253,7 @@ class TrustService:
         restored_revision = -1
         loaded = self.store.snapshots.load_latest()
         wal_start = None
+        buffer_from_wal = True
         if loaded is not None:
             _, arrays, meta = loaded
             st = decode_service_state(arrays, meta)
@@ -192,36 +267,66 @@ class TrustService:
                 scores=st["scores"], revision=st["score_revision"],
                 iterations=st["iterations"], delta=st["delta"],
                 cold=st["cold"], computed_at=st["computed_at"]))
-            for blk, about, payload in st["att_records"]:
+            if st["buffer_in_snapshot"]:
+                # format-1 snapshot (pre-PR 6): the raw buffer rides in
+                # the snapshot itself; replay only the uncovered suffix
+                buffer_from_wal = False
+                for blk, about, payload in st["att_records"]:
+                    signed = self._decode_record(about, payload)
+                    if signed is None:
+                        continue
+                    self._attestations.append(signed)
+                    self._att_blocks.append(blk)
+                    self._seen.add(_att_digest(blk, about, payload))
+            restored_revision = st["revision"]
+            wal_start = st["wal_pos"]
+        batch = []
+        batch_blocks = []
+        if buffer_from_wal:
+            # format 2: snapshots persist WAL COVERAGE, not the buffer
+            # (O(graph) encode, the PR 3 O(history) note closed). One
+            # pass over the full (compacted) log rebuilds the raw
+            # attestation buffer; only records PAST the covered
+            # position apply to the graph — signer recovery, the
+            # expensive part, stays O(uncovered suffix). After a
+            # compaction the covered position's segment is gone and
+            # every folded record re-applies; the graph is latest-wins
+            # and the replay is order-preserving, so that folds to the
+            # identical state.
+            for pos, (blk, about, payload) in \
+                    self.store.wal.replay_frames():
+                digest = _att_digest(blk, about, payload)
+                if digest in self._seen:
+                    continue
                 signed = self._decode_record(about, payload)
                 if signed is None:
                     continue
+                self._seen.add(digest)
                 self._attestations.append(signed)
                 self._att_blocks.append(blk)
-                self._seen.add(_att_digest(blk, about, payload))
-            restored_revision = st["revision"]
-            wal_start = st["wal_pos"]
-        # replay everything past the snapshot's position (after a
-        # compaction that position may be gone — then every surviving
-        # segment replays); dedup by content makes any overlap harmless
-        batch = []
-        batch_blocks = []
-        for blk, about, payload in self.store.wal.replay(wal_start):
-            digest = _att_digest(blk, about, payload)
-            if digest in self._seen:
-                continue
-            signed = self._decode_record(about, payload)
-            if signed is None:
-                continue
-            self._seen.add(digest)
-            batch.append(signed)
-            batch_blocks.append(blk)
+                if wal_start is None or pos > wal_start:
+                    batch.append(signed)
+                    batch_blocks.append(blk)
+        else:
+            # replay everything past the snapshot's position; dedup by
+            # content makes any overlap harmless
+            for blk, about, payload in self.store.wal.replay(wal_start):
+                digest = _att_digest(blk, about, payload)
+                if digest in self._seen:
+                    continue
+                signed = self._decode_record(about, payload)
+                if signed is None:
+                    continue
+                self._seen.add(digest)
+                batch.append(signed)
+                batch_blocks.append(blk)
         if batch:
             signers = recover_signers(
                 batch, batched=self.client.batched_ingest)
             self.graph.apply(batch, signers)
-            self._attestations.extend(batch)
-            self._att_blocks.extend(batch_blocks)
+            if not buffer_from_wal:
+                self._attestations.extend(batch)
+                self._att_blocks.extend(batch_blocks)
         self.store.replayed_records = len(batch)
         trace.event("service.restored",
                     snapshot_revision=restored_revision,
@@ -230,25 +335,53 @@ class TrustService:
                     seconds=round(time.monotonic() - t0, 3))
 
     # --- durability: snapshot ---------------------------------------------
-    def _take_snapshot(self) -> bool:
-        """One consistent cut → atomic snapshot → prune covered WAL
-        segments. Runs on the sink thread (the only graph/buffer
-        mutator) or on the drain path after the sink stopped."""
+    def _take_snapshot(self, compact: bool = True) -> bool:
+        """One consistent cut → atomic snapshot. Runs on the sink
+        thread (the only graph/buffer mutator) or on the drain path
+        after the sink stopped.
+
+        Encode is O(graph): the raw attestation buffer is NOT
+        serialized — the snapshot records the WAL position it covers
+        and restore rebuilds the buffer from the log. The WAL is
+        therefore no longer pruned on snapshot (it IS the attestation
+        history now); instead, the periodic path folds it latest-wins
+        once it outgrows ``wal_compact_segments`` (``compact=False`` on
+        the drain path: a farewell snapshot must not spend the
+        drain_timeout budget re-recovering signers — the next start
+        compacts)."""
         from ..store import encode_service_state
 
+        if compact:
+            # sink thread = the only WAL writer, so folding here can't
+            # race an append; bounds a long-lived daemon's log growth
+            # the way the startup pass bounds it across restarts. The
+            # floor is the last cursor KNOWN ON DISK — the in-memory
+            # cursor can run ahead when a persist fails, and folding a
+            # record a post-crash refetch could re-deliver would
+            # delete the digest that dedups it
+            self._compact_wal(self.tailer.persisted_cursor)
         n, src, dst, val, revision, edits = self.graph.snapshot()
         addrs = self.graph.addresses()[:n]
         invalid = self.graph.invalid
         with self._att_lock:
-            atts = list(self._attestations)
-            att_blocks = list(self._att_blocks)
+            n_atts = len(self._attestations)
+        try:
+            # the snapshot claims the WAL up to `pos` as covered — the
+            # restored buffer comes from those bytes, so they must be
+            # durable BEFORE the snapshot commits (under
+            # wal_fsync="never" they may still be page-cache only)
+            self.store.wal.sync()
+        except OSError:
+            self.store.snapshot_failures += 1
+            trace.event("service.snapshot_failed", revision=revision)
+            return False
         pos = self.store.wal.position()
         arrays, meta = encode_service_state(
             addrs, src, dst, val, revision, edits, invalid,
-            self.refresher.table, atts, att_blocks, pos)
+            self.refresher.table, pos, n_attestations=n_atts)
         try:
             with trace.span("service.snapshot", revision=revision,
-                            n=len(addrs), attestations=len(atts)):
+                            n=len(addrs), attestations=n_atts):
                 self.store.snapshots.save(revision, arrays, meta)
         except (EigenError, OSError):
             # OSError too: CheckpointManager raises raw ENOSPC/EIO, and
@@ -258,7 +391,6 @@ class TrustService:
             trace.event("service.snapshot_failed", revision=revision)
             return False
         self._edits_since_snapshot = 0
-        self.store.wal.prune_below(pos[0])
         trace.metric("service.snapshot_revision", revision)
         return True
 
@@ -391,6 +523,10 @@ class TrustService:
                 "refreshes": self.refresher.refreshes,
                 "cold_refreshes": self.refresher.cold_refreshes,
             },
+            # incremental operator maintenance: is a delta engine
+            # anchored, how much churn has it absorbed in place, and
+            # how dirty is the patched operator vs its anchor build
+            "delta": self.refresher.delta_status(),
             "queue": {
                 "depth": self.jobs.depth(),
                 "completed": self.jobs.completed,
@@ -456,6 +592,12 @@ class TrustService:
                 self.refresher.operator_hits),
             "service.operator_builds": float(
                 self.refresher.operator_builds),
+            "service.delta_batches": float(
+                self.refresher.delta_batches),
+            "service.partial_refreshes": float(
+                self.refresher.partial_refreshes),
+            "service.delta_reanchors": float(
+                self.refresher.delta_reanchors),
             "service.uptime_seconds": (time.time() - self.started_at
                                        if self.started_at else 0.0),
         }
@@ -523,7 +665,7 @@ class TrustService:
         if self.store is not None and clean:
             # farewell snapshot so the next start replays ~nothing;
             # failure is not unclean — the WAL already covers everything
-            self._take_snapshot()
+            self._take_snapshot(compact=False)
         try:
             self.tailer._persist_cursor()
         except (EigenError, OSError):

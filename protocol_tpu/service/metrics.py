@@ -59,6 +59,9 @@ MONOTONIC_METRICS = frozenset({
     "service.proof_failed",
     "service.operator_cache_hits",
     "service.operator_builds",
+    "service.delta_batches",
+    "service.partial_refreshes",
+    "service.delta_reanchors",
     "store.wal_records_appended",
     "store.wal_torn_skipped",
     "store.snapshot_failures",
@@ -87,15 +90,17 @@ HISTOGRAM_FAMILIES = {
     "prover_total_seconds": ("k", "path"),
     "converge_sweep_seconds": ("backend",),
     "routed_plan_build_seconds": (),
+    "operator_delta_seconds": ("kind",),
     "xla_compile_seconds": ("site",),
 }
 
 # typed counters/gauges of the device-observability layer, declared up
 # front for the same reason (the serve-smoke asserts a steady-state
 # recompile count of 0 — the series must exist to be assertable)
-DECLARED_COUNTERS = ("xla_compiles", "xla_steady_recompiles")
+DECLARED_COUNTERS = ("xla_compiles", "xla_steady_recompiles",
+                     "operator_full_builds", "refresh_sweep_scope")
 DECLARED_GAUGES = ("converge_iterations", "converge_residual",
-                   "proof_queue_depth")
+                   "proof_queue_depth", "dirty_rows")
 
 
 def declare_instruments() -> None:

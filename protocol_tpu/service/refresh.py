@@ -38,6 +38,27 @@ Two scale/restart seams on top:
   ``scores_from_nodes`` path. Cache hits — the warm→cold fallback, the
   periodic cold resync, and every post-restart refresh of an unchanged
   graph — skip the rebuild entirely (``operator_hits`` proves it).
+
+And the write-path scale seam this module grew in PR 6:
+
+- **delta maintenance** (``delta_updates``, on by default): once the
+  routed path has compiled an operator, the refresher anchors a
+  ``protocol_tpu.incremental.DeltaEngine`` on it and every subsequent
+  churn window is absorbed in O(dirty): the graph's edge-change log
+  (drained via ``graph.delta_cut()`` — one lock hold, NO O(E)
+  edge-array materialization) is classified into weight
+  revisions (value-buffer patches), structural inserts/removes (the
+  COO overflow tail) and dirty-row re-normalizations — the routing
+  plan is never rebuilt until the tail outgrows its budget, which
+  demotes full builds (``ptpu_operator_full_builds_total``) to a rare
+  amortized event. Warm refreshes first try the **partial** mode —
+  host sweeps over the dirty frontier + fan-in
+  (``incremental.partial_refresh``) — and fall back to a full (still
+  rebuild-free) device sweep on any residual/footing bound; every
+  refresh reports which scope it swept via
+  ``ptpu_refresh_sweep_scope_total{mode=partial|full|rebuild}``
+  (``rebuild`` = served by the build path: the initial anchor and
+  every re-anchor after a capacity wall or lost log).
 """
 
 from __future__ import annotations
@@ -117,6 +138,12 @@ class ScoreRefresher:
         self._op_digest = None
         self.operator_hits = 0
         self.operator_builds = 0
+        # incremental delta engine (anchored after a routed build)
+        self.delta_engine = None
+        self.delta_batches = 0      # churn windows absorbed in-place
+        self.partial_refreshes = 0  # refreshes served by partial sweeps
+        self.full_sweeps = 0        # delta-path full device sweeps
+        self.delta_reanchors = 0    # engines discarded (capacity/log)
 
     def install(self, table: ScoreTable) -> None:
         """Adopt a restored table (snapshot restore): the next refresh
@@ -227,8 +254,37 @@ class ScoreRefresher:
         (unchanged table if the graph is empty/unchanged). Raises
         EigenError on (injected) device faults — the caller loop owns
         retry; the previously published table stays live throughout."""
-        n, src, dst, val, revision, edits = self.graph.snapshot()
+        # fast path: an anchored engine serves the churn window from
+        # graph.delta_cut() — O(dirty) — never touching the O(E)
+        # edge-array walk of graph.snapshot(), which at 10M-peer scale
+        # is seconds of Python dict iteration under the lock the
+        # ingest sink needs. The full cut is deferred to the (rare)
+        # build path below, where it feeds the rebuild it's amortized
+        # into.
+        if self.delta_engine is not None and self.config.delta_updates:
+            n, revision, edits, deltas, deltas_lost = \
+                self.graph.delta_cut()
+            if revision == self.table.revision:
+                if deltas or deltas_lost:
+                    # defensive: an effective change always bumps the
+                    # revision, but never drop a drained delta (and a
+                    # lost log must discard the engine even here)
+                    self._absorb_deltas(n, deltas, deltas_lost)
+                return self.table
+            if self._absorb_deltas(n, deltas, deltas_lost):
+                if n >= 2:
+                    return self._refresh_via_delta(n, revision, edits,
+                                                   force_cold)
+                # a <2-peer graph can't have anchored a routed build —
+                # defensive only: drop the engine, rebuild below
+                self.delta_engine = None
+            # engine discarded (capacity wall / lost log): fall
+            # through to the build path on a fresh full cut
+        n, src, dst, val, revision, edits, deltas, deltas_lost = \
+            self.graph.snapshot(drain_deltas=True)
         if revision == self.table.revision:
+            if deltas or deltas_lost:
+                self._absorb_deltas(n, deltas, deltas_lost)
             return self.table
         addresses = self.graph.addresses()[:n]
         if n < 2 or not len(src):
@@ -249,14 +305,10 @@ class ScoreRefresher:
 
         cold = force_cold or self._want_cold(len(src), edits)
         valid = np.ones(n, dtype=bool)
-        s0 = None
-        if not cold:
-            from ..ops.converge import warm_start_scores
-
-            # node-order warm vector; the routed backend translates it
-            # to state-slot order via the operator's scores_from_nodes
-            s0 = warm_start_scores(self.table.scores, n, valid,
-                                   self.config.initial_score)
+        # a drained delta log on this path is baseline-reset: either no
+        # engine exists, or it was just discarded — the rebuild below
+        # (and the re-anchor after it) IS the new baseline
+        s0 = self._warm_vector(n, valid) if not cold else None
         self.faults.check("device")
         backend, extra = self._converge_call(n, src, dst, val, valid)
         # the refresh span carries the trace ids of every attestation
@@ -267,7 +319,8 @@ class ScoreRefresher:
         t0 = time.perf_counter()
         try:
             scores, iters, delta, cold = self._converge_traced(
-                n, src, dst, val, valid, s0, cold, tids, backend, extra)
+                n, src, dst, val, valid, s0, cold, tids, backend,
+                extra)
         except Exception:
             # a failed refresh publishes nothing: the ids go back so
             # the retry's span still closes the trace chain
@@ -276,7 +329,54 @@ class ScoreRefresher:
             raise
         trace.histogram("refresh_seconds").observe(
             time.perf_counter() - t0, mode="cold" if cold else "warm")
+        self._anchor_delta_engine(n, src, dst, val, valid,
+                                  extra.get("operator"))
+        # every refresh reports its sweep scope — build-served ones as
+        # "rebuild", so a thrashing delta engine (constant re-anchors)
+        # shows up in the partial/full/rebuild ratio instead of
+        # silently vanishing from it
+        from ..ops.converge import record_refresh_scope
 
+        record_refresh_scope("rebuild")
+        return self._publish(addresses, scores, n, revision, iters,
+                             delta, cold)
+
+    def _warm_vector(self, n, valid):
+        from ..ops.converge import warm_start_scores
+
+        # node-order warm vector; the routed backend translates it
+        # to state-slot order via the operator's scores_from_nodes
+        return warm_start_scores(self.table.scores, n, valid,
+                                 self.config.initial_score)
+
+    def _refresh_via_delta(self, n: int, revision: int, edits: int,
+                           force_cold: bool) -> ScoreTable:
+        """One refresh served entirely by the anchored engine (the
+        churn window is already absorbed): partial or full sweep on
+        the patched operator, publish — no edge arrays, no builds."""
+        addresses = self.graph.addresses()[:n]
+        cold = force_cold or self._want_cold(self.delta_engine.nnz_now,
+                                             edits)
+        s0 = (self._warm_vector(n, np.ones(n, dtype=bool))
+              if not cold else None)
+        self.faults.check("device")
+        tids = (self.pending_traces.take(revision)
+                if self.pending_traces is not None else [])
+        t0 = time.perf_counter()
+        try:
+            scores, iters, delta, cold = self._converge_delta(
+                n, s0, cold, tids)
+        except Exception:
+            if self.pending_traces is not None and tids:
+                self.pending_traces.add(revision, tids)
+            raise
+        trace.histogram("refresh_seconds").observe(
+            time.perf_counter() - t0, mode="cold" if cold else "warm")
+        return self._publish(addresses, scores, n, revision, iters,
+                             delta, cold)
+
+    def _publish(self, addresses, scores, n, revision, iters, delta,
+                 cold) -> ScoreTable:
         self.refreshes += 1
         if cold:
             self.cold_refreshes += 1
@@ -292,6 +392,8 @@ class ScoreRefresher:
         trace.metric("service.refresh_delta", float(delta))
         trace.metric("service.operator_cache_hits", self.operator_hits)
         trace.metric("service.operator_builds", self.operator_builds)
+        trace.metric("service.delta_batches", self.delta_batches)
+        trace.metric("service.partial_refreshes", self.partial_refreshes)
         return self.table
 
     def _converge_traced(self, n, src, dst, val, valid, s0, cold,
@@ -323,6 +425,151 @@ class ScoreRefresher:
                         alpha=self.config.alpha, **extra)
                 cold = True
         return scores, iters, delta, cold
+
+    # --- incremental delta path (protocol_tpu.incremental) ----------------
+    def _absorb_deltas(self, n: int, deltas, deltas_lost: bool) -> bool:
+        """Fold the drained edge-change log into the anchored delta
+        engine; True when this refresh can be served from the patched
+        operator (no rebuild). A capacity wall / lost log discards the
+        engine — the refresh falls through to the build path and
+        re-anchors there."""
+        eng = self.delta_engine
+        if eng is None or not self.config.delta_updates:
+            return False
+        if deltas_lost:
+            trace.event("service.delta_log_lost")
+            self.delta_reanchors += 1
+            self.delta_engine = None
+            return False
+        try:
+            with trace.span("service.delta_apply", n=len(deltas)):
+                ok = eng.apply_deltas(deltas, n=n)
+        except Exception:  # noqa: BLE001 - a raise mid-apply (device
+            # error in a patch scatter) leaves host truth half-mutated
+            # AND the drained batch is gone — the engine is unusable.
+            # Discard it and serve this refresh from a full rebuild,
+            # which re-anchors a clean baseline.
+            trace.event("service.delta_apply_failed")
+            self.delta_reanchors += 1
+            self.delta_engine = None
+            return False
+        reason = eng.should_rebuild() if ok else (
+            eng.stats.rebuild_reason or "apply_failed")
+        if not ok or reason is not None:
+            trace.event("service.delta_reanchor", reason=reason)
+            self.delta_reanchors += 1
+            self.delta_engine = None
+            return False
+        self.delta_batches += 1
+        return True
+
+    def _converge_delta(self, n: int, s0, cold: bool, tids) -> tuple:
+        """Serve one refresh from the patched operator: partial sweeps
+        over the dirty frontier when the warm start has footing, a full
+        device sweep otherwise — zero routing-plan builds either way.
+        Returns ``(scores, iters, delta, cold)``."""
+        from ..incremental import partial_refresh
+        from ..ops.converge import (
+            record_converge_stats,
+            record_refresh_scope,
+        )
+
+        eng = self.delta_engine
+        frontier, partial_ok = eng.take_frontier()
+        try:
+            with trace.context(trace_ids=tids):
+                with trace.span("service.refresh", n=n,
+                                edges=eng.nnz_now, cold=cold,
+                                backend="DeltaEngine"):
+                    frac = self.config.partial_frontier_fraction
+                    if not cold and s0 is not None and partial_ok \
+                            and frac > 0:
+                        limit = max(1, int(frac * n))
+                        t0 = time.perf_counter()
+                        res = partial_refresh(
+                            eng, s0, frontier, self.config.tol,
+                            self.config.max_iterations, limit)
+                        if res is not None:
+                            record_converge_stats(
+                                "partial", res.sweeps, res.residual,
+                                time.perf_counter() - t0, n=n)
+                            record_refresh_scope("partial")
+                            self.partial_refreshes += 1
+                            return (res.scores, res.sweeps,
+                                    res.residual, False)
+                    # scope/full_sweeps count REFRESHES (per the metric
+                    # contract), not converge calls — the warm→cold
+                    # fallback below is still this one refresh
+                    record_refresh_scope("full")
+                    self.full_sweeps += 1
+                    start = (s0 if not cold and s0 is not None else
+                             eng.initial_node_scores(
+                                 self.config.initial_score))
+                    scores, iters, delta = eng.converge(
+                        start, self.config.max_iterations,
+                        self.config.tol)
+                    if not cold and (delta > self.config.tol
+                                     or not np.isfinite(scores).all()):
+                        # warm start failed to converge in budget:
+                        # re-anchor the VECTOR cold — the patched
+                        # operator is reused as-is, no build
+                        with trace.span("service.refresh", n=n,
+                                        cold=True, fallback=True):
+                            scores, iters, delta = eng.converge(
+                                eng.initial_node_scores(
+                                    self.config.initial_score),
+                                self.config.max_iterations,
+                                self.config.tol)
+                        cold = True
+                    return scores, iters, delta, cold
+        except Exception:
+            # the retry must still see the dirty frontier
+            eng.restore_frontier(frontier, partial_ok)
+            raise
+
+    def _anchor_delta_engine(self, n, src, dst, val, valid,
+                             operator) -> None:
+        """After a refresh that ran through a ROUTED operator build (or
+        cache load), anchor the delta engine on it so the next churn
+        window is absorbed in place. O(E) numpy, amortized into the
+        build it makes rare; anchoring failure degrades to the rebuild
+        path, never fails the refresh."""
+        if operator is None or not self.config.delta_updates:
+            return
+        from ..incremental import DeltaEngine
+
+        try:
+            with trace.span("service.delta_anchor", n=n,
+                            edges=len(src)):
+                self.delta_engine = DeltaEngine.anchor(
+                    n, src, dst, val, valid, operator,
+                    dtype=getattr(self.backend, "dtype", None),
+                    alpha=self.config.alpha,
+                    tail_max=self.config.delta_tail_max,
+                    tail_fraction=self.config.delta_tail_fraction)
+        except Exception:  # noqa: BLE001 - a failed anchor must not
+            # take down the refresh loop; the next refresh rebuilds
+            trace.event("service.delta_anchor_failed")
+            self.delta_engine = None
+
+    def delta_status(self) -> dict:
+        """Delta-engine view for ``GET /status``."""
+        eng = self.delta_engine
+        out = {
+            "anchored": eng is not None,
+            "batches_absorbed": self.delta_batches,
+            "partial_refreshes": self.partial_refreshes,
+            "full_sweeps": self.full_sweeps,
+            "reanchors": self.delta_reanchors,
+        }
+        if eng is not None:
+            out.update({
+                "tail": len(eng.tail_index),
+                "tail_capacity": eng.tail_capacity,
+                "dirty_rows": len(eng.dirty_rows),
+                "new_peers": eng.stats.new_peers,
+            })
+        return out
 
     def run(self, stop_event, dirty_event, refresh_interval: float) -> None:
         """Refresher loop: wake on new data (or the interval), refresh,

@@ -86,6 +86,11 @@ def recover_signers(attestations, batched: bool | None = None):
 class OpinionGraph:
     """Mutable trust graph; snapshots are cheap numpy edge arrays."""
 
+    # edge-change log bound: past this without a drain the log is
+    # declared lost (the consumer re-anchors from a full snapshot
+    # instead of replaying an unbounded backlog)
+    DELTA_LOG_MAX = 1 << 20
+
     def __init__(self):
         self._lock = threading.RLock()
         self._ids: dict = {}       # address bytes -> id
@@ -94,6 +99,13 @@ class OpinionGraph:
         self.revision = 0          # bumps on every effective change
         self.edits_since_cold = 0
         self.invalid = 0           # rejected attestations (bad sig/self)
+        # edge-change log for the incremental delta engine
+        # (protocol_tpu.incremental): every effective edge change is
+        # recorded as (src, dst, old_value, new_value) — old None for a
+        # first-ever edge — and drained atomically with a snapshot so
+        # the consumer's view can never tear against the edge arrays
+        self._delta_log: list = []
+        self._delta_lost = False
 
     def _intern(self, addr: bytes) -> int:
         i = self._ids.get(addr)
@@ -119,9 +131,14 @@ class OpinionGraph:
                 i = self._intern(signer)
                 j = self._intern(about)
                 value = float(signed.attestation.value)
-                if self._edges.get((i, j)) != value:
+                old = self._edges.get((i, j))
+                if old != value:
                     self._edges[(i, j)] = value
                     changed += 1
+                    if len(self._delta_log) < self.DELTA_LOG_MAX:
+                        self._delta_log.append((i, j, old, value))
+                    else:
+                        self._delta_lost = True
             if changed:
                 self.revision += 1
                 self.edits_since_cold += changed
@@ -148,6 +165,24 @@ class OpinionGraph:
             self.revision = int(revision)
             self.edits_since_cold = int(edits_since_cold)
             self.invalid = int(invalid)
+            # the restored cut IS the new baseline: any delta consumer
+            # re-anchors from it, the old log is meaningless
+            self._delta_log = []
+            self._delta_lost = False
+
+    def delta_cut(self):
+        """``(n, revision, edits_since_cold, deltas, deltas_lost)``
+        under one lock hold — the delta engine's O(dirty) twin of
+        :meth:`snapshot`: the edge-change log since the last drain plus
+        the scalars a delta-served refresh needs, WITHOUT materializing
+        the O(E) edge arrays. This is the point of the engine's fast
+        path — a churn window must not walk the whole edge dict while
+        holding the lock the ingest sink needs."""
+        with self._lock:
+            deltas, lost = self._delta_log, self._delta_lost
+            self._delta_log, self._delta_lost = [], False
+            return (len(self._addrs), self.revision,
+                    self.edits_since_cold, deltas, lost)
 
     # --- snapshots --------------------------------------------------------
     @property
@@ -172,11 +207,18 @@ class OpinionGraph:
         with self._lock:
             return tuple(self._addrs)
 
-    def snapshot(self):
+    def snapshot(self, drain_deltas: bool = False):
         """(n, src, dst, val, revision, edits_since_cold) under one lock
         hold — a consistent cut for the refresher. Zero-valued edges are
         included; ``graph.filter_edges`` drops them (contract
-        semantics: value 0 = retracted)."""
+        semantics: value 0 = retracted).
+
+        ``drain_deltas=True`` (the refresher, single consumer) appends
+        ``(deltas, deltas_lost)`` to the tuple: the edge-change log
+        since the previous drain, taken in the SAME lock hold so the
+        delta engine's incremental view and the full edge arrays
+        describe the identical cut. ``deltas_lost`` means the log
+        overflowed and the consumer must re-anchor from the arrays."""
         with self._lock:
             n = len(self._addrs)
             m = len(self._edges)
@@ -185,4 +227,9 @@ class OpinionGraph:
             val = np.empty(m, dtype=np.float64)
             for e, ((i, j), v) in enumerate(self._edges.items()):
                 src[e], dst[e], val[e] = i, j, v
-            return n, src, dst, val, self.revision, self.edits_since_cold
+            out = (n, src, dst, val, self.revision, self.edits_since_cold)
+            if drain_deltas:
+                deltas, lost = self._delta_log, self._delta_lost
+                self._delta_log, self._delta_lost = [], False
+                out = out + (deltas, lost)
+            return out

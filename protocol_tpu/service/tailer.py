@@ -91,6 +91,7 @@ class ChainTailer:
         self.backoff_base = backoff_base
         self.backoff_max = backoff_max
         self.cursor = self._restore_cursor()
+        self.persisted_cursor = self.cursor  # last value known on disk
         self.consecutive_failures = 0
         self.batches = 0
         self.attestations = 0
@@ -110,6 +111,10 @@ class ChainTailer:
             self.cursor,
             {"cursor": np.asarray([self.cursor], dtype=np.int64)},
             meta={"kind": "block-cursor"})
+        # only after a SUCCESSFUL save: a failed persist leaves the
+        # in-memory cursor ahead of disk, and consumers that need the
+        # refetch floor (WAL compaction) must see the on-disk value
+        self.persisted_cursor = self.cursor
 
     # --- one poll ---------------------------------------------------------
     def poll_once(self) -> int:

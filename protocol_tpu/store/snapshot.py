@@ -138,26 +138,28 @@ def read_meta_readonly(directory: str, step: int) -> dict | None:
 
 
 def encode_service_state(addrs, src, dst, val, revision, edits_since_cold,
-                         invalid, table, attestations, att_blocks,
-                         wal_pos) -> tuple:
+                         invalid, table, wal_pos,
+                         n_attestations: int = 0) -> tuple:
     """(arrays, meta) for one consistent service cut. ``src``/``dst``/
     ``val`` are the edge arrays ``OpinionGraph.snapshot()`` already
     packs (no second dict walk here); ``table`` is the published
     ScoreTable (its revision may trail ``revision``; the restored
-    refresher warm-refreshes the gap); ``attestations`` the raw
-    SignedAttestationData buffer with ``att_blocks`` their block
-    numbers (REAL blocks, not zeros: the daemon's dedup key includes
-    the block, since deterministic signing makes a re-attested value
-    byte-identical in payload); ``wal_pos`` the WAL high-water mark the
-    snapshot covers."""
+    refresher warm-refreshes the gap); ``wal_pos`` the WAL high-water
+    mark the snapshot covers.
+
+    Format 2 (the PR 3 O(history) note, closed): the raw attestation
+    buffer is NOT serialized — the snapshot persists only the WAL
+    coverage position, and restore rebuilds the buffer by replaying the
+    (compacted) WAL from the beginning while applying only the
+    uncovered suffix to the graph. Encode cost is O(graph), flat in
+    attestation history; the WAL's own growth is bounded by its
+    latest-wins compaction. Format-1 snapshots (with ``att_blob``)
+    stay restorable."""
     t0 = time.perf_counter()
     n = len(addrs)
     src = np.asarray(src, dtype=np.int64)
     dst = np.asarray(dst, dtype=np.int64)
     val = np.asarray(val, dtype=np.float64)
-    blob = b"".join(
-        encode_record(blk, s.attestation.about, s.to_payload())
-        for blk, s in zip(att_blocks, attestations))
     arrays = {
         "addrs": (np.frombuffer(b"".join(addrs), dtype=np.uint8)
                   .reshape(n, 20) if n else np.zeros((0, 20), np.uint8)),
@@ -165,10 +167,10 @@ def encode_service_state(addrs, src, dst, val, revision, edits_since_cold,
         "dst": dst,
         "val": val,
         "scores": np.asarray(table.scores, dtype=np.float64),
-        "att_blob": np.frombuffer(blob, dtype=np.uint8),
     }
     meta = {
         "kind": "service-state",
+        "fmt": 2,
         "revision": int(revision),
         "edits_since_cold": int(edits_since_cold),
         "invalid": int(invalid),
@@ -177,21 +179,20 @@ def encode_service_state(addrs, src, dst, val, revision, edits_since_cold,
         "delta": float(table.delta),
         "cold": bool(table.cold),
         "computed_at": float(table.computed_at),
-        "n_attestations": len(attestations),
+        "n_attestations": int(n_attestations),
         "wal_segment": int(wal_pos[0]),
         "wal_offset": int(wal_pos[1]),
     }
-    # the O(attestation history) re-serialization the ROADMAP flags as
-    # a scale gap — the histogram makes its growth visible per deploy
     trace.histogram("snapshot_encode_seconds").observe(
         time.perf_counter() - t0)
     return arrays, meta
 
 
 def decode_service_state(arrays, meta) -> dict:
-    """Inverse of :func:`encode_service_state`; attestations come back
-    as raw ``(block, about, payload)`` records (the daemon re-decodes
-    them through the tailer's codec)."""
+    """Inverse of :func:`encode_service_state`; for format-1 snapshots
+    the embedded attestations come back as raw ``(block, about,
+    payload)`` records; format 2 returns none (``buffer_in_snapshot``
+    False) and the daemon rebuilds the buffer from the WAL."""
     addr_rows = np.asarray(arrays["addrs"], dtype=np.uint8)
     addrs = [bytes(row) for row in addr_rows]
     src = np.asarray(arrays["src"], dtype=np.int64)
@@ -199,9 +200,13 @@ def decode_service_state(arrays, meta) -> dict:
     val = np.asarray(arrays["val"], dtype=np.float64)
     edges = {(int(src[e]), int(dst[e])): float(val[e])
              for e in range(len(src))}
-    blob = np.asarray(arrays["att_blob"], dtype=np.uint8).tobytes()
-    att_records = [decode_body(body) for _, body in iter_frames(blob)]
+    att_records = []
+    buffer_in_snapshot = "att_blob" in arrays
+    if buffer_in_snapshot:  # format 1: O(history) blob, still readable
+        blob = np.asarray(arrays["att_blob"], dtype=np.uint8).tobytes()
+        att_records = [decode_body(body) for _, body in iter_frames(blob)]
     return {
+        "buffer_in_snapshot": buffer_in_snapshot,
         "addrs": addrs,
         "edges": edges,
         "revision": int(meta["revision"]),

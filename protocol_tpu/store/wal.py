@@ -23,13 +23,17 @@ Durability contract:
   length/CRC check fails; the replay scan stops that segment at the
   last intact frame and the writer truncates the garbage before its
   next append (``_heal``);
-- **segment rotation** bounds file sizes; segments strictly below a
-  snapshot's position are pruned after the snapshot commits;
-- **compaction** (offline, ``store compact``) folds latest-wins
-  duplicates per caller-supplied key into a fresh segment, then removes
-  the old ones — a crash in between leaves old + compacted, whose
-  replay folds to the same final state, so compaction is crash-safe
-  without a journal.
+- **segment rotation** bounds file sizes. Since format-2 snapshots
+  (PR 6) the log IS the attestation history — restore rebuilds the raw
+  buffer from it — so snapshots do NOT prune covered segments anymore;
+  :meth:`AttestationWAL.prune_below` exists only for deployments still
+  on format-1 snapshots (which embed the buffer);
+- **compaction** (``store compact`` offline, or the daemon at startup
+  past ``wal_compact_segments``) bounds the log's growth instead:
+  latest-wins duplicates fold per caller-supplied key into a fresh
+  segment, then the old ones are removed — a crash in between leaves
+  old + compacted, whose replay folds to the same final state, so
+  compaction is crash-safe without a journal.
 """
 
 from __future__ import annotations
@@ -104,6 +108,10 @@ class AttestationWAL:
         self._segment = 0
         self._pos = 0
         self._need_heal = False
+        # segments rotated away with bytes never fsynced (fsync="never"
+        # only): sync() must cover THEM too, not just the live tail —
+        # a snapshot can claim coverage across a rotation boundary
+        self._unsynced: set = set()
         if not readonly:
             os.makedirs(directory, exist_ok=True)
             self._open_tail()
@@ -129,6 +137,10 @@ class AttestationWAL:
 
     def _start_segment(self, segment: int) -> None:
         if self._file is not None:
+            if self.fsync != "always":
+                # the rotated-away segment may hold page-cache-only
+                # bytes; remember it until the next sync()
+                self._unsynced.add(self._segment)
             self._file.close()
         self._file = open(self._path(segment), "wb")
         self._file.write(SEGMENT_MAGIC)
@@ -176,6 +188,31 @@ class AttestationWAL:
         """(segment, offset) after the last committed record — the WAL
         high-water mark a snapshot records as its replay start."""
         return self._segment, self._pos
+
+    def sync(self) -> None:
+        """Force every committed byte durable regardless of the
+        ``wal_fsync`` policy — the live tail AND any segment rotated
+        away since the last sync (under ``fsync="never"`` those closed
+        with page-cache-only bytes). A format-2 snapshot records
+        :meth:`position` as covered — i.e. the restored attestation
+        buffer comes from these bytes, not the snapshot — so they must
+        be on disk before the snapshot commits, or a power cut would
+        leave the restored graph holding edges with no backing
+        attestation. Failure propagates (the caller skips the
+        snapshot); unsynced segments stay tracked for the retry."""
+        if self.readonly:
+            return
+        for seg in sorted(self._unsynced):
+            try:
+                f = open(self._path(seg), "rb")
+            except FileNotFoundError:
+                continue  # removed by compact/prune: superseded
+            with f:
+                os.fsync(f.fileno())
+        self._unsynced.clear()
+        if self._file is not None:
+            self._file.flush()
+            os.fsync(self._file.fileno())
 
     def append(self, records) -> tuple:
         """Append ``[(block, about20, payload)]`` as one write; returns
@@ -229,6 +266,18 @@ class AttestationWAL:
         torn/corrupt frame ends that SEGMENT's scan (counted in
         ``torn_skipped``); later segments still replay — records are
         independent and the graph is latest-wins."""
+        for _, record in self.replay_frames(start):
+            yield record
+
+    def replay_frames(self, start: tuple | None = None):
+        """Like :meth:`replay` but yields ``((segment, end_offset),
+        (block, about, payload))`` — the position AFTER each record, so
+        a caller holding a snapshot's WAL high-water mark can split one
+        full-log pass into "already reflected in the snapshot" (pos ≤
+        mark) and "replay into the graph" (pos > mark). This is the
+        restore seam since snapshots stopped persisting the raw
+        attestation buffer: the buffer is rebuilt from the (compacted)
+        log, the graph only from the uncovered suffix."""
         sseg, soff = start if start is not None else (0, 0)
         for seg in self.segments():
             if seg < sseg:
@@ -247,7 +296,7 @@ class AttestationWAL:
             good = off
             for end, body in iter_frames(buf, off):
                 good = end
-                yield decode_body(body)
+                yield (seg, end), decode_body(body)
             if good < len(buf) and not (
                     not self.readonly and seg == self._segment
                     and good >= self._pos):
@@ -258,8 +307,12 @@ class AttestationWAL:
 
     # --- maintenance ------------------------------------------------------
     def prune_below(self, segment: int) -> int:
-        """Remove segments strictly below ``segment`` (fully covered by
-        a committed snapshot); returns how many were removed."""
+        """Remove segments strictly below ``segment``; returns how many
+        were removed. FORMAT-1 ONLY: a format-2 snapshot does not embed
+        the attestation buffer — restore rebuilds it from the full log,
+        so pruning covered segments would silently lose attestations on
+        the next restart. The daemon no longer calls this; growth is
+        bounded by latest-wins :meth:`compact` instead."""
         removed = 0
         for seg in self.segments():
             if seg >= segment:
@@ -267,6 +320,7 @@ class AttestationWAL:
             try:
                 os.remove(self._path(seg))
                 removed += 1
+                self._unsynced.discard(seg)
             except OSError:
                 pass
         return removed
@@ -306,6 +360,9 @@ class AttestationWAL:
                 os.remove(self._path(seg))
             except OSError:
                 pass
+        # everything the old segments held is in the fsynced fresh
+        # segment now — nothing rotated-away remains to sync
+        self._unsynced -= set(old)
         return {
             "records_in": records_in,
             "records_out": len(folded),

@@ -879,3 +879,172 @@ def test_profile_job_capture_window(tmp_path, devnet):
         assert "steady_recompiles" in result["xla"]
     finally:
         assert svc.shutdown() is True
+
+
+def test_wal_auto_compaction(tmp_path, devnet):
+    """Format-2 snapshots never prune the WAL (it IS the attestation
+    history) — the daemon bounds its growth itself, in both places:
+    (a) startup over a log of >= wal_compact_segments segments folds
+    latest-wins duplicates per recovered (signer, about) into one
+    fresh segment before restoring (oracle-exact scores + a
+    deduplicated attestation buffer come from the compacted log), and
+    (b) a LIVE daemon folds from the periodic snapshot cadence, so a
+    long-running process under churn never grows the log without
+    bound."""
+    import os
+
+    from protocol_tpu.store.wal import AttestationWAL
+
+    _, node_url = devnet
+    state_dir = str(tmp_path / "state")
+    wal_dir = os.path.join(state_dir, "wal")
+
+    def wal_state():
+        ro = AttestationWAL(wal_dir, readonly=True)
+        segs, n = ro.segments(), sum(1 for _ in ro.replay())
+        ro.close()
+        return segs, n
+
+    # --- phase 1: compaction disabled — the log grows ---------------------
+    svc, client = _make_service(
+        tmp_path, node_url, state_dir=state_dir, snapshot_every=2,
+        wal_segment_bytes=256, wal_compact_segments=0)
+    svc.start()
+    kps = ecdsa_keypairs_from_mnemonic(MNEMONIC, 2)
+    addrs = [address_from_public_key(kp.public_key) for kp in kps]
+    try:
+        # the same two (signer, about) edges revised many times over:
+        # the log grows linearly while the live state stays 2 edges
+        for v in range(3, 11):
+            client.keypairs[0] = kps[0]
+            client.attest(addrs[1], v)
+            client.keypairs[0] = kps[1]
+            client.attest(addrs[0], 13 - v)
+        expected = _oracle(client, kps[0])
+        _wait(lambda: svc.graph.n == 2
+              and svc.refresher.table.revision == svc.graph.revision,
+              what="revisions scored")
+    finally:
+        assert svc.shutdown() is True
+    segs_before, n_before = wal_state()
+    assert len(segs_before) >= 2, "workload never rotated the WAL"
+    assert n_before > 2
+
+    # --- phase 2: restart compacts before restore -------------------------
+    svc2, client2 = _make_service(
+        tmp_path, node_url, state_dir=state_dir, snapshot_every=2,
+        wal_segment_bytes=256, wal_compact_segments=2,
+        chain=client.chain)
+    segs_after, records_after = wal_state()
+    assert len(segs_after) == 1 and segs_after[0] > segs_before[-1]
+    assert records_after == 2  # one folded record per live edge
+    # the compacting process itself restored the buffer from the
+    # PRE-compaction log (compaction runs after restore, so _seen
+    # covers every refetchable digest); the deduplicated buffer
+    # materializes on the NEXT restart — asserted in phase 4
+    assert len(svc2.attestation_snapshot()) == 16
+    url2 = svc2.start()
+    try:
+        _wait(lambda: svc2.refresher.table.revision
+              == svc2.graph.revision, what="restored table republished")
+        for addr, ref in expected.items():
+            assert _get(f"{url2}/score/0x{addr.hex()}")[1]["score"] \
+                == pytest.approx(ref, rel=1e-3)
+
+        # --- phase 3: the LIVE daemon folds at snapshot cadence -----------
+        for v in range(3, 11):
+            client2.keypairs[0] = kps[0]
+            client2.attest(addrs[1], v + 10)
+            client2.keypairs[0] = kps[1]
+            client2.attest(addrs[0], 23 - v)
+        _wait(lambda: svc2.refresher.table.revision
+              == svc2.graph.revision
+              and svc2.graph.revision > 2, what="live churn scored")
+        _wait(lambda: len(svc2.store.wal.segments()) <= 2,
+              what="live compaction to fold the churned log")
+        expected3 = _oracle(client2, kps[0])
+    finally:
+        assert svc2.shutdown() is True
+
+    # --- phase 4: the next restart's buffer comes from the compacted
+    # log — deduplicated (one record per live (signer, about) plus the
+    # tail the live floor kept: records from batches whose cursor
+    # wasn't yet persisted at fold time), NOT the 32-revision history
+    _, records_final = wal_state()
+    svc3, _ = _make_service(
+        tmp_path, node_url, state_dir=state_dir, snapshot_every=2,
+        wal_segment_bytes=256, wal_compact_segments=2,
+        chain=client.chain)
+    assert len(svc3.attestation_snapshot()) == records_final < 16
+    url3 = svc3.start()
+    try:
+        _wait(lambda: svc3.refresher.table.revision
+              == svc3.graph.revision, what="phase-4 restart rescored")
+        for addr, ref in expected3.items():
+            assert _get(f"{url3}/score/0x{addr.hex()}")[1]["score"] \
+                == pytest.approx(ref, rel=1e-3)
+    finally:
+        assert svc3.shutdown() is True
+
+
+def test_wal_compaction_preserves_refetchable_records(tmp_path, devnet):
+    """Compaction must never fold a record the tailer could refetch
+    (block > persisted cursor): folding deletes exactly the digest
+    that dedups the refetch, so the superseded value would re-apply
+    while the surviving newer record is skipped — a silent edge
+    revert. Simulated at the maximum: the cursor checkpoint is wiped
+    (everything refetches), so the startup compaction must keep every
+    record verbatim and the refetched history must fold to the same
+    served scores."""
+    import os
+    import shutil
+
+    from protocol_tpu.store.wal import AttestationWAL
+
+    _, node_url = devnet
+    state_dir = str(tmp_path / "state")
+    svc, client = _make_service(
+        tmp_path, node_url, state_dir=state_dir, snapshot_every=1000,
+        wal_segment_bytes=256, wal_compact_segments=0)
+    svc.start()
+    kps = ecdsa_keypairs_from_mnemonic(MNEMONIC, 2)
+    addrs = [address_from_public_key(kp.public_key) for kp in kps]
+    try:
+        client.keypairs[0] = kps[1]
+        client.attest(addrs[0], 5)
+        for v in (3, 9):  # same (signer, about) edge: superseded 3,
+            client.keypairs[0] = kps[0]   # surviving 9
+            client.attest(addrs[1], v)
+        expected = _oracle(client, kps[0])
+        _wait(lambda: svc.graph.n == 2 and svc.graph.n_edges == 2
+              and svc.refresher.table.revision == svc.graph.revision,
+              what="revisions scored")
+    finally:
+        assert svc.shutdown() is True
+    shutil.rmtree(tmp_path / "cursor")  # maximal cursor lag
+
+    svc2, _ = _make_service(
+        tmp_path, node_url, state_dir=state_dir, snapshot_every=1000,
+        wal_segment_bytes=256, wal_compact_segments=1,
+        chain=client.chain)
+    # startup compaction ran (wal_compact_segments=1) but the floor
+    # (cursor 0) kept every record — nothing was refetch-foldable
+    ro = AttestationWAL(os.path.join(state_dir, "wal"), readonly=True)
+    records = sum(1 for _ in ro.replay())
+    ro.close()
+    assert records == 3, \
+        f"compaction folded refetchable records ({records} left)"
+    url2 = svc2.start()
+    try:
+        _wait(lambda: svc2.tailer.cursor > 0, timeout=60.0,
+              what="refetch to land")
+        _wait(lambda: svc2.refresher.table.revision
+              == svc2.graph.revision, timeout=60.0,
+              what="restart rescored")
+        time.sleep(0.5)  # a revert would arrive as a late refresh
+        for addr, ref in expected.items():
+            assert _get(f"{url2}/score/0x{addr.hex()}")[1]["score"] \
+                == pytest.approx(ref, rel=1e-3), \
+                "refetched superseded attestation reverted the edge"
+    finally:
+        assert svc2.shutdown() is True

@@ -194,24 +194,19 @@ class _FakeTable:
 
 
 def test_snapshot_service_state_round_trip(tmp_path):
-    from protocol_tpu.client.attestation import (
-        AttestationData,
-        SignatureData,
-        SignedAttestationData,
-    )
-
     addrs = [bytes([i + 1]) * 20 for i in range(4)]
     edges = {(0, 1): 5.0, (1, 0): 7.0, (2, 3): 0.0}
     src, dst = [0, 1, 2], [1, 0, 3]
     val = [5.0, 7.0, 0.0]
-    att = SignedAttestationData(
-        AttestationData(about=addrs[1], domain=b"\x00" * 20, value=5),
-        SignatureData(b"\x11" * 32, b"\x22" * 32, 1))
     store = SnapshotStore(str(tmp_path))
     arrays, meta = encode_service_state(
         addrs, src, dst, val, revision=9, edits_since_cold=3, invalid=1,
-        table=_FakeTable([10.0, 20.0, 30.0], 8), attestations=[att],
-        att_blocks=[7], wal_pos=(2, 456))
+        table=_FakeTable([10.0, 20.0, 30.0], 8), wal_pos=(2, 456),
+        n_attestations=17)
+    # format 2: O(graph) encode — the raw attestation buffer is NOT in
+    # the snapshot, only the WAL coverage position
+    assert "att_blob" not in arrays
+    assert meta["fmt"] == 2 and meta["n_attestations"] == 17
     store.save(9, arrays, meta)
     step, arrays2, meta2 = store.load_latest()
     st = decode_service_state(arrays2, meta2)
@@ -223,6 +218,34 @@ def test_snapshot_service_state_round_trip(tmp_path):
     assert st["score_revision"] == 8
     np.testing.assert_allclose(st["scores"], [10.0, 20.0, 30.0])
     assert st["wal_pos"] == (2, 456)
+    assert st["buffer_in_snapshot"] is False
+    assert st["att_records"] == []
+
+
+def test_snapshot_v1_with_att_blob_still_decodes():
+    """Pre-PR 6 snapshots carried the raw attestation buffer as an
+    ``att_blob`` array; decode must keep restoring it so an upgraded
+    daemon can read the snapshot a previous version wrote."""
+    from protocol_tpu.client.attestation import (
+        AttestationData,
+        SignatureData,
+        SignedAttestationData,
+    )
+    from protocol_tpu.store.wal import encode_record
+
+    addrs = [bytes([i + 1]) * 20 for i in range(2)]
+    att = SignedAttestationData(
+        AttestationData(about=addrs[1], domain=b"\x00" * 20, value=5),
+        SignatureData(b"\x11" * 32, b"\x22" * 32, 1))
+    arrays, meta = encode_service_state(
+        addrs, [0], [1], [5.0], revision=1, edits_since_cold=0,
+        invalid=0, table=_FakeTable([1.0, 2.0], 1), wal_pos=(1, 8))
+    blob = encode_record(7, att.attestation.about, att.to_payload())
+    arrays["att_blob"] = np.frombuffer(blob, dtype=np.uint8)
+    meta = dict(meta)
+    meta.pop("fmt")
+    st = decode_service_state(arrays, meta)
+    assert st["buffer_in_snapshot"] is True
     [(blk, about, payload)] = st["att_records"]
     assert blk == 7, "attestation block numbers must round-trip"
     assert about == addrs[1]
@@ -234,7 +257,7 @@ def test_snapshot_corrupt_latest_falls_back(tmp_path):
     t = _FakeTable([], -1)
     for step in (1, 2):
         arrays, meta = encode_service_state(
-            [], [], [], [], step, 0, 0, t, [], [], (1, 8))
+            [], [], [], [], step, 0, 0, t, (1, 8))
         store.save(step, arrays, meta)
     # corrupt the newest payload; its sidecar stays valid
     (tmp_path / "step-000000000002.npz").write_bytes(b"not a zipfile")
@@ -246,7 +269,7 @@ def test_snapshot_corrupt_latest_falls_back(tmp_path):
 def test_snapshot_half_written_is_invisible(tmp_path):
     store = SnapshotStore(str(tmp_path))
     t = _FakeTable([], -1)
-    arrays, meta = encode_service_state([], [], [], [], 5, 0, 0, t, [], [], (1, 8))
+    arrays, meta = encode_service_state([], [], [], [], 5, 0, 0, t, (1, 8))
     store.save(5, arrays, meta)
     # a payload rename without its sidecar (crash window) is not a step
     (tmp_path / "step-000000000009.npz").write_bytes(b"PK\x03\x04junk")
@@ -258,7 +281,7 @@ def test_snapshot_disk_fault_injection(tmp_path):
     faults = FaultInjector({"disk": 1.0}, seed=2)
     store = SnapshotStore(str(tmp_path), faults=faults)
     t = _FakeTable([], -1)
-    arrays, meta = encode_service_state([], [], [], [], 1, 0, 0, t, [], [], (1, 8))
+    arrays, meta = encode_service_state([], [], [], [], 1, 0, 0, t, (1, 8))
     for _ in range(3):
         with pytest.raises(EigenError, match="injected"):
             store.save(1, arrays, meta)
@@ -337,3 +360,31 @@ def test_state_store_metrics_shape(tmp_path):
     assert m["store.wal_bytes"] > 0
     assert m["store.snapshot_age_seconds"] == -1.0  # none taken yet
     store.close()
+
+
+def test_wal_sync_flushes_tail(tmp_path):
+    """``sync()`` makes every committed byte durable under
+    ``fsync="never"`` — the live tail AND segments rotated away since
+    the last sync (they closed with page-cache-only bytes). The
+    format-2 snapshot path calls this before recording its covered
+    position — the restored buffer comes from these bytes, not the
+    snapshot."""
+    wal = AttestationWAL(str(tmp_path), segment_bytes=160,
+                         fsync="never")
+    for i in range(8):
+        wal.append([_rec(i)])
+    assert len(wal.segments()) >= 2, "workload never rotated"
+    # every rotated-away segment is tracked until a sync covers it
+    assert wal._unsynced == set(wal.segments()[:-1])
+    wal.sync()
+    assert wal._unsynced == set()
+    ro = AttestationWAL(str(tmp_path), readonly=True)
+    assert [b for b, _, _ in ro.replay()] == list(range(8))
+    ro.sync()  # no-op on a readonly handle, not an error
+    ro.close()
+    # compaction folds the rotated segments away: nothing stale left
+    # for the next sync to trip over
+    wal.compact(lambda b, a, p: (a,))
+    assert wal._unsynced == set()
+    wal.sync()
+    wal.close()

@@ -31,20 +31,25 @@ dots=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -cd . | wc -c)
 echo "tier1: rc=${t1_rc} DOTS_PASSED=${dots}"
 
 rm -f /tmp/_smoke.log
-env JAX_PLATFORMS=cpu python tools/serve_smoke.py --restart 2>&1 \
+env JAX_PLATFORMS=cpu python tools/serve_smoke.py --restart --churn 2>&1 \
     | tee /tmp/_smoke.log
 smoke_rc=${PIPESTATUS[0]}
-echo "serve_smoke --restart: rc=${smoke_rc}"
+echo "serve_smoke --restart --churn: rc=${smoke_rc}"
 
-# scrape-lint + trace-join + device-observability phases must have
-# actually run, not been skipped by an early exit path. DEVICE_OBS_OK
-# asserts the stage/converge histogram families and a steady-state XLA
-# recompile count of 0 on the live daemon's /metrics.
+# scrape-lint + trace-join + device-observability + delta phases must
+# have actually run, not been skipped by an early exit path.
+# DEVICE_OBS_OK asserts the stage/converge histogram families and a
+# steady-state XLA recompile count of 0 on the live daemon's /metrics;
+# DELTA_DAEMON_OK asserts ptpu_operator_full_builds_total stays flat
+# under weight-revision churn on the live daemon; DELTA_OK is the
+# offline >=100k-edge delta-vs-rebuild evidence (>=10x, score parity).
 lint_rc=1
 grep -q SCRAPE_LINT_OK /tmp/_smoke.log \
     && grep -q TRACE_JOIN_OK /tmp/_smoke.log \
-    && grep -q DEVICE_OBS_OK /tmp/_smoke.log && lint_rc=0
-echo "scrape-lint + trace-join + device-obs: rc=${lint_rc}"
+    && grep -q DEVICE_OBS_OK /tmp/_smoke.log \
+    && grep -q DELTA_DAEMON_OK /tmp/_smoke.log \
+    && grep -q "DELTA_OK" /tmp/_smoke.log && lint_rc=0
+echo "scrape-lint + trace-join + device-obs + delta: rc=${lint_rc}"
 
 # opt-in perf-regression gate (PTPU_PERF_GATE=1): per-stage timings of
 # the instrumented prove/refresh workloads vs tools/perf_baseline.json.

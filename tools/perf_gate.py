@@ -44,6 +44,7 @@ DEFAULT_BASELINE = os.path.join(REPO, "tools", "perf_baseline.json")
 PROVE_KW = {"k": 7, "gates": 64, "repeat": 1}
 REFRESH_KW = {"n": 1500, "m": 4, "engine": "gather", "tol": 1e-6,
               "repeat": 1}
+DELTA_KW = {"n": 4000, "m": 4, "batches": 10, "batch_edges": 200}
 
 
 def _run_once() -> dict:
@@ -51,6 +52,7 @@ def _run_once() -> dict:
     returns {workload: {"total_s", "stages": {name: seconds}}}."""
     from protocol_tpu.cli.profilecmd import (
         fold_prover_stages,
+        run_delta_workload,
         run_prove_workload,
         run_refresh_workload,
     )
@@ -76,6 +78,12 @@ def _run_once() -> dict:
     measure("prove", lambda: run_prove_workload(**PROVE_KW), ())
     measure("refresh", lambda: run_refresh_workload(**REFRESH_KW),
             ("converge.edges",))
+    # the delta-apply vs full-plan-build comparison: the churn batches
+    # (delta.* spans) must stay orders of magnitude under the one
+    # routed.plan_build the workload anchors on
+    measure("delta", lambda: run_delta_workload(**DELTA_KW),
+            ("routed.plan_build", "delta.classify", "delta.revise",
+             "delta.structural", "delta.renorm", "converge.edges"))
     return out
 
 
@@ -98,7 +106,8 @@ def run_workloads(runs: int) -> dict:
                 cur["stages"][stage] = v if prev is None else min(prev, v)
     return {
         "schema": "ptpu-perf-gate-v1",
-        "workload_params": {"prove": PROVE_KW, "refresh": REFRESH_KW},
+        "workload_params": {"prove": PROVE_KW, "refresh": REFRESH_KW,
+                            "delta": DELTA_KW},
         "runs": runs,
         "workloads": best,
     }
