@@ -138,7 +138,11 @@ def build_parser() -> argparse.ArgumentParser:
                    help="refresh stopping tolerance (relative L1)")
     p.add_argument("--max-iterations", type=int, default=None)
     p.add_argument("--queue-capacity", type=int, default=None,
-                   help="proof job backpressure bound")
+                   help="proof job backpressure bound (the shedding "
+                        "watermark defaults to it)")
+    p.add_argument("--workers", type=int, default=None,
+                   help="proof pool workers (default 0 = one per jax "
+                        "device; host-path workers on a CPU box)")
     p.add_argument("--shape", choices=["default", "tiny"], default=None,
                    help="circuit shape served by proof jobs")
     p.add_argument("--transcript", choices=["poseidon", "keccak"],
@@ -166,7 +170,8 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--trace-id", dest="trace_id",
                    help="print the span/event chain for one trace id "
                         "(attestation digest prefix, job id — including "
-                        "its prover-stage spans, request id)")
+                        "its prover-stage spans and the pool worker "
+                        "that executed them, request id)")
 
     p = sub.add_parser(
         "profile",
@@ -830,6 +835,7 @@ def handle_serve(args, files, config):
         poll_interval=args.poll_interval, tol=args.tol,
         max_iterations=args.max_iterations,
         queue_capacity=args.queue_capacity,
+        pool_workers=args.workers,
         proof_shape=args.shape, transcript=args.transcript,
         state_dir=args.state_dir)
     if svc_config.state_dir:
@@ -980,8 +986,12 @@ def handle_obs(args, files, config):
                     ids = (f" span={obj.get('span_id', '?')}"
                            + (f" parent={obj['parent_id']}"
                               if obj.get("parent_id") else ""))
+                # pool-worker attribution: which worker executed a
+                # proof job's prover stages
+                who = (f" worker={obj['worker']}"
+                       if obj.get("worker") else "")
                 print(f"  {obj.get('ts', 0.0):.6f} {obj['type']:<6} "
-                      f"{obj['name']}{dur}{ids}")
+                      f"{obj['name']}{dur}{ids}{who}")
 
         if args.follow:
             print("following (Ctrl-C to stop)...", file=sys.stderr)

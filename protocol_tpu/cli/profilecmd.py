@@ -35,6 +35,33 @@ from ..utils.errors import EigenError
 
 # --- workload runners (shared with tools/perf_gate.py) ---------------------
 
+def synthetic_circuit(gates: int = 64, lookup_bits: int = 6,
+                      seed: int = 7, public_input: int = 12345,
+                      lookup_row: bool = False):
+    """The ONE tiny-circuit generator behind every synthetic proving
+    workload — the ``profile`` verb, the perf gate, ``bench.py
+    --proofs`` and the serve smoke's pool phase all build circuits
+    here, so the shape they measure cannot silently drift apart.
+    ``lookup_row`` adds a copy-constrained lookup usage (the prove
+    workload wants the lookup argument exercised; throughput workloads
+    skip it)."""
+    from ..utils.fields import BN254_FR_MODULUS as R
+    from ..zk.plonk import ConstraintSystem
+
+    rng = random.Random(seed)
+    cs = ConstraintSystem(lookup_bits=lookup_bits)
+    for _ in range(gates):
+        a, b = rng.randrange(50), rng.randrange(50)
+        cs.add_row([a, b, (a * b + a) % R], q_a=1, q_mul_ab=1, q_c=R - 1)
+    if lookup_row:
+        lk = cs.lookup_row(37)
+        row = cs.add_row([37], q_a=1, q_const=R - 37)
+        cs.copy(lk, (0, row))
+    cs.public_input(public_input)
+    cs.check_satisfied()
+    return cs
+
+
 def run_prove_workload(k: int = 7, gates: int = 64, repeat: int = 1,
                        seed: int = 7) -> dict:
     """Keygen + prove a synthetic circuit on a 2^k domain through
@@ -43,22 +70,12 @@ def run_prove_workload(k: int = 7, gates: int = 64, repeat: int = 1,
     timings land in the process tracer."""
     from .. import native
     from ..zk import prover_fast as pf
-    from ..zk.plonk import ConstraintSystem, verify
+    from ..zk.plonk import verify
 
     if not native.available():
         raise EigenError("config_error",
                          "the prove workload needs the native toolchain")
-    rng = random.Random(seed)
-    cs = ConstraintSystem(lookup_bits=6)
-    from ..utils.fields import BN254_FR_MODULUS as R
-
-    for _ in range(gates):
-        a, b = rng.randrange(50), rng.randrange(50)
-        cs.add_row([a, b, (a * b + a) % R], q_a=1, q_mul_ab=1, q_c=R - 1)
-    lk = cs.lookup_row(37)
-    row = cs.add_row([37], q_a=1, q_const=R - 37)
-    cs.copy(lk, (0, row))
-    cs.public_input(12345)
+    cs = synthetic_circuit(gates=gates, seed=seed, lookup_row=True)
     params = pf.setup_params_fast(k, seed=b"profile")
     pk = pf.keygen_fast(params, cs, k=k, eval_pk="auto")
     proof = b""
@@ -134,6 +151,60 @@ def run_delta_workload(n: int = 4000, m: int = 4, batches: int = 10,
             "batches": batches, "batch_edges": batch_edges,
             "tail": len(eng.tail_index),
             "partial_sweeps": None if res is None else res.sweeps}
+
+
+def run_proofs_workload(k: int = 7, gates: int = 64, jobs: int = 6,
+                        workers: int = 2, seed: int = 7) -> dict:
+    """Real host-path proves through a ``workers``-worker ProofWorkerPool
+    (the serve daemon's proof path at pool scale): exercises per-worker
+    prover isolation, cache-affinity scheduling and the submit→run
+    pipeline. Stage timings land in ``ptpu_prover_stage_seconds`` (with
+    worker labels) and the ``service.proof`` spans; the perf gate
+    tracks both so a scheduling regression (queue stall, lost wakeup,
+    serialization across workers) shows up as wall-time growth against
+    the committed baseline."""
+    from .. import native
+    from ..service.faults import FaultInjector
+    from ..service.pool import ProofWorkerPool
+    from ..zk import prover_fast as pf
+
+    if not native.available():
+        raise EigenError("config_error",
+                         "the proofs workload needs the native toolchain")
+    cs = synthetic_circuit(gates=gates, seed=seed)
+    params = pf.setup_params_fast(k, seed=b"profile-pool")
+    pk = pf.keygen_fast(params, cs, k=k, eval_pk="auto")
+    reference = pf.prove_fast(params, pk, cs, randint=lambda: 424242)
+
+    def prove(p):
+        return {"proof": pf.prove_fast(
+            params, pk, cs, randint=lambda: 424242).hex()}
+
+    pool = ProofWorkerPool(
+        {"eigentrust": prove}, capacity=max(jobs, 8), workers=workers,
+        faults=FaultInjector({"rpc": 0.0, "device": 0.0, "disk": 0.0}),
+        worker_env=lambda w: pf.worker_isolation(w.name, w.device))
+    pool.start()
+    submitted = [pool.submit("eigentrust", {}) for _ in range(jobs)]
+    deadline = time.monotonic() + 300.0
+    while pool.completed + pool.failed < jobs:
+        if time.monotonic() > deadline:
+            raise EigenError("internal_error", "proof pool stalled")
+        time.sleep(0.01)
+    for job in submitted:
+        got = pool.get(job.job_id)
+        if got.status != "done" or \
+                bytes.fromhex(got.result["proof"]) != reference:
+            raise EigenError(
+                "verification_error",
+                f"pool proof diverged from the single-worker output "
+                f"({got.status}: {got.error})")
+    status = pool.pool_status()
+    pool.drain(10.0)
+    return {"workload": "proofs", "k": k, "gates": gates, "jobs": jobs,
+            "workers": workers,
+            "per_worker": {w["worker"]: w["jobs_run"]
+                           for w in status["workers"]}}
 
 
 def run_daemon_capture(url: str, seconds: float) -> dict:

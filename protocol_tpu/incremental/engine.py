@@ -200,7 +200,19 @@ class DeltaEngine:
         self.tail_dst_np = np.zeros(0, dtype=np.int64)
         self.tail_raw_np = np.zeros(0, dtype=np.float64)
         self.tail_index: dict = {}       # edge key -> tail position
+        # per-ROW tail indexes, maintained incrementally at insert time
+        # (entries live until re-anchor; removed edges keep their slot
+        # with raw 0 and are skipped at use). These are what keep the
+        # partial refresher's fan-in/fan-out O(adjacent tail edges)
+        # instead of a linear scan over the WHOLE tail per sweep —
+        # past ~10^4 tail edges the scan dominated every churn batch.
         self.tail_by_src: dict = {}      # src node -> [tail positions]
+        self.tail_by_dst: dict = {}      # dst node -> [tail positions]
+        # observability + regression hook: how many tail entries the
+        # fan-in/fan-out traversals actually examined (O(hits), not
+        # O(tail) — asserted by tests/test_incremental.py)
+        self.tail_fanin_visited = 0
+        self.tail_fanout_visited = 0
 
         # --- device state ---------------------------------------------
         arrs, static = routed_arrays(op, dtype=self.dtype, alpha=alpha)
@@ -414,6 +426,7 @@ class DeltaEngine:
                     ti = base_len + len(pend_raw)
                     self.tail_index[k] = ti
                     self.tail_by_src.setdefault(i, []).append(ti)
+                    self.tail_by_dst.setdefault(j, []).append(ti)
                     pend_src.append(i)
                     pend_dst.append(j)
                     pend_raw.append(new_v)

@@ -84,34 +84,75 @@ def _fanin(eng, F: np.ndarray, s: np.ndarray):
             pos = np.searchsorted(F, Fb)
             base[pos] += bb
             in_wsum[pos] += ww
-    if len(eng.tail_raw_np):
-        live = eng.tail_raw_np > 0
-        tdst = eng.tail_dst_np[live]
-        pos = np.searchsorted(F, tdst)
-        hit = (pos < len(F)) & (F[np.minimum(pos, len(F) - 1)] == tdst)
-        if hit.any():
-            tsrc = eng.tail_src_np[live][hit]
+    if eng.tail_by_dst:
+        # per-row tail index: visit only the tail edges INTO the
+        # frontier — O(|F| + hits) dict lookups, NOT a linear pass over
+        # the whole tail per sweep (which dominated every churn batch
+        # past ~10^4 tail edges). Dead entries (raw 0 after a removal)
+        # are skipped at use; the index itself only grows until the
+        # next re-anchor. Hybrid: once the frontier rivals the tail,
+        # the interpreter-level walk loses to one vectorized C-speed
+        # pass over the whole tail — fall back to the scan there.
+        if len(F) * 4 < len(eng.tail_raw_np):
+            rows_list: list = []
+            pos_list: list = []
+            for r, u in enumerate(F.tolist()):
+                for ti in eng.tail_by_dst.get(u, ()):
+                    if eng.tail_raw_np[ti] > 0:
+                        rows_list.append(r)
+                        pos_list.append(ti)
+            eng.tail_fanin_visited += len(pos_list)
+            tis = np.asarray(pos_list, dtype=np.int64)
+            rows = np.asarray(rows_list, dtype=np.int64)
+        else:
+            live = eng.tail_raw_np > 0
+            tdst = eng.tail_dst_np[live]
+            pos = np.searchsorted(F, tdst)
+            hit = ((pos < len(F))
+                   & (F[np.minimum(pos, len(F) - 1)] == tdst))
+            tis = np.nonzero(live)[0][hit]
+            rows = pos[hit]
+            # the counter tracks entries EXAMINED (the regression
+            # test's signal), and this branch scanned every live one
+            eng.tail_fanin_visited += int(live.sum())
+        if len(tis):
+            tsrc = eng.tail_src_np[tis]
             denom = eng.row_sum_now[tsrc]
-            w = np.divide(eng.tail_raw_np[live][hit], denom,
-                          out=np.zeros(int(hit.sum())), where=denom > 0)
-            np.add.at(base, pos[hit], w * s[tsrc])
-            np.add.at(in_wsum, pos[hit], w)
+            w = np.divide(eng.tail_raw_np[tis], denom,
+                          out=np.zeros(len(tis)), where=denom > 0)
+            np.add.at(base, rows, w * s[tsrc])
+            np.add.at(in_wsum, rows, w)
     return base, in_wsum
 
 
 def _fanout(eng, nodes: np.ndarray) -> np.ndarray:
-    """Out-neighbors of ``nodes`` (built CSR + tail), unique."""
+    """Out-neighbors of ``nodes`` (built CSR + tail), unique. The tail
+    side walks the per-src index (O(adjacent tail edges)) — the
+    ``np.isin`` scan it replaces re-read the whole tail per sweep."""
     parts = []
     nb = nodes[nodes < eng.n0]
     if len(nb):
         _, pos = expand_csr(eng.out_ptr, nb)
         if len(pos):
             parts.append(eng.fdst[pos])
-    if len(eng.tail_raw_np):
-        live = eng.tail_raw_np > 0
-        m = live & np.isin(eng.tail_src_np, nodes)
-        if m.any():
-            parts.append(eng.tail_dst_np[m])
+    if eng.tail_by_src:
+        # same hybrid rule as _fanin: indexed walk while the node set
+        # is small relative to the tail, vectorized scan past it
+        if len(nodes) * 4 < len(eng.tail_raw_np):
+            dsts: list = []
+            for u in nodes.tolist():
+                for ti in eng.tail_by_src.get(u, ()):
+                    if eng.tail_raw_np[ti] > 0:
+                        dsts.append(int(eng.tail_dst_np[ti]))
+            eng.tail_fanout_visited += len(dsts)
+            if dsts:
+                parts.append(np.asarray(dsts, dtype=np.int64))
+        else:
+            m = (eng.tail_raw_np > 0) & np.isin(eng.tail_src_np, nodes)
+            # examined the whole tail, not just the matches
+            eng.tail_fanout_visited += len(eng.tail_raw_np)
+            if m.any():
+                parts.append(eng.tail_dst_np[m])
     if not parts:
         return np.zeros(0, dtype=np.int64)
     return np.unique(np.concatenate(parts))

@@ -22,10 +22,11 @@ Components (one file each):
   warm-starts ``ConvergeBackend`` power iteration from the last score
   vector (``ops.converge.warm_start_scores``), falling back to a cold
   converge past a staleness bound.
-- :class:`ProofJobQueue` (``jobs.py``) — bounded proof job queue
-  (submit/status/result) with a single device worker, layered on the
-  zk layer's identity-keyed prover caches so steady-state proofs never
-  re-pay device init.
+- :class:`ProofWorkerPool` (``pool.py``) — bounded multi-worker proof
+  pool (submit/status/result): one worker per device with per-worker
+  identity-keyed prover caches, cache-affinity scheduling, and tiered
+  load shedding; ``ProofJobQueue`` (``jobs.py``) is the single-worker
+  blanket-backpressure facade over it.
 - ``http_api.py`` — stdlib ``http.server`` API: GET /scores,
   GET /score/<addr>, POST /proofs, GET /proofs/<id>,
   GET /proofs/<id>/proof.bin, GET /healthz, GET /status (operator
@@ -47,18 +48,28 @@ inspect/compact verbs (``cli/main.py``).
 from .config import ServiceConfig
 from .daemon import TrustService
 from .faults import FaultInjector
-from .jobs import ProofJob, ProofJobQueue, QueueFullError
+from .jobs import (
+    ByteBudgetError,
+    ProofJob,
+    ProofJobQueue,
+    ProofWorkerPool,
+    QueueFullError,
+    ShedError,
+)
 from .refresh import ScoreRefresher, ScoreTable
 from .state import OpinionGraph
 from .tailer import ChainTailer
 
 __all__ = [
+    "ByteBudgetError",
     "ChainTailer",
     "FaultInjector",
     "OpinionGraph",
     "ProofJob",
     "ProofJobQueue",
+    "ProofWorkerPool",
     "QueueFullError",
+    "ShedError",
     "ScoreRefresher",
     "ScoreTable",
     "ServiceConfig",

@@ -84,8 +84,20 @@ class ServiceConfig:
     # of the offline `store compact` verb. 0 disables auto-compaction.
     wal_compact_segments: int = 8
 
-    # --- proof jobs -------------------------------------------------------
-    queue_capacity: int = 8         # backpressure: submits beyond this 429
+    # --- proof pool -------------------------------------------------------
+    # workers: 0 = one per jax device (host-path workers on a CPU box
+    # give 1); an explicit count forces that many workers, each with
+    # its own DeviceProver cache, pinned round-robin across devices
+    pool_workers: int = 0
+    queue_capacity: int = 8         # legacy depth knob; the tiered
+    # admission watermark defaults to it (shed_watermark=0)
+    # tiered load shedding: below the watermark every kind queues;
+    # above it the admission floor rises one priority tier per extra
+    # watermark of depth (profile < threshold < eigentrust,
+    # provers.PROOF_PRIORITIES) — shed kinds get 429 + Retry-After.
+    # Only the byte budget of queued job params is a hard 503.
+    shed_watermark: int = 0         # 0 = queue_capacity
+    queue_bytes: int = 4 << 20      # hard-503 ceiling on queued params
     proof_shape: str = "default"    # "default" (k=21 SRS) | "tiny" (k=20)
     transcript: str = "keccak"
 

@@ -5,7 +5,10 @@ Thread layout (all daemon threads, all stopping on one event):
 - **tailer** — ``ChainTailer.run``: poll chain → decode → sink;
 - **refresher** — ``ScoreRefresher.run``: wake on dirty, converge,
   publish;
-- **proof worker** — ``ProofJobQueue``'s single device worker;
+- **proof workers** — ``ProofWorkerPool``: one worker per device
+  (``pool_workers`` overrides; host-path workers on CPU boxes), each
+  with its own identity-keyed prover cache, cache-affinity scheduling
+  and tiered load shedding (``pool.py``);
 - **HTTP** — ``ThreadingHTTPServer`` (its own accept loop + per-request
   threads; GETs only read immutable snapshots).
 
@@ -51,7 +54,7 @@ from ..utils.checkpoint import CheckpointManager
 from ..utils.errors import EigenError
 from .config import ServiceConfig
 from .faults import FaultInjector
-from .jobs import ProofJobQueue
+from .pool import ProofWorkerPool
 from .refresh import ScoreRefresher, ScoreTable
 from .state import OpinionGraph, att_digest, recover_signers, trace_id_of
 from .tailer import ChainTailer
@@ -139,20 +142,34 @@ class TrustService:
             # dedups either way) and after the tailer restored the
             # persisted cursor (the fold floor)
             self._compact_wal(self.tailer.persisted_cursor)
+        self._ident_digest: tuple | None = None  # (revision, digest)
+        from .provers import PROOF_PRIORITIES, make_worker_env
+
+        cache_key_fn = None
         if provers is None:
             if files is None:
                 raise EigenError(
                     "config_error",
                     "need an EigenFile assets layout (files=) to build "
                     "the default provers, or pass provers= explicitly")
-            from .provers import make_provers
+            from .provers import make_cache_key_fn, make_provers
 
             provers = make_provers(self, files,
                                    shape_name=config.proof_shape,
                                    transcript=config.transcript)
-        self.jobs = ProofJobQueue(
+            # real provers: affinity keys carry (kind, k, identity-set
+            # digest); injected registries fall back to kind-keyed
+            # affinity (the pool's default)
+            cache_key_fn = make_cache_key_fn(
+                self, shape_name=config.proof_shape)
+        self.jobs = ProofWorkerPool(
             provers, capacity=config.queue_capacity, faults=self.faults,
-            artifacts=self.store.artifacts if self.store else None)
+            artifacts=self.store.artifacts if self.store else None,
+            workers=config.pool_workers or None,
+            priorities=PROOF_PRIORITIES, cache_key_fn=cache_key_fn,
+            watermark=config.shed_watermark,
+            queue_bytes=config.queue_bytes,
+            worker_env=make_worker_env(self))
         if self.store is not None:
             rehydrated = self.jobs.rehydrate()
             if rehydrated:
@@ -452,6 +469,23 @@ class TrustService:
         with self._att_lock:
             return list(self._attestations)
 
+    def identity_digest(self) -> str:
+        """Digest of the current participant set — the identity-set
+        component of proof-pool affinity cache keys. Cached per graph
+        revision so a submit costs a tuple compare, not an O(peers)
+        hash; the graph's interning is append-only, so a stale read
+        racing an apply at worst keys one job to the previous set (an
+        affinity miss, never an error)."""
+        from .provers import identity_digest_of
+
+        rev = self.graph.revision
+        cached = self._ident_digest
+        if cached is not None and cached[0] == rev:
+            return cached[1]
+        digest = identity_digest_of(self.graph.addresses())
+        self._ident_digest = (rev, digest)
+        return digest
+
     # --- proof artifacts --------------------------------------------------
     def proof_bytes(self, job_id: str) -> bytes | None:
         """Raw proof for ``GET /proofs/<id>/proof.bin``: the persisted
@@ -532,6 +566,10 @@ class TrustService:
                 "completed": self.jobs.completed,
                 "failed": self.jobs.failed,
             },
+            # the proof pool: per-worker rows (queue depth, running
+            # job, affinity hits/misses, resident cache keys) plus the
+            # admission state (watermark, byte budget, shed counts)
+            "pool": self.jobs.pool_status(),
             # device-layer observability: compile counts and the
             # steady-state recompile latch (a warning here means a
             # shape leak in the refresh or prover cache — see

@@ -11,7 +11,9 @@ Routes:
 - ``GET /scores``         the full published score table (JSON)
 - ``GET /score/<addr>``   one peer's score (404 before first sighting)
 - ``POST /proofs``        submit a proof job ``{"kind", "params"}`` →
-  202 + job id; 429 on queue backpressure; 503 while draining
+  202 + job id; 429 + ``Retry-After`` when the pool's tiered admission
+  sheds this kind (depth past the watermark — lower-priority kinds go
+  first); 503 at the byte-budget ceiling or while draining
 - ``GET /proofs/<id>``    job status/result (falls back to the persisted
   artifact store past the in-memory MRU / across restarts)
 - ``GET /proofs/<id>/proof.bin``  the raw proof bytes
@@ -78,7 +80,8 @@ def make_server(service, host: str, port: int) -> ThreadingHTTPServer:
         _status = 0
         _request_id = None
 
-        def _reply(self, status: int, obj, content_type="application/json"):
+        def _reply(self, status: int, obj, content_type="application/json",
+                   headers=None):
             if isinstance(obj, bytes):
                 body = obj
             elif content_type == "application/json":
@@ -91,6 +94,8 @@ def make_server(service, host: str, port: int) -> ThreadingHTTPServer:
             self.send_header("Content-Length", str(len(body)))
             if self._request_id:
                 self.send_header("X-Request-Id", self._request_id)
+            for key, value in (headers or {}).items():
+                self.send_header(key, value)
             self.end_headers()
             self.wfile.write(body)
 
@@ -197,9 +202,22 @@ def make_server(service, host: str, port: int) -> ThreadingHTTPServer:
             try:
                 job = service.jobs.submit(kind, params)
             except QueueFullError as e:
-                return self._reply(429, {"error": str(e)})
+                # tiered shed: this kind is below the admission floor
+                # right now; Retry-After carries the pool's backlog
+                # estimate so well-behaved clients pace themselves
+                retry = getattr(e, "retry_after", None)
+                headers = ({"Retry-After": str(int(retry))}
+                           if retry else None)
+                body = {"error": str(e)}
+                if retry:
+                    body["retry_after_seconds"] = int(retry)
+                return self._reply(429, body, headers=headers)
             except EigenError as e:
-                status = 503 if e.kind == "service_busy" else 400
+                # over_capacity = the byte-budget ceiling: the pool is
+                # protecting memory, not prioritizing — hard 503 like
+                # a draining service
+                status = (503 if e.kind in ("service_busy",
+                                            "over_capacity") else 400)
                 return self._reply(status, {"error": str(e)})
             return self._reply(202, job.to_json())
 

@@ -1,313 +1,50 @@
-"""Bounded proof job queue: submit/status/result, one device worker.
+"""Legacy single-worker facade over the multi-worker proof pool.
 
-Proof generation is minutes-scale device work; an HTTP handler can
-neither run it inline nor queue it unboundedly (each queued EigenTrust
-job pins its setup). The queue therefore:
+The bounded proof job queue grew into :mod:`.pool` —
+``ProofWorkerPool``: one worker per device, per-worker identity-keyed
+prover caches (the DeviceProver single-driver assumption is per-worker
+now — ``zk/prover_fast.worker_isolation``), cache-affinity scheduling,
+and tiered load shedding in place of the blanket 429. See pool.py for
+the full design.
 
-- accepts jobs up to ``capacity`` and REJECTS beyond it
-  (:class:`QueueFullError` → HTTP 429) — backpressure, not OOM;
-- runs jobs on ONE worker thread: the device is a serially-owned
-  resource (the DeviceProver suspend/resume cache assumes a single
-  driver — ``zk/prover_tpu.py`` suspend docstring), and serial
-  execution is what lets the zk layer's identity-keyed caches
-  (``zk/api._PK_PARSE_CACHE`` → ``prover_fast._DEVICE_PROVERS`` MRU)
-  keep both the inner and outer provers warm across jobs instead of
-  re-paying device init per proof — the steady-state serving win the
-  r5 battery measured at −23% per proof;
-- keeps terminal jobs (done/failed) in a bounded MRU history so
-  ``GET /proofs/<id>`` stays answerable after completion — and, when a
-  :class:`..store.ProofArtifactStore` is wired in, persists every job
-  record at ISSUE time and again on completion (proof bytes included),
-  so history survives both the MRU bound and a restart: lookups fall
-  back to the artifact store, and :meth:`ProofJobQueue.rehydrate`
-  reloads the newest artifacts into the MRU at startup, advancing the
-  id counter past every persisted id (no id reuse even for jobs killed
-  in flight — those rehydrate as ``failed: lost``).
-
-Provers are a registry ``kind -> fn(params: dict) -> dict`` so the
-daemon wires the real EigenTrust/Threshold provers (``provers.py``)
-while tests inject cheap ones; the seam also carries the device
-fault injection.
+``ProofJobQueue`` keeps the pre-pool contract for callers and tests
+that want the original shape: ONE worker thread and blanket
+backpressure — every kind sheds (``QueueFullError`` → HTTP 429) once
+the queue holds ``capacity`` jobs. That is exactly the pool with one
+worker, a watermark equal to ``capacity``, and every kind at equal
+(zero) priority, so the implementation is shared rather than forked:
+history eviction, artifact persistence at issue time, rehydration with
+the id counter advanced past every persisted id, and drain semantics
+are the pool's.
 """
 
 from __future__ import annotations
 
-import itertools
-import threading
-import time
-from collections import OrderedDict, deque
-from dataclasses import dataclass, field
-
-from ..utils import trace
-from ..utils.errors import EigenError
-from .faults import FaultInjector
-
-
-class QueueFullError(EigenError):
-    def __init__(self, capacity: int):
-        super().__init__("service_busy",
-                         f"proof queue full ({capacity} jobs); retry later")
+from .pool import (  # noqa: F401 - re-exports: the public job surface
+    ByteBudgetError,
+    PoolWorker,
+    ProofJob,
+    ProofWorkerPool,
+    QueueFullError,
+    ShedError,
+)
 
 
-@dataclass
-class ProofJob:
-    job_id: str
-    kind: str
-    params: dict
-    status: str = "queued"  # queued | running | done | failed | cancelled
-    submitted_at: float = field(default_factory=time.time)
-    started_at: float | None = None
-    finished_at: float | None = None
-    result: dict | None = None
-    error: str | None = None
-
-    def to_json(self) -> dict:
-        out = {
-            "job_id": self.job_id,
-            "kind": self.kind,
-            "status": self.status,
-            "submitted_at": self.submitted_at,
-            "params": self.params,
-        }
-        if self.started_at is not None:
-            out["started_at"] = self.started_at
-        if self.finished_at is not None:
-            out["finished_at"] = self.finished_at
-        if self.result is not None:
-            out["result"] = self.result
-        if self.error is not None:
-            out["error"] = self.error
-        return out
-
-    @classmethod
-    def from_json(cls, data: dict) -> "ProofJob":
-        """Inverse of :meth:`to_json` — the artifact-store rehydration
-        path. Tolerates records from older layouts (missing params)."""
-        return cls(
-            job_id=str(data["job_id"]),
-            kind=str(data.get("kind", "")),
-            params=dict(data.get("params") or {}),
-            status=str(data.get("status", "done")),
-            submitted_at=float(data.get("submitted_at", 0.0)),
-            started_at=data.get("started_at"),
-            finished_at=data.get("finished_at"),
-            result=data.get("result"),
-            error=data.get("error"),
-        )
-
-
-class ProofJobQueue:
-    """Bounded FIFO + single worker thread + MRU result history."""
+class ProofJobQueue(ProofWorkerPool):
+    """Bounded FIFO + single worker thread + MRU result history (the
+    pre-pool service shape, preserved for drop-in use)."""
 
     def __init__(self, provers: dict, capacity: int = 8,
-                 faults: FaultInjector | None = None,
-                 history: int = 256, artifacts=None):
+                 faults=None, history: int = 256, artifacts=None):
         """``artifacts``: optional ``store.ProofArtifactStore`` —
         terminal jobs are persisted there and lookups/rehydration fall
         back to it, making proof history survive the MRU and restarts."""
-        self.provers = dict(provers)
-        self.capacity = capacity
-        self.artifacts = artifacts
-        self.faults = faults or FaultInjector({"rpc": 0.0, "device": 0.0})
-        self._pending: deque = deque()
-        self._jobs: OrderedDict = OrderedDict()  # job_id -> ProofJob
-        self._history = history
-        self._lock = threading.Lock()
-        self._wake = threading.Condition(self._lock)
-        self._stop = False
-        self._draining = False
-        self._ids = itertools.count(1)
-        self._thread: threading.Thread | None = None
-        self.completed = 0
-        self.failed = 0
+        super().__init__(
+            provers, capacity=capacity, faults=faults, history=history,
+            artifacts=artifacts, workers=1, priorities=None,
+            watermark=capacity)
 
-    def _record_depth(self, depth: int) -> None:
-        """Legacy metric and typed gauge in lockstep: dashboards scrape
-        both series, so every depth change must land on both."""
-        trace.metric("service.proof_queue_depth", depth)
-        trace.gauge("proof_queue_depth").set(depth)
-
-    # --- submission / lookup ---------------------------------------------
-    def submit(self, kind: str, params: dict | None = None) -> ProofJob:
-        if kind not in self.provers:
-            raise EigenError(
-                "validation_error",
-                f"unknown proof kind {kind!r}; have "
-                f"{sorted(self.provers)}")
-        with self._lock:
-            if self._draining or self._stop:
-                raise EigenError("service_busy",
-                                 "service is draining; not accepting jobs")
-            if len(self._pending) >= self.capacity:
-                raise QueueFullError(self.capacity)
-            job = ProofJob(job_id=f"job-{next(self._ids)}", kind=kind,
-                           params=dict(params or {}))
-            self._jobs[job.job_id] = job
-            # bound the lookup table by evicting the OLDEST TERMINAL
-            # jobs; the excess is sized off the terminal count alone, so
-            # queued/running entries can never shrink the history
-            # allowance (nor be dropped themselves). Evicted jobs remain
-            # reachable through the artifact store when one is wired.
-            terminal = [j.job_id for j in self._jobs.values()
-                        if j.status in ("done", "failed", "cancelled")]
-            for jid in terminal[:len(terminal) - self._history]:
-                del self._jobs[jid]
-        if self.artifacts is not None:
-            # persist the id at ISSUE time, OUTSIDE the lock (an fsync
-            # must not stall lookups/health/the worker) but BEFORE the
-            # job is runnable — it is not in _pending yet, so the worker
-            # cannot race a terminal record under this queued one. A
-            # daemon SIGKILLed with the job in flight must not reissue
-            # the id after restart: rehydrate() advances the counter
-            # past every PERSISTED id.
-            self.artifacts.persist(job)
-        with self._lock:
-            if self._draining or self._stop:
-                # drain began between the sections: this job was never
-                # runnable; its queued artifact rehydrates as failed/lost
-                job.status = "cancelled"
-                job.finished_at = time.time()
-                job.error = "cancelled: service shutdown"
-                raise EigenError("service_busy",
-                                 "service is draining; not accepting jobs")
-            self._pending.append(job)
-            self._wake.notify()
-            self._record_depth(len(self._pending))
-            trace.event("service.job_submitted", trace_id=job.job_id,
-                        kind=kind, depth=len(self._pending))
-            return job
-
-    def get(self, job_id: str) -> ProofJob | None:
-        with self._lock:
-            job = self._jobs.get(job_id)
-        if job is None and self.artifacts is not None:
-            data = self.artifacts.load(job_id)
-            if data is not None:
-                job = ProofJob.from_json(data)
-        return job
-
-    def depth(self) -> int:
-        with self._lock:
-            return len(self._pending)
-
-    def rehydrate(self) -> int:
-        """Reload the newest persisted terminal jobs into the MRU (call
-        before :meth:`start`) and advance the id counter past every
-        persisted id; returns how many were loaded. Without an artifact
-        store this is a no-op. Residual window: an id whose artifact
-        persist FAILED (disk fault) can be reissued after a restart —
-        with a disk that broken, its result was already lost."""
-        if self.artifacts is None:
-            return 0
-        ids = self.artifacts.job_ids()
-        top = self.artifacts.max_numeric_id()
-        loaded = 0
-        with self._lock:
-            for jid in ids[-self._history:]:
-                data = self.artifacts.load(jid)
-                if data is None:
-                    continue
-                job = ProofJob.from_json(data)
-                if job.status in ("queued", "running"):
-                    # persisted at issue time, daemon died mid-job: give
-                    # the polling client an honest terminal answer
-                    job.status = "failed"
-                    job.error = "lost: daemon restarted mid-job"
-                    job.finished_at = time.time()
-                    self.artifacts.persist(job)
-                self._jobs[jid] = job
-                loaded += 1
-            self._ids = itertools.count(top + 1)
-        return loaded
-
-    # --- worker -----------------------------------------------------------
-    def start(self) -> None:
-        self._thread = threading.Thread(target=self._run, daemon=True,
-                                        name="ptpu-proof-worker")
-        self._thread.start()
-
-    def _run(self) -> None:
-        while True:
-            with self._lock:
-                while not self._pending and not self._stop:
-                    self._wake.wait(timeout=0.5)
-                if self._stop and not self._pending:
-                    return
-                job = self._pending.popleft()
-                job.status = "running"
-                job.started_at = time.time()
-                # keep the depth honest on the DRAIN side too: a
-                # submit-only gauge would report a stale backlog forever
-                # after the queue empties
-                self._record_depth(len(self._pending))
-            # queue wait vs prove time: the two halves of a client's
-            # submit→done latency a single total would conflate
-            trace.histogram("proof_wait_seconds").observe(
-                job.started_at - job.submitted_at, kind=job.kind)
-            try:
-                self.faults.check("device")
-                # the job id IS the trace id: /proofs/<id> polls and
-                # the JSONL stream join on the same string. Prover
-                # stage spans (prove_tpu.* / prove.*) run on THIS
-                # thread inside the context, so `obs --trace-id <job>`
-                # shows the job's full per-stage decomposition.
-                with trace.context(trace_id=job.job_id):
-                    with trace.span("service.proof", kind=job.kind):
-                        result = self.provers[job.kind](job.params)
-                job.result = result
-                job.status = "done"
-                self.completed += 1
-            except Exception as e:  # noqa: BLE001 - job isolation: one
-                # failed prove must not kill the worker or the daemon
-                job.error = str(e)
-                job.status = "failed"
-                self.failed += 1
-            finally:
-                job.finished_at = time.time()
-                trace.histogram("proof_run_seconds").observe(
-                    job.finished_at - job.started_at, kind=job.kind,
-                    status=job.status)
-                if self.artifacts is not None:
-                    # best-effort: persist() counts its own failures
-                    # (injected disk faults included) and never raises —
-                    # a lost artifact must not take the worker down
-                    self.artifacts.persist(job)
-                trace.metric("service.proofs_done", self.completed)
-                trace.metric("service.proofs_failed", self.failed)
-
-    # --- lifecycle --------------------------------------------------------
-    def drain(self, timeout: float = 30.0) -> bool:
-        """Stop accepting, finish queued + running jobs within
-        ``timeout``, then stop the worker. Jobs still pending after the
-        budget are marked cancelled. Returns True on a clean drain."""
-        with self._lock:
-            self._draining = True
-        deadline = time.monotonic() + timeout
-        while time.monotonic() < deadline:
-            with self._lock:
-                if not self._pending and not any(
-                        j.status == "running" for j in self._jobs.values()):
-                    break
-            time.sleep(0.05)
-        with self._lock:
-            clean = not self._pending
-            cancelled = list(self._pending)
-            for job in cancelled:
-                job.status = "cancelled"
-                job.finished_at = time.time()
-                job.error = "cancelled: service shutdown"
-            self._pending.clear()
-            self._record_depth(0)  # drained/cancelled: scrapes during
-            # the drain window must not report a backlog
-            self._stop = True
-            self._wake.notify_all()
-        if self.artifacts is not None:
-            # cancelled ids must be persisted too: rehydrate() advances
-            # the id counter past persisted ids only, and a restarted
-            # daemon must never reissue an id a client is still polling
-            for job in cancelled:
-                self.artifacts.persist(job)
-        if self._thread is not None:
-            self._thread.join(timeout=max(0.0,
-                                          deadline - time.monotonic()) + 1.0)
-        return clean and not (self._thread and self._thread.is_alive())
+    @property
+    def _thread(self):
+        """Back-compat: the single worker's thread."""
+        return self.workers[0].thread

@@ -84,10 +84,13 @@ HISTOGRAM_FAMILIES = {
     "proof_persist_seconds": (),
     "refresh_seconds": ("mode",),
     "proof_wait_seconds": ("kind",),
-    "proof_run_seconds": ("kind", "status"),
+    "proof_run_seconds": ("kind", "status", "worker"),
     "http_request_seconds": ("endpoint", "status"),
-    "prover_stage_seconds": ("stage", "k", "path"),
-    "prover_total_seconds": ("k", "path"),
+    # the worker label lands only on series observed inside a pool
+    # worker context (trace.worker_context) — batch-CLI proves keep
+    # the shorter label set; cardinality is bounded by the device count
+    "prover_stage_seconds": ("stage", "k", "path", "worker"),
+    "prover_total_seconds": ("k", "path", "worker"),
     "converge_sweep_seconds": ("backend",),
     "routed_plan_build_seconds": (),
     "operator_delta_seconds": ("kind",),
@@ -96,11 +99,16 @@ HISTOGRAM_FAMILIES = {
 
 # typed counters/gauges of the device-observability layer, declared up
 # front for the same reason (the serve-smoke asserts a steady-state
-# recompile count of 0 — the series must exist to be assertable)
+# recompile count of 0 and a shed count of 0 under the watermark — the
+# series must exist to be assertable)
 DECLARED_COUNTERS = ("xla_compiles", "xla_steady_recompiles",
-                     "operator_full_builds", "refresh_sweep_scope")
+                     "operator_full_builds", "refresh_sweep_scope",
+                     "proof_pool_shed", "proof_pool_affinity",
+                     "proof_pool_stolen")
 DECLARED_GAUGES = ("converge_iterations", "converge_residual",
-                   "proof_queue_depth", "dirty_rows")
+                   "proof_queue_depth", "dirty_rows",
+                   "proof_pool_depth", "proof_pool_worker_depth",
+                   "proof_pool_queued_bytes", "proof_pool_workers")
 
 
 def declare_instruments() -> None:

@@ -1,21 +1,93 @@
-"""Production provers for the job queue: EigenTrust + Threshold.
+"""Production provers for the proof pool: EigenTrust + Threshold.
 
 The steady-state contract: artifact BYTES are loaded once and the same
 objects are passed to ``zk.api`` on every job — its parse cache and the
-DeviceProver MRU behind it key on byte-object IDENTITY
+DeviceProver caches behind it key on byte-object IDENTITY
 (``zk/api._load_pk`` docstring), so holding the objects here is what
 turns "a proof job" into "a warm prove" (no re-parse, no device
 re-init, suspend/resume between the k=20 inner and k=21 outer
 provers). A byte-equal re-read from disk would silently re-pay
-everything.
+everything. ONE registry serves every pool worker: the parsed pk is
+host-side read-only state safely shared across workers, while the
+per-worker part — each worker's DeviceProver cache on its own device —
+is installed by :func:`make_worker_env` around the worker thread, not
+held here.
 """
 
 from __future__ import annotations
 
+import hashlib
 import threading
 
 from ..utils import trace
 from ..utils.errors import EigenError
+
+# shedding tiers for the pool's graduated admission (pool.py): above
+# the depth watermark the floor rises one tier per extra watermark of
+# depth, so profile captures shed first, threshold proofs next, and
+# eigentrust — the proof the service exists to mint — sheds only at
+# the byte-budget ceiling. Unknown (test-injected) kinds default to 0.
+PROOF_PRIORITIES = {"profile": 0, "threshold": 1, "eigentrust": 2}
+
+
+def _shape_params_k(shape_name: str):
+    """(CircuitShape, et_params_k, th_params_k) for a served shape
+    name — the ONE mapping both :func:`make_provers` and
+    :func:`make_cache_key_fn` read, so the k baked into affinity cache
+    keys can never drift from the k the provers actually load."""
+    from ..cli.main import ET_PARAMS_K, TH_PARAMS_K
+    from ..zk import api as zk
+
+    if shape_name == "tiny":
+        return zk.TINY_SHAPE, 20, TH_PARAMS_K
+    return zk.DEFAULT_SHAPE, ET_PARAMS_K, TH_PARAMS_K
+
+
+def make_cache_key_fn(service, shape_name: str = "default"):
+    """Affinity cache keys for the pool scheduler: ``(circuit kind, k,
+    identity-set digest)`` — the identity of the prover state a worker
+    holds resident after running a job of this kind. Same kind + k +
+    participant set → same warm DeviceProver/pk parse state, so the
+    scheduler routes the job to the worker already holding it. The
+    digest folds the CURRENT attestation-backed address set (cheap:
+    cached per graph revision by ``TrustService.identity_digest``);
+    profile jobs return None — a capture window leaves no prover
+    residency worth chasing."""
+    _, et_k, th_k = _shape_params_k(shape_name)
+
+    def cache_key(kind: str, params: dict) -> str | None:
+        if kind == "eigentrust":
+            k = et_k
+        elif kind == "threshold":
+            k = th_k
+        else:
+            return None
+        return f"{kind}-k{k}-{service.identity_digest()}"
+
+    return cache_key
+
+
+def make_worker_env(_service=None):
+    """The pool's per-worker thread environment: a private DeviceProver
+    cache (the suspend/resume single-driver assumption, now per worker)
+    pinned to the worker's own device. Imported lazily so jax-less
+    tests never touch the zk layer."""
+
+    def env(worker):
+        from ..zk.prover_fast import worker_isolation
+
+        return worker_isolation(worker.name, worker.device)
+
+    return env
+
+
+def identity_digest_of(addresses) -> str:
+    """sha256 prefix over an ordered address list — the identity-set
+    component of the affinity cache key."""
+    h = hashlib.sha256()
+    for a in addresses:
+        h.update(a)
+    return h.hexdigest()[:16]
 
 
 def make_profile_prover(out_root) -> "callable":
@@ -24,10 +96,12 @@ def make_profile_prover(out_root) -> "callable":
     while the daemon's other threads keep refreshing and serving —
     device activity in the window lands in the xprof log, and the
     capture's start/stop events carry the job id as trace id, so the
-    timeline is joinable against the JSONL span stream. Runs on the
-    proof worker, so it serializes with device proves (by design: the
-    device is a serially-owned resource) but NOT with refreshes or
-    HTTP. Trust model: the same as every other job kind — the API
+    timeline is joinable against the JSONL span stream. Runs on ONE
+    pool worker, so it serializes with that worker's device proves
+    (each device is a serially-owned resource) but NOT with the other
+    workers, refreshes or HTTP — and the shedding tiers drop it first
+    under load (PROOF_PRIORITIES). Trust model: the same as every
+    other job kind — the API
     already hands its (operator-trusted, loopback-bound by default)
     clients minutes of device time per eigentrust/threshold prove, so
     a capture window adds no new starvation class; still, the window
@@ -91,18 +165,14 @@ class ArtifactCache:
 
 def make_provers(service, files, shape_name: str = "default",
                  transcript: str = "keccak") -> dict:
-    """The default registry for :class:`jobs.ProofJobQueue`.
+    """The default registry for :class:`pool.ProofWorkerPool`.
 
     ``service`` supplies the live attestation set and the Client (domain
     + circuit hyperparameters); ``files`` is the ``cli.fs.EigenFile``
     assets layout the batch verbs already populate."""
-    from ..cli.main import ET_PARAMS_K, TH_PARAMS_K
     from ..zk import api as zk
 
-    if shape_name == "tiny":
-        shape, params_k = zk.TINY_SHAPE, 20
-    else:
-        shape, params_k = zk.DEFAULT_SHAPE, ET_PARAMS_K
+    shape, params_k, th_params_k = _shape_params_k(shape_name)
     cache = ArtifactCache()
 
     def eigentrust(params: dict) -> dict:
@@ -135,7 +205,7 @@ def make_provers(service, files, shape_name: str = "default",
         atts = service.attestation_snapshot()
         setup = service.client.th_circuit_setup(atts, peer, threshold_v)
         proof = zk.generate_th_proof(
-            cache.read(files.kzg_params(TH_PARAMS_K)),
+            cache.read(files.kzg_params(th_params_k)),
             cache.read(files.th_proving_key()),
             setup)
         return {

@@ -38,6 +38,9 @@ class EigenError(Exception):
             # service layer (protocol_tpu.service): queue backpressure /
             # drain rejection, and the chaos seam's synthetic failures
             "service_busy",
+            # the proof pool's hard byte-budget ceiling (HTTP 503, vs
+            # the tiered 429 service_busy sheds)
+            "over_capacity",
             "injected_fault",
         }
     )

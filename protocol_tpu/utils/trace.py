@@ -492,11 +492,34 @@ class Tracer:
 
     def _trace_fields(self) -> dict:
         ids = getattr(self._local, "trace", ())
-        if not ids:
-            return {}
+        out: dict = {}
         if len(ids) == 1:
-            return {"trace_id": ids[0]}
-        return {"trace_ids": list(ids)}
+            out["trace_id"] = ids[0]
+        elif ids:
+            out["trace_ids"] = list(ids)
+        worker = getattr(self._local, "worker", None)
+        if worker is not None:
+            out["worker"] = worker
+        return out
+
+    # --- worker context ---------------------------------------------------
+    def current_worker(self) -> str | None:
+        return getattr(self._local, "worker", None)
+
+    @contextlib.contextmanager
+    def worker_context(self, name: str):
+        """Bind a pool-worker identity to this thread: every span/event
+        emitted inside carries ``worker: name`` (the ``obs --trace-id``
+        view shows which worker executed a job's prover stages), and
+        stage instruments that consult :func:`current_worker` label
+        their series with it. Nesting replaces, exit restores — same
+        discipline as :meth:`context`."""
+        prev = getattr(self._local, "worker", None)
+        self._local.worker = name
+        try:
+            yield
+        finally:
+            self._local.worker = prev
 
     # --- recording --------------------------------------------------------
     def _depth(self) -> int:
@@ -522,6 +545,11 @@ class Tracer:
             self._local.depth = depth
             self._local.stack = stack
             trace_ids = getattr(self._local, "trace", ())
+            worker = getattr(self._local, "worker", None)
+            if worker is not None:
+                # into the record's fields too, so dump_jsonl replays
+                # carry the worker id exactly like the live stream
+                fields.setdefault("worker", worker)
             rec = SpanRecord(name, wall, dt, depth, fields,
                              span_id=span_id, parent_id=parent,
                              trace_ids=trace_ids)
@@ -713,6 +741,14 @@ def histogram(name: str, buckets=None) -> Histogram:
 
 def context(trace_id: str | None = None, trace_ids=None):
     return TRACER.context(trace_id=trace_id, trace_ids=trace_ids)
+
+
+def worker_context(name: str):
+    return TRACER.worker_context(name)
+
+
+def current_worker() -> str | None:
+    return TRACER.current_worker()
 
 
 def current_trace_ids() -> tuple:

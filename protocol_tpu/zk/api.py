@@ -24,6 +24,7 @@ from __future__ import annotations
 
 import hashlib
 import os
+import threading
 from dataclasses import dataclass
 from fractions import Fraction
 
@@ -114,6 +115,11 @@ def _load_params(params: bytes):
 
 
 _PK_PARSE_CACHE: list = []  # MRU-first [(pk bytes object, parsed key)]
+_PK_PARSE_LOCK = threading.Lock()  # the proof pool's workers call
+# _load_pk concurrently (shared ArtifactCache bytes, N provers); an
+# unlocked scan/insert/trim would double-parse ~0.5 GB keys and leave
+# duplicate entries whose MRU churn breaks the identity keys the
+# per-worker DeviceProver caches rely on
 
 
 def _load_pk(pk: bytes):
@@ -126,25 +132,30 @@ def _load_pk(pk: bytes):
     bytes every call, and without the cache each call re-parses the key
     AND breaks the identity key of the DeviceProver cache behind it —
     re-paying the full device init per proof. Callers that re-read the
-    bytes from disk simply miss and parse, exactly as before."""
-    for i, entry in enumerate(_PK_PARSE_CACHE):
-        if entry[0] is pk:
-            if i:
-                _PK_PARSE_CACHE.insert(0, _PK_PARSE_CACHE.pop(i))
-            return entry[1]
-    from .prover_fast import FastProvingKey, _dp_cache_cap
+    bytes from disk simply miss and parse, exactly as before. The lock
+    makes a concurrent same-pk miss parse ONCE (pool workers share the
+    pk bytes; the parse is host work safely serialized — seconds, once
+    per process per key)."""
+    with _PK_PARSE_LOCK:
+        for i, entry in enumerate(_PK_PARSE_CACHE):
+            if entry[0] is pk:
+                if i:
+                    _PK_PARSE_CACHE.insert(0, _PK_PARSE_CACHE.pop(i))
+                return entry[1]
+        from .prover_fast import FastProvingKey, _dp_cache_cap
 
-    if pk[:4] in (b"FPK1", b"FPK2"):
-        obj = FastProvingKey.from_bytes(pk)
-    else:
-        from .plonk import ProvingKey
+        if pk[:4] in (b"FPK1", b"FPK2"):
+            obj = FastProvingKey.from_bytes(pk)
+        else:
+            from .plonk import ProvingKey
 
-        obj = ProvingKey.from_bytes(pk)
-    _PK_PARSE_CACHE.insert(0, (pk, obj))
-    # cap follows the DeviceProver cache: a smaller parse cache would
-    # silently defeat a raised PTPU_DP_CACHE (identity keys downstream)
-    del _PK_PARSE_CACHE[_dp_cache_cap():]
-    return obj
+            obj = ProvingKey.from_bytes(pk)
+        _PK_PARSE_CACHE.insert(0, (pk, obj))
+        # cap follows the DeviceProver cache: a smaller parse cache
+        # would silently defeat a raised PTPU_DP_CACHE (identity keys
+        # downstream)
+        del _PK_PARSE_CACHE[_dp_cache_cap():]
+        return obj
 
 
 def _load_vk(pk: bytes):
@@ -413,8 +424,14 @@ def _prewarm_device_prover(pk_obj) -> None:
 
 
 def _join_prewarm() -> None:
-    while _PREWARM_THREADS:
-        _PREWARM_THREADS.pop().join()
+    # pop-with-catch: concurrent pool workers can race the emptiness
+    # check, and a lost race must be a no-op, not an IndexError
+    while True:
+        try:
+            t = _PREWARM_THREADS.pop()
+        except IndexError:
+            return
+        t.join()
 
 
 def _th_cache_dir() -> str | None:

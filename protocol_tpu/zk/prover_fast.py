@@ -22,6 +22,7 @@ boundary (witness columns in, transcript scalars out).
 
 from __future__ import annotations
 
+import contextlib
 import json
 import os
 import secrets
@@ -894,7 +895,9 @@ def _prove_fast_host(params, pk, cs, public_inputs, randint,
 
 # --- TPU-pipelined prover ---------------------------------------------------
 
-_DEVICE_PROVERS: list = []  # MRU-first [(pk object, DeviceProver)]
+_DEVICE_PROVERS: list = []  # MRU-first [(pk object, DeviceProver)] — the
+# DEFAULT (single-driver) cache's backing list; pool workers get their
+# own DeviceProverCache via worker_isolation() below
 _DEVICE_PROVERS_LOCK = threading.Lock()  # api's prewarm thread vs provers
 
 
@@ -927,74 +930,151 @@ def _sync_if_tracing(x) -> None:
     trace.device_sync(x)
 
 
+def _stage_labels(base: dict) -> dict:
+    """Stage histogram labels + the pool-worker id when this thread
+    runs inside a worker context — ``ptpu_prover_stage_seconds`` series
+    then carry ``worker=wN`` so per-device attribution is scrapeable
+    (label cardinality = worker count, bounded by the device count)."""
+    worker = trace.current_worker()
+    if worker is not None:
+        base = dict(base, worker=worker)
+    return base
+
+
 def _stage(stage: str, k: int, path: str, span_name: str | None = None,
            **fields):
     """One named prover stage: a trace span plus a
-    ``ptpu_prover_stage_seconds{stage,k,path}`` histogram observation —
-    the label-aware instrument the service renders on ``/metrics``.
-    Under sync-span mode the caller drains the device queue before the
-    block exits, so the recorded duration is the stage's true cost, not
-    its dispatch time. Default span names are per-path (``prove.`` /
-    ``prove_tpu.``): a process that runs both paths must not merge
-    their durations under one span name."""
+    ``ptpu_prover_stage_seconds{stage,k,path[,worker]}`` histogram
+    observation — the label-aware instrument the service renders on
+    ``/metrics``. Under sync-span mode the caller drains the device
+    queue before the block exits, so the recorded duration is the
+    stage's true cost, not its dispatch time. Default span names are
+    per-path (``prove.`` / ``prove_tpu.``): a process that runs both
+    paths must not merge their durations under one span name."""
     return trace.timed("prover_stage_seconds",
                        span_name or ("prove_tpu." if path == "tpu"
                                      else "prove.") + stage,
-                       {"stage": stage, "k": str(k), "path": path},
+                       _stage_labels({"stage": stage, "k": str(k),
+                                      "path": path}),
                        stage=stage, k=k, **fields)
 
 
 def _prove_total(k: int, path: str):
-    """Whole-prove span + ``ptpu_prover_total_seconds{path,k}`` — the
-    denominator per-stage shares are reported against. Span names are
-    per-path like :func:`_stage`'s."""
+    """Whole-prove span + ``ptpu_prover_total_seconds{path,k[,worker]}``
+    — the denominator per-stage shares are reported against. Span names
+    are per-path like :func:`_stage`'s."""
     return trace.timed("prover_total_seconds",
                        "prove_tpu.total" if path == "tpu"
                        else "prove.total",
-                       {"k": str(k), "path": path}, k=k, path=path)
+                       _stage_labels({"k": str(k), "path": path}),
+                       k=k, path=path)
+
+
+class DeviceProverCache:
+    """One driver's MRU of per-pk DeviceProvers (the pk's fixed/sigma
+    cosets are device-resident, like halo2's ProvingKey holds its
+    cosets in RAM). The cache is a small MRU list (cap: PTPU_DP_CACHE,
+    default 2): the Threshold cycle alternates a k=20 inner and a k=21
+    outer prover on every proof, and a single slot paid BOTH full
+    device inits (uploads + iNTTs + resident ext builds, ~70 s summed)
+    per call. Inactive provers are suspended — resident ext tables
+    released so the active prove keeps its HBM working-set budget —
+    and resumed from their resident packed coeffs on reuse (device
+    compute only). Entries hold strong pk references and compare
+    identity: an id()-keyed map could alias a new key to a collected
+    one's DeviceProver. Serialized by a lock: api's prewarm daemon
+    calls this concurrently with engine-level provers — without it two
+    threads could miss on the same pk and double-init (double HBM).
+
+    The suspend/resume protocol assumes ONE driver per cache — which
+    used to mean one per process. The proof pool gives each worker its
+    own instance pinned to its own device (:func:`worker_isolation`),
+    so N workers drive N devices concurrently without sharing prover
+    state; the module-global default cache keeps the historical
+    single-driver behavior for everything outside a pool worker."""
+
+    def __init__(self, entries: list | None = None, device=None,
+                 name: str | None = None, lock=None):
+        self.entries = entries if entries is not None else []
+        self.device = device
+        self.name = name
+        self._lock = lock or threading.Lock()
+
+    def holds(self, pk) -> bool:
+        with self._lock:
+            return any(entry[0] is pk for entry in self.entries)
+
+    def get(self, pk: FastProvingKey):
+        from . import prover_tpu
+
+        with self._lock:
+            for i, entry in enumerate(self.entries):
+                if entry[0] is pk:
+                    if i:
+                        self.entries.insert(0, self.entries.pop(i))
+                    for _, other in self.entries[1:]:
+                        other.suspend()
+                    dp = entry[1]
+                    with trace.span("prove_tpu.device_prover_resume"):
+                        dp.resume()
+                    return dp
+            # free the evictee's and the suspendees' device arrays
+            # BEFORE the new prover's init starts claiming HBM
+            del self.entries[_dp_cache_cap() - 1:]
+            for _, other in self.entries:
+                other.suspend()
+            ext_n = (1 << pk.k) * 4
+            shift = _find_coset_shifts(ext_n, 2)[1]
+            dp = prover_tpu.DeviceProver(
+                pk.k, shift,
+                [pk.fixed_limbs[i] for i in range(len(FIXED_NAMES))],
+                [pk.sigma_limbs[w] for w in range(NUM_WIRES)],
+                device=self.device)
+            self.entries.insert(0, (pk, dp))
+            return dp
+
+
+# the default process-wide cache shares the module-global list so the
+# historical test/probe seam (pf._DEVICE_PROVERS surgery) keeps working
+_DEFAULT_DP_CACHE = DeviceProverCache(entries=_DEVICE_PROVERS,
+                                      lock=_DEVICE_PROVERS_LOCK)
+_WORKER_DP = threading.local()
+
+
+def current_dp_cache() -> DeviceProverCache:
+    """The DeviceProver cache for THIS thread: a pool worker's own
+    instance inside :func:`worker_isolation`, else the process-wide
+    default."""
+    return getattr(_WORKER_DP, "cache", None) or _DEFAULT_DP_CACHE
+
+
+@contextlib.contextmanager
+def worker_isolation(name: str, device=None):
+    """Per-worker prover isolation for a proof-pool worker thread: a
+    private :class:`DeviceProverCache` (so suspend/resume never crosses
+    drivers) and, when a device is given, ``jax.default_device``
+    pinning so every array this thread materializes lands on the
+    worker's own device. Yields the cache (the pool reads residency
+    from its scheduler state, not from here)."""
+    cache = DeviceProverCache(device=device, name=name)
+    prev = getattr(_WORKER_DP, "cache", None)
+    _WORKER_DP.cache = cache
+    try:
+        if device is not None:
+            import jax
+
+            with jax.default_device(device):
+                yield cache
+        else:
+            yield cache
+    finally:
+        _WORKER_DP.cache = prev
 
 
 def _device_prover(pk: FastProvingKey):
-    """Cached DeviceProver per pk (the pk's fixed/sigma cosets are
-    device-resident, like halo2's ProvingKey holds its cosets in RAM).
-    The cache is a small MRU list (cap: PTPU_DP_CACHE, default 2): the
-    Threshold cycle alternates a k=20 inner and a k=21 outer prover on
-    every proof, and a single slot paid BOTH full device inits
-    (uploads + iNTTs + resident ext builds, ~70 s summed) per call.
-    Inactive provers are suspended — resident ext tables released so
-    the active prove keeps its HBM working-set budget — and resumed
-    from their resident packed coeffs on reuse (device compute only).
-    Entries hold strong pk references and compare identity: an
-    id()-keyed map could alias a new key to a collected one's
-    DeviceProver. Serialized by a lock: api's prewarm daemon calls
-    this concurrently with engine-level provers — without it two
-    threads could miss on the same pk and double-init (double HBM)."""
-    from . import prover_tpu
-
-    with _DEVICE_PROVERS_LOCK:
-        for i, entry in enumerate(_DEVICE_PROVERS):
-            if entry[0] is pk:
-                if i:
-                    _DEVICE_PROVERS.insert(0, _DEVICE_PROVERS.pop(i))
-                for _, other in _DEVICE_PROVERS[1:]:
-                    other.suspend()
-                dp = entry[1]
-                with trace.span("prove_tpu.device_prover_resume"):
-                    dp.resume()
-                return dp
-        # free the evictee's and the suspendees' device arrays BEFORE
-        # the new prover's init starts claiming HBM
-        del _DEVICE_PROVERS[_dp_cache_cap() - 1:]
-        for _, other in _DEVICE_PROVERS:
-            other.suspend()
-        ext_n = (1 << pk.k) * 4
-        shift = _find_coset_shifts(ext_n, 2)[1]
-        dp = prover_tpu.DeviceProver(
-            pk.k, shift,
-            [pk.fixed_limbs[i] for i in range(len(FIXED_NAMES))],
-            [pk.sigma_limbs[w] for w in range(NUM_WIRES)])
-        _DEVICE_PROVERS.insert(0, (pk, dp))
-        return dp
+    """The per-thread cache's DeviceProver for ``pk`` (see
+    :class:`DeviceProverCache` for the MRU/suspend semantics)."""
+    return current_dp_cache().get(pk)
 
 
 def prove_fast_tpu(params: KZGParams, pk: FastProvingKey,

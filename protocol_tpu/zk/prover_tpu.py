@@ -612,9 +612,30 @@ class DeviceProver:
     separate args (a 29-poly jnp.stack is a multi-GB transient)."""
 
     def __init__(self, k: int, shift: int, fixed_evals_u64, sigma_evals_u64,
-                 ext_resident: "bool | str | None" = None):
+                 ext_resident: "bool | str | None" = None, device=None):
+        # ``device``: pin every array this prover materializes to one
+        # jax device (a proof-pool worker's own chip). None keeps the
+        # process default — the pre-pool single-device behavior.
+        self.device = device
         self.k = k
         self.n = n = 1 << k
+        with self._on_device():
+            self._init_device_state(k, shift, fixed_evals_u64,
+                                    sigma_evals_u64, ext_resident)
+
+    def _on_device(self):
+        """``jax.default_device`` pin for this prover's device (no-op
+        when unpinned): init/resume table builds land on the owning
+        worker's chip, not whichever device is the process default."""
+        import contextlib
+
+        if self.device is None:
+            return contextlib.nullcontext()
+        return jax.default_device(self.device)
+
+    def _init_device_state(self, k, shift, fixed_evals_u64,
+                           sigma_evals_u64, ext_resident):
+        n = self.n
         # Resident packed ext chunks are a speed/HBM trade — three modes:
         #   True    full residency (~1.9 GB k=20 / ~3.9 GB k=21): the
         #           fused quotient kernel. k=21 full residency was
@@ -761,13 +782,24 @@ class DeviceProver:
         """Park this prover: release the resident pk ext-chunk tables
         and the per-ζ barycentric cache, keeping the packed coefficient
         columns (so reactivation is device compute only — no
-        re-uploads). A multi-prover process (the Threshold cycle
+        re-uploads). A multi-prover cache (the Threshold cycle
         alternates a k=20 inner and a k=21 outer prover every proof)
         suspends the inactive prover so the active prove keeps its HBM
-        working-set budget. ``deep`` (the default;
-        PTPU_DP_SUSPEND=shallow opts out) also drops the static
-        (k, shift) tables — another ~0.5 GB at k=20 — rebuilt from
-        host scalars on resume for a few cheap dispatches."""
+        working-set budget.
+
+        Driver model: suspend/resume assumes ONE driver per
+        ``DeviceProverCache`` — the cache serializes its provers'
+        activations under its own lock. That used to mean one driver
+        per PROCESS; the proof pool lifted it to one per WORKER
+        (``prover_fast.worker_isolation``): each worker owns a private
+        cache pinned to its own ``jax.devices()[i]``, so N workers
+        drive N devices concurrently while each device still sees
+        strictly serialized suspend/resume traffic.
+
+        ``deep`` (the default; PTPU_DP_SUSPEND=shallow opts out) also
+        drops the static (k, shift) tables — another ~0.5 GB at k=20 —
+        rebuilt from host scalars on resume for a few cheap
+        dispatches."""
         if deep is None:
             deep = os.environ.get("PTPU_DP_SUSPEND", "deep") != "shallow"
         trace.event("prove_tpu.suspend", k=self.k, deep=bool(deep))
@@ -787,7 +819,12 @@ class DeviceProver:
         packed pk ext-chunk tables from the resident packed coeffs.
         Bit-identical to a fresh init — pack16 output is canonical, and
         the streaming quotient already proves from packed-coeff NTTs
-        (test_stream_prove_matches_host)."""
+        (test_stream_prove_matches_host). Rebuilds land on this
+        prover's pinned device (if any), like init."""
+        with self._on_device():
+            self._resume_tables()
+
+    def _resume_tables(self) -> None:
         if not self._tables_live:
             with trace.span("prove_tpu.static_tables_build", k=self.k):
                 self._build_static_tables()

@@ -218,19 +218,25 @@ def test_obs_verb_summary_and_validation(tmp_path, capsys):
         with trace.span("service.tail_batch", n=2):
             with trace.span("service.wal_append", n=2):
                 pass
+        # a pool worker's prover stage: the chain view must show which
+        # worker executed it (the proof-pool obs satellite)
+        with trace.worker_context("w1"):
+            with trace.span("prove.r1_commits", stage="r1_commits"):
+                pass
     trace.metric("service.block_cursor", 7)
     trace.disable()
     trace.TRACER.reset()
 
     assert run(tmp_path, "obs", str(stream)) == 0
     out = capsys.readouterr().out
-    assert "2 span(s)" in out and "0 invalid" in out
+    assert "3 span(s)" in out and "0 invalid" in out
     assert "service.tail_batch" in out and "service.wal_append" in out
 
     assert run(tmp_path, "obs", str(stream), "--trace-id", "cafe0123") == 0
     out = capsys.readouterr().out
-    assert "trace cafe0123: 2 record(s)" in out
+    assert "trace cafe0123: 3 record(s)" in out
     assert "parent=" in out  # the chain is joinable, not just filtered
+    assert "prove.r1_commits" in out and "worker=w1" in out
 
     with open(stream, "a") as f:
         f.write("this is not json\n")

@@ -1,0 +1,504 @@
+"""Multi-worker proof pool tests: cache-affinity scheduling, tiered
+load shedding, kind fairness, concurrent-submit safety, crash
+rehydration, and byte-identity with the single-worker path.
+
+The pool runs host-path workers here (no accelerator), which is the
+design point: the scheduler, admission tiers, and per-worker prover
+isolation are fully exercised on a CPU box."""
+
+import random
+import threading
+import time
+
+import pytest
+
+from protocol_tpu.service import FaultInjector
+from protocol_tpu.service.pool import (
+    ByteBudgetError,
+    ProofWorkerPool,
+    QueueFullError,
+    ShedError,
+)
+from protocol_tpu.store.artifacts import ProofArtifactStore
+from protocol_tpu.utils import trace
+from protocol_tpu.utils.errors import EigenError
+
+NO_FAULTS = FaultInjector({"rpc": 0.0, "device": 0.0, "disk": 0.0})
+
+
+def _wait(cond, timeout=15.0, what="condition"):
+    deadline = time.monotonic() + timeout
+    while not cond():
+        assert time.monotonic() < deadline, f"timed out waiting: {what}"
+        time.sleep(0.01)
+
+
+def _drain_all(pool, n, timeout=30.0):
+    _wait(lambda: pool.completed + pool.failed >= n, timeout,
+          f"{n} terminal jobs")
+
+
+# --- scheduling --------------------------------------------------------------
+
+def test_pool_runs_jobs_on_all_workers():
+    """Concurrency is real: with every job parked on one gate, both
+    workers must be mid-job at once, and the job records carry the
+    worker that executed them."""
+    gate = threading.Event()
+    started = []
+
+    def slow(params):
+        started.append(params["i"])
+        gate.wait(10)
+        return {"i": params["i"]}
+
+    pool = ProofWorkerPool({"slow": slow}, capacity=32, workers=2,
+                           faults=NO_FAULTS)
+    pool.start()
+    jobs = [pool.submit("slow", {"i": i}) for i in range(4)]
+    _wait(lambda: len(started) >= 2, what="two jobs running at once")
+    gate.set()
+    _drain_all(pool, 4)
+    workers_used = {pool.get(j.job_id).worker for j in jobs}
+    assert workers_used == {"w0", "w1"}, workers_used
+    rows = {r["worker"]: r for r in pool.pool_status()["workers"]}
+    assert rows["w0"]["jobs_run"] + rows["w1"]["jobs_run"] == 4
+    assert pool.drain(5.0) is True
+
+
+def test_cache_affinity_routes_to_resident_worker():
+    """Jobs route to the worker whose prover cache already holds their
+    key (inspected at the QUEUES — run-time placement can legitimately
+    differ via stealing), residency is recorded after a run, and hits
+    are counted."""
+    gate = threading.Event()
+
+    def prove(params):
+        gate.wait(10)
+        return {}
+
+    pool = ProofWorkerPool(
+        {"et": prove, "th": prove, "block": prove}, capacity=64,
+        workers=2, faults=NO_FAULTS,
+        cache_key_fn=lambda kind, params:
+        None if kind == "block" else f"{kind}-k20-abc")
+    pool.start()
+    # park BOTH workers so routing is observable in the queues
+    pool.submit("block", {})
+    pool.submit("block", {})
+    _wait(lambda: all(w.running is not None for w in pool.workers),
+          what="both workers parked")
+    with pool._lock:
+        pool.workers[0].resident["et-k20-abc"] = True
+        pool.workers[1].resident["th-k20-abc"] = True
+    for _ in range(3):
+        pool.submit("et", {})
+    for _ in range(3):
+        pool.submit("th", {})
+    with pool._lock:
+        w0_queued = {k: len(q) for k, q in pool.workers[0].kinds.items()}
+        w1_queued = {k: len(q) for k, q in pool.workers[1].kinds.items()}
+    assert w0_queued == {"et": 3}, (w0_queued, w1_queued)
+    assert w1_queued == {"th": 3}, (w0_queued, w1_queued)
+    gate.set()
+    _drain_all(pool, 8)
+    rows = {r["worker"]: r for r in pool.pool_status()["workers"]}
+    # most keyed jobs ran on their resident worker (the tail of one
+    # backlog may be stolen by the faster-finishing worker — a miss,
+    # counted, never an error)
+    hits = rows["w0"]["affinity_hits"] + rows["w1"]["affinity_hits"]
+    assert hits >= 4, rows
+    # a finished run records residency for its key
+    assert "et-k20-abc" in rows["w0"]["resident"] or \
+        "et-k20-abc" in rows["w1"]["resident"]
+    assert pool.drain(5.0) is True
+
+
+def test_idle_worker_steals_backlog():
+    """Affinity must never strand work: a single hot key queues on one
+    worker, and the idle worker steals from its backlog."""
+    gate = threading.Event()
+
+    def prove(params):
+        gate.wait(10)
+        return {}
+
+    pool = ProofWorkerPool(
+        {"et": prove}, capacity=64, workers=2, faults=NO_FAULTS,
+        cache_key_fn=lambda kind, params: "hot-key")
+    pool.start()
+    jobs = [pool.submit("et", {"i": i}) for i in range(6)]
+    # both workers must end up running despite single-key affinity
+    _wait(lambda: sum(1 for w in pool.workers
+                      if w.running is not None) == 2,
+          what="steal put both workers to work")
+    gate.set()
+    _drain_all(pool, 6)
+    used = {pool.get(j.job_id).worker for j in jobs}
+    assert used == {"w0", "w1"}
+    assert sum(r["stolen"] for r in
+               pool.pool_status()["workers"]) >= 1
+    assert pool.drain(5.0) is True
+
+
+# --- fairness (satellite regression) ----------------------------------------
+
+def test_kind_fairness_round_robin_regression():
+    """A burst of one kind must not starve interleaved submissions of
+    the other: the worker drains its queue round-robin across kinds at
+    equal priority, so execution alternates instead of finishing the
+    whole eigentrust burst first."""
+    gate = threading.Event()
+    order = []
+
+    def make(kind):
+        def prove(params):
+            if params.get("i") is not None:
+                order.append((kind, params["i"]))
+            gate.wait(10) if params.get("block") else None
+            return {}
+        return prove
+
+    pool = ProofWorkerPool(
+        {"eigentrust": make("eigentrust"), "threshold": make("threshold")},
+        capacity=64, workers=1, faults=NO_FAULTS)
+    pool.start()
+    # park the worker so the queue builds in submit order
+    blocker = pool.submit("eigentrust", {"block": True})
+    _wait(lambda: pool.workers[0].running is not None,
+          what="worker parked")
+    for i in range(4):
+        pool.submit("eigentrust", {"i": i})
+    for i in range(4):
+        pool.submit("threshold", {"i": i})
+    gate.set()
+    _drain_all(pool, 9)
+    kinds = [k for k, _ in order]
+    # strict FIFO would run eigentrust 0-3 before any threshold; the
+    # round-robin must interleave: a threshold job appears within the
+    # first two slots and kinds alternate throughout
+    assert kinds[:8] == ["eigentrust", "threshold"] * 4 or \
+        kinds[:8] == ["threshold", "eigentrust"] * 4, order
+    # FIFO preserved within each kind
+    assert [i for k, i in order if k == "eigentrust"] == [0, 1, 2, 3]
+    assert [i for k, i in order if k == "threshold"] == [0, 1, 2, 3]
+    assert pool.drain(5.0) is True
+
+
+# --- tiered admission -------------------------------------------------------
+
+def test_tiered_shedding_profile_first():
+    """Above the watermark the floor rises by priority tier: profile
+    sheds first (429 + Retry-After), threshold at twice the watermark,
+    eigentrust only at the byte ceiling (503)."""
+    gate = threading.Event()
+
+    def prove(params):
+        gate.wait(10)
+        return {}
+
+    pool = ProofWorkerPool(
+        {"eigentrust": prove, "threshold": prove, "profile": prove},
+        capacity=2, workers=1, faults=NO_FAULTS,
+        priorities={"profile": 0, "threshold": 1, "eigentrust": 2},
+        watermark=2, queue_bytes=10_000)
+    pool.start()
+    blocker = pool.submit("profile", {"block": 1})
+    _wait(lambda: pool.workers[0].running is not None,
+          what="worker parked")
+    # depth 0, 1: everything admitted
+    pool.submit("profile", {})
+    pool.submit("threshold", {})
+    # depth 2 = watermark: floor 1 → profile sheds, threshold passes
+    with pytest.raises(ShedError) as exc:
+        pool.submit("profile", {})
+    assert exc.value.retry_after >= 1.0
+    pool.submit("threshold", {})
+    pool.submit("eigentrust", {})
+    # depth 4 = 2x watermark: floor 2 → threshold sheds too
+    with pytest.raises(ShedError):
+        pool.submit("threshold", {})
+    pool.submit("eigentrust", {})
+    # eigentrust keeps landing until the byte budget goes hard 503
+    with pytest.raises(ByteBudgetError) as exc2:
+        pool.submit("eigentrust", {"pad": "x" * 20_000})
+    assert exc2.value.kind == "over_capacity"
+    status = pool.pool_status()
+    assert any(key.startswith("profile:tier") for key in status["shed"])
+    gate.set()
+    _drain_all(pool, 6)
+    assert pool.drain(5.0) is True
+
+
+def test_depth_cap_sheds_even_top_priority():
+    """The floor cap exempts the top tier from TIERED shedding, but
+    the absolute backlog bound (DEPTH_CAP_WATERMARKS watermarks) still
+    429s it — device-time backpressure, not just the byte ceiling."""
+    from protocol_tpu.service.pool import DEPTH_CAP_WATERMARKS
+
+    gate = threading.Event()
+
+    def prove(params):
+        gate.wait(10)
+        return {}
+
+    pool = ProofWorkerPool(
+        {"eigentrust": prove}, capacity=2, workers=1, faults=NO_FAULTS,
+        priorities={"eigentrust": 2}, watermark=2,
+        queue_bytes=1 << 20)
+    pool.start()
+    pool.submit("eigentrust", {"block": 1})
+    _wait(lambda: pool.workers[0].running is not None,
+          what="worker parked")
+    cap = 2 * DEPTH_CAP_WATERMARKS
+    for _ in range(cap):
+        pool.submit("eigentrust", {})
+    with pytest.raises(ShedError) as exc:
+        pool.submit("eigentrust", {})
+    assert exc.value.retry_after >= 1.0
+    assert any(key == "eigentrust:depth_cap"
+               for key in pool.pool_status()["shed"])
+    gate.set()
+    _drain_all(pool, cap + 1, timeout=30)
+    assert pool.drain(5.0) is True
+
+
+def test_blanket_compat_single_worker_queue():
+    """The legacy ProofJobQueue shape via the pool: every kind at equal
+    priority sheds at the watermark — the pre-pool blanket 429."""
+    gate = threading.Event()
+    pool = ProofWorkerPool({"slow": lambda p: (gate.wait(10), {})[1]},
+                           capacity=2, workers=1, faults=NO_FAULTS)
+    pool.start()
+    running = pool.submit("slow", {})
+    _wait(lambda: pool.get(running.job_id).status == "running",
+          what="worker claims job")
+    pool.submit("slow", {})
+    pool.submit("slow", {})
+    with pytest.raises(QueueFullError):
+        pool.submit("slow", {})
+    gate.set()
+    _drain_all(pool, 3)
+    assert pool.drain(5.0) is True
+
+
+# --- concurrent-submit race (satellite) -------------------------------------
+
+def test_concurrent_submit_race_no_collisions(tmp_path):
+    """N threads × M jobs: every submit that is admitted gets a unique
+    id, reaches a terminal state, and is persisted — no lost terminals,
+    no id collisions, across 2 workers with an artifact store wired."""
+    store = ProofArtifactStore(str(tmp_path / "proofs"))
+    pool = ProofWorkerPool(
+        {"fast": lambda p: {"i": p["i"]}}, capacity=10_000, workers=2,
+        faults=NO_FAULTS, artifacts=store, history=10_000)
+    pool.start()
+    N_THREADS, M_JOBS = 8, 25
+    ids: list = []
+    errors: list = []
+    lock = threading.Lock()
+
+    def client(t):
+        got = []
+        for i in range(M_JOBS):
+            try:
+                job = pool.submit("fast", {"i": f"{t}:{i}"})
+                got.append(job.job_id)
+            except EigenError as e:  # admission shed: fine, not lost
+                errors.append(str(e))
+        with lock:
+            ids.extend(got)
+
+    threads = [threading.Thread(target=client, args=(t,))
+               for t in range(N_THREADS)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=30)
+    assert len(ids) == len(set(ids)), "duplicate job ids issued"
+    assert len(ids) + len(errors) == N_THREADS * M_JOBS
+    _drain_all(pool, len(ids), timeout=60)
+    # every admitted job reached a terminal state and the result
+    # round-trips (params echo proves no cross-job contamination)
+    for jid in ids:
+        job = pool.get(jid)
+        assert job is not None and job.status == "done", (jid, job)
+        assert job.result["i"] == job.params["i"]
+        assert store.load(jid) is not None, f"{jid} not persisted"
+    assert pool.completed == len(ids)
+    assert pool.drain(5.0) is True
+
+
+# --- crash rehydration (satellite) ------------------------------------------
+
+def test_sigkill_two_workers_rehydrates_in_flight_as_failed(tmp_path):
+    """SIGKILL with jobs in flight on BOTH workers plus a queued
+    backlog: a fresh pool on the same artifact store rehydrates every
+    non-terminal job as ``failed: lost`` and never reuses their ids."""
+    store = ProofArtifactStore(str(tmp_path / "proofs"))
+    gate = threading.Event()
+    started = []
+
+    def wedge(params):
+        started.append(1)
+        gate.wait(30)
+        return {}
+
+    pool1 = ProofWorkerPool({"wedge": wedge, "fast": lambda p: {}},
+                            capacity=64, workers=2, faults=NO_FAULTS,
+                            artifacts=store)
+    pool1.start()
+    done = pool1.submit("fast", {})
+    _wait(lambda: pool1.get(done.job_id).status == "done",
+          what="one clean terminal")
+    in_flight = [pool1.submit("wedge", {"i": i}) for i in range(2)]
+    _wait(lambda: len(started) == 2, what="both workers mid-job")
+    queued = [pool1.submit("wedge", {"i": 9}),
+              pool1.submit("fast", {"i": 10})]
+    # the daemon dies here: nothing is drained, nothing cancelled —
+    # the artifact store holds the issue-time queued/running records
+    top_before = store.max_numeric_id()
+
+    pool2 = ProofWorkerPool({"wedge": wedge, "fast": lambda p: {}},
+                            capacity=64, workers=2, faults=NO_FAULTS,
+                            artifacts=store)
+    loaded = pool2.rehydrate()
+    assert loaded >= 5
+    for j in in_flight + queued:
+        got = pool2.get(j.job_id)
+        assert got.status == "failed", (j.job_id, got.status)
+        assert "lost" in got.error
+    assert pool2.get(done.job_id).status == "done"
+    pool2.start()
+    fresh = pool2.submit("fast", {})
+    assert int(fresh.job_id.split("-")[1]) > top_before, \
+        "job id reused after restart"
+    _wait(lambda: pool2.get(fresh.job_id).status == "done",
+          what="fresh job on pool2")
+    assert pool2.drain(5.0) is True
+    gate.set()  # release pool1's wedged workers before teardown
+    pool1.hard_kill()
+
+
+def test_worker_env_failure_degrades_not_dies():
+    """A broken per-worker environment (failed zk import, dead jax
+    backend) must degrade to an unpinned worker, not silently kill the
+    thread while the API keeps accepting jobs nobody will run."""
+
+    def broken_env(worker):
+        raise RuntimeError("no backend for you")
+
+    pool = ProofWorkerPool({"fast": lambda p: {"ok": True}},
+                           capacity=8, workers=2, faults=NO_FAULTS,
+                           worker_env=broken_env)
+    pool.start()
+    jobs = [pool.submit("fast", {}) for _ in range(4)]
+    _drain_all(pool, 4)
+    assert all(pool.get(j.job_id).status == "done" for j in jobs)
+    assert pool.drain(5.0) is True
+
+
+def test_failed_artifact_persist_releases_reservation(tmp_path):
+    """A submit whose issue-time artifact persist raises (params the
+    job record cannot serialize) must release its admission
+    reservation: ghost depth would otherwise shed every later job on
+    an idle pool."""
+    store = ProofArtifactStore(str(tmp_path / "proofs"))
+    pool = ProofWorkerPool({"fast": lambda p: {"ok": True}},
+                           capacity=4, workers=1, faults=NO_FAULTS,
+                           artifacts=store)
+    pool.start()
+    for _ in range(3):
+        with pytest.raises(TypeError):
+            pool.submit("fast", {"blob": b"not json"})
+    assert pool.depth() == 0 and pool._reserved == 0
+    # the pool still admits and runs clean jobs — no ghost depth
+    jobs = [pool.submit("fast", {"i": i}) for i in range(4)]
+    _drain_all(pool, 4)
+    assert all(pool.get(j.job_id).status == "done" for j in jobs)
+    assert pool.drain(5.0) is True
+
+
+# --- byte identity with the single-worker path (satellite) ------------------
+
+@pytest.fixture(scope="module")
+def tiny_prove_setup():
+    from protocol_tpu import native
+    from protocol_tpu.utils.fields import BN254_FR_MODULUS as R
+    from protocol_tpu.zk import prover_fast as pf
+    from protocol_tpu.zk.plonk import ConstraintSystem
+
+    if not native.available():
+        pytest.skip("native toolchain unavailable")
+    rng = random.Random(7)
+    cs = ConstraintSystem(lookup_bits=6)
+    for _ in range(24):
+        a, b = rng.randrange(50), rng.randrange(50)
+        cs.add_row([a, b, (a * b + a) % R], q_a=1, q_mul_ab=1, q_c=R - 1)
+    cs.public_input(12345)
+    cs.check_satisfied()
+    params = pf.setup_params_fast(7, seed=b"pool")
+    pk = pf.keygen_fast(params, cs)
+    return pf, params, pk, cs
+
+
+def test_pool_proof_bytes_identical_to_single_worker(tiny_prove_setup):
+    """The pool must not change WHAT is proven: with blinding pinned,
+    a real host-path prove through a 2-worker pool is byte-identical
+    to the direct single-worker prove_fast output."""
+    pf, params, pk, cs = tiny_prove_setup
+    reference = pf.prove_fast(params, pk, cs, randint=lambda: 424242)
+
+    def prove(p):
+        proof = pf.prove_fast(params, pk, cs, randint=lambda: 424242)
+        return {"proof": proof.hex()}
+
+    pool = ProofWorkerPool({"eigentrust": prove}, capacity=16,
+                           workers=2, faults=NO_FAULTS)
+    pool.start()
+    jobs = [pool.submit("eigentrust", {}) for _ in range(4)]
+    _drain_all(pool, 4, timeout=120)
+    used = set()
+    for j in jobs:
+        job = pool.get(j.job_id)
+        assert job.status == "done", job.error
+        assert bytes.fromhex(job.result["proof"]) == reference
+        used.add(job.worker)
+    assert pool.drain(5.0) is True
+
+
+def test_worker_label_lands_on_stage_metrics(tiny_prove_setup):
+    """PR 5 stage metrics gain a worker label inside pool workers: a
+    prove run by wN records ptpu_prover_stage_seconds series carrying
+    worker=wN, and the job's prover-stage spans carry the worker id
+    (the `obs --trace-id` view)."""
+    pf, params, pk, cs = tiny_prove_setup
+    trace.TRACER.reset()
+    trace.TRACER.reset_instruments()
+    trace.enable()
+    try:
+        def prove(p):
+            return {"proof": pf.prove_fast(
+                params, pk, cs, randint=lambda: 1).hex()}
+
+        pool = ProofWorkerPool({"eigentrust": prove}, capacity=16,
+                               workers=2, faults=NO_FAULTS)
+        pool.start()
+        job = pool.submit("eigentrust", {})
+        _drain_all(pool, 1, timeout=120)
+        ran_on = pool.get(job.job_id).worker
+        workers_seen = {dict(items).get("worker")
+                        for items, _ in
+                        trace.histogram("prover_stage_seconds").series()}
+        assert ran_on in workers_seen, (ran_on, workers_seen)
+        # the job's spans carry worker + trace id: the obs join
+        spans = [r for r in trace.TRACER.spans
+                 if job.job_id in r.trace_ids
+                 and r.name.startswith("prove.")]
+        assert spans, "no prover-stage spans under the job's trace id"
+        assert all(r.fields.get("worker") == ran_on for r in spans)
+        assert pool.drain(5.0) is True
+    finally:
+        trace.TRACER.reset()
+        trace.TRACER.reset_instruments()
+        trace.disable()

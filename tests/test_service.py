@@ -383,11 +383,7 @@ def _hard_kill(svc):
     svc._dirty.set()
     for t in svc._threads:
         t.join(timeout=10)
-    with svc.jobs._lock:
-        svc.jobs._stop = True
-        svc.jobs._wake.notify_all()
-    if svc.jobs._thread is not None:
-        svc.jobs._thread.join(timeout=10)
+    svc.jobs.hard_kill()
     svc._server.shutdown()
     svc._server.server_close()
     if svc.store is not None:

@@ -121,7 +121,8 @@ def test_committed_baseline_is_loadable():
     with open(path) as f:
         data = json.load(f)
     assert data["schema"] == "ptpu-perf-gate-v1"
-    assert set(data["workloads"]) == {"prove", "refresh", "delta"}
+    assert set(data["workloads"]) == {"prove", "refresh", "delta",
+                                      "proofs"}
 
 
 # --- profile verb ------------------------------------------------------------

@@ -45,6 +45,7 @@ PROVE_KW = {"k": 7, "gates": 64, "repeat": 1}
 REFRESH_KW = {"n": 1500, "m": 4, "engine": "gather", "tol": 1e-6,
               "repeat": 1}
 DELTA_KW = {"n": 4000, "m": 4, "batches": 10, "batch_edges": 200}
+PROOFS_KW = {"k": 7, "gates": 64, "jobs": 6, "workers": 2}
 
 
 def _run_once() -> dict:
@@ -53,6 +54,7 @@ def _run_once() -> dict:
     from protocol_tpu.cli.profilecmd import (
         fold_prover_stages,
         run_delta_workload,
+        run_proofs_workload,
         run_prove_workload,
         run_refresh_workload,
     )
@@ -84,6 +86,11 @@ def _run_once() -> dict:
     measure("delta", lambda: run_delta_workload(**DELTA_KW),
             ("routed.plan_build", "delta.classify", "delta.revise",
              "delta.structural", "delta.renorm", "converge.edges"))
+    # the proof pool: real proves through 2 host-path workers — a
+    # scheduling regression (queue stall, lost wakeup, accidental
+    # serialization) grows the workload total against the baseline
+    measure("proofs", lambda: run_proofs_workload(**PROOFS_KW),
+            ("service.proof",))
     return out
 
 
@@ -107,7 +114,7 @@ def run_workloads(runs: int) -> dict:
     return {
         "schema": "ptpu-perf-gate-v1",
         "workload_params": {"prove": PROVE_KW, "refresh": REFRESH_KW,
-                            "delta": DELTA_KW},
+                            "delta": DELTA_KW, "proofs": PROOFS_KW},
         "runs": runs,
         "workloads": best,
     }

@@ -82,6 +82,38 @@ def _series_sum(metrics_text, name):
     return total if seen else None
 
 
+def _pool_provers():
+    """The pool phase's job registry: REAL tiny host-path proves when
+    the native toolchain is up (worker-labelled prover-stage samples
+    land on /metrics), else 50 ms sleepers (worker labels still land
+    via proof_run_seconds). Two kinds → two affinity cache keys."""
+    import time as _time
+
+    from protocol_tpu import native
+
+    if not native.available():
+        def sleeper(p):
+            _time.sleep(0.05)
+            return {"ok": True}
+        return {"eigentrust": sleeper, "threshold": sleeper,
+                "noop": lambda p: {"ok": True}}
+    from protocol_tpu.cli.profilecmd import synthetic_circuit
+    from protocol_tpu.zk import prover_fast as pf
+
+    params = pf.setup_params_fast(7, seed=b"smoke-pool")
+    regs = {"noop": lambda p: {"ok": True}}
+    for kind, seed in (("eigentrust", 3), ("threshold", 4)):
+        cs = synthetic_circuit(gates=32, seed=seed, public_input=1)
+        pk = pf.keygen_fast(params, cs)
+
+        def prove(p, pk=pk, cs=cs):
+            return {"proof": pf.prove_fast(params, pk, cs,
+                                           randint=lambda: 7).hex()}
+
+        regs[kind] = prove
+    return regs
+
+
 def inprocess_phase(node_url, chain, step) -> None:
     import tempfile
 
@@ -108,9 +140,13 @@ def inprocess_phase(node_url, chain, step) -> None:
                                   # routed+delta path even for the tiny
                                   # smoke graph: the churn assertions
                                   # below watch the REAL delta engine
-                                  routed_edge_threshold=1),
+                                  routed_edge_threshold=1,
+                                  # 2 host-path workers: the pool phase
+                                  # below drives concurrent submissions
+                                  # through the full scheduler
+                                  pool_workers=2, queue_capacity=32),
             os.path.join(tmp, "cursor"),
-            provers={"noop": lambda p: {"ok": True}},
+            provers=_pool_provers(),
             faults=FaultInjector({"rpc": 0.0, "device": 0.0, "disk": 0.0}),
             state_dir=os.path.join(tmp, "state"))
         url = service.start()
@@ -185,6 +221,9 @@ def inprocess_phase(node_url, chain, step) -> None:
 
         # --- delta engine: weight-revision churn never rebuilds -----------
         daemon_churn_phase(url, client, kps, addrs, step)
+
+        # --- proof pool: both workers run jobs, affinity hits, no sheds ---
+        pool_phase(url, step)
 
         # --- end-to-end trace join over the JSONL stream ------------------
         trace_join_phase(trace_path, chain, step)
@@ -299,10 +338,25 @@ def daemon_churn_phase(url, client, kps, addrs, step) -> None:
     client.keypairs[0] = kps[0]
     client.attest(addr2, 2)
     st = wait_settled("churn setup")
-    m0 = _get_json(url, "/metrics")
-    builds0 = _series_sum(m0, "ptpu_operator_full_builds_total")
+    # quiescence gate for the flat-builds window: the setup's
+    # structural insert can trigger a legitimate re-anchor build a beat
+    # AFTER wait_settled reports anchored (observed intermittently) —
+    # snapshot builds0 only once the counter holds still across a read
+    # gap, so a late setup build never lands inside the measurement
+    builds0 = None
+    deadline = time.monotonic() + 30.0
+    while time.monotonic() < deadline:
+        b1 = _series_sum(_get_json(url, "/metrics"),
+                         "ptpu_operator_full_builds_total")
+        time.sleep(0.7)
+        b2 = _series_sum(_get_json(url, "/metrics"),
+                         "ptpu_operator_full_builds_total")
+        if b1 == b2 and _get_json(url, "/status")["delta"]["anchored"]:
+            builds0 = b2
+            break
     assert builds0 is not None and builds0 >= 1, \
-        f"routed path never built an operator (counter {builds0})"
+        f"routed path never built an operator / never quiesced " \
+        f"(counter {builds0})"
     prev2 = None
     for r in range(3):
         rev0 = st["graph"]["revision"]
@@ -354,6 +408,99 @@ def daemon_churn_phase(url, client, kps, addrs, step) -> None:
     step(f"DELTA_DAEMON_OK (full_builds flat at {int(builds1)} across "
          f"3 revision rounds, {d['batches_absorbed']} windows absorbed,"
          f" {d['partial_refreshes']} partial refreshes)")
+
+
+def pool_phase(url, step) -> None:
+    """Proof pool evidence on the LIVE daemon: concurrent submissions
+    of two kinds across 2 host-path workers must all be accepted (202 —
+    zero hard sheds under the watermark), BOTH workers must run jobs
+    (worker-labelled samples on /metrics), the affinity scheduler must
+    land repeat-kind jobs on their resident worker (hit-rate > 0), and
+    /status must carry the per-worker rows → ``PROOF_POOL_OK``."""
+    import json as _json
+    import threading
+    import urllib.request
+
+    def submit(kind):
+        req = urllib.request.Request(
+            url + "/proofs", method="POST",
+            data=_json.dumps({"kind": kind, "params": {}}).encode(),
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=30) as r:
+            assert r.status == 202, \
+                f"submit under the watermark got HTTP {r.status}"
+            return _json.loads(r.read())["job_id"]
+
+    ids, errors = [], []
+    lock = threading.Lock()
+
+    def client(c):
+        for i in range(4):
+            kind = "eigentrust" if (c + i) % 2 else "threshold"
+            try:
+                jid = submit(kind)
+                with lock:
+                    ids.append(jid)
+            except Exception as e:  # noqa: BLE001 - collected + fatal below
+                errors.append(f"{kind}: {e}")
+
+    threads = [threading.Thread(target=client, args=(c,))
+               for c in range(2)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=60)
+    assert not errors, f"submissions under the watermark failed: {errors}"
+    assert len(ids) == 8
+
+    deadline = time.monotonic() + 120
+    while time.monotonic() < deadline:
+        states = [_get_json(url, f"/proofs/{jid}")["status"]
+                  for jid in ids]
+        if all(s in ("done", "failed") for s in states):
+            break
+        time.sleep(0.2)
+    jobs = [_get_json(url, f"/proofs/{jid}") for jid in ids]
+    bad = [j for j in jobs if j["status"] != "done"]
+    assert not bad, f"pool jobs failed: {bad}"
+    ran_on = {j.get("worker") for j in jobs}
+    assert ran_on == {"w0", "w1"}, \
+        f"jobs did not spread across both workers: {ran_on}"
+
+    metrics = _get_json(url, "/metrics")
+    for w in ("w0", "w1"):
+        assert any(line.startswith("ptpu_proof_run_seconds_count")
+                   and f'worker="{w}"' in line
+                   for line in metrics.splitlines()), \
+            f"no worker-labelled run samples for {w}"
+    # real proves additionally land worker-labelled PROVER-STAGE
+    # samples (the PR 5 histograms grew a worker label)
+    from protocol_tpu import native
+
+    if native.available():
+        assert any(line.startswith("ptpu_prover_stage_seconds_count")
+                   and 'worker="' in line
+                   for line in metrics.splitlines()), \
+            "no worker-labelled prover-stage samples"
+    hits = _series_sum(metrics, "ptpu_proof_pool_affinity_total")
+    hit_lines = [line for line in metrics.splitlines()
+                 if line.startswith("ptpu_proof_pool_affinity_total")
+                 and 'result="hit"' in line]
+    hit_count = sum(float(line.split()[-1]) for line in hit_lines)
+    assert hit_count > 0, f"affinity hit-rate is 0 (samples: {hits})"
+    shed = _series_sum(metrics, "ptpu_proof_pool_shed_total")
+    assert shed == 0.0, f"hard sheds under the watermark: {shed}"
+
+    status = _get_json(url, "/status")
+    pool = status["pool"]
+    rows = {r["worker"]: r for r in pool["workers"]}
+    assert set(rows) == {"w0", "w1"} and all(
+        rows[w]["jobs_run"] >= 1 for w in rows), rows
+    depth = _metric_value(metrics, "ptpu_proof_pool_depth")
+    assert depth == 0.0, f"pool depth nonzero after drain: {depth}"
+    step(f"PROOF_POOL_OK (8 jobs 202-accepted, per-worker runs "
+         f"{ {w: rows[w]['jobs_run'] for w in sorted(rows)} }, "
+         f"affinity hits {int(hit_count)}, sheds 0)")
 
 
 def _counter_total(name) -> float:
