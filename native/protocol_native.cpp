@@ -25,6 +25,7 @@
 #endif
 #include <cstdint>
 #include <cstring>
+#include <memory>
 #include <thread>
 #include <vector>
 
@@ -859,12 +860,12 @@ struct IfmaScratch {
 // Mirrors the scalar level exactly — same pairing, same edge rules
 // (doubling / cancel-to-infinity), same output order. ax52/ay52 hold
 // 5x52-bit limbs per element (canonical Montgomery values); abid the
-// bucket ids. Returns the new live count.
+// bucket ids. Pure in→out (callers swap their ping-pong buffers);
+// returns the new live count.
 static long level_pass_ifma(const FieldCtx &f, const Ctx52 &c52,
-                            std::vector<u64> &ax52, std::vector<u64> &ay52,
-                            std::vector<int32_t> &abid,
-                            std::vector<u64> &nx52, std::vector<u64> &ny52,
-                            std::vector<int32_t> &nbid,
+                            const u64 *ax52, const u64 *ay52,
+                            const int32_t *abid,
+                            u64 *nx52, u64 *ny52, int32_t *nbid,
                             const std::vector<unsigned char> &role,
                             long m, long pairs, IfmaScratch &S) {
     S.ensure(pairs);
@@ -908,10 +909,10 @@ static long level_pass_ifma(const FieldCtx &f, const Ctx52 &c52,
         const __m512i hv = _mm512_load_si512((const void *)hoff);
         const __m512i tv = _mm512_add_epi64(hv, _mm512_set1_epi64(5));
         Fp8 Ax, Ay, Bx, By, den;
-        vgather5(Ax, ax52.data(), hv);
-        vgather5(Ay, ay52.data(), hv);
-        vgather5(Bx, ax52.data(), tv);
-        vgather5(By, ay52.data(), tv);
+        vgather5(Ax, ax52, hv);
+        vgather5(Ay, ay52, hv);
+        vgather5(Bx, ax52, tv);
+        vgather5(By, ay52, tv);
         v_sub_mod(den, Bx, Ax, c52);
         __m512i nz = den.l[0];
         for (int i = 1; i < 5; ++i) nz = _mm512_or_si512(nz, den.l[i]);
@@ -1076,9 +1077,6 @@ static long level_pass_ifma(const FieldCtx &f, const Ctx52 &c52,
             ++write;
         }
     }
-    ax52.swap(nx52);
-    ay52.swap(ny52);
-    abid.swap(nbid);
     return write;
 }
 #endif  // PN_IFMA
@@ -1271,8 +1269,13 @@ void g1_msm(const u64 *mod_limbs, const u64 *bases, const u64 *scalars,
             if (!pairs) break;
 #ifdef PN_IFMA
             if (use_ifma && in52 && pairs >= 64) {
-                m = level_pass_ifma(f, c52, x52, y52, abid, nx52, ny52,
-                                    nbid, role, m, pairs, ifma_scratch);
+                m = level_pass_ifma(f, c52, x52.data(), y52.data(),
+                                    abid.data(), nx52.data(), ny52.data(),
+                                    nbid.data(), role, m, pairs,
+                                    ifma_scratch);
+                x52.swap(nx52);
+                y52.swap(ny52);
+                abid.swap(nbid);
                 continue;
             }
             if (in52) {  // tail levels: back to the scalar (s) domain
@@ -1411,6 +1414,1100 @@ void g1_msm(const u64 *mod_limbs, const u64 *bases, const u64 *scalars,
     from_mont(ayy, ayy, f);
     std::memcpy(out, axx.v, 32);
     std::memcpy(out + 4, ayy.v, 32);
+}
+
+// ===== multi-column MSM: K commit columns through one engine call =======
+//
+// The prover's commit wall is K independent g1_msm calls over the SAME
+// base array (SRS / Lagrange powers): each call re-parses and
+// re-converts every base point, re-recodes into a windows·n digit
+// array, and walks cache-hostile monolithic level passes. g1_msm_multi
+// restructures the whole path around what the r8 measurements actually
+// showed:
+//
+//   - bases are parsed + Montgomery/w-domain-converted ONCE for all K
+//     columns (serial: K times — ~0.35 s/column at 2^20);
+//   - windows are processed LSB→MSB with on-the-fly signed recode
+//     (carry byte per scalar), so no windows·n digit array is ever
+//     materialized or re-streamed;
+//   - the batch-affine pairing levels run per BUCKET-RANGE TILE: a
+//     tile of TBUK buckets' entries stays L2-resident across ALL of
+//     its levels (the monolithic pass streams the whole working set
+//     once per level), with pair sums evaluated by affine_pairs_ifma
+//     and compacted in place — no role scans, no merge copies, no
+//     ping-pong arrays;
+//   - the per-window bucket reduction runs 32 group-chains wide in
+//     IFMA lanes (reduce_column_ifma) — the serial telescope is the
+//     one part of Pippenger a single column cannot vectorize, and it
+//     was ~25% of a 2^18 serial MSM;
+//   - cross-column sharing INSIDE one window pass (i-outer/k-inner
+//     placement feeding K bucket placements per base fetch, K× wider
+//     inversion levels) is supported via PN_MSM_KB but measured net
+//     NEGATIVE on the r8 box — the chunk·n working set costs more in
+//     cache/TLB than the shared reads save — so the default sweeps
+//     one column per window pass (see the KB comment in the driver).
+//
+// Each window's bucket total is shifted by c·w doublings before
+// joining its column total. Per column the result is bit-exact with
+// g1_msm (canonical affine output); g1_msm itself is left untouched as
+// the committed-baseline oracle the BENCH_r08 curve is measured
+// against. ``flips`` (optional, K×n bytes) negates a base's y for one
+// column only — the scalar-balancing trick (_msm_signed) without K
+// private copies of the base array.
+
+#ifdef PN_IFMA
+
+static inline void v_add_mod(Fp8 &out, const Fp8 &a, const Fp8 &b,
+                             const Ctx52 &c) {
+    const __m512i mask = _mm512_set1_epi64((long long)MASK52);
+    const __m512i zero = _mm512_setzero_si512();
+    __m512i carry = zero;
+    __m512i s[5];
+    for (int i = 0; i < 5; ++i) {
+        __m512i t = _mm512_add_epi64(_mm512_add_epi64(a.l[i], b.l[i]),
+                                     carry);
+        s[i] = _mm512_and_si512(t, mask);
+        carry = _mm512_srli_epi64(t, 52);
+    }
+    // canonical operands: sum < 2p fits 5 limbs — one conditional
+    // subtract lands canonical (same pattern as v_mont_mul's tail)
+    __m512i borrow = zero;
+    __m512i d[5];
+    for (int i = 0; i < 5; ++i) {
+        __m512i t = _mm512_sub_epi64(_mm512_sub_epi64(s[i], c.p[i]),
+                                     borrow);
+        d[i] = _mm512_and_si512(t, mask);
+        borrow = _mm512_srli_epi64(t, 63);
+    }
+    __mmask8 ge = _mm512_cmpeq_epi64_mask(borrow, zero);
+    for (int i = 0; i < 5; ++i)
+        out.l[i] = _mm512_mask_blend_epi64(ge, s[i], d[i]);
+}
+
+struct Jac8 {  // 8 Jacobian points, lane-parallel, w-domain 5x52 limbs
+    Fp8 x, y, z;
+};
+
+static inline __mmask8 v_is_zero5(const Fp8 &a) {
+    __m512i nz = a.l[0];
+    for (int i = 1; i < 5; ++i) nz = _mm512_or_si512(nz, a.l[i]);
+    return _mm512_cmpeq_epi64_mask(nz, _mm512_setzero_si512());
+}
+
+static inline void v_blend5(Fp8 &dst, __mmask8 m, const Fp8 &src) {
+    for (int i = 0; i < 5; ++i)
+        dst.l[i] = _mm512_mask_blend_epi64(m, dst.l[i], src.l[i]);
+}
+
+static inline void lane_get5(const Fp8 &a, int l, u64 out[5]) {
+    alignas(64) u64 tmp[8];
+    for (int i = 0; i < 5; ++i) {
+        _mm512_store_si512((void *)tmp, a.l[i]);
+        out[i] = tmp[l];
+    }
+}
+
+static inline void lane_set5(Fp8 &a, int l, const u64 in[5]) {
+    alignas(64) u64 tmp[8];
+    for (int i = 0; i < 5; ++i) {
+        _mm512_store_si512((void *)tmp, a.l[i]);
+        tmp[l] = in[i];
+        a.l[i] = _mm512_load_si512((const void *)tmp);
+    }
+}
+
+static void jac_from_lane(const Jac8 &v, int l, JacPoint &p,
+                          const Ctx52 &c, const FieldCtx &f) {
+    u64 t[5];
+    lane_get5(v.x, l, t);
+    from_w52(p.x, t, c, f);
+    lane_get5(v.y, l, t);
+    from_w52(p.y, t, c, f);
+    lane_get5(v.z, l, t);
+    from_w52(p.z, t, c, f);
+}
+
+static void jac_to_lane(Jac8 &v, int l, const JacPoint &p,
+                        const Ctx52 &c, const FieldCtx &f) {
+    u64 t[5];
+    to_w52(t, p.x, c, f);
+    lane_set5(v.x, l, t);
+    to_w52(t, p.y, c, f);
+    lane_set5(v.y, l, t);
+    to_w52(t, p.z, c, f);
+    lane_set5(v.z, l, t);
+}
+
+static inline void vgather5_mask(Fp8 &dst, const u64 *base,
+                                 const __m512i idx5, __mmask8 mk) {
+    for (int i = 0; i < 5; ++i)
+        dst.l[i] = _mm512_mask_i64gather_epi64(
+            _mm512_setzero_si512(), mk,
+            _mm512_add_epi64(idx5, _mm512_set1_epi64(i)), base, 8);
+}
+
+// p[t] += q[t] (q affine, per-lane ``present`` masks) for 4 independent
+// Jac8 states at once — madd-2007-bl, the vector twin of jac_add_mixed.
+// Every primitive runs 4× back to back on independent chains, so the
+// reduction loop is throughput-bound instead of serialized on one
+// chain's v_mont_mul latency. Lanes where p is at infinity take q;
+// equal-x lanes (doubling / cancel) resolve through the exact scalar
+// path, so every input is handled exactly.
+#define VJ4(expr) for (int t = 0; t < 4; ++t) { expr; }
+static void v_jac_add_mixed4(Jac8 p[4], const Fp8 qx[4], const Fp8 qy[4],
+                             const __mmask8 present[4], const Ctx52 &c52,
+                             const FieldCtx &f, const Fp8 &onev) {
+    __mmask8 pinf[4], gen[4], hz[4];
+    Fp8 z1z1[4], u2[4], s2[4], h[4], rr[4], hh[4], i4[4], j[4], v[4],
+        t0[4], x3[4], y3[4], z3[4], y1j[4], zh[4];
+    VJ4(pinf[t] = (__mmask8)(v_is_zero5(p[t].z) & present[t]))
+    VJ4(gen[t] = (__mmask8)(present[t] & ~pinf[t]))
+    VJ4(v_mont_mul(z1z1[t], p[t].z, p[t].z, c52))
+    VJ4(v_mont_mul(u2[t], qx[t], z1z1[t], c52))
+    VJ4(v_mont_mul(s2[t], qy[t], p[t].z, c52))
+    VJ4(v_mont_mul(s2[t], s2[t], z1z1[t], c52))
+    VJ4(v_sub_mod(h[t], u2[t], p[t].x, c52))
+    VJ4(v_sub_mod(rr[t], s2[t], p[t].y, c52))
+    VJ4(hz[t] = (__mmask8)(v_is_zero5(h[t]) & gen[t]))
+    VJ4(v_mont_mul(hh[t], h[t], h[t], c52))
+    VJ4(v_add_mod(i4[t], hh[t], hh[t], c52))
+    VJ4(v_add_mod(i4[t], i4[t], i4[t], c52))
+    VJ4(v_mont_mul(j[t], h[t], i4[t], c52))
+    VJ4(v_add_mod(rr[t], rr[t], rr[t], c52))
+    VJ4(v_mont_mul(v[t], p[t].x, i4[t], c52))
+    VJ4(v_mont_mul(x3[t], rr[t], rr[t], c52))
+    VJ4(v_sub_mod(x3[t], x3[t], j[t], c52))
+    VJ4(v_sub_mod(x3[t], x3[t], v[t], c52))
+    VJ4(v_sub_mod(x3[t], x3[t], v[t], c52))
+    VJ4(v_sub_mod(t0[t], v[t], x3[t], c52))
+    VJ4(v_mont_mul(t0[t], t0[t], rr[t], c52))
+    VJ4(v_mont_mul(y1j[t], p[t].y, j[t], c52))
+    VJ4(v_add_mod(y1j[t], y1j[t], y1j[t], c52))
+    VJ4(v_sub_mod(y3[t], t0[t], y1j[t], c52))
+    VJ4(v_add_mod(zh[t], p[t].z, h[t], c52))
+    VJ4(v_mont_mul(zh[t], zh[t], zh[t], c52))
+    VJ4(v_sub_mod(zh[t], zh[t], z1z1[t], c52))
+    VJ4(v_sub_mod(z3[t], zh[t], hh[t], c52))
+    for (int t = 0; t < 4; ++t) {
+        if (!hz[t]) continue;  // rare: exact scalar resolution per lane
+        for (int l = 0; l < 8; ++l) {
+            if (!((hz[t] >> l) & 1)) continue;
+            JacPoint pl, res;
+            jac_from_lane(p[t], l, pl, c52, f);
+            AffPt q;
+            u64 tt[5];
+            lane_get5(qx[t], l, tt);
+            from_w52(q.x, tt, c52, f);
+            lane_get5(qy[t], l, tt);
+            from_w52(q.y, tt, c52, f);
+            jac_add_mixed(res, pl, q, f);
+            Jac8 tmp;  // route through jac_to_lane on a scratch triple
+            tmp.x = x3[t];
+            tmp.y = y3[t];
+            tmp.z = z3[t];
+            jac_to_lane(tmp, l, res, c52, f);
+            x3[t] = tmp.x;
+            y3[t] = tmp.y;
+            z3[t] = tmp.z;
+        }
+    }
+    for (int t = 0; t < 4; ++t) {
+        v_blend5(p[t].x, gen[t], x3[t]);
+        v_blend5(p[t].y, gen[t], y3[t]);
+        v_blend5(p[t].z, gen[t], z3[t]);
+        v_blend5(p[t].x, pinf[t], qx[t]);
+        v_blend5(p[t].y, pinf[t], qy[t]);
+        v_blend5(p[t].z, pinf[t], onev);
+    }
+}
+
+// p[t] += q[t] (both Jacobian) × 4 chains — add-2007-bl, the vector
+// twin of jac_add, same 4-wide software pipelining as above. Infinity
+// lanes blend (q at ∞ → p unchanged; p at ∞ → q); equal-x lanes
+// resolve through the exact scalar path.
+static void v_jac_add4(Jac8 p[4], const Jac8 q[4], const Ctx52 &c52,
+                       const FieldCtx &f) {
+    __mmask8 copy[4], gen[4], hz[4];
+    Fp8 z1z1[4], z2z2[4], u1[4], u2[4], s1[4], s2[4], h[4], rr[4],
+        i2[4], j[4], v[4], t0[4], x3[4], y3[4], z3[4], s1j[4], zz[4];
+    for (int t = 0; t < 4; ++t) {
+        __mmask8 act = (__mmask8)~v_is_zero5(q[t].z);
+        __mmask8 pinf = v_is_zero5(p[t].z);
+        copy[t] = (__mmask8)(act & pinf);
+        gen[t] = (__mmask8)(act & ~pinf);
+    }
+    VJ4(v_mont_mul(z1z1[t], p[t].z, p[t].z, c52))
+    VJ4(v_mont_mul(z2z2[t], q[t].z, q[t].z, c52))
+    VJ4(v_mont_mul(u1[t], p[t].x, z2z2[t], c52))
+    VJ4(v_mont_mul(u2[t], q[t].x, z1z1[t], c52))
+    VJ4(v_mont_mul(s1[t], p[t].y, q[t].z, c52))
+    VJ4(v_mont_mul(s1[t], s1[t], z2z2[t], c52))
+    VJ4(v_mont_mul(s2[t], q[t].y, p[t].z, c52))
+    VJ4(v_mont_mul(s2[t], s2[t], z1z1[t], c52))
+    VJ4(v_sub_mod(h[t], u2[t], u1[t], c52))
+    VJ4(v_sub_mod(rr[t], s2[t], s1[t], c52))
+    VJ4(hz[t] = (__mmask8)(v_is_zero5(h[t]) & gen[t]))
+    VJ4(v_add_mod(rr[t], rr[t], rr[t], c52))
+    VJ4(v_add_mod(i2[t], h[t], h[t], c52))
+    VJ4(v_mont_mul(i2[t], i2[t], i2[t], c52))
+    VJ4(v_mont_mul(j[t], h[t], i2[t], c52))
+    VJ4(v_mont_mul(v[t], u1[t], i2[t], c52))
+    VJ4(v_mont_mul(x3[t], rr[t], rr[t], c52))
+    VJ4(v_sub_mod(x3[t], x3[t], j[t], c52))
+    VJ4(v_sub_mod(x3[t], x3[t], v[t], c52))
+    VJ4(v_sub_mod(x3[t], x3[t], v[t], c52))
+    VJ4(v_sub_mod(t0[t], v[t], x3[t], c52))
+    VJ4(v_mont_mul(t0[t], t0[t], rr[t], c52))
+    VJ4(v_mont_mul(s1j[t], s1[t], j[t], c52))
+    VJ4(v_add_mod(s1j[t], s1j[t], s1j[t], c52))
+    VJ4(v_sub_mod(y3[t], t0[t], s1j[t], c52))
+    VJ4(v_add_mod(zz[t], p[t].z, q[t].z, c52))
+    VJ4(v_mont_mul(zz[t], zz[t], zz[t], c52))
+    VJ4(v_sub_mod(zz[t], zz[t], z1z1[t], c52))
+    VJ4(v_sub_mod(zz[t], zz[t], z2z2[t], c52))
+    VJ4(v_mont_mul(z3[t], zz[t], h[t], c52))
+    for (int t = 0; t < 4; ++t) {
+        if (!hz[t]) continue;
+        for (int l = 0; l < 8; ++l) {
+            if (!((hz[t] >> l) & 1)) continue;
+            JacPoint pl, ql, res;
+            jac_from_lane(p[t], l, pl, c52, f);
+            jac_from_lane(q[t], l, ql, c52, f);
+            jac_add(res, pl, ql, f);
+            Jac8 tmp;
+            tmp.x = x3[t];
+            tmp.y = y3[t];
+            tmp.z = z3[t];
+            jac_to_lane(tmp, l, res, c52, f);
+            x3[t] = tmp.x;
+            y3[t] = tmp.y;
+            z3[t] = tmp.z;
+        }
+    }
+    for (int t = 0; t < 4; ++t) {
+        v_blend5(p[t].x, gen[t], x3[t]);
+        v_blend5(p[t].y, gen[t], y3[t]);
+        v_blend5(p[t].z, gen[t], z3[t]);
+        v_blend5(p[t].x, copy[t], q[t].x);
+        v_blend5(p[t].y, copy[t], q[t].y);
+        v_blend5(p[t].z, copy[t], q[t].z);
+    }
+}
+#undef VJ4
+
+// Batched independent affine pair sums: for each i < pairs, compute
+// entry[heads[i]] + entry[heads[i]+1] into S.pox/S.poy with
+// S.kind[i] ∈ {0 add, 1 doubling, 2 cancel-to-∞} — the batch-affine
+// primitive (dual den chains, one inversion per 4096-pair batch) with
+// the pairing and merge left to the caller. The multi-column kernel
+// drives this per bucket-range tile so a tile's entries stay
+// L2-resident across ALL its levels, where the monolithic
+// level_pass_ifma above (g1_msm's committed serial path, and the
+// oracle the multi kernel is measured against) re-streams the whole
+// working set once per level. Exact: per-pair dinv is exactly 1/den
+// regardless of batch grouping.
+static void affine_pairs_ifma(const FieldCtx &f, const Ctx52 &c52,
+                              const u64 *ax52, const u64 *ay52,
+                              long pairs, IfmaScratch &S) {
+    const long TILE = 4096;
+    std::vector<long> &heads = S.heads;
+    std::vector<Fp8> &prefv = S.prefv, &denv = S.denv, &axv = S.axv,
+                     &ayv = S.ayv, &bxv = S.bxv, &byv = S.byv;
+    std::vector<unsigned char> &kind = S.kind;
+    std::memset(kind.data(), 0, pairs);
+    u64 one52[5];
+    fp_to52(c52.c_in, one52);
+    const __m512i vzero = _mm512_setzero_si512();
+    std::vector<u64> &pox = S.pox, &poy = S.poy;
+
+    for (long tp0 = 0; tp0 < pairs; tp0 += TILE) {
+        const long tpairs = (TILE < pairs - tp0) ? TILE : pairs - tp0;
+        const long nblk = (tpairs + 7) / 8;
+        Fp8 run[2];
+        for (int ch = 0; ch < 2; ++ch)
+            for (int i = 0; i < 5; ++i)
+                run[ch].l[i] = _mm512_set1_epi64((long long)one52[i]);
+
+        // pass 1 (tile): gather head/tail coords, den = xB − xA,
+        // per-lane chains; saved state indexed tile-locally
+        for (long b = 0; b < nblk; ++b) {
+            const long p0 = tp0 + 8 * b;
+            int cnt = (int)((8 > tpairs - 8 * b) ? tpairs - 8 * b : 8);
+            alignas(64) long long hoff[8];
+            for (int l = 0; l < 8; ++l) {
+                long h = (l < cnt) ? heads[p0 + l] : heads[p0];
+                hoff[l] = 5 * h;
+            }
+            const __m512i hv = _mm512_load_si512((const void *)hoff);
+            const __m512i tv = _mm512_add_epi64(hv, _mm512_set1_epi64(5));
+            Fp8 Ax, Ay, Bx, By, den;
+            vgather5(Ax, ax52, hv);
+            vgather5(Ay, ay52, hv);
+            vgather5(Bx, ax52, tv);
+            vgather5(By, ay52, tv);
+            v_sub_mod(den, Bx, Ax, c52);
+            __m512i nz = den.l[0];
+            for (int i = 1; i < 5; ++i) nz = _mm512_or_si512(nz, den.l[i]);
+            __mmask8 zl = _mm512_cmpeq_epi64_mask(nz, vzero);
+            if (cnt < 8) zl = (__mmask8)(zl | (0xFF << cnt));
+            if (zl) {
+                u64 dl[5][8], ayl[5][8], byl[5][8];
+                for (int i = 0; i < 5; ++i) {
+                    _mm512_storeu_si512((void *)dl[i], den.l[i]);
+                    _mm512_storeu_si512((void *)ayl[i], Ay.l[i]);
+                    _mm512_storeu_si512((void *)byl[i], By.l[i]);
+                }
+                for (int l = 0; l < 8; ++l) {
+                    if (!((zl >> l) & 1)) continue;
+                    u64 t[5];
+                    if (l >= cnt) {
+                        std::memcpy(t, one52, 40);
+                    } else {
+                        Fp aY, bY, sy;
+                        u64 a5[5] = {ayl[0][l], ayl[1][l], ayl[2][l],
+                                     ayl[3][l], ayl[4][l]};
+                        u64 b5[5] = {byl[0][l], byl[1][l], byl[2][l],
+                                     byl[3][l], byl[4][l]};
+                        fp_from52(a5, aY);
+                        fp_from52(b5, bY);
+                        add_mod(sy, aY, bY, f);
+                        if (is_zero_fp(sy)) {
+                            kind[p0 + l] = 2;
+                            std::memcpy(t, one52, 40);
+                        } else {
+                            kind[p0 + l] = 1;
+                            Fp dd;
+                            add_mod(dd, aY, aY, f);
+                            fp_to52(dd, t);
+                        }
+                    }
+                    for (int i = 0; i < 5; ++i) dl[i][l] = t[i];
+                }
+                v_load_lanes(den, dl);
+            }
+            const int ch = (int)(b & 1);
+            prefv[b] = run[ch];
+            denv[b] = den;
+            axv[b] = Ax;
+            ayv[b] = Ay;
+            bxv[b] = Bx;
+            byv[b] = By;
+            v_mont_mul(run[ch], run[ch], den, c52);
+        }
+
+        // tile inversion: both chains' lane totals → ONE mont_inv
+        Fp8 inv_vec[2];
+        {
+            Fp lane_tot[16], pre[16], inv_lane[16];
+            u64 lanes[2][5][8];
+            for (int ch = 0; ch < 2; ++ch)
+                for (int i = 0; i < 5; ++i)
+                    _mm512_storeu_si512((void *)lanes[ch][i],
+                                        run[ch].l[i]);
+            for (int jj = 0; jj < 16; ++jj) {
+                int ch = jj >> 3, l = jj & 7;
+                u64 t[5] = {lanes[ch][0][l], lanes[ch][1][l],
+                            lanes[ch][2][l], lanes[ch][3][l],
+                            lanes[ch][4][l]};
+                from_w52(lane_tot[jj], t, c52, f);
+            }
+            Fp acc = f.one;
+            for (int jj = 0; jj < 16; ++jj) {
+                pre[jj] = acc;
+                mont_mul(acc, acc, lane_tot[jj], f);
+            }
+            Fp tinv;
+            mont_inv(tinv, acc, f);
+            for (int jj = 15; jj >= 0; --jj) {
+                mont_mul(inv_lane[jj], tinv, pre[jj], f);
+                mont_mul(tinv, tinv, lane_tot[jj], f);
+            }
+            u64 t[5];
+            for (int jj = 0; jj < 16; ++jj) {
+                int ch = jj >> 3, l = jj & 7;
+                to_w52(t, inv_lane[jj], c52, f);
+                for (int i = 0; i < 5; ++i) lanes[ch][i][l] = t[i];
+            }
+            for (int ch = 0; ch < 2; ++ch)
+                v_load_lanes(inv_vec[ch], lanes[ch]);
+        }
+
+        // pass 2 (tile, backward): unwind chains, evaluate the adds
+        for (long b = nblk - 1; b >= 0; --b) {
+            const long p0 = tp0 + 8 * b;
+            int cnt = (int)((8 > tpairs - 8 * b) ? tpairs - 8 * b : 8);
+            const int ch = (int)(b & 1);
+            Fp8 dinv, num;
+            v_mont_mul(dinv, inv_vec[ch], prefv[b], c52);
+            v_mont_mul(inv_vec[ch], inv_vec[ch], denv[b], c52);
+            const Fp8 &Ax = axv[b], &Ay = ayv[b];
+            const Fp8 &Bx = bxv[b], &By = byv[b];
+            v_sub_mod(num, By, Ay, c52);
+            bool patch = false;
+            for (int l = 0; l < cnt; ++l)
+                if (kind[p0 + l] == 1) patch = true;
+            if (patch) {
+                u64 lanes[5][8], axl[5][8];
+                for (int i = 0; i < 5; ++i) {
+                    _mm512_storeu_si512((void *)lanes[i], num.l[i]);
+                    _mm512_storeu_si512((void *)axl[i], Ax.l[i]);
+                }
+                for (int l = 0; l < cnt; ++l) {
+                    if (kind[p0 + l] != 1) continue;
+                    u64 a5[5] = {axl[0][l], axl[1][l], axl[2][l],
+                                 axl[3][l], axl[4][l]};
+                    Fp aX, sq, n3;
+                    fp_from52(a5, aX);
+                    mont_sqr(sq, aX, f);
+                    mont_mul(sq, sq, c52.c_out, f);
+                    add_mod(n3, sq, sq, f);
+                    add_mod(n3, n3, sq, f);
+                    u64 t[5];
+                    fp_to52(n3, t);
+                    for (int i = 0; i < 5; ++i) lanes[i][l] = t[i];
+                }
+                v_load_lanes(num, lanes);
+            }
+            Fp8 lam, x3, y3, t0;
+            v_mont_mul(lam, num, dinv, c52);
+            v_mont_mul(x3, lam, lam, c52);
+            v_sub_mod(x3, x3, Ax, c52);
+            v_sub_mod(x3, x3, Bx, c52);
+            v_sub_mod(t0, Ax, x3, c52);
+            v_mont_mul(y3, lam, t0, c52);
+            v_sub_mod(y3, y3, Ay, c52);
+            alignas(64) long long ooff[8];
+            for (int l = 0; l < 8; ++l)
+                ooff[l] = 5 * (p0 + ((l < cnt) ? l : cnt - 1));
+            const __m512i ov = _mm512_load_si512((const void *)ooff);
+            __mmask8 live = (__mmask8)((1u << cnt) - 1);
+            for (int i = 0; i < 5; ++i) {
+                _mm512_mask_i64scatter_epi64(
+                    pox.data(), live,
+                    _mm512_add_epi64(ov, _mm512_set1_epi64(i)), x3.l[i],
+                    8);
+                _mm512_mask_i64scatter_epi64(
+                    poy.data(), live,
+                    _mm512_add_epi64(ov, _mm512_set1_epi64(i)), y3.l[i],
+                    8);
+            }
+        }
+    }
+
+}
+
+// Bucket-weighted suffix telescope of ONE column's window, 32 groups
+// wide (8 IFMA lanes × 4 software-pipelined chain blocks): the
+// column's ``half`` buckets split into 32 contiguous groups whose
+// local telescopes (run += S_j; tot += run) are independent chains —
+// the parallelism a single serial Pippenger reduction cannot expose.
+// 8 lanes alone leave the loop bound on one chain's v_mont_mul
+// LATENCY; 4 chain blocks per step keep the multipliers fed. D is the
+// dense per-bucket sum array (10 u64 per bucket: x | y, w-domain),
+// ``bitmap`` its occupancy. Result (s-domain Jacobian) = Σ_b b·S_b.
+static void reduce_column_ifma(const FieldCtx &f, const Ctx52 &c52,
+                               const u64 *D, const u64 *bitmap,
+                               long half, JacPoint &out_sum) {
+    const long G = half / 32;
+    Jac8 run[4], tot[4];
+    const __m512i zero = _mm512_setzero_si512();
+    for (int t = 0; t < 4; ++t)
+        for (int i = 0; i < 5; ++i) {
+            run[t].x.l[i] = run[t].y.l[i] = run[t].z.l[i] = zero;
+            tot[t].x.l[i] = tot[t].y.l[i] = tot[t].z.l[i] = zero;
+        }
+    u64 one52[5];
+    fp_to52(c52.c_in, one52);
+    Fp8 onev;
+    for (int i = 0; i < 5; ++i)
+        onev.l[i] = _mm512_set1_epi64((long long)one52[i]);
+    for (long j = G; j >= 1; --j) {
+        __mmask8 present[4];
+        Fp8 sx[4], sy[4];
+        for (int t = 0; t < 4; ++t) {
+            alignas(64) long long off[8];
+            __mmask8 pm = 0;
+            for (int g = 0; g < 8; ++g) {
+                long b = (long)(t * 8 + g) * G + j;
+                if (bitmap[b >> 6] & (1ULL << (b & 63)))
+                    pm = (__mmask8)(pm | (1u << g));
+                off[g] = 10 * b;
+            }
+            present[t] = pm;
+            const __m512i ov = _mm512_load_si512((const void *)off);
+            vgather5_mask(sx[t], D, ov, pm);
+            vgather5_mask(sy[t], D + 5, ov, pm);
+        }
+        v_jac_add_mixed4(run, sx, sy, present, c52, f, onev);
+        v_jac_add4(tot, run, c52, f);
+    }
+    out_sum.z = Fp{{0, 0, 0, 0}};
+    for (int t = 0; t < 4; ++t)
+        for (int g = 0; g < 8; ++g) {
+            JacPoint tt, r;
+            jac_from_lane(tot[t], g, tt, c52, f);
+            jac_from_lane(run[t], g, r, c52, f);
+            jac_add(out_sum, out_sum, tt, f);
+            jac_add_small_mul(out_sum, r, (u64)((long)(t * 8 + g) * G),
+                              f);
+        }
+}
+
+// Sparse form of the telescope: walk only the OCCUPIED buckets (bitmap
+// scan, descending) with the gap-skipping serial chain — cheaper than
+// G masked vector steps when a window populated few buckets (small
+// columns, 0/1 selector columns).
+static void reduce_column_sparse_ifma(const FieldCtx &f, const Ctx52 &c52,
+                                      const u64 *D, const u64 *bitmap,
+                                      long half, JacPoint &out_sum) {
+    JacPoint running;
+    running.z = Fp{{0, 0, 0, 0}};
+    out_sum.z = Fp{{0, 0, 0, 0}};
+    long prev_b = half + 1;
+    for (long wq = half >> 6; wq >= 0; --wq) {
+        u64 bits = bitmap[wq];
+        while (bits) {
+            int hi = 63 - __builtin_clzll(bits);
+            bits &= ~(1ULL << hi);
+            long b = (wq << 6) + hi;
+            jac_add_small_mul(out_sum, running, (u64)(prev_b - b - 1), f);
+            AffPt q;
+            from_w52(q.x, &D[10 * b], c52, f);
+            from_w52(q.y, &D[10 * b + 5], c52, f);
+            jac_add_mixed(running, running, q, f);
+            jac_add(out_sum, out_sum, running, f);
+            prev_b = b;
+        }
+    }
+    jac_add_small_mul(out_sum, running, (u64)(prev_b - 1), f);
+}
+#endif  // PN_IFMA
+
+void g1_msm_multi(const u64 *mod_limbs, const u64 *bases,
+                  const u64 *scalars, const unsigned char *flips,
+                  long n, long K, u64 *out) {
+    FieldCtx f = make_ctx(mod_limbs);
+    if (n <= 0 || K <= 0) {
+        if (K > 0) std::memset(out, 0, 64 * (size_t)K);
+        return;
+    }
+#ifdef PN_IFMA
+    const bool use_ifma = !std::getenv("PN_NO_IFMA") && ifma_available() &&
+                          v_mul_selftest(f);
+    Ctx52 c52;
+    if (use_ifma) c52 = make_ctx52(f);
+#endif
+    int c = 4;
+    if (n > 32) c = 8;
+    if (n > 1024) c = 12;
+    if (n > 131072) c = 15;  // g1_msm's ladder (the r4 grid)
+    if (n > 600000) c = 16;  // the tiled levels + 32-chain vector
+                             // reduce move the multi optimum UP at
+                             // 2^20 (r8 grid on the IFMA box);
+                             // PN_MSM_C_MULTI / PN_MSM_C override
+    if (const char *cenv = std::getenv("PN_MSM_C_MULTI")) {
+        int cv = std::atoi(cenv);
+        if (cv >= 2 && cv <= 20) c = cv;
+    } else if (const char *cenv = std::getenv("PN_MSM_C")) {
+        int cv = std::atoi(cenv);
+        if (cv >= 2 && cv <= 20) c = cv;
+    }
+    const long half = 1L << (c - 1);
+    const int windows = (256 + c - 1) / c + 1;
+
+    std::vector<AffPt> pts(n);
+    std::vector<unsigned char> finite(n);
+    long n_finite = 0;
+    for (long i = 0; i < n; ++i) {
+        Fp x, y;
+        std::memcpy(x.v, bases + 8 * i, 32);
+        std::memcpy(y.v, bases + 8 * i + 4, 32);
+        bool inf = is_zero_fp(x) && is_zero_fp(y);
+        finite[i] = !inf;
+        if (!inf) {
+            to_mont(pts[i].x, x, f);
+            to_mont(pts[i].y, y, f);
+            ++n_finite;
+        }
+    }
+    std::vector<JacPoint> totals(K);
+    for (long k = 0; k < K; ++k) totals[k].z = Fp{{0, 0, 0, 0}};
+    if (!n_finite) {
+        std::memset(out, 0, 64 * (size_t)K);
+        return;
+    }
+
+    const bool dbg = std::getenv("PN_MSM_DEBUG") != nullptr;
+    long dbg_vec_cols = 0, dbg_scal_cols = 0;
+    double t_conv = 0, t_sort = 0, t_levels = 0, t_reduce = 0;
+    auto now = [] { return std::chrono::steady_clock::now(); };
+    auto secs = [](auto a, auto b) {
+        return std::chrono::duration<double>(b - a).count();
+    };
+    auto tc0 = now();
+
+#ifdef PN_IFMA
+    // shared per-point table, converted ONCE for all K columns:
+    // 15 w-domain limbs per point — x | y | −y (signed digits and
+    // per-column flips both index the negated copy)
+    std::unique_ptr<u64[]> p15;
+    if (use_ifma) {
+        p15.reset(new u64[15 * (size_t)n]);
+        for (long i = 0; i < n; ++i) {
+            if (!finite[i]) continue;
+            to_w52(&p15[15 * (size_t)i], pts[i].x, c52, f);
+            to_w52(&p15[15 * (size_t)i + 5], pts[i].y, c52, f);
+            Fp yn;
+            neg_mod(yn, pts[i].y, f);
+            to_w52(&p15[15 * (size_t)i + 10], yn, c52, f);
+        }
+    }
+#endif
+    t_conv += secs(tc0, now());
+
+    // columns are processed in chunks of KB — the window pass (recode,
+    // counting sort, levels) is shared WITHIN a chunk, while the base
+    // parse + w-domain conversion are amortized over ALL K columns.
+    // Measured on the r8 IFMA box, the cross-column sharing inside a
+    // window pass is NET NEGATIVE: the chunk·n working set's cache/TLB
+    // cost exceeds the shared-read win at every size tried (2^20 K=4
+    // aggregate: 1.59x at KB=1 vs 1.55x/1.52x at KB=2/4; K=8
+    // monolithic was 1.38x), so the default processes one column per
+    // window sweep and the K-column win comes from the shared
+    // conversions + the tiled levels + the vector reduce. PN_MSM_KB
+    // re-enables wider sharing for boxes where the balance differs.
+    long KB = 1;
+    if (const char *kbenv = std::getenv("PN_MSM_KB")) {
+        long kv = std::atol(kbenv);
+        if (kv >= 1 && kv <= 64) KB = kv;
+    }
+    if (KB > K) KB = K;
+    const size_t mcap = (size_t)n_finite * (size_t)KB;
+    std::unique_ptr<int32_t[]> abid_own, nbid_own;
+    int32_t *abid = nullptr, *nbid = nullptr;
+#ifdef PN_IFMA
+    std::unique_ptr<u64[]> x52_own, y52_own;
+    u64 *x52 = nullptr, *y52 = nullptr;
+    std::unique_ptr<u64[]> Dbuf, Dbitmap;
+    IfmaScratch ifma_scratch;
+    const bool vec_reduce_ok = use_ifma && half >= 256 &&
+                               (half % 32) == 0;
+    std::unique_ptr<long[]> bstart;
+    if (use_ifma) {
+        x52_own.reset(new u64[5 * mcap]);
+        y52_own.reset(new u64[5 * mcap]);
+        x52 = x52_own.get();
+        y52 = y52_own.get();
+        Dbuf.reset(new u64[10 * (size_t)(half + 1)]);
+        Dbitmap.reset(new u64[(size_t)(half >> 6) + 2]);
+        bstart.reset(new long[(size_t)KB * half + 2]);
+    }
+#endif
+    // Fp working set, allocated only when a scalar level/tail runs
+    std::unique_ptr<Fp[]> ax_own, ay_own, nx_own, ny_own;
+    Fp *ax = nullptr, *ay = nullptr, *nxp = nullptr, *nyp = nullptr;
+    auto ensure_fp = [&]() {
+        if (!ax_own) {
+            ax_own.reset(new Fp[mcap]);
+            ay_own.reset(new Fp[mcap]);
+            nx_own.reset(new Fp[mcap]);
+            ny_own.reset(new Fp[mcap]);
+            ax = ax_own.get();
+            ay = ay_own.get();
+            nxp = nx_own.get();
+            nyp = ny_own.get();
+        }
+    };
+    bool scalar_path = true;
+#ifdef PN_IFMA
+    scalar_path = !use_ifma;
+#endif
+    std::vector<unsigned char> role;
+    std::vector<Fp> dens, prefix;
+    if (scalar_path) {  // the Fp pairing-level machinery
+        ensure_fp();
+        abid_own.reset(new int32_t[mcap]);
+        nbid_own.reset(new int32_t[mcap]);
+        abid = abid_own.get();
+        nbid = nbid_own.get();
+        role.resize(mcap);
+        dens.reserve(mcap / 2 + 1);
+        prefix.reserve(mcap / 2 + 1);
+    }
+
+    std::vector<long> counts((size_t)KB * half + 1);
+    std::vector<unsigned char> carry((size_t)KB * n);
+    std::unique_ptr<int32_t[]> dcur(new int32_t[(size_t)KB * n]);
+
+    // windows ascend (the on-the-fly recode's carries flow LSB→MSB);
+    // each window total joins its column shifted by c·w doublings —
+    // a few thousand doublings per call, noise next to the levels
+    for (long k0 = 0; k0 < K; k0 += KB) {
+    const long Kc = (KB < K - k0) ? KB : K - k0;
+    std::memset(carry.data(), 0, (size_t)Kc * n);
+    for (int w = 0; w < windows; ++w) {
+        auto ts0 = now();
+        std::fill(counts.begin(), counts.end(), 0);
+        long m = 0;
+        const long bit0 = (long)w * c;
+        for (long k = 0; k < Kc; ++k) {
+            const u64 *sc = scalars + 4 * (size_t)n * (k0 + k);
+            unsigned char *cy = &carry[(size_t)k * n];
+            int32_t *dk = &dcur[(size_t)k * n];
+            for (long i = 0; i < n; ++i) {
+                if (!finite[i]) {
+                    dk[i] = 0;
+                    continue;
+                }
+                u64 raw = 0;
+                if (bit0 < 256) {
+                    int word = (int)(bit0 / 64), off = (int)(bit0 % 64);
+                    raw = sc[4 * i + word] >> off;
+                    if (off && word + 1 < 4)
+                        raw |= sc[4 * i + word + 1] << (64 - off);
+                    raw &= ((u64)1 << c) - 1;
+                }
+                raw += cy[i];
+                int32_t d;
+                if (raw > (u64)half) {
+                    d = (int32_t)raw - (int32_t)(1L << c);
+                    cy[i] = 1;
+                } else {
+                    d = (int32_t)raw;
+                    cy[i] = 0;
+                }
+                dk[i] = d;
+                if (d) {
+                    ++counts[(size_t)k * half + (d < 0 ? -d : d)];
+                    ++m;
+                }
+            }
+        }
+        if (!m) continue;
+        long acc_off = 0;
+        for (long b = 1; b <= (long)Kc * half; ++b) {
+            long cnt = counts[b];
+            counts[b] = acc_off;
+            acc_off += cnt;
+        }
+#ifdef PN_IFMA
+        if (use_ifma) {
+            // bucket start offsets (pre-placement prefix) + sentinel —
+            // the tiled levels and the dense reduce read ranges from
+            // here instead of carrying a per-entry bucket-id array
+            std::memcpy(bstart.get(), counts.data(),
+                        sizeof(long) * ((size_t)Kc * half + 1));
+            bstart[(size_t)Kc * half + 1] = m;
+        }
+#endif
+        // placement, i-outer / k-inner: ONE walk of the shared base
+        // table covers all K columns' bucket placements — point i's
+        // coordinates are read once and feed up to K placements while
+        // they sit in L1 (the amortized gather; serial calls re-stream
+        // the whole table once per column per window).
+        for (long i = 0; i < n; ++i) {
+            if (!finite[i]) continue;
+#ifdef PN_IFMA
+            const u64 *src = use_ifma ? &p15[15 * (size_t)i] : nullptr;
+#endif
+            for (long k = 0; k < Kc; ++k) {
+                int32_t d = dcur[(size_t)k * n + i];
+                if (!d) continue;
+                long b = d < 0 ? -d : d;
+                long pos = counts[(size_t)k * half + b]++;
+                int neg = d < 0;
+                if (flips && flips[(size_t)(k0 + k) * n + i]) neg ^= 1;
+#ifdef PN_IFMA
+                if (use_ifma) {
+                    std::memcpy(&x52[5 * (size_t)pos], src, 40);
+                    std::memcpy(&y52[5 * (size_t)pos],
+                                src + 5 + 5 * neg, 40);
+                    continue;
+                }
+#endif
+                abid[pos] = (int32_t)((size_t)k * half + b);
+                ax[pos] = pts[i].x;
+                if (neg) neg_mod(ay[pos], pts[i].y, f);
+                else ay[pos] = pts[i].y;
+            }
+        }
+        t_sort += secs(ts0, now());
+
+#ifdef PN_IFMA
+        if (use_ifma) {
+            // Per-column bucket-range-tiled levels: a tile of TB
+            // buckets' entries (~TB·avg-count rows, ~1-2 MB dense)
+            // stays cache-resident across ALL of its pairing levels —
+            // a monolithic level pass re-streams the whole K·n
+            // working set once per level instead. Survivors drop
+            // straight into the dense per-bucket array D that feeds
+            // the 32-group vector telescope; no per-entry bucket-id
+            // array, no merge pass, no ping-pong copies.
+            const long TB = 256;
+            std::vector<long> bloc(TB), bcnt(TB);
+            u64 *D = Dbuf.get();
+            for (long k = 0; k < Kc; ++k) {
+                auto tl0 = now();
+                const size_t kbase = (size_t)k * half;
+                std::memset(Dbitmap.get(), 0,
+                            8 * ((size_t)(half >> 6) + 2));
+                long occ = 0;
+                for (long tb0 = 0; tb0 < half; tb0 += TB) {
+                    const long nb = (TB < half - tb0) ? TB : half - tb0;
+                    const long tstart = bstart[kbase + tb0 + 1];
+                    const long tend = bstart[kbase + tb0 + nb + 1];
+                    if (tend == tstart) continue;
+                    for (long t = 0; t < nb; ++t) {
+                        bloc[t] = bstart[kbase + tb0 + t + 1];
+                        bcnt[t] = bstart[kbase + tb0 + t + 2] - bloc[t];
+                    }
+                    ifma_scratch.ensure((tend - tstart) / 2 + 8);
+                    while (true) {
+                        long pairs = 0;
+                        for (long t = 0; t < nb; ++t) {
+                            long pb = bcnt[t] >> 1;
+                            for (long j2 = 0; j2 < pb; ++j2)
+                                ifma_scratch.heads[pairs + j2] =
+                                    bloc[t] + 2 * j2;
+                            pairs += pb;
+                        }
+                        if (!pairs) break;
+                        affine_pairs_ifma(f, c52, x52, y52, pairs,
+                                          ifma_scratch);
+                        // bucket-aware in-place compaction: survivors
+                        // (pair sums + odd tails) pack forward; writes
+                        // never pass reads (survivors ≤ entries)
+                        long pi = 0, wr = bloc[0];
+                        for (long t = 0; t < nb; ++t) {
+                            const long cnt = bcnt[t], pb = cnt >> 1;
+                            const long ns = wr;
+                            for (long j2 = 0; j2 < pb; ++j2, ++pi) {
+                                if (ifma_scratch.kind[pi] == 2)
+                                    continue;
+                                std::memcpy(&x52[5 * wr],
+                                            &ifma_scratch.pox[5 * pi],
+                                            40);
+                                std::memcpy(&y52[5 * wr],
+                                            &ifma_scratch.poy[5 * pi],
+                                            40);
+                                ++wr;
+                            }
+                            if (cnt & 1) {
+                                long src2 = bloc[t] + cnt - 1;
+                                if (src2 != wr) {
+                                    std::memcpy(&x52[5 * wr],
+                                                &x52[5 * src2], 40);
+                                    std::memcpy(&y52[5 * wr],
+                                                &y52[5 * src2], 40);
+                                }
+                                ++wr;
+                            }
+                            bloc[t] = ns;
+                            bcnt[t] = wr - ns;
+                        }
+                    }
+                    for (long t = 0; t < nb; ++t) {
+                        if (!bcnt[t]) continue;
+                        long b = tb0 + t + 1;
+                        std::memcpy(&D[10 * b], &x52[5 * bloc[t]], 40);
+                        std::memcpy(&D[10 * b + 5], &y52[5 * bloc[t]],
+                                    40);
+                        Dbitmap[b >> 6] |= 1ULL << (b & 63);
+                        ++occ;
+                    }
+                }
+                t_levels += secs(tl0, now());
+                auto tr0 = now();
+                if (occ) {
+                    JacPoint sum;
+                    if (vec_reduce_ok && occ * 4 >= half) {
+                        reduce_column_ifma(f, c52, D, Dbitmap.get(),
+                                           half, sum);
+                        if (dbg) ++dbg_vec_cols;
+                    } else {
+                        reduce_column_sparse_ifma(f, c52, D,
+                                                  Dbitmap.get(), half,
+                                                  sum);
+                        if (dbg) ++dbg_scal_cols;
+                    }
+                    if (!is_zero_fp(sum.z)) {
+                        // shift into place: window w weighs 2^{c·w}
+                        for (long d2 = 0; d2 < (long)c * w; ++d2)
+                            jac_double(sum, sum, f);
+                        jac_add(totals[k0 + k], totals[k0 + k], sum, f);
+                    }
+                }
+                t_reduce += secs(tr0, now());
+            }
+            continue;  // next window
+        }
+#endif
+
+        auto tl0 = now();
+        // scalar fallback (no IFMA): level-by-level batch-affine
+        // segment sums over ALL K columns at once (bucket keys are
+        // column-disjoint, so segments never cross columns and one
+        // inversion serves K columns' pairs)
+        while (true) {
+            long pairs = 0;
+            for (long i = 0; i < m;) {
+                if (i + 1 < m && abid[i + 1] == abid[i]) {
+                    role[i] = 1;
+                    role[i + 1] = 2;
+                    ++pairs;
+                    i += 2;
+                } else {
+                    role[i] = 0;
+                    ++i;
+                }
+            }
+            if (!pairs) break;
+            dens.clear();
+            prefix.clear();
+            Fp run = f.one;
+            std::vector<unsigned char> kind;
+            kind.reserve(pairs);
+            for (long i = 0; i < m; ++i) {
+                if (role[i] != 1) continue;
+                Fp d;
+                sub_mod(d, ax[i + 1], ax[i], f);
+                if (is_zero_fp(d)) {
+                    Fp sy;
+                    add_mod(sy, ay[i], ay[i + 1], f);
+                    if (is_zero_fp(sy)) {
+                        kind.push_back(2);
+                        d = f.one;
+                    } else {
+                        kind.push_back(1);
+                        add_mod(d, ay[i], ay[i], f);
+                    }
+                } else kind.push_back(0);
+                dens.push_back(d);
+                prefix.push_back(run);
+                mont_mul(run, run, d, f);
+            }
+            Fp inv;
+            mont_inv(inv, run, f);
+            long n_out = m - pairs;
+            for (long pi = 0; pi < pairs; ++pi)
+                if (kind[pi] == 2) --n_out;
+            long write = n_out;
+            long pi = pairs - 1;
+            for (long i = m - 1; i >= 0; --i) {
+                if (role[i] == 2) continue;
+                if (role[i] == 1) {
+                    Fp dinv;
+                    mont_mul(dinv, inv, prefix[pi], f);
+                    mont_mul(inv, inv, dens[pi], f);
+                    if (kind[pi] != 2) {
+                        long a = i, b = i + 1;
+                        Fp lam, num, x3, y3;
+                        if (kind[pi] == 1) {
+                            mont_sqr(num, ax[a], f);
+                            Fp n3;
+                            add_mod(n3, num, num, f);
+                            add_mod(num, n3, num, f);
+                        } else {
+                            sub_mod(num, ay[b], ay[a], f);
+                        }
+                        mont_mul(lam, num, dinv, f);
+                        mont_sqr(x3, lam, f);
+                        sub_mod(x3, x3, ax[a], f);
+                        sub_mod(x3, x3, ax[b], f);
+                        sub_mod(y3, ax[a], x3, f);
+                        mont_mul(y3, y3, lam, f);
+                        sub_mod(y3, y3, ay[a], f);
+                        --write;
+                        nxp[write] = x3;
+                        nyp[write] = y3;
+                        nbid[write] = abid[i];
+                    }
+                    --pi;
+                } else {
+                    --write;
+                    nxp[write] = ax[i];
+                    nyp[write] = ay[i];
+                    nbid[write] = abid[i];
+                }
+            }
+            m = n_out;
+            std::swap(ax, nxp);
+            std::swap(ay, nyp);
+            std::swap(abid, nbid);
+        }
+        t_levels += secs(tl0, now());
+
+        auto tr0 = now();
+        // per-column bucket reduction (scalar path): survivors sit
+        // ascending by (column, bucket); walk columns from the top
+        // with the gap-skipping serial telescope.
+        long i_top = m - 1;
+        for (long k = Kc - 1; k >= 0; --k) {
+            const long base = k * half;
+            long lo = i_top;
+            while (lo >= 0 && abid[lo] > base) --lo;
+            // column k's survivors are (lo, i_top]
+            if (lo == i_top) continue;
+            JacPoint sum;
+            sum.z = Fp{{0, 0, 0, 0}};
+            JacPoint running;
+            running.z = Fp{{0, 0, 0, 0}};
+            long prev_b = half + 1;
+            for (long i = i_top; i > lo; --i) {
+                long b = abid[i] - base;
+                jac_add_small_mul(sum, running, (u64)(prev_b - b - 1),
+                                  f);
+                AffPt q;
+                q.x = ax[i];
+                q.y = ay[i];
+                jac_add_mixed(running, running, q, f);
+                jac_add(sum, sum, running, f);
+                prev_b = b;
+            }
+            jac_add_small_mul(sum, running, (u64)(prev_b - 1), f);
+            if (dbg) ++dbg_scal_cols;
+            if (!is_zero_fp(sum.z)) {
+                // shift into place: window w weighs 2^{c·w}
+                for (long d = 0; d < (long)c * w; ++d)
+                    jac_double(sum, sum, f);
+                jac_add(totals[k0 + k], totals[k0 + k], sum, f);
+            }
+            i_top = lo;
+        }
+        t_reduce += secs(tr0, now());
+    }
+    }  // column chunk
+
+    if (dbg) {
+#ifdef PN_IFMA
+        std::fprintf(stderr, "g1_msm_multi ifma=%d\n", (int)use_ifma);
+#endif
+        std::fprintf(stderr,
+                     "g1_msm_multi n=%ld K=%ld c=%d: conv %.2fs sort "
+                     "%.2fs levels %.2fs reduce %.2fs\n",
+                     n, K, c, t_conv, t_sort, t_levels, t_reduce);
+        std::fprintf(stderr,
+                     "g1_msm_multi reduce: vec_cols=%ld scal_cols=%ld\n",
+                     dbg_vec_cols, dbg_scal_cols);
+    }
+
+    for (long k = 0; k < K; ++k) {
+        u64 *ok = out + 8 * (size_t)k;
+        if (is_zero_fp(totals[k].z)) {
+            std::memset(ok, 0, 64);
+            continue;
+        }
+        Fp zinv, zinv2, zinv3, axx, ayy;
+        mont_inv(zinv, totals[k].z, f);
+        mont_sqr(zinv2, zinv, f);
+        mont_mul(zinv3, zinv2, zinv, f);
+        mont_mul(axx, totals[k].x, zinv2, f);
+        mont_mul(ayy, totals[k].y, zinv3, f);
+        from_mont(axx, axx, f);
+        from_mont(ayy, ayy, f);
+        std::memcpy(ok, axx.v, 32);
+        std::memcpy(ok + 4, ayy.v, 32);
+    }
 }
 
 // Many scalar multiples of ONE fixed affine base: out[i] = scalars[i]·B.

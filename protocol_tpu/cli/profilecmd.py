@@ -153,6 +153,60 @@ def run_delta_workload(n: int = 4000, m: int = 4, batches: int = 10,
             "partial_sweeps": None if res is None else res.sweeps}
 
 
+def run_commits_workload(k: int = 13, columns: int = 8,
+                         seed: int = 23) -> dict:
+    """The commit engine in isolation at a size where the MSM is the
+    cost: one batched flush of ``columns`` Lagrange-basis eval columns
+    and one of SRS coefficient columns at 2^k, stage-attributed as
+    ``commit.bench_evals`` / ``commit.bench_coeffs`` (batched label
+    from the engine). One column of each batch is re-committed through
+    the serial oracle and compared, so the gate can never lock in a
+    fast-but-wrong batch. tools/perf_gate.py's ``commits`` workload
+    gates these stages against the committed baseline."""
+    import random
+
+    import numpy as np
+
+    from .. import native
+    from ..utils.fields import BN254_FR_MODULUS as R
+    from ..zk import prover_fast as pf
+    from ..zk.commit_engine import CommitEngine
+
+    if not native.available():
+        raise EigenError("config_error",
+                         "the commits workload needs the native "
+                         "toolchain")
+    params = pf.setup_params_fast(k, seed=b"commit-bench")
+    rng = random.Random(seed)
+    n = 1 << k
+    blob = np.frombuffer(
+        rng.getrandbits(8 * 32 * n * columns).to_bytes(
+            32 * n * columns, "little"),
+        dtype="<u8").reshape(columns, n, 4).copy()
+    blob[:, :, 3] &= (1 << 59) - 1  # keep scalars < R
+    eng = CommitEngine(params)
+    with pf._stage("commit.bench_evals", k, "host",
+                   labels=eng.stage_labels()):
+        for i in range(columns):
+            eng.submit_evals(f"col{i}", blob[i])
+        eval_pts = eng.flush()
+    with pf._stage("commit.bench_coeffs", k, "host",
+                   labels=eng.stage_labels()):
+        for i in range(columns):
+            eng.submit_coeffs(f"col{i}", blob[i])
+        coeff_pts = eng.flush()
+    if eval_pts[0] != pf._msm_signed(pf.lagrange_limbs(params), blob[0]):
+        raise EigenError("internal_error",
+                         "batched eval commit diverged from the serial "
+                         "oracle")
+    if coeff_pts[-1] != pf.commit_limbs(params, blob[-1]):
+        raise EigenError("internal_error",
+                         "batched coeff commit diverged from the "
+                         "serial oracle")
+    return {"workload": "commits", "k": k, "columns": columns,
+            "batched": eng.batching}
+
+
 def run_proofs_workload(k: int = 7, gates: int = 64, jobs: int = 6,
                         workers: int = 2, seed: int = 7) -> dict:
     """Real host-path proves through a ``workers``-worker ProofWorkerPool

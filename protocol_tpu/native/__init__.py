@@ -14,6 +14,7 @@ Data layout at the boundary: little-endian 4×uint64 limb arrays
 from __future__ import annotations
 
 import ctypes
+import json
 import os
 import subprocess
 import threading
@@ -78,6 +79,9 @@ def _load():
             # symbol set does not match this source revision
             _build_failed = True
             return None
+        # per-box MSM window tune: env writes happen HERE, under the
+        # loader lock, before any caller can be inside a native getenv
+        _apply_msm_tuning_locked()
         _lib = lib
         return _lib
 
@@ -92,6 +96,9 @@ def _bind(lib, u64p) -> None:
                                    ctypes.c_long, u64p, u64p]
     lib.batch_inverse.argtypes = [u64p, u64p, ctypes.c_long]
     lib.g1_msm.argtypes = [u64p, u64p, u64p, ctypes.c_long, u64p]
+    lib.g1_msm_multi.argtypes = [u64p, u64p, u64p,
+                                 ctypes.POINTER(ctypes.c_uint8),
+                                 ctypes.c_long, ctypes.c_long, u64p]
     lib.perm_grand_product.argtypes = [u64p, u64p, ctypes.c_int, u64p,
                                        u64p, u64p, u64p, u64p,
                                        ctypes.c_long, u64p]
@@ -158,6 +165,58 @@ def _scalar(v: int) -> np.ndarray:
     return ints_to_limbs([v])
 
 
+# --- per-box MSM window tune ----------------------------------------------
+
+_tune_applied = False
+
+
+def apply_msm_tuning() -> int | None:
+    """One-time application of the cached per-box Pippenger window size
+    (``<assets>/msm_tune.json``, written by ``tools/probe_msm_prims.py
+    --tune`` — the r4 manual c=16→15 retune, mechanized). An explicit
+    ``PN_MSM_C`` env always wins; without a cache file the kernel's
+    built-in ladder stands. Applied automatically when the library
+    first LOADS, inside the loader lock — mutating ``os.environ``
+    while another thread sits in native ``getenv`` (pool workers run
+    MSMs concurrently with the GIL released) is undefined behavior in
+    glibc, so the env writes must land before any native call can be
+    in flight. Returns the applied c, if any.
+
+    The assets dir resolves like ``cli.fs.assets_dir``'s env tier:
+    ``EIGEN_ASSETS`` or ``./assets`` (a ``--assets`` CLI flag exports
+    the env before proving starts)."""
+    with _lock:
+        return _apply_msm_tuning_locked()
+
+
+def _apply_msm_tuning_locked() -> int | None:
+    global _tune_applied
+    if _tune_applied:
+        return None
+    _tune_applied = True
+    if os.environ.get("PN_MSM_C") or os.environ.get("PN_MSM_C_MULTI"):
+        return None  # explicit override preserved
+    path = Path(os.environ.get("EIGEN_ASSETS", "assets")) / "msm_tune.json"
+    try:
+        data = json.loads(path.read_text())
+        c = int(data["c"])
+    except (OSError, ValueError, KeyError, TypeError):
+        return None
+    applied = None
+    if 2 <= c <= 20:
+        os.environ["PN_MSM_C"] = str(c)
+        applied = c
+    # the multi kernel's optimum can sit one window size up (its
+    # vector reduce repriced the bucket count — see g1_msm_multi)
+    try:
+        cm = int(data.get("c_multi", 0))
+    except (ValueError, TypeError):
+        cm = 0
+    if 2 <= cm <= 20:
+        os.environ["PN_MSM_C_MULTI"] = str(cm)
+    return applied
+
+
 def g1_msm(base_modulus: int, bases: np.ndarray, scalars: np.ndarray):
     """Pippenger MSM. Point arithmetic runs over the curve's BASE field
     (``base_modulus`` — Fq for BN254 G1); scalars are plain 256-bit
@@ -175,6 +234,48 @@ def g1_msm(base_modulus: int, bases: np.ndarray, scalars: np.ndarray):
     if vals[0] == 0 and vals[1] == 0:
         return None
     return (vals[0], vals[1])
+
+
+def g1_msm_multi(base_modulus: int, bases: np.ndarray,
+                 scalars: np.ndarray, flips: np.ndarray | None = None
+                 ) -> list:
+    """K-column MSM sharing ONE signed-digit window pass: per column k,
+    out[k] = Σᵢ scalars[k, i]·bases[i] — bit-exact with K serial
+    :func:`g1_msm` calls, but the base parse/Montgomery conversion, the
+    window counting sorts and the batch-affine inversion levels are
+    amortized across the K columns (native ``g1_msm_multi``; see the
+    kernel comment for the full cost model). bases: (n, 8) affine
+    standard form (zeros = identity); scalars: (K, n, 4); ``flips``
+    ((K, n) uint8, optional) negates base i's y for column k only —
+    the scalar-balancing hook. Returns K affine points (None =
+    identity)."""
+    lib = _load()
+    if lib is None:
+        raise RuntimeError("native library unavailable")
+    bases = np.ascontiguousarray(bases)
+    scalars = np.ascontiguousarray(scalars)
+    if scalars.ndim != 3 or scalars.shape[2] != 4:
+        raise ValueError("scalars must be (K, n, 4)")
+    kcols, n = scalars.shape[0], scalars.shape[1]
+    if n != len(bases):
+        raise ValueError("scalar columns do not match the base count")
+    if kcols > 64:
+        raise ValueError("g1_msm_multi is capped at 64 columns per call")
+    fptr = None
+    if flips is not None:
+        flips = np.ascontiguousarray(flips, dtype=np.uint8)
+        if flips.shape != (kcols, n):
+            raise ValueError("flips must be (K, n)")
+        fptr = flips.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8))
+    out = np.empty((kcols, 8), dtype="<u8")
+    lib.g1_msm_multi(_ptr(_scalar(base_modulus)), _ptr(bases),
+                     _ptr(scalars), fptr, n, kcols, _ptr(out))
+    vals = limbs_to_ints(out.reshape(-1, 4))
+    points = []
+    for k in range(kcols):
+        x, y = vals[2 * k], vals[2 * k + 1]
+        points.append(None if x == 0 and y == 0 else (x, y))
+    return points
 
 
 def g1_fixed_base_muls(base_modulus: int, base_pt, scalars: np.ndarray

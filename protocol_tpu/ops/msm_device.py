@@ -58,20 +58,31 @@ SCALAR_BITS = 264  # full 22×12-bit limb coverage
 def _seg_scan_add(ctx, pts, seg):
     """Segmented inclusive scan under the group law: pts is a Jacobian
     triple of (n, L) arrays sorted by segment key ``seg``; each output
-    position holds the running sum of its segment's prefix."""
+    position holds the running sum of its segment's prefix.
+
+    The log2(n) Hillis-Steele steps run as ONE ``fori_loop`` body with
+    a dynamic shift (gather + validity mask) instead of a Python-
+    unrolled chain of ``_add`` graphs: the unrolled form put ~log2(n)
+    copies of the full group-law graph into ``_window_contrib`` and
+    XLA compiles of the skeleton ran to many minutes — on the CPU
+    fallback AND the chip. Identical math (a shifted-in zero row is
+    the same infinity the old zero-concatenate produced)."""
     n = seg.shape[0]
-    off = 1
-    while off < n:
+    steps = max(1, (n - 1).bit_length())
+    idx = jnp.arange(n)
+
+    def body(i, cur):
+        off = jnp.left_shift(jnp.int32(1), i)
+        src = idx - off
+        valid = src >= 0
+        srcc = jnp.maximum(src, 0)
         shifted = tuple(
-            jnp.concatenate([jnp.zeros((off, NUM_LIMBS), jnp.int32),
-                             p[:-off]])
-            for p in pts)
-        seg_shift = jnp.concatenate(
-            [jnp.full((off,), -1, seg.dtype), seg[:-off]])
-        summed = _add(ctx, pts, shifted)
-        pts = _select(seg == seg_shift, summed, pts)
-        off *= 2
-    return pts
+            jnp.where(valid[:, None], p[srcc], 0) for p in cur)
+        seg_shift = jnp.where(valid, seg[srcc], -1)
+        summed = _add(ctx, cur, shifted)
+        return _select(seg == seg_shift, summed, cur)
+
+    return lax.fori_loop(0, steps, body, pts)
 
 
 @partial(jax.jit, static_argnames=("c",))
@@ -124,13 +135,28 @@ def _combine(acc, tot, c: int):
     return _add(CTX_Q, acc, tot)
 
 
-def msm_device(points, scalars, c: int = 4):
+def msm_device(points, scalars, c: int = 4, scalar_bits: int | None = None,
+               affine: bool = True):
     """Σ scalars[i]·points[i] over BN254 G1 on the device.
 
     points: [(x, y)] affine int pairs (no identities); scalars: ints.
-    Returns an affine (x, y) int pair, or None for the identity."""
+    Returns an affine (x, y) int pair, or None for the identity.
+
+    ``scalar_bits`` bounds the window sweep when every scalar is known
+    small (selector/0-1 columns — the host Pippenger skips empty
+    windows the same way; raises if a scalar exceeds the bound).
+    ``affine=False`` returns the raw Jacobian (x, y, z) ints instead of
+    normalizing on device — the in-graph Fermat inversion is ~254
+    sequential muls, which the tiny tier-1 CPU parity case (the r5
+    kill's executable witness) verifies host-side instead."""
     if 12 % c:
         raise ValueError("window size must divide the 12-bit limb")
+    nbits = SCALAR_BITS if scalar_bits is None else int(scalar_bits)
+    if scalar_bits is not None:
+        for s in scalars:
+            if int(s) >> nbits:
+                raise ValueError(
+                    f"scalar exceeds the {nbits}-bit window bound")
     ctx = CTX_Q
     k = len(points)
     xs = to_mont(ctx, jnp.asarray(to_limbs([p[0] for p in points])))
@@ -139,12 +165,15 @@ def msm_device(points, scalars, c: int = 4):
     s_pl = jnp.asarray(to_limbs([int(s) for s in scalars]))
 
     acc = (jnp.zeros((1, NUM_LIMBS), jnp.int32),) * 3  # ∞
-    for w in range(SCALAR_BITS // c - 1, -1, -1):
+    for w in range((nbits + c - 1) // c - 1, -1, -1):
         tot = _window_contrib(xs, ys, one, s_pl, w, c)
         acc = _combine(acc, tot, c)
 
     if not bool(np.asarray(~_is_zero_row(acc[2]))[0]):
         return None
+    if not affine:
+        return tuple(from_limbs(np.asarray(from_mont(ctx, a)))[0]
+                     for a in acc)
     ax, ay = _to_affine(ctx, acc)
     x = from_limbs(np.asarray(from_mont(ctx, ax)))[0]
     y = from_limbs(np.asarray(from_mont(ctx, ay)))[0]

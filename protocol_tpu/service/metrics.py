@@ -88,9 +88,15 @@ HISTOGRAM_FAMILIES = {
     "http_request_seconds": ("endpoint", "status"),
     # the worker label lands only on series observed inside a pool
     # worker context (trace.worker_context) — batch-CLI proves keep
-    # the shorter label set; cardinality is bounded by the device count
-    "prover_stage_seconds": ("stage", "k", "path", "worker"),
+    # the shorter label set; cardinality is bounded by the device
+    # count. ``batched`` lands only on the commit.* stages (the commit
+    # engine's on/off dimension).
+    "prover_stage_seconds": ("stage", "k", "path", "worker", "batched"),
     "prover_total_seconds": ("k", "path", "worker"),
+    # columns per MSM batch (a size histogram, not seconds): the
+    # commit engine's grouping evidence — p50 near 1 means the engine
+    # is running but nothing batches (grouping regression)
+    "commit_batch_size": ("bases",),
     "converge_sweep_seconds": ("backend",),
     "routed_plan_build_seconds": (),
     "operator_delta_seconds": ("kind",),
@@ -118,7 +124,12 @@ def declare_instruments() -> None:
     a zero default series only once touched — so the counters are
     touched with a no-op ``inc(0)`` here (monotonicity unaffected)."""
     for name in HISTOGRAM_FAMILIES:
-        trace.histogram(name)
+        # commit_batch_size counts columns, not seconds — its buckets
+        # are integers; creation sites must agree (first one wins)
+        trace.histogram(name,
+                        buckets=(trace.COMMIT_BATCH_BUCKETS
+                                 if name == "commit_batch_size"
+                                 else None))
     for name in DECLARED_COUNTERS:
         trace.counter(name).inc(0.0)
     for name in DECLARED_GAUGES:

@@ -79,6 +79,13 @@ DURATION_WINDOW_CAP = 512
 # given series has identical bucket boundaries.
 DEFAULT_BUCKETS = tuple(10.0 ** (e / 2.0) for e in range(-8, 5))
 
+# ptpu_commit_batch_size counts COLUMNS per MSM batch, not seconds —
+# integer buckets sized to the commit engine's grouping (K ≤ 16 per
+# g1_msm_multi call). Every creation site must pass these (buckets are
+# fixed at first registration): the commit engine and
+# service/metrics.py declare_instruments.
+COMMIT_BATCH_BUCKETS = (1.0, 2.0, 3.0, 4.0, 6.0, 8.0, 12.0, 16.0, 32.0)
+
 
 def _label_key(labels: dict) -> tuple:
     """Canonical (sorted, stringified) label identity for one series."""

@@ -1,0 +1,331 @@
+"""Batched multi-column commit engine for the PLONK provers.
+
+BASELINE.md r4 pins the prover's remaining wall to the commit path:
+~30 s of serial host dense-MSM commits in a 62 s warm k=20 device
+prove, ~8 s × ~8 dense columns at the k=21 flagship — every column an
+independent ``native.g1_msm`` call that re-parses, re-converts and
+re-streams the SAME base array (SRS or Lagrange powers) window by
+window. This module is the scheduler over the measurement-informed fix:
+
+- **Batching**: commit columns are submitted as (label, bases, scalars)
+  work items; columns over the same bases with the same length group
+  into ONE ``native.g1_msm_multi`` call — base parse and Montgomery/
+  w-domain conversion amortized across the K columns, with the kernel's
+  bucket-range-tiled batch-affine levels and 32-chain vector bucket
+  reduction doing the per-column heavy lifting (bit-exact per column vs
+  K serial ``g1_msm`` calls; BENCH_r08 holds the speedup curve and the
+  measured finding that sharing INSIDE one window pass is net-negative
+  on this box — ``PN_MSM_KB`` re-enables it).
+- **Download/commit overlap**: items may carry a ``fetch`` callable
+  instead of materialized scalars (device→host chunk downloads, opening
+  folds). ``flush()`` runs fetches on one background thread, in
+  submission order, and greedily batches whatever columns are READY
+  while the native MSM (which releases the GIL) chews the previous
+  batch — the generic form of the one-off t-chunk downloader thread it
+  replaces.
+- **Ordering**: ``flush()`` returns points in SUBMISSION order and the
+  caller absorbs them into the transcript there — points may be
+  computed out of order but are absorbed in order, so proofs are
+  byte-identical with the engine on or off (tested for both prove
+  paths).
+- **Device seam**: ``PTPU_MSM_DEVICE=1`` routes every column through
+  ``ops.msm_device.msm_device`` — the sorted-prefix device MSM the r5
+  chip probes killed on THIS hardware stays re-litigable on real TPU
+  silicon with zero code changes (see BASELINE.md "Why the MSM stays
+  on the host").
+
+Knobs: ``PTPU_COMMIT_ENGINE=0`` disables batching (serial per-column
+oracle path, same scheduling surface); ``PTPU_MSM_DEVICE=1`` selects
+the device seam; ``PN_MSM_C`` / the cached auto-tune (see
+``native.apply_msm_tuning``) size the Pippenger window.
+
+Observability: every batch records ``ptpu_commit_batch_size{bases}``
+and the caller wraps each flush in a ``ptpu_prover_stage_seconds``
+stage labelled ``stage="commit.*", batched="0|1"``.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+
+import numpy as np
+
+from .. import native
+from ..utils import trace
+from ..utils.errors import EigenError
+from ..utils.fields import BN254_FR_MODULUS
+from .bn254 import BN254_FQ_MODULUS, g1_add, g1_mul
+
+R = BN254_FR_MODULUS
+Q = BN254_FQ_MODULUS
+
+# columns per g1_msm_multi call: the native kernel sweeps column
+# chunks internally for cache locality (PN_MSM_KB); 16 just bounds one
+# call's scalar footprint
+MAX_BATCH = 16
+
+_R_LIMBS = np.frombuffer(int(R).to_bytes(32, "little"), dtype="<u8")
+_HALF_LIMBS = np.frombuffer(((R + 1) // 2).to_bytes(32, "little"),
+                            dtype="<u8")
+
+
+def engine_enabled() -> bool:
+    """Batched commits are on unless ``PTPU_COMMIT_ENGINE=0`` (or the
+    native library is absent — the engine is a scheduler over native
+    kernels; pure-Python proving never routes through it)."""
+    if os.environ.get("PTPU_COMMIT_ENGINE", "1") == "0":
+        return False
+    return native.available()
+
+
+def device_msm_enabled() -> bool:
+    return os.environ.get("PTPU_MSM_DEVICE") == "1"
+
+
+def balance_rows(flat: np.ndarray) -> np.ndarray:
+    """IN-PLACE scalar balancing of an (m, 4) uint64 limb array: every
+    row with s ≥ (R+1)/2 becomes R−s (lexicographic limb compare + a
+    4-limb borrow subtract); returns the boolean flip mask. The ONE
+    copy of this subtle limb arithmetic — the engine's column batches
+    and ``prover_fast._msm_signed``'s per-call base negation both call
+    it, so the serial oracle and the batched path can never drift."""
+    m = len(flat)
+    ge = np.zeros(m, dtype=bool)
+    eq = np.ones(m, dtype=bool)
+    for j in (3, 2, 1, 0):
+        ge |= eq & (flat[:, j] > _HALF_LIMBS[j])
+        eq &= flat[:, j] == _HALF_LIMBS[j]
+    ge |= eq
+    rows = np.nonzero(ge)[0]
+    if len(rows):
+        borrow = np.zeros(len(rows), dtype=np.uint64)
+        for j in range(4):
+            sub = flat[rows, j] + borrow
+            wrapped = sub < borrow  # s_j + borrow overflowed 2^64
+            diff = _R_LIMBS[j] - sub  # uint64 wrap IS the borrow case
+            borrow = ((_R_LIMBS[j] < sub) | wrapped).astype(np.uint64)
+            flat[rows, j] = diff
+    return ge
+
+
+def balance_columns(stack: np.ndarray) -> tuple:
+    """Scalar-balancing for a (K, n, 4) column stack: every s ≥ (R+1)/2
+    is replaced by R−s with the flip bit set, so a near-R scalar (−1,
+    −small coefficients) costs one window pass instead of seventeen.
+    OWNS (mutates) ``stack`` — callers pass a private copy; at k=21 a
+    7-column batch is ~450 MB, and a defensive copy here would double
+    the flush's transient footprint. Returns (stack, flips (K, n)
+    uint8) — the shared-base twin of ``_msm_signed``'s per-call base
+    negation: the flips ride into ``g1_msm_multi`` instead of K
+    private negated copies of the base array."""
+    kcols, n = stack.shape[0], stack.shape[1]
+    ge = balance_rows(stack.reshape(kcols * n, 4).view(np.uint64))
+    return stack, ge.reshape(kcols, n).astype(np.uint8)
+
+
+class _Item:
+    __slots__ = ("label", "bases_id", "scalars", "fetch", "blinds",
+                 "point", "error")
+
+    def __init__(self, label, bases_id, scalars, fetch, blinds):
+        self.label = label
+        self.bases_id = bases_id
+        self.scalars = scalars
+        self.fetch = fetch
+        self.blinds = blinds
+        self.point = None
+        self.error = None
+
+
+class CommitEngine:
+    """Per-prove commit scheduler (see module docstring). Submit
+    columns as they become ready; ``flush()`` computes every pending
+    commit (batched + overlapped) and returns the points in submission
+    order for in-order transcript absorption."""
+
+    def __init__(self, params):
+        self.params = params
+        self.batching = engine_enabled()
+        self.device = device_msm_enabled()
+        self._items: list = []
+        self._cv = threading.Condition()
+        self._device_pts: dict = {}
+
+    def stage_labels(self) -> dict:
+        """The ``batched`` label dimension for commit.* stage series."""
+        return {"batched": "1" if self.batching and not self.device
+                else "0"}
+
+    # --- submission --------------------------------------------------------
+
+    def submit_evals(self, label: str, evals: np.ndarray | None = None,
+                     blinds=(), fetch=None) -> None:
+        """Commit a polynomial from its 2^k-domain EVALUATIONS via the
+        Lagrange basis, plus the Z_H-blinding τ-basis correction —
+        the batched form of ``prover_fast._commit_blinded_evals``."""
+        if self.params.g1_lagrange is None:
+            raise EigenError("proving_error",
+                             "params carry no Lagrange basis")
+        if evals is not None and len(evals) != (1 << self.params.k):
+            raise EigenError("proving_error",
+                             "evals length must equal 2^k")
+        self._items.append(_Item(label, "lagrange", evals, fetch,
+                                 list(blinds)))
+
+    def submit_coeffs(self, label: str, coeffs: np.ndarray | None = None,
+                      fetch=None) -> None:
+        """Commit a coefficient array over the SRS powers — the batched
+        form of ``prover_fast.commit_limbs``."""
+        if coeffs is not None and len(coeffs) > len(self.params.g1_powers):
+            raise EigenError("proving_error", "poly exceeds SRS")
+        self._items.append(_Item(label, "srs", coeffs, fetch, []))
+
+    # --- execution ---------------------------------------------------------
+
+    def flush(self) -> list:
+        """Compute every pending commit and return the points in
+        submission order. Fetch-backed items download on ONE background
+        thread in submission order; the main thread greedily groups
+        whatever is ready into ``g1_msm_multi`` batches, so downloads
+        overlap the GIL-released MSM compute."""
+        items, self._items = self._items, []
+        if not items:
+            return []
+        fetches = [it for it in items if it.scalars is None]
+        th = None
+        if fetches:
+            # the fetch thread inherits the submitting thread's trace
+            # context and pool-worker identity — fetch callables run
+            # real traced work (fold downloads + divides), and a bare
+            # thread would detach their spans from the job's trace
+            ctx_ids = trace.current_trace_ids()
+            worker = trace.current_worker()
+            th = threading.Thread(target=self._fetch_loop,
+                                  args=(fetches, ctx_ids, worker),
+                                  daemon=True,
+                                  name="commit-engine-fetch")
+            th.start()
+        pending = set(range(len(items)))
+        while pending:
+            with self._cv:
+                while True:
+                    err = next((items[i].error for i in pending
+                                if items[i].error is not None), None)
+                    if err is not None:
+                        raise err
+                    ready = [i for i in sorted(pending)
+                             if items[i].scalars is not None]
+                    if ready:
+                        break
+                    self._cv.wait()
+            groups: dict = {}
+            for i in ready:
+                it = items[i]
+                groups.setdefault((it.bases_id, len(it.scalars)),
+                                  []).append(i)
+            for key, idxs in groups.items():
+                for j in range(0, len(idxs), MAX_BATCH):
+                    chunk = idxs[j : j + MAX_BATCH]
+                    self._commit_group(key, [items[i] for i in chunk])
+                pending.difference_update(idxs)
+        if th is not None:
+            th.join()
+        return [it.point for it in items]
+
+    def _fetch_loop(self, fetches: list, ctx_ids: tuple,
+                    worker: str | None) -> None:
+        import contextlib
+
+        with contextlib.ExitStack() as stack:
+            if ctx_ids:
+                stack.enter_context(trace.context(trace_ids=ctx_ids))
+            if worker is not None:
+                stack.enter_context(trace.worker_context(worker))
+            for it in fetches:
+                try:
+                    scalars = it.fetch()
+                except BaseException as e:  # surfaced by flush()
+                    with self._cv:
+                        it.error = e
+                        self._cv.notify_all()
+                    return
+                with self._cv:
+                    it.scalars = scalars
+                    self._cv.notify_all()
+
+    def _bases(self, bases_id: str, length: int) -> np.ndarray:
+        from . import prover_fast as pf
+
+        if bases_id == "lagrange":
+            return pf.lagrange_limbs(self.params)
+        return pf.srs_limbs(self.params)[:length]
+
+    def _commit_group(self, key: tuple, group: list) -> None:
+        bases_id, length = key
+        trace.histogram("commit_batch_size",
+                        buckets=trace.COMMIT_BATCH_BUCKETS).observe(
+            float(len(group)), bases=bases_id)
+        bases = self._bases(bases_id, length)
+        if self.device:
+            pts = self._device_base_points(bases_id, length, bases)
+            for it in group:
+                it.point = _device_msm(pts, it.scalars)
+        elif self.batching:
+            cols = []
+            for it in group:
+                cols.append(np.ascontiguousarray(it.scalars))
+                it.scalars = None  # fetched chunks (~32-64 MB each)
+                # free as soon as the stack below owns their bytes
+            stack = np.stack(cols)
+            del cols
+            balanced, flips = balance_columns(stack)  # in place
+            points = native.g1_msm_multi(Q, bases, balanced, flips)
+            del stack, balanced
+            for it, pt in zip(group, points):
+                it.point = pt
+        else:  # serial oracle path (PTPU_COMMIT_ENGINE=0)
+            from .prover_fast import _msm_signed
+
+            for it in group:
+                if bases_id == "lagrange":
+                    it.point = _msm_signed(bases, it.scalars)
+                else:
+                    it.point = native.g1_msm(Q, bases, it.scalars)
+        n = 1 << self.params.k
+        for it in group:
+            it.scalars = None  # fetched chunks can be ~32 MB each
+            for i, b in enumerate(it.blinds):
+                if b == 0:
+                    continue
+                it.point = g1_add(it.point,
+                                  g1_mul(self.params.g1_powers[n + i], b))
+                it.point = g1_add(it.point,
+                                  g1_mul(self.params.g1_powers[i],
+                                         (R - b) % R))
+
+    def _device_base_points(self, bases_id: str, length: int,
+                            bases: np.ndarray) -> list:
+        cached = self._device_pts.get((bases_id, length))
+        if cached is None:
+            vals = native.limbs_to_ints(
+                np.ascontiguousarray(bases).reshape(-1, 4))
+            cached = []
+            for i in range(length):
+                x, y = vals[2 * i], vals[2 * i + 1]
+                cached.append(None if x == 0 and y == 0 else (x, y))
+            self._device_pts[(bases_id, length)] = cached
+        return cached
+
+
+def _device_msm(pts: list, scalars: np.ndarray):
+    """One column through the sorted-prefix device MSM (the r5 kill's
+    executable skeleton) — identity bases and zero scalars are
+    filtered, matching the host oracle's semantics."""
+    from ..ops.msm_device import msm_device
+
+    sc = native.limbs_to_ints(np.ascontiguousarray(scalars))
+    pairs = [(p, s % R) for p, s in zip(pts, sc) if p is not None and s % R]
+    if not pairs:
+        return None
+    return msm_device([p for p, _ in pairs], [s for _, s in pairs])

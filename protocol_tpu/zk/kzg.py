@@ -76,6 +76,17 @@ class KZGParams:
 
     def commit(self, coeffs: list):
         assert len(coeffs) <= len(self.g1_powers), "poly exceeds SRS"
+        from .. import native
+
+        if native.available() and len(coeffs) > 16:
+            # the compiled Pippenger (identical result; the pure-python
+            # g1_msm below stays as the oracle fallback). The SRS limb
+            # view is cached on the params object by prover_fast.
+            from .prover_fast import commit_limbs
+
+            return commit_limbs(self,
+                                native.ints_to_limbs(
+                                    [int(c) % R for c in coeffs]))
         return g1_msm(self.g1_powers[: len(coeffs)], coeffs)
 
     # --- serialization ----------------------------------------------------

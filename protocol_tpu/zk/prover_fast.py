@@ -27,7 +27,6 @@ import json
 import os
 import secrets
 import threading
-from concurrent.futures import ThreadPoolExecutor
 from dataclasses import dataclass
 
 import numpy as np
@@ -37,6 +36,7 @@ from ..utils import trace
 from ..utils.errors import EigenError
 from ..utils.fields import BN254_FR_MODULUS
 from .bn254 import BN254_FQ_MODULUS, G1_GEN
+from .commit_engine import CommitEngine
 from .domain import EvaluationDomain
 from .kzg import KZGParams, g1_from_bytes, g1_to_bytes
 from .plonk import (
@@ -129,32 +129,17 @@ def _msm_signed(bases: np.ndarray, scalars: np.ndarray):
     min(s, R−s) with the base's y negated when R−s is the smaller —
     a scalar like −1 (= R−1, full-width) then costs one window pass
     instead of seventeen. Pays off whenever a column is ±small
-    (selector/coefficient columns); a wash on dense columns."""
-    n = len(scalars)
-    s = scalars.astype(np.uint64, copy=False)
-    R_limbs = np.frombuffer(int(R).to_bytes(32, "little"), dtype="<u8")
-    half_limbs = np.frombuffer(((R + 1) // 2).to_bytes(32, "little"),
-                               dtype="<u8")
-    # lexicographic s >= (R+1)/2, top limb first
-    ge = np.zeros(n, dtype=bool)
-    eq = np.ones(n, dtype=bool)
-    for j in (3, 2, 1, 0):
-        ge |= eq & (s[:, j] > half_limbs[j])
-        eq &= s[:, j] == half_limbs[j]
-    ge |= eq
+    (selector/coefficient columns); a wash on dense columns. The limb
+    compare + borrow subtract is the shared
+    ``commit_engine.balance_rows`` core (the engine's batched path
+    applies the SAME balancing as per-column flips)."""
+    from .commit_engine import balance_rows
+
+    flipped = scalars.astype(np.uint64, copy=True)
+    ge = balance_rows(flipped)
     if not ge.any():
         return native.g1_msm(Q, bases, scalars)
-    # s' = R - s on the flipped rows (4-limb borrow subtract)
-    flipped = s.copy()
     rows = np.nonzero(ge)[0]
-    borrow = np.zeros(len(rows), dtype=np.uint64)
-    for j in range(4):
-        sub = s[rows, j] + borrow
-        wrapped = sub < borrow  # s_j + borrow overflowed 2^64
-        diff = R_limbs[j] - sub  # uint64 wraps, which is the borrow case
-        new_borrow = ((R_limbs[j] < sub) | wrapped).astype(np.uint64)
-        flipped[rows, j] = diff
-        borrow = new_borrow
     # negate base y for flipped rows: y' = Q - y (y == 0 stays 0)
     b = bases.astype(np.uint64, copy=True)
     Q_limbs = np.frombuffer(int(Q).to_bytes(32, "little"), dtype="<u8")
@@ -657,8 +642,20 @@ def _prove_fast_host(params, pk, cs, public_inputs, randint,
 
     use_lagrange = (params.g1_lagrange is not None
                     and len(params.g1_lagrange) == n)
+    eng = CommitEngine(params)
 
-    # round 1: wires + lookup multiplicities
+    def submit_column(label, evals, blinds, coeffs):
+        # eval-basis (Lagrange) when the params carry it, else SRS
+        # coefficients — the same rule the serial commits applied
+        if use_lagrange:
+            eng.submit_evals(label, evals, blinds)
+        else:
+            eng.submit_coeffs(label, coeffs)
+
+    # round 1: wires + lookup multiplicities. Values, iNTTs and blind
+    # draws run per column; the commits batch into ONE engine flush
+    # (7 same-bases columns), absorbed in the historical order — the
+    # blinding stream and the transcript sequence are unchanged.
     with _stage("witness_build", pk.k, "host"):
         wire_vals = np.zeros((NUM_WIRES, n, 4), dtype="<u8")
         for w in range(NUM_WIRES):
@@ -673,25 +670,24 @@ def _prove_fast_host(params, pk, cs, public_inputs, randint,
             blinded, blinds = _blind_arr(c, n, 2, randint)
             wire_coeffs.append(blinded)
             wire_blinds.append(blinds)
-    with _stage("r1_commits", pk.k, "host"):
-        if use_lagrange:
-            wire_commits = [
-                _commit_blinded_evals(params, wire_vals[w], wire_blinds[w])
-                for w in range(NUM_WIRES)
-            ]
-        else:
-            wire_commits = [commit_limbs(params, c) for c in wire_coeffs]
-        for cm in wire_commits:
-            tr.absorb_point(cm)
 
-    with _stage("lookup_commit", pk.k, "host"):
+    with _stage("lookup_build", pk.k, "host"):
         table_size = 1 << pk.lookup_bits if pk.lookup_bits else 1
         m_vals = _lookup_multiplicities(cs, n, table_size)
         m_coeffs_base = m_vals.copy()
         fk.ntt(m_coeffs_base, d.omega, inverse=True)
         m_coeffs, m_blinds = _blind_arr(m_coeffs_base, n, 2, randint)
-        m_commit = (_commit_blinded_evals(params, m_vals, m_blinds)
-                    if use_lagrange else commit_limbs(params, m_coeffs))
+
+    with _stage("commit.r1", pk.k, "host", labels=eng.stage_labels()):
+        for w in range(NUM_WIRES):
+            submit_column(f"wire{w}", wire_vals[w], wire_blinds[w],
+                          wire_coeffs[w])
+        submit_column("m", m_vals, m_blinds, m_coeffs)
+        r1_points = eng.flush()
+        wire_commits = r1_points[:NUM_WIRES]
+        m_commit = r1_points[NUM_WIRES]
+        for cm in wire_commits:
+            tr.absorb_point(cm)
         tr.absorb_point(m_commit)
 
     with _stage("transcript", pk.k, "host"):
@@ -709,9 +705,6 @@ def _prove_fast_host(params, pk, cs, public_inputs, randint,
         z_base = z_vals.copy()
         fk.ntt(z_base, d.omega, inverse=True)
         z_coeffs, z_blinds = _blind_arr(z_base, n, 3, randint)
-        z_commit = (_commit_blinded_evals(params, z_vals, z_blinds)
-                    if use_lagrange else commit_limbs(params, z_coeffs))
-        tr.absorb_point(z_commit)
 
     # round 2b: LogUp running sum (native kernel)
     with _stage("logup_sum", pk.k, "host"):
@@ -722,9 +715,6 @@ def _prove_fast_host(params, pk, cs, public_inputs, randint,
         phi_base = phi_vals.copy()
         fk.ntt(phi_base, d.omega, inverse=True)
         phi_coeffs, phi_blinds = _blind_arr(phi_base, n, 3, randint)
-        phi_commit = (_commit_blinded_evals(params, phi_vals, phi_blinds)
-                      if use_lagrange else commit_limbs(params, phi_coeffs))
-        tr.absorb_point(phi_commit)
 
     # round 2c: z-split partial products (u1, u2, v1, v2)
     with _stage("partials", pk.k, "host"):
@@ -732,15 +722,27 @@ def _prove_fast_host(params, pk, cs, public_inputs, randint,
                                      pk.shifts, omegas, z_vals, beta, gamma)
         uv_coeffs = []
         uv_blinds = []
-        uv_commits = []
         for vals in uv_vals:
             base = vals.copy()
             fk.ntt(base, d.omega, inverse=True)
             c, blinds = _blind_arr(base, n, 2, randint)
             uv_coeffs.append(c)
             uv_blinds.append(blinds)
-            uv_commits.append(_commit_blinded_evals(params, vals, blinds)
-                              if use_lagrange else commit_limbs(params, c))
+
+    # round-2 commits batch into one flush (z, φ and the 4 partials
+    # sit between the SAME two challenges — none of their values
+    # depends on another round-2 commitment, only the absorb ORDER
+    # matters, and that is preserved below)
+    with _stage("commit.r2", pk.k, "host", labels=eng.stage_labels()):
+        submit_column("z", z_vals, z_blinds, z_coeffs)
+        submit_column("phi", phi_vals, phi_blinds, phi_coeffs)
+        for i, vals in enumerate(uv_vals):
+            submit_column(f"uv{i}", vals, uv_blinds[i], uv_coeffs[i])
+        r2_points = eng.flush()
+        z_commit, phi_commit = r2_points[0], r2_points[1]
+        uv_commits = r2_points[2:]
+        tr.absorb_point(z_commit)
+        tr.absorb_point(phi_commit)
         for cm in uv_commits:
             tr.absorb_point(cm)
 
@@ -827,8 +829,10 @@ def _prove_fast_host(params, pk, cs, public_inputs, randint,
             )
         chunks = [np.ascontiguousarray(t_ext[i * n : (i + 1) * n])
                   for i in range(QUOTIENT_CHUNKS)]
-    with _stage("t_commits", pk.k, "host"):
-        t_commits = [commit_limbs(params, ch) for ch in chunks]
+    with _stage("commit.t", pk.k, "host", labels=eng.stage_labels()):
+        for u, ch in enumerate(chunks):
+            eng.submit_coeffs(f"t{u}", ch)
+        t_commits = eng.flush()
         for cm in t_commits:
             tr.absorb_point(cm)
     with _stage("transcript", pk.k, "host"):
@@ -870,8 +874,10 @@ def _prove_fast_host(params, pk, cs, public_inputs, randint,
         v_ch = tr.challenge()
         tr.challenge()  # u — verifier-side fold; lockstep transcripts
 
-    # batched openings at ζ and ωζ: fold with γ powers, divide, commit
-    def open_group(polys: list, at: int):
+    # batched openings at ζ and ωζ: fold with γ powers, divide, then
+    # BOTH witness commits ride one engine batch (same SRS bases, same
+    # quotient length; neither depends on the other)
+    def open_group(polys: list, at: int) -> np.ndarray:
         width = max(len(p) for p in polys)
         folded = np.zeros((width, 4), dtype="<u8")
         g = 1
@@ -879,12 +885,15 @@ def _prove_fast_host(params, pk, cs, public_inputs, randint,
             term = fk.scalar_mul(p, g)
             folded[: len(term)] = fk.vec_add(folded[: len(term)], term)
             g = g * v_ch % R
-        quotient = fk.poly_divide_linear(folded, at)
-        return commit_limbs(params, quotient)
+        return fk.poly_divide_linear(folded, at)
 
     with _stage("openings", pk.k, "host"):
-        w_x = open_group(all_polys, zeta)
-        w_wx = open_group([z_coeffs, phi_coeffs], zeta_w)
+        q_x = open_group(all_polys, zeta)
+        q_wx = open_group([z_coeffs, phi_coeffs], zeta_w)
+    with _stage("commit.open", pk.k, "host", labels=eng.stage_labels()):
+        eng.submit_coeffs("w_x", q_x)
+        eng.submit_coeffs("w_wx", q_wx)
+        w_x, w_wx = eng.flush()
 
     proof = Proof(wire_commits, m_commit, z_commit, phi_commit, uv_commits,
                   t_commits, wire_evals, m_eval, z_eval, z_next, phi_eval,
@@ -942,20 +951,24 @@ def _stage_labels(base: dict) -> dict:
 
 
 def _stage(stage: str, k: int, path: str, span_name: str | None = None,
-           **fields):
+           labels: dict | None = None, **fields):
     """One named prover stage: a trace span plus a
     ``ptpu_prover_stage_seconds{stage,k,path[,worker]}`` histogram
     observation — the label-aware instrument the service renders on
-    ``/metrics``. Under sync-span mode the caller drains the device
-    queue before the block exits, so the recorded duration is the
-    stage's true cost, not its dispatch time. Default span names are
-    per-path (``prove.`` / ``prove_tpu.``): a process that runs both
-    paths must not merge their durations under one span name."""
+    ``/metrics``. ``labels`` adds extra label dimensions (the commit
+    stages carry ``batched="0|1"`` from the engine). Under sync-span
+    mode the caller drains the device queue before the block exits, so
+    the recorded duration is the stage's true cost, not its dispatch
+    time. Default span names are per-path (``prove.`` /
+    ``prove_tpu.``): a process that runs both paths must not merge
+    their durations under one span name."""
+    base = {"stage": stage, "k": str(k), "path": path}
+    if labels:
+        base.update(labels)
     return trace.timed("prover_stage_seconds",
                        span_name or ("prove_tpu." if path == "tpu"
                                      else "prove.") + stage,
-                       _stage_labels({"stage": stage, "k": str(k),
-                                      "path": path}),
+                       _stage_labels(base),
                        stage=stage, k=k, **fields)
 
 
@@ -1175,6 +1188,7 @@ def _prove_fast_tpu_impl(params, pk, cs, public_inputs, randint,
         return [ptpu._pack16_impl(e)
                 for e in dp.ext_chunks(coeff_dev, blinds)]
 
+    eng = CommitEngine(params)
     with _stage("witness_upload", pk.k, "tpu",
                 span_name="prove_tpu.r1_upload_intt"):
         wire_coeff_dev = [dp.upload_intt_packed(wire_vals[w])
@@ -1193,24 +1207,31 @@ def _prove_fast_tpu_impl(params, pk, cs, public_inputs, randint,
         # earlier array would let the pre-dispatched ext8 compute skew
         # onto whichever later stage blocks first
         _sync_if_tracing((wire_ext, pi_ext) if pre else pi_coeff_dev)
-    with _stage("r1_commits", pk.k, "tpu",
-                span_name="prove_tpu.r1_wire_commits"):
-        wire_commits = [
-            _commit_blinded_evals(params, wire_vals[w], wire_blinds[w])
-            for w in range(NUM_WIRES)
-        ]
-        for cm in wire_commits:
-            tr.absorb_point(cm)
 
-    with _stage("lookup_commit", pk.k, "tpu",
-                span_name="prove_tpu.r1_lookup_commit"):
+    with _stage("lookup_build", pk.k, "tpu",
+                span_name="prove_tpu.r1_lookup_build"):
         table_size = 1 << pk.lookup_bits if pk.lookup_bits else 1
         m_vals = _lookup_multiplicities(cs, n, table_size)
         m_coeff_dev = dp.upload_intt_packed(m_vals)
         m_blinds = [randint() for _ in range(2)]
         if pre:
             m_ext = ext8(m_coeff_dev, m_blinds)
-        m_commit = _commit_blinded_evals(params, m_vals, m_blinds)
+
+    # round-1 commits batch through the engine (7 Lagrange-basis
+    # columns, one g1_msm_multi window pass) and absorb in the
+    # historical order; the pre-dispatched device ext chunks above
+    # compute under this host MSM block exactly as they did under the
+    # serial commits
+    with _stage("commit.r1", pk.k, "tpu", labels=eng.stage_labels(),
+                span_name="prove_tpu.commit_r1"):
+        for w in range(NUM_WIRES):
+            eng.submit_evals(f"wire{w}", wire_vals[w], wire_blinds[w])
+        eng.submit_evals("m", m_vals, m_blinds)
+        r1_points = eng.flush()
+        wire_commits = r1_points[:NUM_WIRES]
+        m_commit = r1_points[NUM_WIRES]
+        for cm in wire_commits:
+            tr.absorb_point(cm)
         tr.absorb_point(m_commit)
 
     with _stage("transcript", pk.k, "tpu"):
@@ -1230,8 +1251,6 @@ def _prove_fast_tpu_impl(params, pk, cs, public_inputs, randint,
         z_blinds = [randint() for _ in range(3)]
         if pre:
             z_ext = ext8(z_coeff_dev, z_blinds)
-        z_commit = _commit_blinded_evals(params, z_vals, z_blinds)
-        tr.absorb_point(z_commit)
 
     with _stage("logup_sum", pk.k, "tpu",
                 span_name="prove_tpu.r2_logup_sum"):
@@ -1244,8 +1263,6 @@ def _prove_fast_tpu_impl(params, pk, cs, public_inputs, randint,
         phi_blinds = [randint() for _ in range(3)]
         if pre:
             phi_ext = ext8(phi_coeff_dev, phi_blinds)
-        phi_commit = _commit_blinded_evals(params, phi_vals, phi_blinds)
-        tr.absorb_point(phi_commit)
 
     # round 2c: z-split partial products — values on host kernels (the
     # lockstep twin of prove_fast's round 2c), ext chunks on device
@@ -1262,12 +1279,24 @@ def _prove_fast_tpu_impl(params, pk, cs, public_inputs, randint,
         if pre:
             uv_ext = [ext8(uv_coeff_dev[i], uv_blinds[i])
                       for i in range(NUM_PERM_PARTIALS)]
-        uv_commits = [
-            _commit_blinded_evals(params, uv_vals[i], uv_blinds[i])
-            for i in range(NUM_PERM_PARTIALS)
-        ]
-    for cm in uv_commits:
-        tr.absorb_point(cm)
+
+    # round-2 commits batch into one flush (z, φ, partials sit between
+    # the same two challenges; blind draws already happened above in
+    # the historical order, absorbs happen here in it). The dispatched
+    # ext8 chunks overlap this host MSM block as before.
+    with _stage("commit.r2", pk.k, "tpu", labels=eng.stage_labels(),
+                span_name="prove_tpu.commit_r2"):
+        eng.submit_evals("z", z_vals, z_blinds)
+        eng.submit_evals("phi", phi_vals, phi_blinds)
+        for i in range(NUM_PERM_PARTIALS):
+            eng.submit_evals(f"uv{i}", uv_vals[i], uv_blinds[i])
+        r2_points = eng.flush()
+        z_commit, phi_commit = r2_points[0], r2_points[1]
+        uv_commits = r2_points[2:]
+        tr.absorb_point(z_commit)
+        tr.absorb_point(phi_commit)
+        for cm in uv_commits:
+            tr.absorb_point(cm)
 
     with _stage("transcript", pk.k, "tpu"):
         alpha = tr.challenge()
@@ -1313,9 +1342,11 @@ def _prove_fast_tpu_impl(params, pk, cs, public_inputs, randint,
         t_coeff_chunks = dp.intt_ext(t_chunks_fs)
         _sync_if_tracing(t_coeff_chunks[-1])
     # the degree check pins the full device pipeline; the remaining
-    # chunk downloads then overlap the host t-commit MSMs (the ctypes
-    # MSM call releases the GIL, so the downloader thread streams chunk
-    # u+1 through the tunnel while chunk u commits)
+    # chunk downloads then overlap the host t-commit MSMs through the
+    # engine's fetch thread (the ctypes MSM releases the GIL, so chunk
+    # u+1 streams through the tunnel while whatever chunks are already
+    # on the host commit as one batch) — the generic form of the old
+    # one-off downloader thread
     with trace.span("prove_tpu.r3_top_check"):
         # device-side zero check: one scalar over the wire, not a chunk
         top_max = int(np.asarray(
@@ -1327,18 +1358,13 @@ def _prove_fast_tpu_impl(params, pk, cs, public_inputs, randint,
                 "quotient degree overflow — witness does not satisfy "
                 "the circuit",
             )
-    with _stage("t_commits", pk.k, "tpu",
-                span_name="prove_tpu.r3_t_commits"):
-        t_commits = []
-        with ThreadPoolExecutor(max_workers=1) as pool:
-            fut = pool.submit(ptpu.download_std, t_coeff_chunks[0])
-            for u in range(QUOTIENT_CHUNKS):
-                arr = fut.result()
-                if u + 1 < QUOTIENT_CHUNKS:
-                    fut = pool.submit(ptpu.download_std,
-                                      t_coeff_chunks[u + 1])
-                t_commits.append(commit_limbs(params, arr))
-                del arr  # ~32 MB each; t_evals run on-device now
+    with _stage("commit.t", pk.k, "tpu", labels=eng.stage_labels(),
+                span_name="prove_tpu.commit_t"):
+        for u in range(QUOTIENT_CHUNKS):
+            eng.submit_coeffs(
+                f"t{u}",
+                fetch=(lambda u=u: ptpu.download_std(t_coeff_chunks[u])))
+        t_commits = eng.flush()
         for cm in t_commits:
             tr.absorb_point(cm)
     with _stage("transcript", pk.k, "tpu"):
@@ -1410,8 +1436,8 @@ def _prove_fast_tpu_impl(params, pk, cs, public_inputs, randint,
     def _g_pows(poly_idx: list) -> list:
         return [pow(v_ch, i, R) for i in range(len(poly_idx))]
 
-    def open_finish(g_pows: list, folded_np: np.ndarray, poly_idx: list,
-                    at: int):
+    def open_quotient(g_pows: list, folded_np: np.ndarray,
+                      poly_idx: list, at: int) -> np.ndarray:
         folded = np.zeros((n + 3, 4), dtype="<u8")
         folded[:n] = folded_np
         for gi, idx in zip(g_pows, poly_idx):
@@ -1423,36 +1449,35 @@ def _prove_fast_tpu_impl(params, pk, cs, public_inputs, randint,
                 _set_int(folded, i, (_get_int(folded, i) - corr) % R)
                 _set_int(folded, n + i,
                          (_get_int(folded, n + i) + corr) % R)
-        with trace.span("prove_tpu.r4_divide_commit"):
-            quotient = fk.poly_divide_linear(folded, at)
-            return commit_limbs(params, quotient)
+        with trace.span("prove_tpu.r4_divide"):
+            return fk.poly_divide_linear(folded, at)
 
     with _stage("openings", pk.k, "tpu",
                 span_name="prove_tpu.r4_openings"):
-        # both folds dispatch up front; the ωζ fold downloads on a side
-        # thread while the ζ group divides+commits on the host (the
-        # fold itself is device work, the MSM releases the GIL)
+        # both folds dispatch up front; the engine's fetch thread then
+        # downloads fold1, divides, and hands the ζ witness to the MSM
+        # while fold2 downloads behind it — the tunnel still sees one
+        # transfer at a time (parallel streams don't aggregate), only
+        # ONE thread sits inside JAX dispatch, and after fold1 lands
+        # _to_u16_wire is compiled and warm for the (L, n) fold shape.
         all_idx = list(range(len(base_polys)))
         g1 = _g_pows(all_idx)
         wx_idx = [NUM_WIRES + 1, NUM_WIRES + 2]
         g2 = _g_pows(wx_idx)
-        with trace.span("prove_tpu.r4_fold_download"):
+        with trace.span("prove_tpu.r4_fold_dispatch"):
             fold1_dev = dp.fold_coeffs(base_polys, g1)
             fold2_dev = dp.fold_coeffs([z_coeff_dev, phi_coeff_dev], g2)
-            # fold1 downloads on the MAIN thread first: the tunnel
-            # serializes transfers (parallel streams don't aggregate),
-            # so a concurrent fold2 download buys nothing — and doing
-            # it on a worker would put two threads inside JAX dispatch
-            # at once. After fold1 lands, _to_u16_wire is compiled and
-            # warm for the (L, n) fold shape, so the worker's fold2
-            # download overlaps only the GIL-releasing host
-            # divide+commit below.
-            fold1_np = ptpu.download_std(fold1_dev)
-            with ThreadPoolExecutor(max_workers=1) as pool:
-                fut2 = pool.submit(ptpu.download_std, fold2_dev)
-                w_x = open_finish(g1, fold1_np, all_idx, zeta)
-                fold2_np = fut2.result()
-        w_wx = open_finish(g2, fold2_np, wx_idx, zeta_w)
+    with _stage("commit.open", pk.k, "tpu", labels=eng.stage_labels(),
+                span_name="prove_tpu.commit_open"):
+        eng.submit_coeffs(
+            "w_x",
+            fetch=lambda: open_quotient(
+                g1, ptpu.download_std(fold1_dev), all_idx, zeta))
+        eng.submit_coeffs(
+            "w_wx",
+            fetch=lambda: open_quotient(
+                g2, ptpu.download_std(fold2_dev), wx_idx, zeta_w))
+        w_x, w_wx = eng.flush()
 
     proof = Proof(wire_commits, m_commit, z_commit, phi_commit, uv_commits,
                   t_commits, wire_evals, m_eval, z_eval, z_next, phi_eval,

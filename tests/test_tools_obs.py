@@ -98,10 +98,14 @@ def test_perf_gate_roundtrip(tmp_path):
     data = json.loads(baseline.read_text())
     assert data["schema"] == "ptpu-perf-gate-v1"
     stages = data["workloads"]["prove"]["stages"]
-    # the named prover stages all made it into the record
-    for stage in ("r1_commits", "grand_product", "quotient", "openings",
+    # the named prover stages all made it into the record (commit.*
+    # are the engine-batched commit stages of this round)
+    for stage in ("commit.r1", "grand_product", "quotient", "openings",
                   "transcript"):
         assert stage in stages, sorted(stages)
+    commits = data["workloads"]["commits"]["stages"]
+    for stage in ("commit.bench_evals", "commit.bench_coeffs"):
+        assert stage in commits, sorted(commits)
 
     ok = gate("--baseline", str(baseline))
     assert ok.returncode == 0, ok.stdout + ok.stderr
@@ -122,7 +126,7 @@ def test_committed_baseline_is_loadable():
         data = json.load(f)
     assert data["schema"] == "ptpu-perf-gate-v1"
     assert set(data["workloads"]) == {"prove", "refresh", "delta",
-                                      "proofs"}
+                                      "proofs", "commits"}
 
 
 # --- profile verb ------------------------------------------------------------
@@ -164,7 +168,7 @@ def test_profile_verb_prove_coverage(tmp_path, capsys, clean_tracer):
     assert report["coverage"] >= 0.9
     assert abs(report["stage_total_s"] - report["prove_total_s"]) \
         <= 0.1 * report["prove_total_s"]
-    for stage in ("witness_build", "r1_commits", "grand_product",
+    for stage in ("witness_build", "commit.r1", "grand_product",
                   "quotient", "evals", "openings", "transcript"):
         assert stage in report["stages"], stage
 

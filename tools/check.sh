@@ -46,15 +46,19 @@ echo "serve_smoke --restart --churn: rc=${smoke_rc}"
 # parity); PROOF_POOL_OK asserts 2 host-path pool workers both ran
 # concurrently submitted proof jobs (worker-labelled stage samples on
 # /metrics), affinity hit-rate > 0, and ZERO shed responses under the
-# admission watermark.
+# admission watermark; COMMIT_PIPE_OK asserts the pool's real proves
+# routed their MSMs through the commit engine (commit.* stage samples
+# with batched="1" and a ptpu_commit_batch_size mean width > 1 on the
+# live daemon's /metrics).
 lint_rc=1
 grep -q SCRAPE_LINT_OK /tmp/_smoke.log \
     && grep -q TRACE_JOIN_OK /tmp/_smoke.log \
     && grep -q DEVICE_OBS_OK /tmp/_smoke.log \
     && grep -q DELTA_DAEMON_OK /tmp/_smoke.log \
     && grep -q PROOF_POOL_OK /tmp/_smoke.log \
+    && grep -q COMMIT_PIPE_OK /tmp/_smoke.log \
     && grep -q "DELTA_OK" /tmp/_smoke.log && lint_rc=0
-echo "scrape-lint + trace-join + device-obs + delta + pool: rc=${lint_rc}"
+echo "scrape-lint + trace-join + device-obs + delta + pool + commit: rc=${lint_rc}"
 
 # opt-in perf-regression gate (PTPU_PERF_GATE=1): per-stage timings of
 # the instrumented prove/refresh workloads vs tools/perf_baseline.json.

@@ -46,6 +46,7 @@ REFRESH_KW = {"n": 1500, "m": 4, "engine": "gather", "tol": 1e-6,
               "repeat": 1}
 DELTA_KW = {"n": 4000, "m": 4, "batches": 10, "batch_edges": 200}
 PROOFS_KW = {"k": 7, "gates": 64, "jobs": 6, "workers": 2}
+COMMITS_KW = {"k": 13, "columns": 8}
 
 
 def _run_once() -> dict:
@@ -53,6 +54,7 @@ def _run_once() -> dict:
     returns {workload: {"total_s", "stages": {name: seconds}}}."""
     from protocol_tpu.cli.profilecmd import (
         fold_prover_stages,
+        run_commits_workload,
         run_delta_workload,
         run_proofs_workload,
         run_prove_workload,
@@ -91,6 +93,10 @@ def _run_once() -> dict:
     # serialization) grows the workload total against the baseline
     measure("proofs", lambda: run_proofs_workload(**PROOFS_KW),
             ("service.proof",))
+    # the commit engine: batched multi-column MSM flushes at a size
+    # where the MSM is the cost — locks the g1_msm_multi win (and the
+    # engine's scheduling overhead) against the committed baseline
+    measure("commits", lambda: run_commits_workload(**COMMITS_KW), ())
     return out
 
 
@@ -114,7 +120,8 @@ def run_workloads(runs: int) -> dict:
     return {
         "schema": "ptpu-perf-gate-v1",
         "workload_params": {"prove": PROVE_KW, "refresh": REFRESH_KW,
-                            "delta": DELTA_KW, "proofs": PROOFS_KW},
+                            "delta": DELTA_KW, "proofs": PROOFS_KW,
+                            "commits": COMMITS_KW},
         "runs": runs,
         "workloads": best,
     }

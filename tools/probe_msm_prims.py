@@ -1,6 +1,7 @@
-"""Chip probe for the device-MSM design (round 4).
+"""Chip probe for the device-MSM design (round 4) + the host MSM
+window auto-tune.
 
-Measures on the real TPU, through the tunnel:
+Default mode measures on the real TPU, through the tunnel:
   1. upload / download bandwidth (the 16 MB/s figure, per direction)
   2. lax.sort of (u32 key, u32 payload) at MSM sizes
   3. row-gather throughput for point-table layouts
@@ -8,22 +9,104 @@ Measures on the real TPU, through the tunnel:
      building block)
   5. small-dispatch round-trip latency
 
+``--tune`` instead runs the HOST Pippenger window-size grid (the r4
+manual c=16→15 retune, mechanized): times ``native.g1_msm`` and the
+batched ``native.g1_msm_multi`` per candidate c and caches the winner
+under ``<assets>/msm_tune.json`` — ``native.apply_msm_tuning()`` picks
+it up on every box at prove time, with an explicit ``PN_MSM_C`` env
+always taking precedence.
+
 Sync rule for this box: jax.block_until_ready does NOT reliably drain
 the tunnel — every timed region ends with a tiny reduction downloaded
 via np.asarray (see memory/BASELINE notes).
 """
+import argparse
+import json
+import os
 import time
+from pathlib import Path
 
 import numpy as np
-import jax
-import jax.numpy as jnp
-from jax import lax
 
 import sys
-sys.path.insert(0, "/root/repo")
-from protocol_tpu.ops import fieldops2 as f2  # noqa: E402
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
 
-L = f2.L
+
+def tune_main(args) -> int:
+    """Grid the Pippenger window size on THIS box and cache the winner.
+    The engine's production path is ``g1_msm_multi`` (K-column batch),
+    so the choice minimizes the batched per-column time; the serial
+    timings are recorded alongside for the methodology."""
+    import random
+
+    from protocol_tpu import native
+    from protocol_tpu.utils.fields import BN254_FR_MODULUS as R
+    from protocol_tpu.zk.bn254 import BN254_FQ_MODULUS as Q, G1_GEN
+
+    if not native.available():
+        print("tune: native library unavailable", file=sys.stderr)
+        return 1
+    n = args.tune_n
+    kcols = args.tune_k
+    rng = random.Random(0xC0FFEE)
+    seed_sc = native.ints_to_limbs(
+        [rng.randrange(1, R) for _ in range(n)])
+    bases = native.g1_fixed_base_muls(Q, G1_GEN, seed_sc)
+    cols = np.stack([
+        native.ints_to_limbs([rng.randrange(0, R) for _ in range(n)])
+        for _ in range(kcols)])
+    prev = os.environ.get("PN_MSM_C")
+    prev_multi = os.environ.get("PN_MSM_C_MULTI")
+    os.environ.pop("PN_MSM_C_MULTI", None)  # the grid pins ONE c
+    results = {}
+    try:
+        for c in args.tune_grid:
+            os.environ["PN_MSM_C"] = str(c)
+            # best-of-reps on BOTH sides: a single noisy sample at the
+            # true-best c would cache the wrong window box-wide
+            serial_s = best_multi = None
+            for _ in range(args.tune_reps):
+                t0 = time.perf_counter()
+                native.g1_msm(Q, bases, cols[0])
+                dt = time.perf_counter() - t0
+                serial_s = dt if serial_s is None else min(serial_s, dt)
+                t0 = time.perf_counter()
+                native.g1_msm_multi(Q, bases, cols)
+                dt = (time.perf_counter() - t0) / kcols
+                best_multi = dt if best_multi is None else min(
+                    best_multi, dt)
+            results[c] = {"multi_col_s": round(best_multi, 4),
+                          "serial_s": round(serial_s, 4)}
+            print(f"c={c}: multi/col {best_multi:.3f}s "
+                  f"serial {serial_s:.3f}s")
+    finally:
+        if prev is None:
+            os.environ.pop("PN_MSM_C", None)
+        else:
+            os.environ["PN_MSM_C"] = prev
+        if prev_multi is not None:
+            os.environ["PN_MSM_C_MULTI"] = prev_multi
+    # the two kernels tune independently: serial g1_msm picks its own
+    # best c, the multi kernel (whose vector reduce repriced the
+    # bucket count) its own — apply_msm_tuning() sets both envs
+    best_serial = min(results, key=lambda c: results[c]["serial_s"])
+    best_multi = min(results, key=lambda c: results[c]["multi_col_s"])
+    out = {
+        "schema": "ptpu-msm-tune-v1",
+        "c": best_serial,
+        "c_multi": best_multi,
+        "n": n,
+        "k_columns": kcols,
+        "grid": {str(c): r for c, r in results.items()},
+        "host": os.uname().nodename,
+    }
+    assets = Path(args.assets or os.environ.get("EIGEN_ASSETS", "assets"))
+    assets.mkdir(parents=True, exist_ok=True)
+    path = assets / "msm_tune.json"
+    path.write_text(json.dumps(out, indent=2) + "\n")
+    print(f"MSM_TUNE_OK c={best_serial} c_multi={best_multi} -> {path}")
+    return 0
 
 
 def sync_scalar(x):
@@ -51,6 +134,16 @@ def timeit(label, fn, warm=1, reps=3):
 
 
 def main():
+    # the chip probes import the device stack lazily so --tune (host
+    # path only) works on jax-less boxes
+    global jax, jnp, lax, f2, L
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    from protocol_tpu.ops import fieldops2 as f2
+
+    L = f2.L
     print("devices:", jax.devices())
     dev = jax.devices()[0]
 
@@ -193,4 +286,22 @@ def main():
 
 
 if __name__ == "__main__":
+    parser = argparse.ArgumentParser(
+        description="device-MSM chip probes / host MSM window tune")
+    parser.add_argument("--tune", action="store_true",
+                        help="run the host Pippenger window grid and "
+                             "cache the per-box winner under the "
+                             "assets dir (PN_MSM_C still overrides)")
+    parser.add_argument("--tune-n", type=int, default=1 << 18)
+    parser.add_argument("--tune-k", type=int, default=4,
+                        help="columns per g1_msm_multi batch timed")
+    parser.add_argument("--tune-reps", type=int, default=2)
+    parser.add_argument("--tune-grid", type=int, nargs="*",
+                        default=[13, 14, 15, 16, 17])
+    parser.add_argument("--assets", default=None,
+                        help="assets dir (default EIGEN_ASSETS or "
+                             "./assets)")
+    args = parser.parse_args()
+    if args.tune:
+        sys.exit(tune_main(args))
     main()

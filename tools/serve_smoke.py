@@ -225,6 +225,9 @@ def inprocess_phase(node_url, chain, step) -> None:
         # --- proof pool: both workers run jobs, affinity hits, no sheds ---
         pool_phase(url, step)
 
+        # --- commit engine: batched commit stages on the live daemon ------
+        commit_pipe_phase(url, step)
+
         # --- end-to-end trace join over the JSONL stream ------------------
         trace_join_phase(trace_path, chain, step)
 
@@ -501,6 +504,44 @@ def pool_phase(url, step) -> None:
     step(f"PROOF_POOL_OK (8 jobs 202-accepted, per-worker runs "
          f"{ {w: rows[w]['jobs_run'] for w in sorted(rows)} }, "
          f"affinity hits {int(hit_count)}, sheds 0)")
+
+
+def commit_pipe_phase(url, step) -> None:
+    """Batched-commit evidence on the LIVE daemon (``COMMIT_PIPE_OK``):
+    the pool phase's real proves route their MSM commits through the
+    commit engine, so the daemon's /metrics must carry ``commit.*``
+    prover-stage samples labelled ``batched="1"`` and a populated
+    ``ptpu_commit_batch_size`` histogram whose mean batch width is > 1
+    — i.e. columns actually GROUPED into multi-MSM calls, not just
+    renamed stages."""
+    from protocol_tpu import native
+    from protocol_tpu.zk.commit_engine import engine_enabled
+
+    if not (native.available() and engine_enabled()):
+        step("COMMIT_PIPE_OK (skipped: no native toolchain, pool "
+             "proves ran as sleepers — no commit stages to assert)")
+        return
+    metrics = _get_json(url, "/metrics")
+    lines = metrics.splitlines()
+    commit_stage = [
+        line for line in lines
+        if line.startswith("ptpu_prover_stage_seconds_count")
+        and 'stage="commit.' in line
+    ]
+    assert commit_stage, "no commit.* prover-stage samples on /metrics"
+    assert any('batched="1"' in line for line in commit_stage), \
+        "commit stages present but none labelled batched=\"1\""
+    batches = sum(float(line.split()[-1]) for line in lines
+                  if line.startswith("ptpu_commit_batch_size_count"))
+    assert batches > 0, "ptpu_commit_batch_size has no samples"
+    width_sum = sum(float(line.split()[-1]) for line in lines
+                    if line.startswith("ptpu_commit_batch_size_sum"))
+    mean = width_sum / batches
+    assert mean > 1.0, \
+        f"commit columns never grouped (mean batch width {mean:.2f})"
+    step(f"COMMIT_PIPE_OK ({int(batches)} MSM batches on the live "
+         f"daemon, mean width {mean:.1f}, commit.* stages "
+         f"batched=\"1\")")
 
 
 def _counter_total(name) -> float:
