@@ -153,6 +153,68 @@ def run_delta_workload(n: int = 4000, m: int = 4, batches: int = 10,
             "partial_sweeps": None if res is None else res.sweeps}
 
 
+def run_sublinear_workload(n: int = 3000, m: int = 4,
+                           seed: int = 19) -> dict:
+    """The sublinear refresh ladder end to end, stage-attributed for
+    the perf gate: one routed build + anchor (``routed.plan_build``),
+    a LOCALIZED churn window served by the device partial sweep
+    (``partial.device`` span — ``device_threshold=0`` forces the
+    kernel), a FLOODED churn window pushed past a tight frontier limit
+    so the partially-observed mode serves it (``partial.sampled``
+    span), and the full-sweep oracle both are checked against
+    (``converge.edges``). A ladder regression — a rung silently
+    falling through to the full sweep, or the device kernel slowing
+    down — moves these stages against the committed baseline."""
+    import numpy as np
+
+    from ..graph import barabasi_albert_edges, filter_edges
+    from ..incremental import DeltaEngine, ladder_refresh, revision_batch
+    from ..ops.routed import build_routed_operator
+
+    rng = np.random.default_rng(seed)
+    src, dst, val = barabasi_albert_edges(n, m, seed=seed)
+    valid = np.ones(n, dtype=bool)
+    fsrc, fdst, _, _, _, raw, _ = filter_edges(n, src, dst, val, valid,
+                                               return_raw=True)
+    cur = raw.copy()
+    op = build_routed_operator(n, src, dst, val, valid)
+    # alpha: geometric convergence keeps the workload's sweep counts
+    # stable across seeds (the gate times stages, not mixing rates)
+    eng = DeltaEngine.anchor(n, src, dst, val, valid, op, alpha=0.15)
+    s_pub, iters, delta = eng.converge(
+        eng.initial_node_scores(1000.0), 300, 1e-6)
+    eng.take_frontier()
+
+    # localized churn -> device partial sweep
+    deltas = revision_batch(rng, fsrc, fdst, cur, 20)
+    if not eng.apply_deltas(deltas):
+        raise EigenError("internal_error",
+                         f"delta batch rejected: {eng.stats}")
+    frontier, _ = eng.take_frontier()
+    res_dev, mode_dev = ladder_refresh(
+        eng, s_pub, frontier, 1e-6, 300, n, device_threshold=0,
+        sample_budget=n, error_budget=1e-3)
+    s_pub = s_pub if res_dev is None else res_dev.scores
+
+    # flooded churn past a tight frontier limit -> sampled mode
+    deltas = revision_batch(rng, fsrc, fdst, cur, 400)
+    if not eng.apply_deltas(deltas):
+        raise EigenError("internal_error",
+                         f"delta batch rejected: {eng.stats}")
+    frontier, _ = eng.take_frontier()
+    res_smp, mode_smp = ladder_refresh(
+        eng, s_pub, frontier, 1e-6, 300, max(len(frontier) // 4, 1),
+        device_threshold=0, sample_budget=n, error_budget=1e-3)
+
+    # the full-sweep oracle the sublinear modes are measured against
+    s_full, it_f, d_f = eng.converge(s_pub, 300, 1e-6)
+    return {"workload": "sublinear", "n": n, "edges": len(fsrc),
+            "device_mode": mode_dev, "sampled_mode": mode_smp,
+            "device_sweeps": None if res_dev is None else res_dev.sweeps,
+            "sampled_sweeps": None if res_smp is None else res_smp.sweeps,
+            "full_iterations": int(it_f)}
+
+
 def run_commits_workload(k: int = 13, columns: int = 8,
                          seed: int = 23) -> dict:
     """The commit engine in isolation at a size where the MSM is the

@@ -15,25 +15,39 @@ O(dirty) instead:
   ``inv_row_scale`` vector) — the routing plan itself never changes
   until the tail outgrows its budget, at which point a full rebuild is
   a rare, amortized event;
-- :mod:`partial` — the partial-refresh mode: power-iteration sweeps
-  restricted to the dirty frontier plus its fan-in, warm-started from
-  the published vector, falling back to a full (patched-operator,
-  still rebuild-free) device sweep on a residual bound. The
-  convergence footing is the partially-observed-matvec analysis named
-  in PAPERS.md (arXiv 2606.11956).
+- :mod:`partial` — the host partial-refresh mode: power-iteration
+  sweeps restricted to the dirty frontier plus its fan-in, warm-started
+  from the published vector. Right for tiny frontiers;
+- :mod:`device` — the device twin (``device_partial_refresh``: the
+  same sweeps through the ``ops.converge.partial_sweep_device``
+  segment-gather kernel, score vector device-resident) plus the
+  partially-observed ``sampled_refresh`` mode (fixed sample set with a
+  neglected-propagation honesty budget — the arXiv 2606.11956
+  footing), and ``ladder_refresh``, the explicit sublinear ladder
+  ``partial → device_partial → sampled`` the refresher (and bench)
+  drive before falling back to a full device sweep, then a rebuild.
 
 The service wiring lives in ``protocol_tpu.service.refresh``; the
 patched-matvec seams (``inv_row_scale``, the ``tail_*`` COO arrays,
 ``RoutedOperator.out_edge_slot``) live in ``ops/routed.py``.
 """
 
+from .device import (
+    device_partial_refresh,
+    ladder_refresh,
+    sampled_refresh,
+)
 from .engine import DeltaEngine, DeltaStats, revision_batch
-from .partial import PartialResult, partial_refresh
+from .partial import PartialResult, as_frontier_array, partial_refresh
 
 __all__ = [
     "DeltaEngine",
     "DeltaStats",
     "PartialResult",
+    "as_frontier_array",
+    "device_partial_refresh",
+    "ladder_refresh",
     "partial_refresh",
     "revision_batch",
+    "sampled_refresh",
 ]

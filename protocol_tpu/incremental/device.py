@@ -1,0 +1,383 @@
+"""Device partial sweeps and partially-observed (sampled) refreshes.
+
+``partial.py`` runs the frontier-restricted power iteration in host
+numpy — right for tiny frontiers, interpreter- and bandwidth-bound past
+~10^4 dirty rows. This module moves the same math onto the device and
+adds the mode between "partial" and "full":
+
+- :func:`device_partial_refresh` — the host partial sweep's device
+  twin: per sweep the frontier's in-edge segments (built-CSR slices +
+  the per-row COO tail indexes) are gathered host-side, pow2-padded
+  (bounded jit-cache shapes, the delta patch-batch discipline) and
+  reduced by ``ops.converge.partial_sweep_device``; the score vector
+  stays device-resident across sweeps and the dangling-mass rank-1
+  shift stays the O(1) host scalar ``partial.py`` tracks. Frontiers of
+  10^4–10^6 rows run at O(frontier fan-in) instead of dropping to host
+  numpy or a full O(E) sweep.
+
+- :func:`sampled_refresh` — the partially-observed mode ("Analysis of
+  Power Iteration Algorithm with Partially Observed Matrix-vector
+  Products", PAPERS.md): when the frontier outgrows the partial bound,
+  converge on a FIXED sample set S = frontier ∪ importance-sampled
+  fan-out closure (≤ ``sample_budget`` rows, Gumbel top-k on score
+  mass — the heavy rows absorb most of the neglected L1). Rows outside
+  S are never updated; what their staleness can cost is bounded
+  exactly: a row r ∈ S that moved by |Δr| propagates at most
+  |Δr| · ext_w(r) of L1 mass outside S per sweep (row-stochastic
+  operator), where ext_w(r) is r's out-weight leaving S. That
+  neglected-propagation mass is the paper's observation-error term,
+  accumulated into the SAME relative-L1 honesty budget the partial
+  sweep already keeps for the uniform dangling shift — blow the
+  ``max(tol, error_budget)`` budget and the refresh falls back to the
+  full device sweep. The accumulated spend is the FIRST-ORDER leak;
+  once outside S the mass keeps propagating, so the end-to-end L1
+  error vs a full sweep is bounded by the damped Neumann series —
+  ``budget_spent / alpha`` — which is what benchmarks and tests
+  declare and assert against.
+
+- :func:`ladder_refresh` — the explicit sublinear ladder
+  ``partial → device_partial → sampled``; the caller's remaining rungs
+  are ``full`` (whole-operator device sweep) and ``rebuild``.
+
+Everything here shares operand semantics with the host twin through
+``partial.frontier_inedges`` and mirrors its per-sweep scalar math
+exactly — the device-vs-host parity test in ``tests/test_sublinear.py``
+pins that.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..utils import trace
+from .partial import (
+    PartialResult,
+    _fanout,
+    _member,
+    as_frontier_array,
+    external_out_weight,
+    frontier_inedges,
+    partial_refresh,
+)
+
+
+def _pow2(x: int, floor: int = 16) -> int:
+    cap = floor
+    while cap < x:
+        cap <<= 1
+    return cap
+
+
+def _frontier_device_arrays(eng, F: np.ndarray, dummy: int, ext_w=None):
+    """Pow2-padded device operands for ``partial_sweep_device``: pad
+    frontier rows point at the dummy slot with valid=dangling=ext=0 and
+    pad edges carry weight 0, so every pad lane computes exactly 0."""
+    import jax.numpy as jnp
+
+    rows, srcs, w = frontier_inedges(eng, F)
+    f_cap = _pow2(len(F))
+    e_cap = _pow2(max(len(rows), 1))
+    f_idx = np.full(f_cap, dummy, dtype=np.int64)
+    f_idx[:len(F)] = F
+    f_valid = np.zeros(f_cap)
+    f_valid[:len(F)] = eng.valid_np[F]
+    f_dang = np.zeros(f_cap)
+    f_dang[:len(F)] = eng.dangling_np[F]
+    f_ext = np.zeros(f_cap)
+    if ext_w is not None:
+        f_ext[:len(F)] = ext_w
+    e_row = np.zeros(e_cap, dtype=np.int64)
+    e_row[:len(rows)] = rows
+    e_src = np.full(e_cap, dummy, dtype=np.int64)
+    e_src[:len(rows)] = srcs
+    e_w = np.zeros(e_cap)
+    e_w[:len(rows)] = w
+    return (jnp.asarray(f_idx, dtype=jnp.int32),
+            jnp.asarray(f_valid), jnp.asarray(f_dang),
+            jnp.asarray(f_ext),
+            jnp.asarray(e_row, dtype=jnp.int32),
+            jnp.asarray(e_src, dtype=jnp.int32),
+            jnp.asarray(e_w))
+
+
+def _device_sweeps(eng, s0, F: np.ndarray, tol: float, max_sweeps: int,
+                   frontier_limit: int | None, ext_w,
+                   error_budget: float = 0.0) -> PartialResult | None:
+    """The shared sweep driver: device kernel per sweep, host scalars
+    for the dangling shift and the honesty budget — the exact per-sweep
+    math of ``partial.partial_refresh`` (mirror changes both ways; the
+    parity test catches drift).
+
+    ``frontier_limit`` set: expanding-frontier (device-partial) mode —
+    F grows along fan-out of moved rows, declines past the limit, and
+    truncated expansion (rows under drop_eps) is priced at |Δ|·ext_w
+    against the budget, exactly like the host twin. ``frontier_limit``
+    None: fixed-set (sampled) mode — F never grows and EVERY row's
+    |Δ|·ext_w is charged (the complement never updates, so all
+    boundary-crossing propagation is permanently neglected). The
+    stopping residual is the observed-rows residual either way; the
+    accumulated charge is reported as ``budget_spent``, the declared
+    error vs a full sweep."""
+    import jax.numpy as jnp
+
+    from ..ops.converge import partial_sweep_device
+
+    n = eng.n_now
+    valid = eng.valid_np.astype(np.float64)
+    dangling = eng.dangling_np.astype(np.float64)
+    n_valid = float(eng.n_valid)
+    denom = max(n_valid - 1.0, 1.0)
+    alpha = eng.alpha
+    keep = 1.0 - alpha
+
+    s = np.asarray(s0, dtype=np.float64)
+    if s.shape != (n,):
+        return None
+    norm = max(float(np.sum(np.abs(s))), 1.0)
+    total = float(np.sum(s * valid))
+    uni = 0.0
+    d_arr = float(np.sum(s * dangling))
+    dang_count = float(dangling.sum())
+    d_prev = d_arr
+
+    if not len(F):
+        return PartialResult(s.copy(), 0, 0.0, 0)
+
+    s_cap = _pow2(n + 1, floor=128)
+    dummy = s_cap - 1
+    s_dev = jnp.asarray(np.concatenate([s, np.zeros(s_cap - n)]))
+    expand = frontier_limit is not None
+    # fixed-set mode: the kernel prices every row's external leak; the
+    # expanding mode prices only truncated (sub-drop_eps) rows, on the
+    # host, from the downloaded per-row changes
+    arrays = _frontier_device_arrays(eng, F, dummy,
+                                     None if expand else ext_w)
+    ext = None
+
+    peak = len(F)
+    residual = np.inf
+    budget = max(tol, error_budget)
+    # the kernel runs in JAX's default float dtype (f32 unless x64 is
+    # enabled), whose relative-L1 residual plateaus near the dtype
+    # oscillation floor at scale — a finer tol would burn max_sweeps
+    # and decline every time. When the honesty budget can absorb the
+    # coarser stop, clamp the stopping tol to the floor and charge the
+    # slack like any other neglected term; when it cannot (exact
+    # mode), keep the caller's tol — tiny graphs do reach an exact
+    # f32 fixed point — and let the stall guard below decline fast.
+    floor = 8.0 * float(jnp.finfo(s_dev.dtype).eps)
+    tol_slack = floor - tol if (tol < floor <= budget + tol) else 0.0
+    eff_tol = tol + tol_slack
+    uni_budget = 0.0
+    negl_budget = 0.0
+    drop_eps = 0.25 * budget * norm / max(n_valid, 1.0)
+    best_residual = np.inf
+    stalled = 0
+    for sweep in range(1, max_sweeps + 1):
+        if expand and len(F) > frontier_limit:
+            return None
+        peak = max(peak, len(F))
+        d_now = d_arr + uni * dang_count
+        g = keep * (d_now - d_prev) / denom
+        d_prev = d_now
+        uni_next = uni + g
+        scal = jnp.asarray(np.array([uni, uni_next, d_now, denom, keep,
+                                     alpha, n_valid, total]))
+        s_dev, changed, l1, d_delta, vsum, negl = partial_sweep_device(
+            s_dev, *arrays, scal)
+        uni = uni_next
+        uni_budget += abs(g) * n_valid / norm
+        if uni_budget + negl_budget + tol_slack > budget:
+            return None  # dangling mass drifted too far for partial
+        d_arr += float(d_delta)
+        if not expand:
+            negl_budget += float(negl) / norm
+            if uni_budget + negl_budget + tol_slack > budget:
+                return None  # neglected-propagation budget exhausted
+        # full-vector per-sweep L1 change: exact on the observed rows,
+        # uniform |g| on every other valid coordinate
+        l1_full = float(l1) + abs(g) * max(n_valid - float(vsum), 0.0)
+        residual = l1_full / norm
+        if residual <= eff_tol:
+            break
+        # stall guard: a residual pinned NEAR the dtype's oscillation
+        # floor above eff_tol means the tol is unreachable in this
+        # precision — decline to the next rung instead of burning the
+        # cap. Scoped to the floor regime (within ~8x of the floor):
+        # a slow-mixing graph stalling far above it keeps its full
+        # sweep budget, exactly like the host twin.
+        if residual < 0.99 * best_residual:
+            best_residual = residual
+            stalled = 0
+        else:
+            stalled += 1
+            if stalled >= 6 and residual <= 8.0 * floor:
+                return None
+        if expand:
+            changed_np = np.asarray(changed)[:len(F)]
+            big = np.abs(changed_np) > drop_eps
+            if ext is None:
+                ext = external_out_weight(eng, F)
+            negl_budget += float(
+                np.sum(np.abs(changed_np[~big]) * ext[~big])) / norm
+            if uni_budget + negl_budget + tol_slack > budget:
+                return None  # truncated-expansion budget exhausted
+            moved = F[big]
+            if len(moved):
+                F2 = np.union1d(F, _fanout(eng, moved))
+                if len(F2) > len(F):
+                    F = F2
+                    arrays = _frontier_device_arrays(eng, F, dummy,
+                                                     None)
+                    ext = None
+                    # new rows legitimately move the residual: the
+                    # stall guard restarts on every expansion
+                    best_residual = np.inf
+                    stalled = 0
+    else:
+        return None
+    s_out = np.asarray(s_dev[:n]).astype(np.float64)
+    if uni != 0.0:
+        s_out = s_out + uni * valid
+    return PartialResult(s_out, sweep, residual, peak,
+                         budget_spent=uni_budget + negl_budget
+                         + tol_slack)
+
+
+def device_partial_refresh(eng, s0, frontier, tol: float,
+                           max_sweeps: int, frontier_limit: int,
+                           error_budget: float = 0.0
+                           ) -> PartialResult | None:
+    """``partial.partial_refresh``'s device twin: same footing, bounds
+    and residual semantics, with the per-sweep reduction on device and
+    the score vector device-resident across sweeps. None = out of
+    budget / frontier outgrew the limit — try the next ladder rung."""
+    F = as_frontier_array(frontier)
+    F = F[(F >= 0) & (F < eng.n_now)]
+    with trace.span("partial.device", n=eng.n_now, frontier=len(F)):
+        return _device_sweeps(eng, s0, F, tol, max_sweeps,
+                              frontier_limit, None,
+                              error_budget=error_budget)
+
+
+def sample_set(eng, F: np.ndarray, s0, budget: int,
+               rng=None) -> np.ndarray | None:
+    """The sampled mode's observation set: the frontier plus its
+    fan-out closure, importance-sampled down to ``budget`` rows when a
+    hop overflows it (Gumbel top-k on warm-start score mass — heavy
+    rows absorb most of the L1 the un-observed complement would
+    accumulate). None when the frontier alone exceeds the budget."""
+    if len(F) > budget:
+        return None
+    if not len(F):
+        return F
+    s0 = np.asarray(s0, dtype=np.float64)
+    if rng is None:
+        # deterministic per refresh, varying ACROSS refreshes (seeded
+        # from the frontier and its warm score mass): a fixed noise
+        # sequence would pick correlated observation sets over a long
+        # sampled streak and concentrate the neglected complement on
+        # the same rows between cold resyncs
+        mass = np.abs(s0[F]).sum()
+        rng = np.random.default_rng(
+            [len(F), int(F[0]), int(F[-1]),
+             int(np.float64(mass).view(np.uint64))])
+    S = F
+    hop = F
+    while len(S) < budget and len(hop):
+        nxt = _fanout(eng, hop)
+        nxt = nxt[(nxt >= 0) & (nxt < eng.n_now)]
+        nxt = nxt[~_member(S, nxt)]
+        if not len(nxt):
+            break
+        room = budget - len(S)
+        if len(nxt) > room:
+            mass = np.abs(s0[nxt]) + 1e-300
+            keys = np.log(mass) + rng.gumbel(size=len(nxt))
+            nxt = nxt[np.argpartition(-keys, room - 1)[:room]]
+        S = np.union1d(S, nxt)
+        hop = nxt
+    return S
+
+
+def sampled_refresh(eng, s0, frontier, tol: float, max_sweeps: int,
+                    sample_budget: int, error_budget: float = 0.0,
+                    rng=None) -> PartialResult | None:
+    """Partially-observed refresh: converge on the fixed sample set
+    with the neglected-propagation mass accumulated against the
+    honesty budget (``max(tol, error_budget)`` — see module
+    docstring). None = no footing, frontier past the budget, or budget
+    exhausted — fall back to the full device sweep."""
+    F = as_frontier_array(frontier)
+    F = F[(F >= 0) & (F < eng.n_now)]
+    if not len(F):
+        return PartialResult(np.asarray(s0, dtype=np.float64).copy(),
+                             0, 0.0, 0)
+    with trace.span("partial.sampled", n=eng.n_now, frontier=len(F)):
+        S = sample_set(eng, F, s0, sample_budget, rng=rng)
+        if S is None:
+            return None
+        ext_w = external_out_weight(eng, S)
+        return _device_sweeps(eng, s0, S, tol, max_sweeps, None, ext_w,
+                              error_budget=error_budget)
+
+
+def ladder_refresh(eng, s0, frontier, tol: float, max_sweeps: int,
+                   frontier_limit: int, device_threshold: int = 4096,
+                   sample_budget: int = 0, error_budget: float = 0.0,
+                   rng=None):
+    """The sublinear refresh ladder, made explicit:
+
+    1. ``partial`` — host sweeps (frontier under both the limit and
+       ``device_threshold``: interpreter dispatch beats device round
+       trips at tiny frontiers);
+    2. ``device_partial`` — the device kernel (frontier ≥
+       ``device_threshold``; 0 = always device, < 0 = never);
+    3. ``sampled`` — partially-observed sweeps over ≤ ``sample_budget``
+       rows (0 disables) when the frontier outgrew the partial bound
+       or a partial attempt declined mid-flight.
+
+    ``error_budget`` (relative L1) is the declared sublinearity price
+    every rung charges its neglected-propagation mass against — 0
+    means exact mode (budget = tol), under which small-world frontiers
+    flood and honestly decline to the full sweep.
+
+    Returns ``(PartialResult, mode)`` or ``(None, None)`` — the
+    caller's remaining rungs are the full device sweep on the patched
+    operator, then the rebuild path.
+    """
+    F = as_frontier_array(frontier)
+    F = F[(F >= 0) & (F < eng.n_now)]
+    if len(F) <= frontier_limit:
+        if 0 <= device_threshold <= len(F):
+            res = device_partial_refresh(eng, s0, F, tol, max_sweeps,
+                                         frontier_limit,
+                                         error_budget=error_budget)
+            if res is not None:
+                return res, "device_partial"
+            # a device decline under a budget too small to absorb the
+            # kernel dtype's tol slack may be precision-caused, not a
+            # genuine flood — the f64 host twin can still serve
+            # exact-mode local churn (the documented ladder). In the
+            # absorbing config the decline was honest; skip the rung.
+            import jax.numpy as jnp
+            floor = 8.0 * float(jnp.finfo(jnp.zeros(0).dtype).eps)
+            if tol < floor and floor > max(tol, error_budget) + tol:
+                res = partial_refresh(eng, s0, F, tol, max_sweeps,
+                                      frontier_limit,
+                                      error_budget=error_budget)
+                if res is not None:
+                    return res, "partial"
+        else:
+            res = partial_refresh(eng, s0, F, tol, max_sweeps,
+                                  frontier_limit,
+                                  error_budget=error_budget)
+            if res is not None:
+                return res, "partial"
+    if sample_budget > 0 and len(F):
+        res = sampled_refresh(eng, s0, F, tol, max_sweeps,
+                              sample_budget, error_budget=error_budget,
+                              rng=rng)
+        if res is not None:
+            return res, "sampled"
+    return None, None

@@ -225,7 +225,14 @@ class DeltaEngine:
 
         # --- churn bookkeeping ----------------------------------------
         self.dirty_rows: set = set()       # rows renormalized vs build
-        self.pending_frontier: set = set()  # nodes whose fan-in changed
+        # nodes whose fan-in changed, accumulated as a LIST of int64
+        # array parts — one unique+sort at drain time (take_frontier),
+        # not a full re-sort of the accumulated frontier per batch
+        # (O(batches · |F| log |F|) under one-attestation churn). The
+        # refreshers consume the drained SORTED ndarray directly — a
+        # set here meant an O(|F|) per-element int() rematerialization
+        # per refresh, interpreter-bound past ~10^5 dirty nodes
+        self.pending_frontier: list = []
         self.pending_new_peers = False      # since last frontier drain
         self._new_valid_slots: list = []   # device patches queued by
         self._new_dangling: dict = {}      # _grow_nodes for _classify
@@ -343,7 +350,7 @@ class DeltaEngine:
             # first surviving edge flips it in the same/next batch
             self._new_dangling[int(s)] = 1.0
         # every new peer is frontier: its score starts undefined
-        self.pending_frontier.update(int(i) for i in ids)
+        self.pending_frontier.append(np.asarray(ids, dtype=np.int64))
         return True
 
     def _classify(self, deltas) -> dict | None:
@@ -475,8 +482,7 @@ class DeltaEngine:
                 for ti in self.tail_by_src.get(u, ()):
                     frontier_parts.append(
                         self.tail_dst_np[ti:ti + 1].astype(np.int64))
-        self.pending_frontier.update(
-            np.unique(np.concatenate(frontier_parts)).tolist())
+        self.pending_frontier.extend(frontier_parts)
 
         state_valid_idx = list(self._new_valid_slots)
         self._new_valid_slots = []
@@ -591,20 +597,29 @@ class DeltaEngine:
     # --- frontier handoff to the partial refresher ------------------------
     def take_frontier(self):
         """(frontier_node_ids, partial_ok): the accumulated dirty
-        frontier since the last drain, cleared. ``partial_ok`` is False
-        when the window added peers (n_valid changed → the published
-        vector is not a near-fixed-point of the new operator for ANY
-        node, so a partial sweep has no footing)."""
-        frontier = self.pending_frontier
+        frontier since the last drain — a SORTED unique int64 ndarray,
+        handed over as-is (no per-element materialization) — cleared.
+        ``partial_ok`` is False when the window added peers (n_valid
+        changed → the published vector is not a near-fixed-point of the
+        new operator for ANY node, so a partial sweep has no
+        footing)."""
+        parts = self.pending_frontier
+        self.pending_frontier = []
+        if parts:
+            frontier = np.unique(
+                np.concatenate(parts).astype(np.int64, copy=False))
+        else:
+            frontier = np.zeros(0, dtype=np.int64)
         ok = not self.pending_new_peers
-        self.pending_frontier = set()
         self.pending_new_peers = False
         return frontier, ok
 
     def restore_frontier(self, frontier, partial_ok: bool) -> None:
         """Put a drained frontier back (failed refresh: the retry must
         still see it)."""
-        self.pending_frontier |= set(frontier)
+        from .partial import as_frontier_array
+
+        self.pending_frontier.append(as_frontier_array(frontier))
         if not partial_ok:
             self.pending_new_peers = True
 

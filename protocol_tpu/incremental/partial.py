@@ -28,12 +28,16 @@ sweeps are exact. The damping term (α > 0) needs no tracking at all:
 total mass is conserved by the operator, so ``α·p·total`` is constant
 per coordinate.
 
-Honesty bounds (all falling back to a FULL device sweep on the patched
-operator — still zero plan rebuilds):
+Honesty bounds (all degrading down the ladder — the sampled mode, then
+a FULL device sweep on the patched operator — still zero plan
+rebuilds):
 
 - the frontier outgrowing ``frontier_limit`` (propagation reached too
   much of the graph for partial to win);
 - failing to reach ``tol`` within ``max_sweeps``;
+- the accumulated L1 honesty budget (``max(tol, error_budget)``)
+  exhausted by the uniform-shift drift plus the priced truncation of
+  sub-``drop_eps`` expansion (see :func:`external_out_weight`);
 - a peer-set change since publish (the warm vector is then not a
   near-fixed-point anywhere — the engine reports ``partial_ok=False``).
 
@@ -58,15 +62,40 @@ class PartialResult:
     sweeps: int
     residual: float
     frontier_peak: int   # widest frontier reached (observability)
+    # accumulated relative-L1 honesty-budget spend: the uniform-shift
+    # propagation bound, plus (sampled mode) the neglected-propagation
+    # mass bound — the declared error vs a full-sweep oracle
+    budget_spent: float = 0.0
 
 
-def _fanin(eng, F: np.ndarray, s: np.ndarray):
-    """(base, in_wsum) over the frontier: Σ w·s[src] and Σ w per
-    frontier node, built CSR + overflow tail. Weights are the TRUE
-    current normalized weights raw/row_sum_now (removed edges carry
-    raw 0 and vanish)."""
-    base = np.zeros(len(F))
-    in_wsum = np.zeros(len(F))
+def as_frontier_array(frontier) -> np.ndarray:
+    """Sorted unique int64 frontier. The engine (and the ladder, which
+    normalizes once and hands the same array to each rung) pass an
+    already-canonical array — detected with one vectorized
+    monotonicity pass, no re-sort; legacy set/iterable callers pay one
+    conversion — never a per-element ``int()`` loop over an ndarray."""
+    if isinstance(frontier, np.ndarray):
+        f = frontier.astype(np.int64, copy=False)
+        if len(f) < 2 or bool(np.all(f[1:] > f[:-1])):
+            return f
+    else:
+        f = np.fromiter((int(x) for x in frontier), dtype=np.int64,
+                        count=len(frontier))
+    return np.unique(f)
+
+
+def frontier_inedges(eng, F: np.ndarray):
+    """The frontier's gathered in-edge segments ``(rows, srcs, w)``:
+    entry k is an in-edge of frontier row ``F[rows[k]]`` from node
+    ``srcs[k]`` with TRUE current normalized weight
+    ``w[k] = raw/row_sum_now`` (removed edges carry raw 0 and vanish).
+    Built CSR + overflow tail; the one gather both the host partial
+    sweep (bincount reduction) and the device kernel
+    (``ops.converge.partial_sweep_device``) consume, so their operand
+    semantics cannot drift."""
+    rows_parts: list = []
+    src_parts: list = []
+    w_parts: list = []
     Fb = F[F < eng.n0]
     if len(Fb):
         rows, pos = expand_csr(eng.in_ptr, Fb)
@@ -77,52 +106,151 @@ def _fanin(eng, F: np.ndarray, s: np.ndarray):
             denom = eng.row_sum_now[srcs]
             w = np.divide(eng.raw_val[eids], denom,
                           out=np.zeros(total), where=denom > 0)
-            bb = np.bincount(rows, weights=w * s[srcs],
-                             minlength=len(Fb))
-            ww = np.bincount(rows, weights=w, minlength=len(Fb))
             # Fb is a prefix-filtered subset of the sorted F: map back
-            pos = np.searchsorted(F, Fb)
-            base[pos] += bb
-            in_wsum[pos] += ww
-    if eng.tail_by_dst:
-        # per-row tail index: visit only the tail edges INTO the
-        # frontier — O(|F| + hits) dict lookups, NOT a linear pass over
-        # the whole tail per sweep (which dominated every churn batch
-        # past ~10^4 tail edges). Dead entries (raw 0 after a removal)
-        # are skipped at use; the index itself only grows until the
-        # next re-anchor. Hybrid: once the frontier rivals the tail,
-        # the interpreter-level walk loses to one vectorized C-speed
-        # pass over the whole tail — fall back to the scan there.
-        if len(F) * 4 < len(eng.tail_raw_np):
-            rows_list: list = []
-            pos_list: list = []
-            for r, u in enumerate(F.tolist()):
-                for ti in eng.tail_by_dst.get(u, ()):
-                    if eng.tail_raw_np[ti] > 0:
-                        rows_list.append(r)
-                        pos_list.append(ti)
-            eng.tail_fanin_visited += len(pos_list)
-            tis = np.asarray(pos_list, dtype=np.int64)
-            rows = np.asarray(rows_list, dtype=np.int64)
-        else:
-            live = eng.tail_raw_np > 0
-            tdst = eng.tail_dst_np[live]
-            pos = np.searchsorted(F, tdst)
-            hit = ((pos < len(F))
-                   & (F[np.minimum(pos, len(F) - 1)] == tdst))
-            tis = np.nonzero(live)[0][hit]
-            rows = pos[hit]
-            # the counter tracks entries EXAMINED (the regression
-            # test's signal), and this branch scanned every live one
-            eng.tail_fanin_visited += int(live.sum())
-        if len(tis):
-            tsrc = eng.tail_src_np[tis]
-            denom = eng.row_sum_now[tsrc]
-            w = np.divide(eng.tail_raw_np[tis], denom,
-                          out=np.zeros(len(tis)), where=denom > 0)
-            np.add.at(base, rows, w * s[tsrc])
-            np.add.at(in_wsum, rows, w)
+            rows_parts.append(np.searchsorted(F, Fb)[rows])
+            src_parts.append(srcs)
+            w_parts.append(w)
+    t_rows, t_tis = _tail_inedges(eng, F)
+    if len(t_tis):
+        tsrc = eng.tail_src_np[t_tis]
+        denom = eng.row_sum_now[tsrc]
+        w = np.divide(eng.tail_raw_np[t_tis], denom,
+                      out=np.zeros(len(t_tis)), where=denom > 0)
+        rows_parts.append(t_rows)
+        src_parts.append(tsrc)
+        w_parts.append(w)
+    if not rows_parts:
+        z = np.zeros(0, dtype=np.int64)
+        return z, z, np.zeros(0)
+    return (np.concatenate(rows_parts), np.concatenate(src_parts),
+            np.concatenate(w_parts))
+
+
+def _fanin(eng, F: np.ndarray, s: np.ndarray):
+    """(base, in_wsum) over the frontier: Σ w·s[src] and Σ w per
+    frontier node, reduced from the shared in-edge gather."""
+    rows, srcs, w = frontier_inedges(eng, F)
+    if not len(rows):
+        return np.zeros(len(F)), np.zeros(len(F))
+    base = np.bincount(rows, weights=w * s[srcs], minlength=len(F))
+    in_wsum = np.bincount(rows, weights=w, minlength=len(F))
     return base, in_wsum
+
+
+def _member_pos(sorted_arr: np.ndarray, values: np.ndarray):
+    """(membership mask, insertion positions) of ``values`` against a
+    sorted unique array — the positions double as indexes into
+    ``sorted_arr`` wherever the mask is set."""
+    if not len(sorted_arr):
+        z = np.zeros(len(values), dtype=np.int64)
+        return np.zeros(len(values), dtype=bool), z
+    pos = np.searchsorted(sorted_arr, values)
+    hit = ((pos < len(sorted_arr))
+           & (sorted_arr[np.minimum(pos, len(sorted_arr) - 1)]
+              == values))
+    return hit, pos
+
+
+def _member(sorted_arr: np.ndarray, values: np.ndarray) -> np.ndarray:
+    """Boolean membership of ``values`` in a sorted unique array."""
+    return _member_pos(sorted_arr, values)[0]
+
+
+def _tail_outedges(eng, S: np.ndarray):
+    """(rows, tail positions) of live tail edges OUT of ``S`` — the
+    src-side twin of :func:`_tail_inedges`, same hybrid rule."""
+    z = np.zeros(0, dtype=np.int64)
+    if not eng.tail_by_src:
+        return z, z
+    if len(S) * 4 < len(eng.tail_raw_np):
+        rows_list: list = []
+        pos_list: list = []
+        for r, u in enumerate(S.tolist()):
+            for ti in eng.tail_by_src.get(u, ()):
+                if eng.tail_raw_np[ti] > 0:
+                    rows_list.append(r)
+                    pos_list.append(ti)
+        eng.tail_fanout_visited += len(pos_list)
+        return (np.asarray(rows_list, dtype=np.int64),
+                np.asarray(pos_list, dtype=np.int64))
+    live = eng.tail_raw_np > 0
+    tsrc = eng.tail_src_np[live]
+    hit = _member(S, tsrc)
+    eng.tail_fanout_visited += int(live.sum())
+    return (np.searchsorted(S, tsrc[hit]),
+            np.nonzero(live)[0][hit])
+
+
+def external_out_weight(eng, S: np.ndarray) -> np.ndarray:
+    """Per-row external out-weight of an observed row set: for each
+    r in S, the sum of r's TRUE normalized out-edge weights whose
+    destination lies OUTSIDE S — the multiplier that prices a row's
+    per-sweep change into neglected-propagation L1 mass (the operator
+    is row-stochastic, so a |Δr| change leaks at most |Δr|·ext_w(r)
+    of L1 outside the observed set per sweep). The observation-error
+    term of the partially-observed power-iteration footing (PAPERS.md,
+    arXiv 2606.11956), charged to the honesty budget by both the
+    truncated-expansion partial sweeps and the fixed-set sampled
+    mode."""
+    ext = np.zeros(len(S))
+    Sb = S[S < eng.n0]
+    if len(Sb):
+        rows, pos = expand_csr(eng.out_ptr, Sb)
+        if len(pos):
+            src = Sb[rows]
+            denom = eng.row_sum_now[src]
+            w = np.divide(eng.raw_val[pos], denom,
+                          out=np.zeros(len(pos)), where=denom > 0)
+            outside = ~_member(S, eng.fdst[pos])
+            ext_b = np.bincount(rows, weights=w * outside,
+                                minlength=len(Sb))
+            ext[np.searchsorted(S, Sb)] += ext_b
+    rows2, tis = _tail_outedges(eng, S)
+    if len(tis):
+        tsrc = eng.tail_src_np[tis]
+        denom = eng.row_sum_now[tsrc]
+        w = np.divide(eng.tail_raw_np[tis], denom,
+                      out=np.zeros(len(tis)), where=denom > 0)
+        outside = ~_member(S, eng.tail_dst_np[tis])
+        np.add.at(ext, rows2, w * outside)
+    return ext
+
+
+def _tail_inedges(eng, F: np.ndarray):
+    """(rows, tail positions) of live tail edges INTO the frontier.
+
+    Per-row tail index: visit only the tail edges INTO the frontier —
+    O(|F| + hits) dict lookups, NOT a linear pass over the whole tail
+    per sweep (which dominated every churn batch past ~10^4 tail
+    edges). Dead entries (raw 0 after a removal) are skipped at use;
+    the index itself only grows until the next re-anchor. Hybrid: once
+    the frontier rivals the tail, the interpreter-level walk loses to
+    one vectorized C-speed pass over the whole tail — fall back to the
+    scan there."""
+    z = np.zeros(0, dtype=np.int64)
+    if not eng.tail_by_dst:
+        return z, z
+    if len(F) * 4 < len(eng.tail_raw_np):
+        rows_list: list = []
+        pos_list: list = []
+        for r, u in enumerate(F.tolist()):
+            for ti in eng.tail_by_dst.get(u, ()):
+                if eng.tail_raw_np[ti] > 0:
+                    rows_list.append(r)
+                    pos_list.append(ti)
+        eng.tail_fanin_visited += len(pos_list)
+        tis = np.asarray(pos_list, dtype=np.int64)
+        rows = np.asarray(rows_list, dtype=np.int64)
+    else:
+        live = eng.tail_raw_np > 0
+        tdst = eng.tail_dst_np[live]
+        hit, pos = _member_pos(F, tdst)
+        tis = np.nonzero(live)[0][hit]
+        rows = pos[hit]
+        # the counter tracks entries EXAMINED (the regression
+        # test's signal), and this branch scanned every live one
+        eng.tail_fanin_visited += int(live.sum())
+    return rows, tis
 
 
 def _fanout(eng, nodes: np.ndarray) -> np.ndarray:
@@ -159,11 +287,14 @@ def _fanout(eng, nodes: np.ndarray) -> np.ndarray:
 
 
 def partial_refresh(eng, s0, frontier, tol: float, max_sweeps: int,
-                    frontier_limit: int) -> PartialResult | None:
+                    frontier_limit: int, error_budget: float = 0.0
+                    ) -> PartialResult | None:
     """Frontier-restricted sweeps from ``s0`` (node order, the warm
     vector); ``frontier`` is the engine's dirty set (nodes whose
-    fan-in changed since publish). None = no footing / out of budget —
-    run a full sweep instead."""
+    fan-in changed since publish). ``error_budget`` (relative L1, 0 =
+    exact mode: the budget is ``tol``) prices truncated expansion —
+    see the drop_eps comment below. None = no footing / out of
+    budget — degrade down the ladder (sampled, then a full sweep)."""
     n = eng.n_now
     valid = eng.valid_np.astype(np.float64)
     dangling = eng.dangling_np.astype(np.float64)
@@ -182,18 +313,28 @@ def partial_refresh(eng, s0, frontier, tol: float, max_sweeps: int,
     dang_count = float(dangling.sum())
     d_prev = d_arr                     # d_mass of the previous iterate
 
-    F = np.unique(np.fromiter((int(x) for x in frontier),
-                              dtype=np.int64, count=len(frontier)))
+    # the engine hands the frontier over as a sorted int64 ndarray —
+    # O(1) here; a per-element int() loop at 10^5+ dirty nodes was the
+    # old interpreter-bound materialization
+    F = as_frontier_array(frontier)
     F = F[(F >= 0) & (F < n)]
     if not len(F):
         return PartialResult(s, 0, 0.0, 0)
 
     peak = len(F)
     residual = np.inf
+    budget = max(tol, error_budget)
     uni_budget = 0.0   # L1 bound on neglected uniform-shift propagation
-    # expansion threshold: changes this small may skip fan-out — their
-    # total neglected propagation stays under tol·norm/4 (mass bound)
-    drop_eps = 0.25 * tol * norm / max(n_valid, 1.0)
+    negl_budget = 0.0  # L1 bound on neglected truncated expansion
+    ext = None         # external out-weights of F (refreshed on growth)
+    # expansion threshold: changes this small may skip fan-out — the
+    # L1 mass their skip can leak outside the frontier (|Δ|·ext_w, the
+    # partially-observed observation-error term) is CHARGED to the
+    # honesty budget below, so truncation is priced, never silent.
+    # error_budget > tol buys sublinear frontiers on small-world
+    # graphs, where the exact influence region of any churn floods the
+    # whole graph at tol-level thresholds.
+    drop_eps = 0.25 * budget * norm / max(n_valid, 1.0)
     for sweep in range(1, max_sweeps + 1):
         if len(F) > frontier_limit:
             return None
@@ -211,7 +352,7 @@ def partial_refresh(eng, s0, frontier, tol: float, max_sweeps: int,
                 valid[F] / max(n_valid, 1.0)) * total
         uni += g
         uni_budget += abs(g) * n_valid / norm
-        if uni_budget > tol:
+        if uni_budget + negl_budget > budget:
             return None  # dangling mass drifted too far for partial
         # store representation: true = s + uni*valid
         old_arr = s[F].copy()
@@ -225,11 +366,25 @@ def partial_refresh(eng, s0, frontier, tol: float, max_sweeps: int,
         residual = l1 / norm
         if residual <= tol:
             break
-        moved = F[np.abs(changed) > drop_eps]
+        big = np.abs(changed) > drop_eps
+        if ext is None:
+            ext = external_out_weight(eng, F)
+        # skipped rows: their un-expanded fan-out leaks ≤ |Δ|·ext_w of
+        # L1 outside F this sweep (expanded rows' propagation is only
+        # DELAYED — their fan-out joins F and reads the updated score)
+        negl_budget += float(
+            np.sum(np.abs(changed[~big]) * ext[~big])) / norm
+        if uni_budget + negl_budget > budget:
+            return None  # truncated-expansion budget exhausted
+        moved = F[big]
         if len(moved):
-            F = np.unique(np.concatenate([F, _fanout(eng, moved)]))
+            F2 = np.unique(np.concatenate([F, _fanout(eng, moved)]))
+            if len(F2) > len(F):
+                F = F2
+                ext = None
     else:
         return None
     if uni != 0.0:
         s = s + uni * valid
-    return PartialResult(s, sweep, residual, peak)
+    return PartialResult(s, sweep, residual, peak,
+                         budget_spent=uni_budget + negl_budget)

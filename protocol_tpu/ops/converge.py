@@ -67,16 +67,26 @@ def record_converge_stats(backend: str, iters: int, delta, seconds: float,
 
 
 def record_refresh_scope(mode: str) -> None:
-    """The one seam that says HOW a refresh swept the graph:
-    ``mode="partial"`` — host sweeps restricted to the dirty frontier
-    plus its fan-in (O(dirty), the delta engine's fast path);
+    """The one seam that says HOW a refresh swept the graph — the
+    four-mode ladder ``partial → sampled → full → rebuild``:
+    ``mode="partial"`` — host numpy sweeps restricted to the dirty
+    frontier plus its fan-in (O(dirty), tiny frontiers);
+    ``mode="device_partial"`` — the same frontier-restricted sweeps run
+    through the device segment-gather kernel
+    (:func:`partial_sweep_device`; frontiers past
+    ``device_partial_threshold``);
+    ``mode="sampled"`` — partially-observed sweeps over a bounded
+    sample set (frontier + importance-sampled closure under
+    ``sample_budget``) with the neglected-propagation mass tracked
+    against the L1 honesty budget;
     ``mode="full"`` — whole-operator device sweeps on the patched
-    operator; ``mode="rebuild"`` — served by a fresh operator build
-    (the initial anchor, or a re-anchor after a capacity wall / lost
-    delta log). Emits ``ptpu_refresh_sweep_scope_total{mode}`` so an
-    operator can see the ratio drift (a rising full share means churn
-    windows outgrow the partial-refresh bound; a rising rebuild share
-    means the delta engine is thrashing on re-anchors)."""
+    operator (the partial bounds or the budget were exhausted);
+    ``mode="rebuild"`` — served by a fresh operator build (the initial
+    anchor, or a re-anchor after a capacity wall / lost delta log).
+    Emits ``ptpu_refresh_sweep_scope_total{mode}`` so an operator can
+    see the ratio drift (a rising full share means churn windows
+    outgrow the sublinear bounds; a rising rebuild share means the
+    delta engine is thrashing on re-anchors)."""
     trace.counter("refresh_sweep_scope").inc(mode=mode)
     trace.event("refresh.sweep_scope", mode=mode)
 
@@ -152,6 +162,81 @@ def warm_start_scores(prev, n: int, valid, initial_score: float):
     s *= valid
     target = float(valid.sum()) * float(initial_score)
     return s * (target / float(s.sum()))
+
+
+@jax.jit
+def partial_sweep_device(s, f_idx, f_valid, f_dang, f_ext,
+                         e_row, e_src, e_w, scal):
+    """One frontier-restricted power-iteration sweep on device — the
+    segment-gather kernel behind ``incremental.device``.
+
+    The full sweep applies the whole operator; this evaluates the
+    update ONLY for a frontier row set, from its gathered in-edge
+    segments: ``e_src[k]``/``e_w[k]`` is the k-th in-edge (source node,
+    true normalized weight) of frontier row ``e_row[k]``, built
+    host-side from the delta engine's CSR slices plus the per-row COO
+    tail indexes. One gather + two segment-sums + elementwise tail —
+    O(frontier fan-in) device work instead of O(E).
+
+    The dangling-mass rank-1 shift stays the lazily-materialized
+    SCALAR the host partial refresher tracks (``partial.py`` — change
+    the math there and mirror it here; the device-vs-host parity test
+    catches drift): ``scal`` packs the per-sweep host scalars
+    ``[uni, uni_next, d_now, denom, keep, alpha, n_valid, total]`` as
+    one device array so value changes never retrace.
+
+    Shapes are the jit-cache identity — callers pow2-pad ``f_*`` and
+    ``e_*`` (the delta patch-batch discipline) so the cache stays
+    O(log frontier · log fan-in). Pad rows point at a dummy slot of
+    ``s`` with ``f_valid = f_dang = 0`` and pad edges carry weight 0,
+    so every pad lane computes exactly 0 and the frontier scatter
+    stays deterministic (duplicate dummy indices all write 0).
+
+    XLA:CPU constraint note (this box compiles limb-engine graphs for
+    many minutes): this kernel is a fixed, loop-free graph — gathers,
+    two segment scatter-adds and elementwise math — so its compile is
+    cheap at every bucket shape. Keep it that way: no Python-unrolled
+    per-sweep loops in here (roll any future iteration into a
+    ``lax.fori_loop`` body), and never let a host float leak in as a
+    traced constant (everything value-like rides in ``scal``).
+
+    Returns ``(s2, changed, l1, d_delta, vsum, negl)``:
+    ``s2`` — s with the frontier rows updated (store representation:
+    true = s + uni·valid); ``changed`` — per-frontier-row true-value
+    delta (the host expands the frontier where |changed| > drop_eps);
+    ``l1`` — Σ|changed|; ``d_delta`` — dangling-mass delta of the
+    store update; ``vsum`` — Σ valid over the frontier; ``negl`` —
+    Σ|changed|·f_ext, the neglected-propagation mass bound of the
+    sampled mode (``f_ext`` = per-row external out-weight; zeros in
+    the plain partial mode).
+    """
+    uni = scal[0]
+    uni_next = scal[1]
+    d_now = scal[2]
+    denom = scal[3]
+    keep = scal[4]
+    alpha = scal[5]
+    n_valid = scal[6]
+    total = scal[7]
+    base = jnp.zeros(f_idx.shape[0], s.dtype).at[e_row].add(e_w * s[e_src])
+    in_wsum = jnp.zeros(f_idx.shape[0], s.dtype).at[e_row].add(e_w)
+    s_f = s[f_idx]
+    base_true = base + uni * in_wsum
+    s_true = s_f + uni * f_valid
+    corr = (d_now - f_dang * s_true) / denom
+    new_true = base_true + corr * f_valid
+    # alpha == 0 => keep == 1 and the pretrust term vanishes: computing
+    # the damped form unconditionally is exactly the undamped update
+    new_true = keep * new_true + alpha * (
+        f_valid / jnp.maximum(n_valid, 1.0)) * total
+    changed = new_true - s_true
+    new_store = new_true - uni_next * f_valid
+    s2 = s.at[f_idx].set(new_store)
+    l1 = jnp.sum(jnp.abs(changed))
+    d_delta = jnp.sum(f_dang * (new_store - s_f))
+    vsum = jnp.sum(f_valid)
+    negl = jnp.sum(jnp.abs(changed) * f_ext)
+    return s2, changed, l1, d_delta, vsum, negl
 
 
 def operator_arrays(

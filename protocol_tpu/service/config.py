@@ -29,7 +29,12 @@ class ServiceConfig:
 
     # --- score refresh ----------------------------------------------------
     refresh_interval: float = 0.5   # max latency from ingest to refresh
-    tol: float = 1e-9               # relative-L1 stopping tolerance
+    # relative-L1 stopping tolerance. The device sublinear rungs run
+    # in JAX's default float dtype (f32 without x64), whose residual
+    # floors near 1e-6 at scale: a finer tol there stops at the floor
+    # with the slack charged against refresh_error_budget (or, in
+    # exact mode, declines to the f64 host/full rungs).
+    tol: float = 1e-9
     max_iterations: int = 500
     initial_score: float = 1000.0
     alpha: float = 0.0              # pre-trust damping (0 = reference)
@@ -60,9 +65,34 @@ class ServiceConfig:
     delta_tail_fraction: float = 0.25
     # partial refresh: warm sweeps restricted to the dirty frontier +
     # fan-in; past this fraction of the peer set the frontier is no
-    # longer "partial" and the refresh runs a full (still rebuild-free)
-    # device sweep instead. 0 disables partial refresh.
+    # longer "partial" and the refresh degrades down the ladder
+    # (sampled, then a full — still rebuild-free — device sweep).
+    # 0 disables the partial/sampled rungs entirely.
     partial_frontier_fraction: float = 0.25
+    # the sublinear-refresh ladder (partial -> device_partial ->
+    # sampled -> full -> rebuild): frontiers at/above this many rows
+    # run the partial sweeps through the device segment-gather kernel
+    # (ops.converge.partial_sweep_device) instead of host numpy — the
+    # host path wins below it on interpreter-dispatch grounds. 0 =
+    # always device, negative = host sweeps only.
+    device_partial_threshold: int = 4096
+    # partially-observed mode: when the frontier outgrows the partial
+    # bound, converge on frontier + importance-sampled fan-out closure
+    # up to this many rows, with the neglected-propagation mass
+    # accumulated against the L1 honesty budget. 0 disables the rung.
+    sample_budget: int = 1 << 20
+    # the declared relative-L1 error budget of the sublinear rungs: on
+    # small-world graphs the EXACT influence region of any churn
+    # floods the whole graph at tol-level thresholds, so sublinearity
+    # is bought with a declared, accounted approximation — every rung
+    # charges its neglected-propagation mass (|Δ|·external-out-weight)
+    # against this budget and falls back to the full sweep when it is
+    # genuinely exhausted; the per-refresh spend is live on
+    # ptpu_refresh_budget_spent. The periodic cold resync
+    # (cold_every) re-anchors exactness. 0 = exact mode (budget =
+    # tol): sublinear rungs serve only churn whose influence truly
+    # stays local.
+    refresh_error_budget: float = 1e-3
 
     # --- durable state store ----------------------------------------------
     # empty = memory-only (the block cursor is still checkpointed);

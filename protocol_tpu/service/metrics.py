@@ -61,6 +61,8 @@ MONOTONIC_METRICS = frozenset({
     "service.operator_builds",
     "service.delta_batches",
     "service.partial_refreshes",
+    "service.device_partial_refreshes",
+    "service.sampled_refreshes",
     "service.delta_reanchors",
     "store.wal_records_appended",
     "store.wal_torn_skipped",
@@ -97,6 +99,11 @@ HISTOGRAM_FAMILIES = {
     # commit engine's grouping evidence — p50 near 1 means the engine
     # is running but nothing batches (grouping regression)
     "commit_batch_size": ("bases",),
+    # frontier/sample-set rows per sublinear refresh (a size histogram,
+    # not seconds): the freshness-vs-compute frontier evidence — mode
+    # is the ladder rung that served (partial | device_partial |
+    # sampled)
+    "refresh_frontier_rows": ("mode",),
     "converge_sweep_seconds": ("backend",),
     "routed_plan_build_seconds": (),
     "operator_delta_seconds": ("kind",),
@@ -113,6 +120,7 @@ DECLARED_COUNTERS = ("xla_compiles", "xla_steady_recompiles",
                      "proof_pool_stolen")
 DECLARED_GAUGES = ("converge_iterations", "converge_residual",
                    "proof_queue_depth", "dirty_rows",
+                   "refresh_frontier_peak", "refresh_budget_spent",
                    "proof_pool_depth", "proof_pool_worker_depth",
                    "proof_pool_queued_bytes", "proof_pool_workers")
 
@@ -123,13 +131,12 @@ def declare_instruments() -> None:
     with no samples render as a bare TYPE line; counters/gauges render
     a zero default series only once touched — so the counters are
     touched with a no-op ``inc(0)`` here (monotonicity unaffected)."""
+    size_buckets = {"commit_batch_size": trace.COMMIT_BATCH_BUCKETS,
+                    "refresh_frontier_rows": trace.FRONTIER_ROWS_BUCKETS}
     for name in HISTOGRAM_FAMILIES:
-        # commit_batch_size counts columns, not seconds — its buckets
-        # are integers; creation sites must agree (first one wins)
-        trace.histogram(name,
-                        buckets=(trace.COMMIT_BATCH_BUCKETS
-                                 if name == "commit_batch_size"
-                                 else None))
+        # the size histograms count columns/rows, not seconds — their
+        # buckets are integers; creation sites must agree (first wins)
+        trace.histogram(name, buckets=size_buckets.get(name))
     for name in DECLARED_COUNTERS:
         trace.counter(name).inc(0.0)
     for name in DECLARED_GAUGES:

@@ -51,14 +51,20 @@ And the write-path scale seam this module grew in PR 6:
   COO overflow tail) and dirty-row re-normalizations — the routing
   plan is never rebuilt until the tail outgrows its budget, which
   demotes full builds (``ptpu_operator_full_builds_total``) to a rare
-  amortized event. Warm refreshes first try the **partial** mode —
-  host sweeps over the dirty frontier + fan-in
-  (``incremental.partial_refresh``) — and fall back to a full (still
-  rebuild-free) device sweep on any residual/footing bound; every
-  refresh reports which scope it swept via
-  ``ptpu_refresh_sweep_scope_total{mode=partial|full|rebuild}``
-  (``rebuild`` = served by the build path: the initial anchor and
-  every re-anchor after a capacity wall or lost log).
+  amortized event. Warm refreshes walk the explicit **sublinear
+  ladder** (``incremental.ladder_refresh``): host partial sweeps over
+  the dirty frontier + fan-in for tiny frontiers, the device
+  segment-gather kernel past ``device_partial_threshold``, the
+  partially-observed **sampled** mode (frontier + importance-sampled
+  closure ≤ ``sample_budget``, neglected-propagation mass charged to
+  the L1 honesty budget) once the frontier outgrows the partial
+  bound — and only a genuinely exhausted budget falls back to a full
+  (still rebuild-free) device sweep; every refresh reports which
+  scope it swept via ``ptpu_refresh_sweep_scope_total{mode=partial|
+  device_partial|sampled|full|rebuild}`` (``rebuild`` = served by the
+  build path: the initial anchor and every re-anchor after a capacity
+  wall or lost log), with the frontier width and budget spend live on
+  ``ptpu_refresh_frontier_peak`` / ``ptpu_refresh_budget_spent``.
 """
 
 from __future__ import annotations
@@ -141,9 +147,14 @@ class ScoreRefresher:
         # incremental delta engine (anchored after a routed build)
         self.delta_engine = None
         self.delta_batches = 0      # churn windows absorbed in-place
-        self.partial_refreshes = 0  # refreshes served by partial sweeps
+        self.partial_refreshes = 0  # refreshes served below "full":
+        # any ladder rung (host partial, device partial, sampled)
+        self.device_partial_refreshes = 0
+        self.sampled_refreshes = 0
         self.full_sweeps = 0        # delta-path full device sweeps
         self.delta_reanchors = 0    # engines discarded (capacity/log)
+        self.last_frontier_peak = 0   # widest frontier, last sublinear
+        self.last_budget_spent = 0.0  # its accumulated L1 budget spend
 
     def install(self, table: ScoreTable) -> None:
         """Adopt a restored table (snapshot restore): the next refresh
@@ -394,6 +405,9 @@ class ScoreRefresher:
         trace.metric("service.operator_builds", self.operator_builds)
         trace.metric("service.delta_batches", self.delta_batches)
         trace.metric("service.partial_refreshes", self.partial_refreshes)
+        trace.metric("service.device_partial_refreshes",
+                     self.device_partial_refreshes)
+        trace.metric("service.sampled_refreshes", self.sampled_refreshes)
         return self.table
 
     def _converge_traced(self, n, src, dst, val, valid, s0, cold,
@@ -464,11 +478,12 @@ class ScoreRefresher:
         return True
 
     def _converge_delta(self, n: int, s0, cold: bool, tids) -> tuple:
-        """Serve one refresh from the patched operator: partial sweeps
-        over the dirty frontier when the warm start has footing, a full
-        device sweep otherwise — zero routing-plan builds either way.
-        Returns ``(scores, iters, delta, cold)``."""
-        from ..incremental import partial_refresh
+        """Serve one refresh from the patched operator, walking the
+        explicit sublinear ladder ``partial → device_partial → sampled
+        → full`` (``incremental.ladder_refresh``; the rebuild rung
+        lives on the build path) — zero routing-plan builds on every
+        rung here. Returns ``(scores, iters, delta, cold)``."""
+        from ..incremental import ladder_refresh
         from ..ops.converge import (
             record_converge_stats,
             record_refresh_scope,
@@ -486,15 +501,23 @@ class ScoreRefresher:
                             and frac > 0:
                         limit = max(1, int(frac * n))
                         t0 = time.perf_counter()
-                        res = partial_refresh(
+                        res, mode = ladder_refresh(
                             eng, s0, frontier, self.config.tol,
-                            self.config.max_iterations, limit)
+                            self.config.max_iterations, limit,
+                            self.config.device_partial_threshold,
+                            self.config.sample_budget,
+                            self.config.refresh_error_budget)
                         if res is not None:
                             record_converge_stats(
-                                "partial", res.sweeps, res.residual,
+                                mode, res.sweeps, res.residual,
                                 time.perf_counter() - t0, n=n)
-                            record_refresh_scope("partial")
+                            record_refresh_scope(mode)
                             self.partial_refreshes += 1
+                            if mode == "device_partial":
+                                self.device_partial_refreshes += 1
+                            elif mode == "sampled":
+                                self.sampled_refreshes += 1
+                            self._record_sublinear(mode, res)
                             return (res.scores, res.sweeps,
                                     res.residual, False)
                     # scope/full_sweeps count REFRESHES (per the metric
@@ -526,6 +549,23 @@ class ScoreRefresher:
             # the retry must still see the dirty frontier
             eng.restore_frontier(frontier, partial_ok)
             raise
+
+    def _record_sublinear(self, mode: str, res) -> None:
+        """Sublinear-refresh observability: the frontier width and the
+        accumulated L1 honesty-budget spend were trapped inside
+        ``PartialResult`` — surface them as live gauges plus a
+        per-mode frontier-size histogram so dashboards can watch the
+        freshness-vs-compute frontier drift."""
+        self.last_frontier_peak = int(res.frontier_peak)
+        self.last_budget_spent = float(res.budget_spent)
+        trace.gauge("refresh_frontier_peak").set(
+            float(res.frontier_peak))
+        trace.gauge("refresh_budget_spent").set(
+            float(res.budget_spent))
+        trace.histogram(
+            "refresh_frontier_rows",
+            buckets=trace.FRONTIER_ROWS_BUCKETS).observe(
+            float(res.frontier_peak), mode=mode)
 
     def _anchor_delta_engine(self, n, src, dst, val, valid,
                              operator) -> None:
@@ -559,8 +599,12 @@ class ScoreRefresher:
             "anchored": eng is not None,
             "batches_absorbed": self.delta_batches,
             "partial_refreshes": self.partial_refreshes,
+            "device_partial_refreshes": self.device_partial_refreshes,
+            "sampled_refreshes": self.sampled_refreshes,
             "full_sweeps": self.full_sweeps,
             "reanchors": self.delta_reanchors,
+            "frontier_peak": self.last_frontier_peak,
+            "budget_spent": self.last_budget_spent,
         }
         if eng is not None:
             out.update({

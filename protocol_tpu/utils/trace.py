@@ -86,6 +86,13 @@ DEFAULT_BUCKETS = tuple(10.0 ** (e / 2.0) for e in range(-8, 5))
 # service/metrics.py declare_instruments.
 COMMIT_BATCH_BUCKETS = (1.0, 2.0, 3.0, 4.0, 6.0, 8.0, 12.0, 16.0, 32.0)
 
+# ptpu_refresh_frontier_rows counts frontier/sample-set ROWS per
+# sublinear refresh, not seconds — decade buckets spanning one dirty
+# node to a 10M-peer graph. Every creation site must pass these
+# (buckets are fixed at first registration): service/refresh.py
+# _record_sublinear and service/metrics.py declare_instruments.
+FRONTIER_ROWS_BUCKETS = (1.0, 10.0, 100.0, 1e3, 1e4, 1e5, 1e6, 1e7)
+
 
 def _label_key(labels: dict) -> tuple:
     """Canonical (sorted, stringified) label identity for one series."""
@@ -736,6 +743,20 @@ def metric(name: str, value) -> None:
 
 def counter(name: str) -> Counter:
     return TRACER.counter(name)
+
+
+def counter_total(name: str, **labels) -> float:
+    """Sum of a named counter's samples, optionally restricted to the
+    label values given (compared stringified, the stored form) — the
+    one instrument-scan idiom bench, the smoke and the tests kept
+    re-implementing, each slightly differently."""
+    want = {str(k): str(v) for k, v in labels.items()}
+    for inst in TRACER.instruments():
+        if inst.name == name and inst.kind == "counter":
+            return sum(v for items, v in inst.samples()
+                       if all(dict(items).get(k) == w
+                              for k, w in want.items()))
+    return 0.0
 
 
 def gauge(name: str) -> Gauge:

@@ -254,7 +254,8 @@ def test_partial_refresh_residual_parity_with_full_sweep():
         assert eng.apply_deltas([(i, j, edges[(i, j)], new)])
         edges[(i, j)] = new
     frontier, partial_ok = eng.take_frontier()
-    assert partial_ok and frontier
+    assert partial_ok and len(frontier)
+    assert isinstance(frontier, np.ndarray)  # no per-element int() loop
     res = partial_refresh(eng, s_pub, frontier, TOL, 500,
                           frontier_limit=n)
     assert res is not None, "partial refresh fell back unexpectedly"
@@ -294,7 +295,7 @@ def test_partial_refresh_declines_without_footing():
     # restore_frontier puts a drained frontier back for the retry
     eng.restore_frontier(frontier, partial_ok)
     f2, ok2 = eng.take_frontier()
-    assert f2 == set(frontier) and ok2
+    assert np.array_equal(f2, np.unique(frontier)) and ok2
 
 
 def test_tail_fanin_index_stays_o_dirty_at_large_tail():

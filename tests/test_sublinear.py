@@ -1,0 +1,325 @@
+"""Sublinear-refresh ladder tests (``protocol_tpu.incremental.device``):
+device-partial-vs-host parity, sampled-vs-full residual/score parity
+under random churn, the frontier-limit boundary, and honest budget-
+exhaustion degradation down the ladder.
+
+Tolerance notes: the sampled/partial paths run host-f64 scalars with
+the device kernel at the anchor dtype; the full-sweep oracle runs the
+patched routed operator. Both stop when the per-sweep relative-L1
+delta ≤ tol, so each can sit up to tol·r/(1−r) ≤ tol/alpha from the
+fixed point — score assertions compare against
+``budget_spent + 2·tol/alpha`` (the declared budget), and iteration
+counts carry the established ±1 reduction-order slack (PR 5
+diagnosis)."""
+
+import numpy as np
+
+from protocol_tpu.graph import barabasi_albert_edges
+from protocol_tpu.incremental import (
+    DeltaEngine,
+    device_partial_refresh,
+    ladder_refresh,
+    partial_refresh,
+    sampled_refresh,
+)
+from protocol_tpu.ops.routed import build_routed_operator
+
+TOL = 1e-8
+MAX_IT = 500
+INITIAL = 1000.0
+ALPHA = 0.15
+
+
+def _edge_dict(src, dst, val):
+    edges = {}
+    for s, d, v in zip(src, dst, val):
+        if s != d:
+            edges[(int(s), int(d))] = edges.get((int(s), int(d)),
+                                                0.0) + float(v)
+    return edges
+
+
+def _anchored(n=240, m=3, seed=21, dtype=None, alpha=ALPHA):
+    import jax.numpy as jnp
+
+    src, dst, val = barabasi_albert_edges(n, m, seed=seed)
+    valid = np.ones(n, dtype=bool)
+    op = build_routed_operator(n, src, dst, val, valid)
+    eng = DeltaEngine.anchor(n, src, dst, val, valid, op,
+                             dtype=dtype or jnp.float64, alpha=alpha)
+    return eng, _edge_dict(src, dst, val)
+
+
+def _published(eng):
+    s_pub, it0, d0 = eng.converge(eng.initial_node_scores(INITIAL),
+                                  MAX_IT, TOL)
+    assert d0 <= TOL
+    eng.take_frontier()
+    return s_pub
+
+
+def _revise(eng, edges, rng, count, inserts=0):
+    """A churn window: ``count`` random weight revisions (+ optional
+    structural inserts, exercising the COO-tail side of the shared
+    in-edge gather); returns the drained frontier."""
+    keys = [k for k in edges if edges[k] > 0]
+    deltas = []
+    for k in rng.choice(len(keys), count, replace=False):
+        i, j = keys[k]
+        new = float(rng.integers(1, 25))
+        deltas.append((i, j, edges[(i, j)], new))
+        edges[(i, j)] = new
+    n = eng.n_now
+    added = 0
+    while added < inserts:
+        a, b = int(rng.integers(0, n)), int(rng.integers(0, n))
+        if a == b or edges.get((a, b), 0.0) > 0:
+            continue
+        deltas.append((a, b, edges.get((a, b)), 6.0))
+        edges[(a, b)] = 6.0
+        added += 1
+    assert eng.apply_deltas(deltas), eng.stats
+    frontier, ok = eng.take_frontier()
+    assert ok and len(frontier)
+    return frontier
+
+
+def _rel_l1(a, b):
+    return float(np.sum(np.abs(np.asarray(a) - np.asarray(b)))
+                 / np.sum(np.abs(b)))
+
+
+def test_device_partial_matches_host_partial():
+    """The device kernel mirrors the host partial sweep's math exactly
+    (same gather via frontier_inedges, same scalar accounting): from
+    the same warm vector and frontier — tail edges included — both
+    must run the same number of sweeps to the same residual and
+    essentially identical scores."""
+    rng = np.random.default_rng(2)
+    eng, edges = _anchored()
+    s_pub = _published(eng)
+    frontier = _revise(eng, edges, rng, 6, inserts=3)
+    n = eng.n_now
+    res_h = partial_refresh(eng, s_pub, frontier, TOL, MAX_IT, n)
+    res_d = device_partial_refresh(eng, s_pub, frontier, TOL, MAX_IT, n)
+    assert res_h is not None and res_d is not None
+    assert res_d.sweeps == res_h.sweeps
+    assert res_d.frontier_peak == res_h.frontier_peak
+    assert abs(res_d.residual - res_h.residual) <= 1e-12
+    assert np.max(np.abs(res_d.scores - res_h.scores)) \
+        <= 1e-9 * np.max(np.abs(res_h.scores))
+
+
+def test_sampled_vs_full_residual_parity_property():
+    """The sampled-mode property test: random LOCALIZED and FLOODED
+    churn windows, each served by the partially-observed mode and
+    checked against the full device sweep from the same warm vector —
+    scores within the declared budget (accumulated honesty-budget
+    spend + both stopping windows) and sweep counts within the
+    established reduction-order slack."""
+    rng = np.random.default_rng(31)
+    eng, edges = _anchored(n=260, m=3, seed=17)
+    n = eng.n_now
+    s_pub = _published(eng)
+    served = 0
+    for round_, count in enumerate((4, 120, 7, 200)):
+        frontier = _revise(eng, edges, rng, count,
+                           inserts=2 if round_ % 2 else 0)
+        res = sampled_refresh(eng, s_pub, frontier, TOL, MAX_IT, n)
+        assert res is not None, \
+            f"round {round_}: sampled fell back with budget n"
+        s_full, it_f, d_f = eng.converge(s_pub, MAX_IT, TOL)
+        assert d_f <= TOL
+        declared = (res.budget_spent + 2.0 * TOL) / ALPHA
+        err = _rel_l1(res.scores, s_full)
+        assert err <= declared, \
+            f"round {round_}: L1 {err:.3e} outside declared " \
+            f"{declared:.3e}"
+        assert abs(int(res.sweeps) - int(it_f)) <= 1, \
+            f"round {round_}: sweeps {res.sweeps} vs full {it_f}"
+        served += 1
+        s_pub = s_full
+    assert served == 4
+
+
+def test_frontier_limit_boundary_exactly_at_limit_serves():
+    """The partial bound is exclusive: a frontier of EXACTLY
+    frontier_limit rows must be served, not fall back — on the host
+    path, the device path, and through the ladder (which must then
+    report the partial mode, not sampled/full)."""
+    rng = np.random.default_rng(5)
+    eng, edges = _anchored(n=200, m=3, seed=11)
+    n = eng.n_now
+    s_pub = _published(eng)
+    _revise(eng, edges, rng, 30)
+    # the whole-graph frontier cannot expand past itself: at
+    # frontier_limit == len(F) the > bound must NOT trip
+    F = np.arange(n, dtype=np.int64)
+    res_h = partial_refresh(eng, s_pub, F, TOL, MAX_IT, len(F))
+    assert res_h is not None, "host partial fell back at exactly-limit"
+    res_d = device_partial_refresh(eng, s_pub, F, TOL, MAX_IT, len(F))
+    assert res_d is not None, "device partial fell back at exactly-limit"
+    res, mode = ladder_refresh(eng, s_pub, F, TOL, MAX_IT, len(F),
+                               device_threshold=0, sample_budget=n)
+    assert res is not None and mode == "device_partial", mode
+    # one below the limit falls through to the sampled rung instead
+    res, mode = ladder_refresh(eng, s_pub, F, TOL, MAX_IT, len(F) - 1,
+                               device_threshold=0, sample_budget=n)
+    assert res is not None and mode == "sampled", mode
+
+
+def test_sampled_budget_exhaustion_returns_none():
+    """A sample budget too small to cover the active closure must make
+    the sampled mode decline (accumulated neglected-propagation mass
+    past the tol budget, or no room for the frontier at all) — never
+    silently publish under-converged scores."""
+    rng = np.random.default_rng(9)
+    eng, edges = _anchored(n=400, m=3, seed=13)
+    s_pub = _published(eng)
+    frontier = _revise(eng, edges, rng, 3)
+    assert len(frontier) + 4 < eng.n_now  # a real complement exists
+    # frontier larger than the whole budget: no footing at all
+    assert sampled_refresh(eng, s_pub, frontier, TOL, MAX_IT,
+                           max(len(frontier) // 2, 1)) is None
+    # budget admits the frontier but not its closure: the neglected-
+    # propagation bound must exhaust the tol budget and decline
+    assert sampled_refresh(eng, s_pub, frontier, TOL, MAX_IT,
+                           len(frontier) + 4) is None
+
+
+def test_refresher_ladder_degrades_sampled_to_full_honestly():
+    """ScoreRefresher-level budget exhaustion: with the partial bound
+    forced tiny and a sample budget too small for the closure, a warm
+    refresh must degrade to the FULL device sweep (scope mode "full"),
+    still publish rebuild-accurate scores, and count zero sublinear
+    refreshes."""
+    from protocol_tpu.backend import JaxRoutedBackend
+    from protocol_tpu.service.config import ServiceConfig
+    from protocol_tpu.service.refresh import ScoreRefresher
+    from protocol_tpu.service.state import OpinionGraph
+    from protocol_tpu.utils import trace
+
+    trace.enable()
+
+    def scope_total(mode):
+        return trace.counter_total("refresh_sweep_scope", mode=mode)
+
+    g = OpinionGraph()
+    cfg = ServiceConfig(routed_edge_threshold=1, tol=1e-8,
+                        partial_frontier_fraction=1e-9,
+                        device_partial_threshold=0, sample_budget=2,
+                        cold_edit_fraction=1e9, cold_every=0)
+    r = ScoreRefresher(g, cfg)
+    n = 40
+    a = [bytes([i + 1]) * 20 for i in range(n)]
+    src, dst, val = barabasi_albert_edges(n, 3, seed=6)
+
+    class _Signed:
+        def __init__(self, about, value):
+            self.attestation = type("A", (), {"about": about,
+                                              "value": value})()
+
+    for s, d, v in zip(src, dst, val):
+        if s != d:
+            g.apply([_Signed(a[int(d)], float(v))], [a[int(s)]])
+    r.refresh()
+    assert r.delta_engine is not None
+    full0 = scope_total("full")
+    s0, d0 = int(src[0]), int(dst[0])
+    g.apply([_Signed(a[d0], 25.0)], [a[s0]])
+    r.refresh()
+    assert scope_total("full") == full0 + 1, \
+        "exhausted ladder did not degrade to the full sweep"
+    assert r.partial_refreshes == 0 and r.sampled_refreshes == 0
+    assert r.full_sweeps >= 1
+    gn, gsrc, gdst, gval, _, _ = g.snapshot()
+    s_ref, _, _ = JaxRoutedBackend().converge_edges(
+        gn, gsrc, gdst, gval, np.ones(gn, dtype=bool),
+        cfg.initial_score, cfg.max_iterations, tol=cfg.tol)
+    np.testing.assert_allclose(r.table.scores, s_ref, rtol=1e-3)
+
+
+def test_refresher_ladder_records_device_and_sampled_modes():
+    """Refresher integration: with the device kernel forced on, a
+    localized window must be served as ``device_partial`` and a
+    flooded window (frontier past the partial bound, budget ample) as
+    ``sampled`` — with the frontier-peak/budget gauges updated and
+    zero full plan builds across both."""
+    from protocol_tpu.service.config import ServiceConfig
+    from protocol_tpu.service.refresh import ScoreRefresher
+    from protocol_tpu.service.state import OpinionGraph
+    from protocol_tpu.utils import trace
+
+    trace.enable()
+
+    counter_total = trace.counter_total
+
+    g = OpinionGraph()
+    cfg = ServiceConfig(routed_edge_threshold=1, tol=1e-8,
+                        partial_frontier_fraction=1.0,
+                        device_partial_threshold=0,
+                        sample_budget=1 << 16,
+                        cold_edit_fraction=1e9, cold_every=0)
+    r = ScoreRefresher(g, cfg)
+    n = 40
+    a = [bytes([i + 1]) * 20 for i in range(n)]
+    src, dst, val = barabasi_albert_edges(n, 3, seed=6)
+
+    class _Signed:
+        def __init__(self, about, value):
+            self.attestation = type("A", (), {"about": about,
+                                              "value": value})()
+
+    for s, d, v in zip(src, dst, val):
+        if s != d:
+            g.apply([_Signed(a[int(d)], float(v))], [a[int(s)]])
+    r.refresh()
+    assert r.delta_engine is not None
+    builds0 = counter_total("operator_full_builds")
+    s0, d0 = int(src[0]), int(dst[0])
+    g.apply([_Signed(a[d0], 21.0)], [a[s0]])
+    r.refresh()
+    assert r.device_partial_refreshes >= 1, r.delta_status()
+    assert r.last_frontier_peak >= 1
+    # flood: shrink the partial bound so the same churn shape lands on
+    # the sampled rung (config is per-refresher state — mutate in place
+    # like the daemon's env overrides would)
+    r.config.partial_frontier_fraction = 1e-9
+    g.apply([_Signed(a[d0], 22.0)], [a[s0]])
+    r.refresh()
+    assert r.sampled_refreshes >= 1, r.delta_status()
+    st = r.delta_status()
+    assert st["frontier_peak"] >= 1 and st["budget_spent"] >= 0.0
+    assert counter_total("operator_full_builds") == builds0
+
+
+def test_device_rung_floors_tol_at_f32_and_charges_slack():
+    """The service DEFAULT tol (1e-9) sits below the f32 kernel's
+    residual floor — and production imports run with x64 OFF (conftest
+    enables it for tests only). With a budget that can absorb the
+    coarser stop, the device rung must SERVE: stop at the dtype floor,
+    charge the slack to ``budget_spent``, and land within the declared
+    error of the f64 host twin — never burn ``max_sweeps`` spinning
+    under an unreachable tol."""
+    import jax
+
+    rng = np.random.default_rng(17)
+    eng, edges = _anchored(seed=29)
+    s_pub = _published(eng)
+    frontier = _revise(eng, edges, rng, 5)
+    tol = 1e-9
+    jax.config.update("jax_enable_x64", False)
+    try:
+        res = device_partial_refresh(eng, s_pub, frontier, tol, MAX_IT,
+                                     eng.n_now, error_budget=1e-3)
+    finally:
+        jax.config.update("jax_enable_x64", True)
+    assert res is not None
+    floor = 8.0 * float(np.finfo(np.float32).eps)
+    assert res.sweeps < MAX_IT
+    assert res.budget_spent >= floor - tol
+    res_h = partial_refresh(eng, s_pub, frontier, TOL, MAX_IT,
+                            eng.n_now)
+    assert res_h is not None
+    assert _rel_l1(res.scores, res_h.scores) \
+        <= (res.budget_spent + 2 * floor) / ALPHA

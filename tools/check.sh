@@ -41,7 +41,10 @@ echo "serve_smoke --restart --churn: rc=${smoke_rc}"
 # path. DEVICE_OBS_OK asserts the stage/converge histogram families
 # and a steady-state XLA recompile count of 0 on the live daemon's
 # /metrics; DELTA_DAEMON_OK asserts ptpu_operator_full_builds_total
-# stays flat under weight-revision churn on the live daemon; DELTA_OK
+# stays flat under weight-revision churn on the live daemon;
+# SUBLINEAR_OK asserts the ladder's device_partial AND sampled
+# sweep-scope samples land on the live daemon with full builds flat
+# and the frontier-peak/budget gauges live; DELTA_OK
 # is the offline >=100k-edge delta-vs-rebuild evidence (>=10x, score
 # parity); PROOF_POOL_OK asserts 2 host-path pool workers both ran
 # concurrently submitted proof jobs (worker-labelled stage samples on
@@ -55,10 +58,11 @@ grep -q SCRAPE_LINT_OK /tmp/_smoke.log \
     && grep -q TRACE_JOIN_OK /tmp/_smoke.log \
     && grep -q DEVICE_OBS_OK /tmp/_smoke.log \
     && grep -q DELTA_DAEMON_OK /tmp/_smoke.log \
+    && grep -q SUBLINEAR_OK /tmp/_smoke.log \
     && grep -q PROOF_POOL_OK /tmp/_smoke.log \
     && grep -q COMMIT_PIPE_OK /tmp/_smoke.log \
     && grep -q "DELTA_OK" /tmp/_smoke.log && lint_rc=0
-echo "scrape-lint + trace-join + device-obs + delta + pool + commit: rc=${lint_rc}"
+echo "scrape-lint + trace-join + device-obs + delta + sublinear + pool + commit: rc=${lint_rc}"
 
 # opt-in perf-regression gate (PTPU_PERF_GATE=1): per-stage timings of
 # the instrumented prove/refresh workloads vs tools/perf_baseline.json.

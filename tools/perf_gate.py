@@ -45,6 +45,7 @@ PROVE_KW = {"k": 7, "gates": 64, "repeat": 1}
 REFRESH_KW = {"n": 1500, "m": 4, "engine": "gather", "tol": 1e-6,
               "repeat": 1}
 DELTA_KW = {"n": 4000, "m": 4, "batches": 10, "batch_edges": 200}
+SUBLINEAR_KW = {"n": 3000, "m": 4}
 PROOFS_KW = {"k": 7, "gates": 64, "jobs": 6, "workers": 2}
 COMMITS_KW = {"k": 13, "columns": 8}
 
@@ -59,6 +60,7 @@ def _run_once() -> dict:
         run_proofs_workload,
         run_prove_workload,
         run_refresh_workload,
+        run_sublinear_workload,
     )
     from protocol_tpu.utils import trace
 
@@ -88,6 +90,13 @@ def _run_once() -> dict:
     measure("delta", lambda: run_delta_workload(**DELTA_KW),
             ("routed.plan_build", "delta.classify", "delta.revise",
              "delta.structural", "delta.renorm", "converge.edges"))
+    # the sublinear refresh ladder: the device partial sweep and the
+    # partially-observed sampled mode vs the full-sweep oracle — a
+    # rung regressing (or silently degrading to the full sweep, which
+    # would move converge.edges instead) fails against the baseline
+    measure("sublinear", lambda: run_sublinear_workload(**SUBLINEAR_KW),
+            ("partial.device", "partial.sampled", "converge.edges",
+             "routed.plan_build"))
     # the proof pool: real proves through 2 host-path workers — a
     # scheduling regression (queue stall, lost wakeup, accidental
     # serialization) grows the workload total against the baseline
@@ -121,7 +130,8 @@ def run_workloads(runs: int) -> dict:
         "schema": "ptpu-perf-gate-v1",
         "workload_params": {"prove": PROVE_KW, "refresh": REFRESH_KW,
                             "delta": DELTA_KW, "proofs": PROOFS_KW,
-                            "commits": COMMITS_KW},
+                            "commits": COMMITS_KW,
+                            "sublinear": SUBLINEAR_KW},
         "runs": runs,
         "workloads": best,
     }
