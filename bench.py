@@ -130,6 +130,27 @@ def main():
                         help="concurrent submitting clients")
     parser.add_argument("--workers-list", default="1,2",
                         help="comma-separated worker counts to sweep")
+    parser.add_argument("--sharded", action="store_true",
+                        help="BENCH_r10: intra-prove sharding — one "
+                             "flagship-shape prove's wall clock at "
+                             "1/2/4 workers (worker lending) under the "
+                             "device-window methodology, plus real-"
+                             "prove byte parity through the pool")
+    parser.add_argument("--shard-k", type=int, default=16,
+                        help="log2 column length of the flagship-shape "
+                             "commit flush")
+    parser.add_argument("--shard-cols", type=int, default=8,
+                        help="commit columns per flagship-shape flush")
+    parser.add_argument("--shard-workers", default="1,2,4",
+                        help="worker counts for the sharded curve")
+    parser.add_argument("--shard-reps", type=int, default=3,
+                        help="best-of-N per cell")
+    parser.add_argument("--shard-window", type=float, default=0.0,
+                        help="device-occupancy window seconds inside "
+                             "the flagship-shape prove (0 = auto: the "
+                             "measured inline commit wall, the "
+                             "flagship regime where device quotient "
+                             "and commit wall are comparable)")
     parser.add_argument("--device-window", type=float, default=1.2,
                         help="per-proof device-occupancy window in "
                              "seconds (GIL-released wait modeling the "
@@ -142,6 +163,9 @@ def main():
 
     if args.proofs:
         return bench_proofs(args)
+
+    if args.sharded:
+        return bench_sharded(args)
 
     if args.ingest:
         # chip-measured att/s for hash + binding-checked GLV recovery;
@@ -918,6 +942,207 @@ def bench_proofs(args) -> int:
     if speedup_2w is not None and speedup_2w < 1.8:
         print("BENCH FAILED: 2-worker scaling under the 1.8x floor",
               file=sys.stderr)
+        return 1
+    return 0
+
+
+def bench_sharded(args) -> int:
+    """BENCH_r10: intra-prove sharding — ONE prove's wall clock vs
+    worker count, with worker lending fanning the prove's commit work
+    units across the pool.
+
+    Methodology (the BENCH_r07 device-window discipline one level
+    down, now INSIDE a single prove): the flagship-shape workload is a
+    real CommitEngine flush of ``--shard-cols`` columns at
+    2^``--shard-k`` over real SRS bases, dispatched with
+    ``flush_async()`` and merged through the deterministic rendezvous,
+    with a ``--shard-window`` seconds device-occupancy window between
+    dispatch and merge — ``time.sleep`` releasing the GIL, standing in
+    for the device-resident quotient/ext phase a real flagship prove
+    holds there (BASELINE r4: the warm k=20 device prove is ~30 s of
+    host commits against a comparable device-resident phase; window
+    auto-sizes to the measured inline commit wall to reproduce that
+    regime). On this 1-core box that window is what makes intra-prove
+    overlap physically possible at all: a single worker must run the
+    window THEN the MSMs serially, while lent workers chew the
+    GIL-released ``g1_msm_multi`` shards UNDER it. On a real
+    multi-device box the same fan-out overlaps MSM shards with other
+    workers' cores outright — that curve is owed to hardware, like
+    BENCH_r07's. Every cell's transcript digest must equal the inline
+    (runner-free) reference — sharding may move work, never a
+    transcript byte. 4 workers ≈ 2 workers here by construction (one
+    window, one spare core's worth of GIL-released compute); recorded
+    anyway so the shape of the curve is honest.
+
+    A second leg proves byte parity end-to-end on the REAL prove path:
+    a full ``prove_fast`` sharded through the pool must produce the
+    exact bytes of the direct single-worker call (its sharded-vs-single
+    wall on this box is ~1.0x — host arithmetic cannot overlap itself
+    on one core — and is reported, not hidden).
+
+    Headline: flagship-shape wall at 1 worker / wall at 2 workers;
+    acceptance floor 1.3x.
+    """
+    from protocol_tpu.utils.platform import honor_jax_platforms_env
+
+    honor_jax_platforms_env()
+
+    from protocol_tpu.cli.profilecmd import synthetic_circuit
+    from protocol_tpu.service.faults import FaultInjector
+    from protocol_tpu.service.pool import ProofWorkerPool
+    from protocol_tpu.zk import prover_fast as pf
+    from protocol_tpu.zk.commit_engine import CommitEngine
+    from protocol_tpu.zk.transcript import make_transcript
+
+    import random as _random
+
+    k, cols_n = args.shard_k, args.shard_cols
+    print(f"setup: params 2^{k}, {cols_n} columns", file=sys.stderr)
+    params = pf.setup_params_fast(k, seed=b"shard-bench")
+    rng = _random.Random(17)
+    n = 1 << k
+    blob = np.frombuffer(
+        rng.getrandbits(8 * 32 * n * cols_n).to_bytes(
+            32 * n * cols_n, "little"),
+        dtype="<u8").reshape(cols_n, n, 4).copy()
+    blob[:, :, 3] &= (1 << 59) - 1  # keep scalars < R
+    cols = [np.ascontiguousarray(blob[i]) for i in range(cols_n)]
+    no_faults = FaultInjector({"rpc": 0.0, "device": 0.0, "disk": 0.0})
+
+    def flush_digest(window: float) -> tuple:
+        """One flagship-shape prove body: dispatch → window → merge →
+        absorb in submission order → transcript digest."""
+        eng = CommitEngine(params)
+        for i, c in enumerate(cols):
+            eng.submit_coeffs(f"c{i}", c)
+        handle = eng.flush_async()
+        if window:
+            time.sleep(window)  # the device-occupancy stand-in
+        pts = handle.result()
+        tr = make_transcript("poseidon")
+        for pt in pts:
+            tr.absorb_point(pt)
+        return tr.challenge()
+
+    # inline reference: no runner → everything computes at result();
+    # also measures the commit wall the auto window reproduces. One
+    # unmeasured warm-up first: the initial flush pays the one-time
+    # SRS limb conversion, which is params-cached for every later cell
+    flush_digest(0.0)
+    t0 = time.perf_counter()
+    ref_digest = flush_digest(0.0)
+    t_flush = time.perf_counter() - t0
+    window = args.shard_window or round(t_flush, 3)
+    print(f"inline commit wall {t_flush:.3f}s -> window {window:.3f}s",
+          file=sys.stderr)
+
+    def run_cell(n_workers: int) -> dict:
+        pool = ProofWorkerPool(
+            {"flagship": lambda p: {"digest": str(flush_digest(window))}},
+            capacity=8, workers=n_workers, faults=no_faults,
+            shard_kinds={"flagship"}, shard_cap=4)
+        pool.start()
+        best = None
+        digest = None
+        for _ in range(max(1, args.shard_reps)):
+            job = pool.submit("flagship", {})
+            # a rendezvous/lending regression must FAIL the bench,
+            # not hang it (the bench_proofs stall-deadline rule)
+            stall = time.monotonic() + 600.0
+            while pool.get(job.job_id).status not in ("done", "failed"):
+                if time.monotonic() > stall:
+                    raise RuntimeError("sharded flagship prove stalled")
+                time.sleep(0.01)
+            got = pool.get(job.job_id)
+            assert got.status == "done", got.error
+            digest = got.result["digest"]
+            assert digest == str(ref_digest), \
+                f"{n_workers}w: transcript digest diverged"
+            wall = got.finished_at - got.started_at
+            best = wall if best is None else min(best, wall)
+        status = pool.pool_status()
+        pool.drain(10.0)
+        return {
+            "workers": n_workers,
+            "wall_s": round(best, 3),
+            "lent_units": sum(w["shards_run"]
+                              for w in status["workers"]),
+        }
+
+    worker_counts = [int(x) for x in args.shard_workers.split(",") if x]
+    if not {1, 2} <= set(worker_counts):
+        # the headline IS wall(1w)/wall(2w): without both cells the
+        # bench would fabricate a passing 1.0x — refuse instead
+        print("error: --shard-workers must include 1 and 2 (the "
+              "headline cells)", file=sys.stderr)
+        return 1
+    run_cell(worker_counts[0])  # warm (base parse/limb caches)
+    curve = [run_cell(nw) for nw in worker_counts]
+    by_workers = {c["workers"]: c for c in curve}
+
+    # leg B: the real prove path end-to-end through the pool
+    cs = synthetic_circuit(gates=args.proof_gates, seed=11)
+    pparams = pf.setup_params_fast(args.proof_k, seed=b"shard-parity")
+    ppk = pf.keygen_fast(pparams, cs)
+    reference = pf.prove_fast(pparams, ppk, cs, randint=lambda: 424242)
+    t0 = time.perf_counter()
+    pf.prove_fast(pparams, ppk, cs, randint=lambda: 424242)
+    t_direct = time.perf_counter() - t0
+    pool = ProofWorkerPool(
+        {"eigentrust": lambda p: {"proof": pf.prove_fast(
+            pparams, ppk, cs, randint=lambda: 424242).hex()}},
+        capacity=8, workers=2, faults=no_faults,
+        shard_kinds={"eigentrust"}, shard_cap=4,
+        worker_env=lambda w: pf.worker_isolation(w.name, w.device))
+    pool.start()
+    job = pool.submit("eigentrust", {})
+    stall = time.monotonic() + 600.0
+    while pool.get(job.job_id).status not in ("done", "failed"):
+        if time.monotonic() > stall:
+            raise RuntimeError("sharded real prove stalled")
+        time.sleep(0.01)
+    got = pool.get(job.job_id)
+    assert got.status == "done", got.error
+    assert bytes.fromhex(got.result["proof"]) == reference, \
+        "sharded real prove diverged from the direct prove_fast"
+    t_sharded_real = got.finished_at - got.started_at
+    pool.drain(10.0)
+
+    speedup_2w = None
+    if 1 in by_workers and 2 in by_workers:
+        speedup_2w = by_workers[1]["wall_s"] / by_workers[2]["wall_s"]
+    meta = {
+        "mode": "sharded",
+        "shard_k": k,
+        "columns": cols_n,
+        "window_s": window,
+        "inline_commit_wall_s": round(t_flush, 3),
+        "curve": curve,
+        "transcript_parity": "digest identical to the inline "
+                             "(runner-free) flush at every cell",
+        "real_prove": {
+            "k": args.proof_k, "gates": args.proof_gates,
+            "direct_s": round(t_direct, 3),
+            "sharded_2w_s": round(t_sharded_real, 3),
+            "byte_parity": "identical to direct prove_fast",
+        },
+        "host_cores": os.cpu_count(),
+        "speedup_2w": (round(speedup_2w, 3)
+                       if speedup_2w is not None else None),
+    }
+    print(json.dumps(meta), file=sys.stderr)
+    value = speedup_2w if speedup_2w is not None else 1.0
+    print(json.dumps({
+        "metric": "intra-prove sharding: flagship-shape prove wall, "
+                  f"1 worker vs 2 (2^{k} x {cols_n} commit columns, "
+                  f"{window:.2f}s device window)",
+        "value": round(value, 3),
+        "unit": "x",
+        "vs_baseline": round(value / 1.3, 3),
+    }))
+    if speedup_2w is not None and speedup_2w < 1.3:
+        print("BENCH FAILED: 2-worker sharded speedup under the 1.3x "
+              "floor", file=sys.stderr)
         return 1
     return 0
 

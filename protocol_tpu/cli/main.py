@@ -143,6 +143,11 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--workers", type=int, default=None,
                    help="proof pool workers (default 0 = one per jax "
                         "device; host-path workers on a CPU box)")
+    p.add_argument("--shard-proves", type=int, default=None,
+                   metavar="0|1",
+                   help="1: fan a single prove's commit/quotient/fold "
+                        "work units out to idle pool workers "
+                        "(byte-identical proofs; default 0)")
     p.add_argument("--shape", choices=["default", "tiny"], default=None,
                    help="circuit shape served by proof jobs")
     p.add_argument("--transcript", choices=["poseidon", "keccak"],
@@ -836,6 +841,7 @@ def handle_serve(args, files, config):
         max_iterations=args.max_iterations,
         queue_capacity=args.queue_capacity,
         pool_workers=args.workers,
+        shard_proves=args.shard_proves,
         proof_shape=args.shape, transcript=args.transcript,
         state_dir=args.state_dir)
     if svc_config.state_dir:

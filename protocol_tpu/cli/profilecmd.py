@@ -323,6 +323,69 @@ def run_proofs_workload(k: int = 7, gates: int = 64, jobs: int = 6,
                            for w in status["workers"]}}
 
 
+def run_sharded_workload(k: int = 7, gates: int = 64, workers: int = 2,
+                         jobs: int = 3, seed: int = 9) -> dict:
+    """Real host-path proves SHARDED across a 2-worker pool (worker
+    lending, ``pool.shard_kinds``): each prove's commit columns,
+    quotient row chunks and opening folds fan out to the idle worker
+    and rendezvous in submission order. Byte parity vs the direct
+    single-worker prove is asserted per job, and the run must have
+    actually sharded (``ptpu_prove_shards_total`` > 0) — a fan-out
+    regression that silently serializes would otherwise still pass.
+    The perf gate tracks the ``service.proof`` and ``prove.shard``
+    spans against the committed baseline."""
+    from .. import native
+    from ..service.faults import FaultInjector
+    from ..service.pool import ProofWorkerPool
+    from ..utils import trace
+    from ..zk import prover_fast as pf
+
+    if not native.available():
+        raise EigenError("config_error",
+                         "the sharded workload needs the native "
+                         "toolchain")
+    cs = synthetic_circuit(gates=gates, seed=seed)
+    params = pf.setup_params_fast(k, seed=b"profile-shard")
+    pk = pf.keygen_fast(params, cs, k=k, eval_pk="auto")
+    reference = pf.prove_fast(params, pk, cs, randint=lambda: 424242)
+    shards0 = trace.counter_total("prove_shards")
+
+    def prove(p):
+        return {"proof": pf.prove_fast(
+            params, pk, cs, randint=lambda: 424242).hex()}
+
+    pool = ProofWorkerPool(
+        {"eigentrust": prove}, capacity=max(jobs, 8), workers=workers,
+        faults=FaultInjector({"rpc": 0.0, "device": 0.0, "disk": 0.0}),
+        shard_kinds={"eigentrust"}, shard_cap=4,
+        worker_env=lambda w: pf.worker_isolation(w.name, w.device))
+    pool.start()
+    submitted = [pool.submit("eigentrust", {}) for _ in range(jobs)]
+    deadline = time.monotonic() + 300.0
+    while pool.completed + pool.failed < jobs:
+        if time.monotonic() > deadline:
+            raise EigenError("internal_error", "sharded pool stalled")
+        time.sleep(0.01)
+    for job in submitted:
+        got = pool.get(job.job_id)
+        if got.status != "done" or \
+                bytes.fromhex(got.result["proof"]) != reference:
+            raise EigenError(
+                "verification_error",
+                f"sharded proof diverged from the direct prove "
+                f"({got.status}: {got.error})")
+    shards = trace.counter_total("prove_shards") - shards0
+    if shards <= 0:
+        raise EigenError("internal_error",
+                         "sharding never engaged (0 shard units)")
+    status = pool.pool_status()
+    pool.drain(10.0)
+    return {"workload": "sharded", "k": k, "gates": gates,
+            "jobs": jobs, "workers": workers, "shards": int(shards),
+            "lent": {w["worker"]: w["shards_run"]
+                     for w in status["workers"]}}
+
+
 def run_daemon_capture(url: str, seconds: float) -> dict:
     """Submit a ``profile`` job to a live daemon and wait for the
     capture window to close; returns the job result (xprof log dir on

@@ -21,9 +21,10 @@ O(dirty) instead:
 - :mod:`device` — the device twin (``device_partial_refresh``: the
   same sweeps through the ``ops.converge.partial_sweep_device``
   segment-gather kernel, score vector device-resident) plus the
-  partially-observed ``sampled_refresh`` mode (fixed sample set with a
-  neglected-propagation honesty budget — the arXiv 2606.11956
-  footing), and ``ladder_refresh``, the explicit sublinear ladder
+  partially-observed ``sampled_refresh`` mode (per-sweep-resampled
+  observation set with a neglected-propagation honesty budget — the
+  arXiv 2606.11956 footing), and ``ladder_refresh``, the explicit
+  sublinear ladder
   ``partial → device_partial → sampled`` the refresher (and bench)
   drive before falling back to a full device sweep, then a rebuild.
 

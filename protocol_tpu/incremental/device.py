@@ -18,10 +18,14 @@ adds the mode between "partial" and "full":
 - :func:`sampled_refresh` — the partially-observed mode ("Analysis of
   Power Iteration Algorithm with Partially Observed Matrix-vector
   Products", PAPERS.md): when the frontier outgrows the partial bound,
-  converge on a FIXED sample set S = frontier ∪ importance-sampled
-  fan-out closure (≤ ``sample_budget`` rows, Gumbel top-k on score
-  mass — the heavy rows absorb most of the neglected L1). Rows outside
-  S are never updated; what their staleness can cost is bounded
+  converge on a PER-SWEEP-RESAMPLED set S_t = frontier ∪
+  importance-sampled fan-out closure (≤ ``sample_budget`` rows, Gumbel
+  top-k on score mass — the heavy rows absorb most of the neglected
+  L1; each sweep's draw is seeded per (refresh, sweep), so runs stay
+  deterministic while long sampled streaks stop neglecting the same
+  complement rows — the paper's per-iteration resampling). Rows
+  outside the current S are not updated that sweep; what their
+  staleness can cost is bounded
   exactly: a row r ∈ S that moved by |Δr| propagates at most
   |Δr| · ext_w(r) of L1 mass outside S per sweep (row-stochastic
   operator), where ext_w(r) is r's out-weight leaving S. That
@@ -68,56 +72,164 @@ def _pow2(x: int, floor: int = 16) -> int:
     return cap
 
 
-def _frontier_device_arrays(eng, F: np.ndarray, dummy: int, ext_w=None):
-    """Pow2-padded device operands for ``partial_sweep_device``: pad
-    frontier rows point at the dummy slot with valid=dangling=ext=0 and
-    pad edges carry weight 0, so every pad lane computes exactly 0."""
-    import jax.numpy as jnp
+class _FrontierOperands:
+    """Pow2-padded device operands for ``partial_sweep_device`` with
+    INCREMENTAL append: pad frontier rows point at the dummy slot with
+    valid=dangling=ext=0 and pad edges carry weight 0, so every pad
+    lane computes exactly 0 and real entries may be written into pad
+    lanes later without touching the device-resident rest.
 
-    rows, srcs, w = frontier_inedges(eng, F)
-    f_cap = _pow2(len(F))
-    e_cap = _pow2(max(len(rows), 1))
-    f_idx = np.full(f_cap, dummy, dtype=np.int64)
-    f_idx[:len(F)] = F
-    f_valid = np.zeros(f_cap)
-    f_valid[:len(F)] = eng.valid_np[F]
-    f_dang = np.zeros(f_cap)
-    f_dang[:len(F)] = eng.dangling_np[F]
-    f_ext = np.zeros(f_cap)
-    if ext_w is not None:
-        f_ext[:len(F)] = ext_w
-    e_row = np.zeros(e_cap, dtype=np.int64)
-    e_row[:len(rows)] = rows
-    e_src = np.full(e_cap, dummy, dtype=np.int64)
-    e_src[:len(rows)] = srcs
-    e_w = np.zeros(e_cap)
-    e_w[:len(rows)] = w
-    return (jnp.asarray(f_idx, dtype=jnp.int32),
-            jnp.asarray(f_valid), jnp.asarray(f_dang),
-            jnp.asarray(f_ext),
-            jnp.asarray(e_row, dtype=jnp.int32),
-            jnp.asarray(e_src, dtype=jnp.int32),
-            jnp.asarray(e_w))
+    The append path is what makes the device_partial rung's dominant
+    host cost sublinear in frontier size: a frontier expansion gathers
+    the in-edges of ONLY the newly-added rows (``frontier_inedges``
+    over the new rows, O(new fan-in)) and writes them into the
+    existing device arrays with two ``dynamic_update_slice`` bursts —
+    the old per-expansion rebuild re-gathered and re-uploaded the
+    WHOLE frontier every time, an O(frontier fan-in) host pass per
+    expansion that dominated the rung's wall at 10^5+ rows. Appended
+    rows take slots AFTER the existing ones, so ``slots`` is
+    insertion-ordered (sorted within each append batch) while
+    ``sorted`` keeps the membership view; the kernel never cares about
+    slot order, and the per-slot ``changed`` vector aligns with
+    ``slots``. Capacities grow by pow2 blocks and updates are pow2-
+    padded, so the jit cache stays O(log frontier · log fan-in) —
+    the delta patch-batch discipline.
+    """
+
+    def __init__(self, eng, F: np.ndarray, dummy: int, ext_w=None):
+        import jax.numpy as jnp
+
+        self.eng = eng
+        self.dummy = dummy
+        F = np.asarray(F, dtype=np.int64)
+        self.slots = F            # slot -> node id (insertion order)
+        self.sorted = F           # sorted membership view (F is sorted)
+        self.n_f = len(F)
+        self.gathered_rows = int(len(F))  # each row gathered ONCE —
+        # the regression test's no-rebuild signal
+        rows, srcs, w = frontier_inedges(eng, F)
+        self.n_e = len(rows)
+        f_cap = _pow2(max(len(F), 1))
+        e_cap = _pow2(max(len(rows), 1))
+        f_idx = np.full(f_cap, dummy, dtype=np.int64)
+        f_idx[:len(F)] = F
+        f_valid = np.zeros(f_cap)
+        f_valid[:len(F)] = eng.valid_np[F]
+        f_dang = np.zeros(f_cap)
+        f_dang[:len(F)] = eng.dangling_np[F]
+        f_ext = np.zeros(f_cap)
+        if ext_w is not None:
+            f_ext[:len(F)] = ext_w
+        e_row = np.zeros(e_cap, dtype=np.int64)
+        e_row[:len(rows)] = rows
+        e_src = np.full(e_cap, dummy, dtype=np.int64)
+        e_src[:len(rows)] = srcs
+        e_w = np.zeros(e_cap)
+        e_w[:len(rows)] = w
+        self.f_idx = jnp.asarray(f_idx, dtype=jnp.int32)
+        self.f_valid = jnp.asarray(f_valid)
+        self.f_dang = jnp.asarray(f_dang)
+        self.f_ext = jnp.asarray(f_ext)
+        self.e_row = jnp.asarray(e_row, dtype=jnp.int32)
+        self.e_src = jnp.asarray(e_src, dtype=jnp.int32)
+        self.e_w = jnp.asarray(e_w)
+
+    def arrays(self) -> tuple:
+        return (self.f_idx, self.f_valid, self.f_dang, self.f_ext,
+                self.e_row, self.e_src, self.e_w)
+
+    def _grow(self, name: str, need: int, fill) -> None:
+        import jax.numpy as jnp
+
+        arr = getattr(self, name)
+        cap = arr.shape[0]
+        if need <= cap:
+            return
+        new_cap = _pow2(need)
+        block = jnp.full((new_cap - cap,), fill, dtype=arr.dtype)
+        setattr(self, name, jnp.concatenate([arr, block]))
+
+    def _update(self, name: str, start: int, values: np.ndarray,
+                pad_len: int, fill) -> None:
+        """Write ``values`` at [start, start+len) via one
+        dynamic_update_slice of pow2-padded length — the pad lanes
+        re-write dummy/zero over dummy/zero, so the burst is exact."""
+        import jax
+        import jax.numpy as jnp
+
+        arr = getattr(self, name)
+        upd = np.full(pad_len, fill, dtype=arr.dtype)
+        upd[:len(values)] = values
+        setattr(self, name, jax.lax.dynamic_update_slice(
+            arr, jnp.asarray(upd, dtype=arr.dtype),
+            (jnp.asarray(start, dtype=jnp.int32),)))
+
+    def append(self, new_rows: np.ndarray) -> None:
+        """Extend the frontier by ``new_rows`` (sorted, disjoint from
+        the current set): gather ONLY their in-edges and append both
+        row and edge operands in place on device."""
+        eng = self.eng
+        new_rows = np.asarray(new_rows, dtype=np.int64)
+        if not len(new_rows):
+            return
+        self.gathered_rows += int(len(new_rows))
+        rows, srcs, w = frontier_inedges(eng, new_rows)
+        pad_f = _pow2(len(new_rows))
+        pad_e = _pow2(max(len(rows), 1))
+        self._grow("f_idx", self.n_f + pad_f, self.dummy)
+        self._grow("f_valid", self.n_f + pad_f, 0.0)
+        self._grow("f_dang", self.n_f + pad_f, 0.0)
+        self._grow("f_ext", self.n_f + pad_f, 0.0)
+        self._grow("e_row", self.n_e + pad_e, 0)
+        self._grow("e_src", self.n_e + pad_e, self.dummy)
+        self._grow("e_w", self.n_e + pad_e, 0.0)
+        self._update("f_idx", self.n_f, new_rows, pad_f, self.dummy)
+        self._update("f_valid", self.n_f, eng.valid_np[new_rows],
+                     pad_f, 0.0)
+        self._update("f_dang", self.n_f, eng.dangling_np[new_rows],
+                     pad_f, 0.0)
+        # f_ext stays 0: the expanding mode prices truncation on the
+        # host; the fixed-set mode never appends
+        # pad edges: e_row 0 with e_src dummy / weight 0 computes 0
+        # into slot 0 — exactly the original pad-lane contract
+        self._update("e_row", self.n_e, rows + self.n_f, pad_e, 0)
+        self._update("e_src", self.n_e, srcs, pad_e, self.dummy)
+        self._update("e_w", self.n_e, w, pad_e, 0.0)
+        self.n_f += len(new_rows)
+        self.n_e += len(rows)
+        self.slots = np.concatenate([self.slots, new_rows])
+        # linear merge of two sorted DISJOINT arrays — union1d's
+        # concat-sort is O(F log F) per expansion for no reason
+        pos = np.searchsorted(self.sorted, new_rows)
+        self.sorted = np.insert(self.sorted, pos, new_rows)
 
 
 def _device_sweeps(eng, s0, F: np.ndarray, tol: float, max_sweeps: int,
                    frontier_limit: int | None, ext_w,
-                   error_budget: float = 0.0) -> PartialResult | None:
+                   error_budget: float = 0.0,
+                   resample=None) -> PartialResult | None:
     """The shared sweep driver: device kernel per sweep, host scalars
     for the dangling shift and the honesty budget — the exact per-sweep
     math of ``partial.partial_refresh`` (mirror changes both ways; the
     parity test catches drift).
 
     ``frontier_limit`` set: expanding-frontier (device-partial) mode —
-    F grows along fan-out of moved rows, declines past the limit, and
-    truncated expansion (rows under drop_eps) is priced at |Δ|·ext_w
-    against the budget, exactly like the host twin. ``frontier_limit``
-    None: fixed-set (sampled) mode — F never grows and EVERY row's
-    |Δ|·ext_w is charged (the complement never updates, so all
-    boundary-crossing propagation is permanently neglected). The
-    stopping residual is the observed-rows residual either way; the
-    accumulated charge is reported as ``budget_spent``, the declared
-    error vs a full sweep."""
+    F grows along fan-out of moved rows (operands APPEND on device —
+    only the new rows' in-edges are gathered, never the whole frontier
+    again), declines past the limit, and truncated expansion (rows
+    under drop_eps) is priced at |Δ|·ext_w against the budget, exactly
+    like the host twin. ``frontier_limit`` None: fixed-set (sampled)
+    mode — EVERY observed row's |Δ|·ext_w is charged (the complement
+    never updates, so all boundary-crossing propagation is permanently
+    neglected); when ``resample`` is given (``sweep -> sorted row
+    set``), the observation set is REDRAWN before every sweep — the
+    paper's per-iteration resampling, de-biasing which rows stay
+    neglected over a long sampled streak — and the operands (and each
+    row set's external out-weights) rebuild only on a draw that
+    actually changed the set. The stopping residual is the
+    observed-rows residual either way; the accumulated charge is
+    reported as ``budget_spent``, the declared error vs a full
+    sweep."""
     import jax.numpy as jnp
 
     from ..ops.converge import partial_sweep_device
@@ -150,9 +262,10 @@ def _device_sweeps(eng, s0, F: np.ndarray, tol: float, max_sweeps: int,
     # fixed-set mode: the kernel prices every row's external leak; the
     # expanding mode prices only truncated (sub-drop_eps) rows, on the
     # host, from the downloaded per-row changes
-    arrays = _frontier_device_arrays(eng, F, dummy,
-                                     None if expand else ext_w)
+    ops = _FrontierOperands(eng, F, dummy,
+                            None if expand else ext_w)
     ext = None
+    resamples = 0
 
     peak = len(F)
     residual = np.inf
@@ -174,9 +287,20 @@ def _device_sweeps(eng, s0, F: np.ndarray, tol: float, max_sweeps: int,
     best_residual = np.inf
     stalled = 0
     for sweep in range(1, max_sweeps + 1):
-        if expand and len(F) > frontier_limit:
+        if expand and ops.n_f > frontier_limit:
             return None
-        peak = max(peak, len(F))
+        if resample is not None and sweep > 1:
+            # per-sweep resampling (sampled mode): a fresh Gumbel draw
+            # picks this sweep's observation set; only an actually-
+            # different set pays the operand + ext_w rebuild
+            S_new = resample(sweep)
+            if S_new is not None and not np.array_equal(S_new,
+                                                        ops.sorted):
+                ops = _FrontierOperands(
+                    eng, S_new, dummy,
+                    external_out_weight(eng, S_new))
+                resamples += 1
+        peak = max(peak, ops.n_f)
         d_now = d_arr + uni * dang_count
         g = keep * (d_now - d_prev) / denom
         d_prev = d_now
@@ -184,7 +308,7 @@ def _device_sweeps(eng, s0, F: np.ndarray, tol: float, max_sweeps: int,
         scal = jnp.asarray(np.array([uni, uni_next, d_now, denom, keep,
                                      alpha, n_valid, total]))
         s_dev, changed, l1, d_delta, vsum, negl = partial_sweep_device(
-            s_dev, *arrays, scal)
+            s_dev, *ops.arrays(), scal)
         uni = uni_next
         uni_budget += abs(g) * n_valid / norm
         if uni_budget + negl_budget + tol_slack > budget:
@@ -214,21 +338,28 @@ def _device_sweeps(eng, s0, F: np.ndarray, tol: float, max_sweeps: int,
             if stalled >= 6 and residual <= 8.0 * floor:
                 return None
         if expand:
-            changed_np = np.asarray(changed)[:len(F)]
+            # changed aligns with the SLOT order (insertion order
+            # after appends), as do ext_w and the big mask below
+            changed_np = np.asarray(changed)[:ops.n_f]
             big = np.abs(changed_np) > drop_eps
             if ext is None:
-                ext = external_out_weight(eng, F)
+                # external_out_weight wants the sorted membership
+                # view; map its per-row output back to slot order
+                ext_sorted = external_out_weight(eng, ops.sorted)
+                ext = ext_sorted[np.searchsorted(ops.sorted,
+                                                 ops.slots)]
             negl_budget += float(
                 np.sum(np.abs(changed_np[~big]) * ext[~big])) / norm
             if uni_budget + negl_budget + tol_slack > budget:
                 return None  # truncated-expansion budget exhausted
-            moved = F[big]
+            moved = ops.slots[big]
             if len(moved):
-                F2 = np.union1d(F, _fanout(eng, moved))
-                if len(F2) > len(F):
-                    F = F2
-                    arrays = _frontier_device_arrays(eng, F, dummy,
-                                                     None)
+                grown = _fanout(eng, moved)
+                new = grown[~_member(ops.sorted, grown)]
+                if len(new):
+                    # device-side append: gather ONLY the new rows'
+                    # in-edges — never rebuild the whole frontier
+                    ops.append(new)
                     ext = None
                     # new rows legitimately move the residual: the
                     # stall guard restarts on every expansion
@@ -241,7 +372,7 @@ def _device_sweeps(eng, s0, F: np.ndarray, tol: float, max_sweeps: int,
         s_out = s_out + uni * valid
     return PartialResult(s_out, sweep, residual, peak,
                          budget_spent=uni_budget + negl_budget
-                         + tol_slack)
+                         + tol_slack, resamples=resamples)
 
 
 def device_partial_refresh(eng, s0, frontier, tol: float,
@@ -260,30 +391,46 @@ def device_partial_refresh(eng, s0, frontier, tol: float,
                               error_budget=error_budget)
 
 
+def refresh_seed(F: np.ndarray, s0) -> list:
+    """The per-refresh seed material of the sampled mode's Gumbel
+    draws: the frontier shape and its warm score mass — deterministic
+    for a given refresh, varying across refreshes. The per-SWEEP rngs
+    extend it with the sweep index (see :func:`sampled_refresh`)."""
+    s0 = np.asarray(s0, dtype=np.float64)
+    mass = np.abs(s0[F]).sum()
+    return [len(F), int(F[0]), int(F[-1]),
+            int(np.float64(mass).view(np.uint64))]
+
+
 def sample_set(eng, F: np.ndarray, s0, budget: int,
                rng=None) -> np.ndarray | None:
-    """The sampled mode's observation set: the frontier plus its
-    fan-out closure, importance-sampled down to ``budget`` rows when a
-    hop overflows it (Gumbel top-k on warm-start score mass — heavy
-    rows absorb most of the L1 the un-observed complement would
-    accumulate). None when the frontier alone exceeds the budget."""
+    """One observation-set draw for the sampled mode: the frontier
+    plus its fan-out closure, importance-sampled down to ``budget``
+    rows when a hop overflows it (Gumbel top-k on warm-start score
+    mass — heavy rows absorb most of the L1 the un-observed complement
+    would accumulate). None when the frontier alone exceeds the
+    budget."""
+    S, _ = _sample_set_trimmed(eng, F, s0, budget, rng)
+    return S
+
+
+def _sample_set_trimmed(eng, F: np.ndarray, s0, budget: int,
+                        rng=None) -> tuple:
+    """(set, trimmed): ``trimmed`` says whether the Gumbel actually
+    cut a hop down to the budget. For fixed (F, s0, budget) the walk
+    is deterministic UNTIL the first trim, so an untrimmed draw cannot
+    differ between sweeps — :func:`sampled_refresh` uses that to skip
+    the per-sweep closure walk entirely in the no-trim regime."""
     if len(F) > budget:
-        return None
+        return None, False
     if not len(F):
-        return F
+        return F, False
     s0 = np.asarray(s0, dtype=np.float64)
     if rng is None:
-        # deterministic per refresh, varying ACROSS refreshes (seeded
-        # from the frontier and its warm score mass): a fixed noise
-        # sequence would pick correlated observation sets over a long
-        # sampled streak and concentrate the neglected complement on
-        # the same rows between cold resyncs
-        mass = np.abs(s0[F]).sum()
-        rng = np.random.default_rng(
-            [len(F), int(F[0]), int(F[-1]),
-             int(np.float64(mass).view(np.uint64))])
+        rng = np.random.default_rng(refresh_seed(F, s0))
     S = F
     hop = F
+    trimmed = False
     while len(S) < budget and len(hop):
         nxt = _fanout(eng, hop)
         nxt = nxt[(nxt >= 0) & (nxt < eng.n_now)]
@@ -292,21 +439,32 @@ def sample_set(eng, F: np.ndarray, s0, budget: int,
             break
         room = budget - len(S)
         if len(nxt) > room:
+            trimmed = True
             mass = np.abs(s0[nxt]) + 1e-300
             keys = np.log(mass) + rng.gumbel(size=len(nxt))
             nxt = nxt[np.argpartition(-keys, room - 1)[:room]]
         S = np.union1d(S, nxt)
         hop = nxt
-    return S
+    return S, trimmed
 
 
 def sampled_refresh(eng, s0, frontier, tol: float, max_sweeps: int,
                     sample_budget: int, error_budget: float = 0.0,
                     rng=None) -> PartialResult | None:
-    """Partially-observed refresh: converge on the fixed sample set
-    with the neglected-propagation mass accumulated against the
-    honesty budget (``max(tol, error_budget)`` — see module
-    docstring). None = no footing, frontier past the budget, or budget
+    """Partially-observed refresh with PER-SWEEP resampling (arXiv
+    2606.11956): every sweep converges on a freshly-drawn observation
+    set S_t = frontier ∪ Gumbel-top-k(fan-out closure) ≤
+    ``sample_budget``, with the neglected-propagation mass accumulated
+    against the honesty budget (``max(tol, error_budget)`` — see
+    module docstring). Each draw is seeded per (refresh, sweep) —
+    ``refresh_seed(F, s0) + [sweep]`` — so runs stay deterministic
+    while long sampled streaks between cold resyncs stop neglecting
+    the SAME complement rows sweep after sweep (the known bias of the
+    old per-refresh draw). When the closure fits the budget whole, the
+    Gumbel never trims and every draw is the same set — the operands
+    build once and ``resamples`` stays 0. An explicit ``rng`` replaces
+    the seeded per-sweep generators with one sequential stream (test
+    seam). None = no footing, frontier past the budget, or budget
     exhausted — fall back to the full device sweep."""
     F = as_frontier_array(frontier)
     F = F[(F >= 0) & (F < eng.n_now)]
@@ -314,12 +472,27 @@ def sampled_refresh(eng, s0, frontier, tol: float, max_sweeps: int,
         return PartialResult(np.asarray(s0, dtype=np.float64).copy(),
                              0, 0.0, 0)
     with trace.span("partial.sampled", n=eng.n_now, frontier=len(F)):
-        S = sample_set(eng, F, s0, sample_budget, rng=rng)
+        base_seed = refresh_seed(F, s0)
+
+        def draw(sweep: int):
+            r = rng if rng is not None else np.random.default_rng(
+                base_seed + [sweep])
+            return sample_set(eng, F, s0, sample_budget, rng=r)
+
+        S, trimmed = _sample_set_trimmed(
+            eng, F, s0, sample_budget,
+            rng=(rng if rng is not None
+                 else np.random.default_rng(base_seed + [1])))
         if S is None:
             return None
         ext_w = external_out_weight(eng, S)
+        # no-trim regime: the closure walk is deterministic for fixed
+        # (F, s0, budget) until the first trim, so every redraw would
+        # return the SAME set — skip the per-sweep O(closure) walk
+        # entirely instead of re-walking just to array-compare it
         return _device_sweeps(eng, s0, S, tol, max_sweeps, None, ext_w,
-                              error_budget=error_budget)
+                              error_budget=error_budget,
+                              resample=draw if trimmed else None)
 
 
 def ladder_refresh(eng, s0, frontier, tol: float, max_sweeps: int,

@@ -66,6 +66,11 @@ class PartialResult:
     # propagation bound, plus (sampled mode) the neglected-propagation
     # mass bound — the declared error vs a full-sweep oracle
     budget_spent: float = 0.0
+    # sampled mode: how many sweeps redrew a DIFFERENT observation set
+    # (the per-sweep Gumbel-top-k resampling of arXiv 2606.11956 —
+    # 0 when the closure fits the budget whole, so every draw is the
+    # same set, or in the partial/device_partial modes)
+    resamples: int = 0
 
 
 def as_frontier_array(frontier) -> np.ndarray:

@@ -130,6 +130,19 @@ class ServiceConfig:
     queue_bytes: int = 4 << 20      # hard-503 ceiling on queued params
     proof_shape: str = "default"    # "default" (k=21 SRS) | "tiny" (k=20)
     transcript: str = "keccak"
+    # intra-prove sharding (opt-in): 1 = a prove submitted to the pool
+    # fans its independent work units (commit columns per engine
+    # flush, host quotient row chunks, the two opening folds) out to
+    # IDLE pool workers, with a deterministic merge point that keeps
+    # proofs byte-identical to a direct single-worker prove_fast
+    # (profile jobs are exempt — a capture window has no shardable
+    # stages). 0 (default): every prove runs entirely on its own
+    # worker (the PR 7 behavior).
+    shard_proves: int = 0
+    # fan-out cap per sharded stage; the effective fan-out is
+    # min(shard_cap, pool workers), so 1 disables splitting even with
+    # shard_proves=1
+    shard_cap: int = 4
 
     # --- lifecycle --------------------------------------------------------
     drain_timeout: float = 30.0     # SIGTERM: budget to finish in-flight

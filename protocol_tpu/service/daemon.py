@@ -143,7 +143,11 @@ class TrustService:
             # persisted cursor (the fold floor)
             self._compact_wal(self.tailer.persisted_cursor)
         self._ident_digest: tuple | None = None  # (revision, digest)
-        from .provers import PROOF_PRIORITIES, make_worker_env
+        from .provers import (
+            PROOF_PRIORITIES,
+            PROOF_SHARD_EXEMPT,
+            make_worker_env,
+        )
 
         cache_key_fn = None
         if provers is None:
@@ -169,7 +173,14 @@ class TrustService:
             priorities=PROOF_PRIORITIES, cache_key_fn=cache_key_fn,
             watermark=config.shed_watermark,
             queue_bytes=config.queue_bytes,
-            worker_env=make_worker_env(self))
+            worker_env=make_worker_env(self),
+            # every prover kind except the capture window is shardable
+            # (PROOF_SHARD_EXEMPT) — injected test registries included,
+            # so the smoke's deterministic provers shard like the real
+            # eigentrust/threshold ones
+            shard_kinds=(set(provers) - PROOF_SHARD_EXEMPT
+                         if config.shard_proves else None),
+            shard_cap=config.shard_cap)
         if self.store is not None:
             rehydrated = self.jobs.rehydrate()
             if rehydrated:

@@ -10,7 +10,10 @@ the full design.
 ``ProofJobQueue`` keeps the pre-pool contract for callers and tests
 that want the original shape: ONE worker thread and blanket
 backpressure — every kind sheds (``QueueFullError`` → HTTP 429) once
-the queue holds ``capacity`` jobs. That is exactly the pool with one
+the queue holds ``capacity`` jobs. Intra-prove sharding
+(``pool.shard_kinds`` worker lending) stays off here by construction:
+with one worker there is nobody to lend, and the legacy queue predates
+the sharded fabric. That is exactly the pool with one
 worker, a watermark equal to ``capacity``, and every kind at equal
 (zero) priority, so the implementation is shared rather than forked:
 history eviction, artifact persistence at issue time, rehydration with

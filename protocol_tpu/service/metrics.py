@@ -108,6 +108,10 @@ HISTOGRAM_FAMILIES = {
     "routed_plan_build_seconds": (),
     "operator_delta_seconds": ("kind",),
     "xla_compile_seconds": ("site",),
+    # queue wait of one intra-prove shard unit (submit → execution
+    # start) — the lending latency of the sharded proving fabric;
+    # stage is the work-unit family (commit | quotient | open_fold)
+    "prove_shard_wait_seconds": ("stage",),
 }
 
 # typed counters/gauges of the device-observability layer, declared up
@@ -117,7 +121,7 @@ HISTOGRAM_FAMILIES = {
 DECLARED_COUNTERS = ("xla_compiles", "xla_steady_recompiles",
                      "operator_full_builds", "refresh_sweep_scope",
                      "proof_pool_shed", "proof_pool_affinity",
-                     "proof_pool_stolen")
+                     "proof_pool_stolen", "prove_shards")
 DECLARED_GAUGES = ("converge_iterations", "converge_residual",
                    "proof_queue_depth", "dirty_rows",
                    "refresh_frontier_peak", "refresh_budget_spent",

@@ -39,7 +39,29 @@ single driver, so an 8-device box minted the same proofs/hour as a
   are issued under the pool lock but persisted OUTSIDE it at issue
   time, so a daemon SIGKILLed with N jobs in flight across N workers
   rehydrates every one of them as ``failed: lost`` and never reissues
-  an id (``rehydrate``).
+  an id (``rehydrate``);
+
+- **worker lending (intra-prove shards)**: a job whose kind is in
+  ``shard_kinds`` runs under a shard runner (``zk/shards.py``), so the
+  prove's independent work units — commit columns per engine flush,
+  host quotient row chunks, the two opening folds — land on the pool's
+  shard queue and IDLE workers execute them before stealing whole
+  jobs. Lending never disturbs a worker's own scheduling state: its
+  queue, affinity residency and kind rotation are untouched; only
+  ``lent_to`` (visible on ``GET /status``) marks the borrow. The
+  merge point is deterministic (results absorbed in submission order;
+  proofs byte-identical to a direct ``prove_fast`` — tested), and the
+  admission/steal/rehydrate semantics extend naturally: sub-jobs
+  bypass admission (their parent was admitted, and a pool busy enough
+  to shed has no idle workers to lend), the shard queue IS the steal
+  surface for sub-jobs (claim-from-shared-queue, FIFO), and shards are
+  never persisted — a daemon SIGKILLed mid-sharded-prove rehydrates
+  exactly ONE ``failed: lost`` job. Fan-out per stage is
+  ``min(shard_cap, workers)``; the submitting worker always claims
+  whatever no one lent a hand for, so progress never depends on idle
+  capacity. ``ptpu_prove_shards_total{stage}`` counts executed units,
+  ``ptpu_prove_shard_wait_seconds{stage}`` their queue wait, and
+  shard spans carry ``worker=`` via the executing thread's context.
 
 Everything is visible: ``ptpu_proof_pool_depth`` /
 ``_worker_depth{worker}`` / ``_queued_bytes`` / ``_workers`` gauges,
@@ -198,6 +220,9 @@ class PoolWorker:
         self.index = index
         self.name = name
         self.device = device
+        self.lent_to = None   # job id whose shard this worker is
+        # executing (idle-worker lending; own queue/affinity untouched)
+        self.shards_run = 0
         # kind -> FIFO deque; the OrderedDict rotation IS the fairness:
         # pop from the first non-empty kind, then move that kind to the
         # end, so kinds at equal priority round-robin instead of a
@@ -276,8 +301,66 @@ class PoolWorker:
             "affinity_hits": self.affinity_hits,
             "affinity_misses": self.affinity_misses,
             "stolen": self.stolen,
+            "lent_to": self.lent_to,
+            "shards_run": self.shards_run,
             "resident": list(self.resident),
         }
+
+
+class _ShardRunner:
+    """Worker-lending shard runner for ONE running job (duck-types the
+    ``zk/shards.py`` runner contract; installed by ``_run_job`` around
+    shardable prover calls). ``dispatch`` parks units on the pool's
+    shared shard queue and wakes idle workers; ``rendezvous`` claims
+    whatever nobody lent a hand for — the submitting worker is always
+    a sufficient executor, so a fully-busy pool degenerates to the
+    unsharded serial order — waits for every claimed unit, and
+    re-raises the first error in submission order.
+
+    Sub-jobs deliberately bypass admission: their parent job was
+    admitted (and still holds exactly one depth slot), and a pool deep
+    enough to shed has no idle workers to lend anyway. They are never
+    persisted: SIGKILL mid-sharded-prove rehydrates ONE failed:lost
+    job, not N sub-records."""
+
+    def __init__(self, pool: "ProofWorkerPool", job: ProofJob,
+                 fanout: int):
+        self.pool = pool
+        self.job = job
+        self.fanout = fanout
+
+    def dispatch(self, units: list) -> None:
+        with self.pool._lock:
+            for u in units:
+                u.job_id = self.job.job_id
+                self.pool._shards.append(u)
+            self.pool._wake.notify_all()
+
+    def rendezvous(self, units: list) -> None:
+        pool = self.pool
+        while True:
+            unit = None
+            with pool._lock:
+                for u in units:
+                    if not u.claimed:
+                        u.claimed = True
+                        try:
+                            pool._shards.remove(u)
+                        except ValueError:  # pragma: no cover - already
+                            pass            # off the queue (racing pop)
+                        unit = u
+                        break
+            if unit is None:
+                break
+            unit.run()
+        for u in units:
+            # claimed by a lent worker: the worker always completes a
+            # claimed unit (the claim and the run are not separated by
+            # a stop check), so this join cannot hang on hard_kill
+            u.done.wait()
+        err = next((u.error for u in units if u.error is not None), None)
+        if err is not None:
+            raise err
 
 
 class ProofWorkerPool:
@@ -305,7 +388,9 @@ class ProofWorkerPool:
                  watermark: int = 0,
                  queue_bytes: int = 4 << 20,
                  resident_keys: int = 2,
-                 worker_env=None):
+                 worker_env=None,
+                 shard_kinds=None,
+                 shard_cap: int = 4):
         self.provers = dict(provers)
         self.capacity = capacity
         self.artifacts = artifacts
@@ -317,6 +402,12 @@ class ProofWorkerPool:
         self.queue_bytes = int(queue_bytes)
         self.resident_keys = max(1, int(resident_keys))
         self.worker_env = worker_env
+        # intra-prove sharding: kinds whose jobs run under a worker-
+        # lending shard runner (None/empty = off, the PR 7 behavior);
+        # per-stage fan-out is min(shard_cap, workers)
+        self.shard_kinds = frozenset(shard_kinds or ())
+        self.shard_cap = int(shard_cap)
+        self._shards: deque = deque()  # pending ShardUnits (all jobs)
         devices = _detect_devices()
         # clamp: a negative/zero explicit count must not build an empty
         # pool (healthy daemon, every submit crashing in _route)
@@ -388,6 +479,8 @@ class ProofWorkerPool:
                 "avg_run_seconds": round(self._avg_run_s, 3),
                 "shed": {f"{kind}:{tier}": n
                          for (kind, tier), n in sorted(self.shed.items())},
+                "shard_kinds": sorted(self.shard_kinds),
+                "shards_pending": len(self._shards),
             }
 
     # --- admission --------------------------------------------------------
@@ -633,6 +726,7 @@ class ProofWorkerPool:
 
     def _worker_loop(self, w: PoolWorker) -> None:
         while True:
+                unit = None
                 with self._lock:
                     if self._killed:
                         # hard_kill: the backlog must stay QUEUED (a
@@ -640,34 +734,74 @@ class ProofWorkerPool:
                         # graceful drain finishes pending work
                         return
                     job = w.pop_next()
-                    if job is None:
+                    if job is None and self._shards:
+                        # worker lending: before committing an idle
+                        # worker to a whole stolen job, hand it a shard
+                        # of a RUNNING prove — the unit is sub-second
+                        # and unblocks a client already mid-wait. The
+                        # worker's own queue always wins over lending
+                        # (its jobs carry their own latency budget).
+                        unit = self._shards.popleft()
+                        unit.claimed = True
+                        w.lent_to = unit.job_id
+                    elif job is None:
                         job = self._steal(w)
-                    if job is None:
+                    if job is None and unit is None:
                         if self._stop:
                             return
                         self._wake.wait(timeout=0.5)
                         continue
-                    job.status = "running"
-                    job.started_at = time.time()
-                    job.worker = w.name
-                    w.running = job
-                    self._queued_bytes -= job._bytes
-                    if job.cache_key is not None:
-                        # hit = this worker's prover state serves the
-                        # job warm (exact key or same-prover prefix)
-                        if self._holds(w, job.cache_key):
-                            w.affinity_hits += 1
-                            trace.counter("proof_pool_affinity").inc(
-                                result="hit")
-                        else:
-                            w.affinity_misses += 1
-                            trace.counter("proof_pool_affinity").inc(
-                                result="miss")
-                    # keep the depth honest on the DRAIN side too: a
-                    # submit-only gauge would report a stale backlog
-                    # forever after the queues empty
-                    self._record_depth()
+                    if job is not None:
+                        # same lock hold as the pop: drain() must never
+                        # observe the job off a queue but not running
+                        job.status = "running"
+                        job.started_at = time.time()
+                        job.worker = w.name
+                        w.running = job
+                        self._queued_bytes -= job._bytes
+                        if job.cache_key is not None:
+                            # hit = this worker's prover state serves
+                            # the job warm (exact key or same-prover
+                            # prefix)
+                            if self._holds(w, job.cache_key):
+                                w.affinity_hits += 1
+                                trace.counter("proof_pool_affinity").inc(
+                                    result="hit")
+                            else:
+                                w.affinity_misses += 1
+                                trace.counter("proof_pool_affinity").inc(
+                                    result="miss")
+                        # keep the depth honest on the DRAIN side too:
+                        # a submit-only gauge would report a stale
+                        # backlog forever after the queues empty
+                        self._record_depth()
+                if unit is not None:
+                    # outside the lock: the unit's MSM/quotient compute
+                    # is milliseconds-to-seconds of native work. A
+                    # claimed unit ALWAYS runs to completion — there is
+                    # no stop check between claim and run, so the
+                    # rendezvous join can never hang on a kill.
+                    try:
+                        unit.run()
+                    finally:
+                        with self._lock:
+                            w.lent_to = None
+                            w.shards_run += 1
+                    continue
                 self._run_job(w, job)
+
+    def _shard_scope(self, job: ProofJob):
+        """The worker-lending runner for a shardable job's prover call
+        (no-op context otherwise). Imported lazily: a pool with
+        sharding off — every jax-less injected-prover test — never
+        touches the zk layer. Fan-out 1 (single worker) installs
+        nothing: splitting work for no one costs slice copies."""
+        fanout = min(self.shard_cap, len(self.workers))
+        if job.kind not in self.shard_kinds or fanout <= 1:
+            return contextlib.nullcontext()
+        from ..zk.shards import shard_scope
+
+        return shard_scope(_ShardRunner(self, job, fanout))
 
     def _run_job(self, w: PoolWorker, job: ProofJob) -> None:
         # queue wait vs prove time: the two halves of a client's
@@ -684,7 +818,8 @@ class ProofWorkerPool:
             # the worker that executed it.
             with trace.context(trace_id=job.job_id):
                 with trace.span("service.proof", kind=job.kind):
-                    result = self.provers[job.kind](job.params)
+                    with self._shard_scope(job):
+                        result = self.provers[job.kind](job.params)
             job.result = result
             job.status = "done"
         except Exception as e:  # noqa: BLE001 - job isolation: one

@@ -29,6 +29,15 @@ from ..utils.errors import EigenError
 # the byte-budget ceiling. Unknown (test-injected) kinds default to 0.
 PROOF_PRIORITIES = {"profile": 0, "threshold": 1, "eigentrust": 2}
 
+# kinds that never shard under config.shard_proves: the profile
+# capture window holds a device trace open, not prove stages — there
+# is nothing to fan out, and lending workers into an xprof window
+# would only pollute its timeline. Every real prove kind (and any
+# injected registry kind) is shardable; the prove paths degrade to
+# fully-inline execution when no idle worker lends a hand, so
+# shardability is an opportunity, never a requirement.
+PROOF_SHARD_EXEMPT = frozenset({"profile"})
+
 
 def _shape_params_k(shape_name: str):
     """(CircuitShape, et_params_k, th_params_k) for a served shape

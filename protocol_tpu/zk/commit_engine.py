@@ -33,6 +33,16 @@ window. This module is the scheduler over the measurement-informed fix:
   chip probes killed on THIS hardware stays re-litigable on real TPU
   silicon with zero code changes (see BASELINE.md "Why the MSM stays
   on the host").
+- **Shards**: when a shard runner is installed (``zk/shards.py`` — the
+  proof pool installs one around shardable jobs), each ready group's
+  columns split into ≤ fan-out sub-batches dispatched as addressable
+  shard units, so idle pool workers execute commit MSMs of a running
+  prove. Points are still absorbed in submission order and every
+  column is bit-exact regardless of grouping, so sharding never moves
+  a transcript byte. ``flush_async()`` additionally dispatches the
+  already-materialized groups NOW and returns a rendezvous handle —
+  the shards compute under whatever device-occupancy window the
+  caller holds before ``result()``.
 
 Knobs: ``PTPU_COMMIT_ENGINE=0`` disables batching (serial per-column
 oracle path, same scheduling surface); ``PTPU_MSM_DEVICE=1`` selects
@@ -188,12 +198,28 @@ class CommitEngine:
         submission order. Fetch-backed items download on ONE background
         thread in submission order; the main thread greedily groups
         whatever is ready into ``g1_msm_multi`` batches, so downloads
-        overlap the GIL-released MSM compute."""
+        overlap the GIL-released MSM compute. Under a shard runner each
+        group additionally fans out to lent pool workers (points still
+        land in submission order — see the module docstring)."""
+        return self.flush_async().result()
+
+    def flush_async(self) -> "FlushHandle":
+        """The rendezvous form of :func:`flush`: pending commits whose
+        scalars are already materialized are grouped and DISPATCHED as
+        shard units immediately (when a runner is installed), then a
+        handle is returned. ``result()`` completes whatever remains —
+        fetch-backed items, unclaimed units — and returns the points in
+        submission order. The caller can hold a device-occupancy window
+        between dispatch and ``result()`` and the lent workers chew the
+        MSMs under it; without a runner this degenerates to plain
+        ``flush()`` work done inside ``result()``."""
+        from . import shards
+
         items, self._items = self._items, []
+        handle = FlushHandle(self, items)
         if not items:
-            return []
+            return handle
         fetches = [it for it in items if it.scalars is None]
-        th = None
         if fetches:
             # the fetch thread inherits the submitting thread's trace
             # context and pool-worker identity — fetch callables run
@@ -201,37 +227,65 @@ class CommitEngine:
             # thread would detach their spans from the job's trace
             ctx_ids = trace.current_trace_ids()
             worker = trace.current_worker()
-            th = threading.Thread(target=self._fetch_loop,
-                                  args=(fetches, ctx_ids, worker),
-                                  daemon=True,
-                                  name="commit-engine-fetch")
-            th.start()
-        pending = set(range(len(items)))
-        while pending:
-            with self._cv:
-                while True:
-                    err = next((items[i].error for i in pending
-                                if items[i].error is not None), None)
-                    if err is not None:
-                        raise err
-                    ready = [i for i in sorted(pending)
-                             if items[i].scalars is not None]
-                    if ready:
-                        break
-                    self._cv.wait()
-            groups: dict = {}
-            for i in ready:
-                it = items[i]
-                groups.setdefault((it.bases_id, len(it.scalars)),
-                                  []).append(i)
-            for key, idxs in groups.items():
-                for j in range(0, len(idxs), MAX_BATCH):
-                    chunk = idxs[j : j + MAX_BATCH]
-                    self._commit_group(key, [items[i] for i in chunk])
-                pending.difference_update(idxs)
-        if th is not None:
-            th.join()
-        return [it.point for it in items]
+            handle.fetch_thread = threading.Thread(
+                target=self._fetch_loop,
+                args=(fetches, ctx_ids, worker),
+                daemon=True, name="commit-engine-fetch")
+            handle.fetch_thread.start()
+        runner = shards.current_runner()
+        if runner is not None and not self.device:
+            ready = [i for i in range(len(items))
+                     if items[i].scalars is not None]
+            if len(ready) > 1:
+                handle.pre_dispatch(runner, ready)
+        return handle
+
+    def _group_ready(self, items: list, ready: list) -> list:
+        """(key, item-index chunk) batches for the ready items — the
+        same grouping rule whether the chunks run inline, pre-dispatch
+        as shards, or split across lent workers."""
+        groups: dict = {}
+        for i in ready:
+            it = items[i]
+            groups.setdefault((it.bases_id, len(it.scalars)),
+                              []).append(i)
+        out = []
+        for key, idxs in groups.items():
+            for j in range(0, len(idxs), MAX_BATCH):
+                out.append((key, idxs[j : j + MAX_BATCH]))
+        return out
+
+    def _split_parts(self, key: tuple, group: list, fanout: int) -> list:
+        """The ONE split policy for a grouped chunk under a fan-out —
+        shared by the inline path (:meth:`_commit_chunk`) and the
+        pre-dispatch path (:meth:`FlushHandle.pre_dispatch`) so the two
+        can never group differently. Splitting never changes bytes —
+        every column is bit-exact against the serial oracle in any
+        grouping — so this is placement, not semantics. When a split
+        happens, the bases limb cache is materialized on the
+        dispatching thread first: two lent workers racing the
+        params-level cache would both pay the conversion."""
+        from . import shards
+
+        if fanout <= 1 or len(group) <= 1 or self.device:
+            return [group]
+        self._bases(*key)  # warm the shared limb cache pre-dispatch
+        return [group[a:b]
+                for a, b in shards.split_ranges(len(group), fanout)]
+
+    def _commit_chunk(self, items: list, key: tuple, chunk: list) -> None:
+        """One grouped chunk, split across the shard fan-out when a
+        runner is active (see :meth:`_split_parts`)."""
+        from . import shards
+
+        group = [items[i] for i in chunk]
+        parts = self._split_parts(key, group, shards.shard_fanout())
+        if len(parts) == 1:
+            self._commit_group(key, group)
+            return
+        shards.shard_map(
+            "commit",
+            [lambda p=p: self._commit_group(key, p) for p in parts])
 
     def _fetch_loop(self, fetches: list, ctx_ids: tuple,
                     worker: str | None) -> None:
@@ -316,6 +370,96 @@ class CommitEngine:
                 cached.append(None if x == 0 and y == 0 else (x, y))
             self._device_pts[(bases_id, length)] = cached
         return cached
+
+
+class FlushHandle:
+    """Result-rendezvous of one engine flush: the addressable-shard
+    form of the old blocking loop. ``result()`` is the ONE merge point
+    — it finishes fetch-backed items, claims whatever pre-dispatched
+    units no lent worker took, waits for the rest, and returns points
+    in submission order (the transcript absorbs them there). Errors
+    from any side (fetch thread, lent worker, inline commit) surface
+    here, after every claimed unit has completed — a lent worker
+    cannot be interrupted mid-MSM."""
+
+    def __init__(self, eng: CommitEngine, items: list):
+        self.eng = eng
+        self.items = items
+        self.fetch_thread = None
+        self.units: list = []
+        self._runner = None
+        self._covered: set = set()
+        self._done = False
+        self._error = None  # first failure, re-raised on every call
+
+    def pre_dispatch(self, runner, ready: list) -> None:
+        """Group the already-materialized items and hand them to the
+        runner NOW (non-blocking): lent workers start on the MSMs while
+        the caller holds its device-occupancy window (or keeps
+        absorbing fetches). Called by ``flush_async`` only."""
+        from . import shards
+
+        units = []
+        fanout = max(1, int(getattr(runner, "fanout", 1)))
+        for key, chunk in self.eng._group_ready(self.items, ready):
+            group = [self.items[i] for i in chunk]
+            parts = self.eng._split_parts(key, group, fanout)
+            for p in parts:
+                units.append(shards.ShardUnit(
+                    "commit",
+                    (lambda key=key, p=p:
+                     self.eng._commit_group(key, p)),
+                    len(units),
+                    trace_ids=trace.current_trace_ids()))
+            self._covered.update(chunk)
+        runner.dispatch(units)
+        self._runner = runner
+        self.units = units
+
+    def result(self) -> list:
+        """Complete the flush and return points in submission order.
+        Idempotent: repeated calls return the same points — or re-raise
+        the SAME error (a failed flush must never degrade into a point
+        list with silent None holes on retry)."""
+        if self._done:
+            if self._error is not None:
+                raise self._error
+            return [it.point for it in self.items]
+        self._done = True
+        items = self.items
+        eng = self.eng
+        err = None
+        try:
+            pending = set(range(len(items))) - self._covered
+            while pending:
+                with eng._cv:
+                    while True:
+                        e = next((items[i].error for i in pending
+                                  if items[i].error is not None), None)
+                        if e is not None:
+                            raise e
+                        ready = [i for i in sorted(pending)
+                                 if items[i].scalars is not None]
+                        if ready:
+                            break
+                        eng._cv.wait()
+                for key, chunk in eng._group_ready(items, ready):
+                    eng._commit_chunk(items, key, chunk)
+                    pending.difference_update(chunk)
+        except BaseException as e:  # noqa: BLE001 - rendezvous below
+            err = e  # must still drain claimed units before raising
+        finally:
+            if self._runner is not None and self.units:
+                try:
+                    self._runner.rendezvous(self.units)
+                except BaseException as e2:  # noqa: BLE001
+                    err = err or e2
+            if self.fetch_thread is not None:
+                self.fetch_thread.join()
+        if err is not None:
+            self._error = err
+            raise err
+        return [it.point for it in items]
 
 
 def _device_msm(pts: list, scalars: np.ndarray):

@@ -52,10 +52,32 @@ from .plonk import (
     _find_coset_shifts,
     _table_values,
 )
+from .shards import shard_fanout, shard_map, split_ranges
 from .transcript import PoseidonTranscript, make_transcript
 
 R = BN254_FR_MODULUS
 Q = BN254_FQ_MODULUS
+
+# The prove stage graph's parallelizable stage sets — the work units an
+# installed shard runner (zk/shards.py; the pool's worker lending) fans
+# out, per path. Everything else is transcript-sequential: each round's
+# commits must be absorbed before the challenges the next round
+# consumes, so intra-prove parallelism lives INSIDE stages, never
+# across them. Host path: the K commit columns per engine flush, the
+# row-sliced quotient evaluation (the native kernel is pointwise per
+# evaluation row — bit-exact under any row split), and the two opening
+# folds. TPU path: only the commit flushes — quotient chunks, ext
+# builds and the opening folds are device-resident there, and the
+# per-device dispatch queue is a serially-owned resource. LOAD-BEARING
+# for the host quotient/openings stages (their shard paths gate on
+# membership here — removing an entry reverts that stage to inline);
+# the commit.* entries describe the engine, which shards identically
+# on both paths.
+SHARDABLE_STAGES = {
+    "host": ("commit.r1", "commit.r2", "quotient", "commit.t",
+             "openings", "commit.open"),
+    "tpu": ("commit.r1", "commit.r2", "commit.t", "commit.open"),
+}
 
 
 def available() -> bool:
@@ -812,9 +834,25 @@ def _prove_fast_host(params, pk, cs, public_inputs, randint,
         l0 = fk.vec_mul(zh_tiled, l0_den)
 
     with _stage("quotient", pk.k, "host"):
-        t_ext = fk.quotient_eval(wires_e, z_e, zw_e, m_e, phi_e, phiw_e,
-                                 uv_e, fixed_e, sigma_e, pi_e, xs, zh_inv,
-                                 l0, beta, gamma, beta_lk, alpha, pk.shifts)
+        def _quotient_rows(a: int, b: int) -> np.ndarray:
+            # the quotient kernel is pointwise per evaluation row, so a
+            # row slice of every operand computes the identical bytes
+            # for its rows — the shard unit of the host quotient stage
+            return fk.quotient_eval(
+                wires_e[:, a:b], z_e[a:b], zw_e[a:b], m_e[a:b],
+                phi_e[a:b], phiw_e[a:b], uv_e[:, a:b], fixed_e[:, a:b],
+                sigma_e[:, a:b], pi_e[a:b], xs[a:b], zh_inv[a:b],
+                l0[a:b], beta, gamma, beta_lk, alpha, pk.shifts)
+
+        fanout = (shard_fanout()
+                  if "quotient" in SHARDABLE_STAGES["host"] else 1)
+        if fanout > 1:
+            t_ext = np.concatenate(shard_map(
+                "quotient",
+                [lambda a=a, b=b: _quotient_rows(a, b)
+                 for a, b in split_ranges(ext_n, fanout)]))
+        else:
+            t_ext = _quotient_rows(0, ext_n)
     del wires_e, zw_e, m_e, phiw_e, uv_e, fixed_e, sigma_e, pi_e, xs, zh_inv
     del zh_tiled, l0_den, l0, z_e, phi_e
 
@@ -888,8 +926,16 @@ def _prove_fast_host(params, pk, cs, public_inputs, randint,
         return fk.poly_divide_linear(folded, at)
 
     with _stage("openings", pk.k, "host"):
-        q_x = open_group(all_polys, zeta)
-        q_wx = open_group([z_coeffs, phi_coeffs], zeta_w)
+        # the two witness folds are independent whole units (native
+        # field kernels are stateless) — the opening-side shard pair
+        if "openings" in SHARDABLE_STAGES["host"]:
+            q_x, q_wx = shard_map("open_fold", [
+                lambda: open_group(all_polys, zeta),
+                lambda: open_group([z_coeffs, phi_coeffs], zeta_w),
+            ])
+        else:  # pragma: no cover - stage-set edit seam
+            q_x = open_group(all_polys, zeta)
+            q_wx = open_group([z_coeffs, phi_coeffs], zeta_w)
     with _stage("commit.open", pk.k, "host", labels=eng.stage_labels()):
         eng.submit_coeffs("w_x", q_x)
         eng.submit_coeffs("w_wx", q_wx)

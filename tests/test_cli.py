@@ -38,7 +38,8 @@ def test_parser_accepts_all_verbs():
                      "--url", "http://127.0.0.1:1", "--seconds", "2"]),
         ("scores", ["--backend", "jax"]),
         ("serve", ["--port", "0", "--poll-interval", "0.5",
-                   "--state-dir", "svc-state"]),
+                   "--state-dir", "svc-state", "--workers", "2",
+                   "--shard-proves", "1"]),
         ("show", []),
         ("store", ["inspect"]),
         ("store", ["compact", "--state-dir", "svc-state"]),

@@ -186,6 +186,105 @@ def test_fetch_error_propagates():
         eng.flush()
 
 
+# --- intra-prove shards (addressable work units + rendezvous) ---------------
+
+class _ThreadRunner:
+    """Minimal zk/shards.py runner: executes dispatched units on a
+    side thread in REVERSE submission order — the adversarial
+    completion order the rendezvous must absorb back into submission
+    order."""
+
+    fanout = 3
+
+    def __init__(self):
+        self.threads = []
+        self.executed = 0
+
+    def dispatch(self, units):
+        def run_all(us):
+            for u in us:
+                u.claimed = True
+                u.run()
+                self.executed += 1
+
+        t = threading.Thread(target=run_all,
+                             args=(list(reversed(units)),), daemon=True)
+        t.start()
+        self.threads.append(t)
+
+    def rendezvous(self, units):
+        for u in units:
+            assert u.done.wait(30), "unit never completed"
+        err = next((u.error for u in units if u.error is not None),
+                   None)
+        if err is not None:
+            raise err
+
+
+def test_sharded_flush_keeps_submission_order():
+    """Under a shard runner, flush() splits groups into units executed
+    out of order on another thread — points must still come back in
+    submission order, bit-exact vs the serial oracle."""
+    from protocol_tpu.zk import prover_fast as pf
+    from protocol_tpu.zk import shards
+
+    params = pf.setup_params_fast(8, seed=b"shard-order")
+    n = 1 << 8
+    cols = [np.ascontiguousarray(_cols(1, n, 70 + i)[0])
+            for i in range(7)]
+    oracle = [pf.commit_limbs(params, c) for c in cols]
+    runner = _ThreadRunner()
+    with shards.shard_scope(runner):
+        eng = CommitEngine(params)
+        for i, c in enumerate(cols):
+            eng.submit_coeffs(f"col{i}", c)
+        got = eng.flush()
+    assert got == oracle
+    assert runner.executed >= 2, "the group never split into units"
+
+
+def test_flush_async_rendezvous_under_device_window():
+    """flush_async dispatches materialized groups NOW; result() is the
+    deterministic merge point — the caller can hold a device-occupancy
+    window in between and the units compute under it."""
+    import time as _time
+
+    from protocol_tpu.zk import prover_fast as pf
+    from protocol_tpu.zk import shards
+
+    params = pf.setup_params_fast(8, seed=b"shard-async")
+    n = 1 << 8
+    cols = [np.ascontiguousarray(_cols(1, n, 90 + i)[0])
+            for i in range(6)]
+    oracle = [pf.commit_limbs(params, c) for c in cols]
+    runner = _ThreadRunner()
+    with shards.shard_scope(runner):
+        eng = CommitEngine(params)
+        for i, c in enumerate(cols):
+            eng.submit_coeffs(f"col{i}", c)
+        handle = eng.flush_async()
+        assert handle.units, "materialized groups were not dispatched"
+        _time.sleep(0.05)  # the device-occupancy stand-in
+        assert handle.result() == oracle
+        assert handle.result() == oracle  # idempotent
+    assert runner.executed >= 2, "pre-dispatch never split into units"
+
+
+def test_sharded_flush_surfaces_unit_errors():
+    from protocol_tpu.zk import prover_fast as pf
+    from protocol_tpu.zk import shards
+
+    params = pf.setup_params_fast(8, seed=b"shard-err")
+    bad = np.zeros((1 << 8, 5), dtype="<u8")  # wrong limb shape
+    runner = _ThreadRunner()
+    with shards.shard_scope(runner):
+        eng = CommitEngine(params)
+        eng.submit_coeffs("a", _cols(1, 1 << 8, 99)[0])
+        eng.submit_coeffs("b", bad)
+        with pytest.raises(Exception):
+            eng.flush()
+
+
 # --- byte-identical proofs, engine on vs off -------------------------------
 
 def _tiny_circuit():

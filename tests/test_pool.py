@@ -467,6 +467,193 @@ def test_pool_proof_bytes_identical_to_single_worker(tiny_prove_setup):
     assert pool.drain(5.0) is True
 
 
+def _sharded_prove_pool(pf, registry, **kw):
+    return ProofWorkerPool(
+        registry, capacity=16, workers=2, faults=NO_FAULTS,
+        shard_kinds=set(registry), shard_cap=4,
+        worker_env=lambda w: pf.worker_isolation(w.name, w.device), **kw)
+
+
+def _run_one(pool, kind, timeout=180.0):
+    job = pool.submit(kind, {})
+    _wait(lambda: pool.get(job.job_id).status in ("done", "failed"),
+          timeout, f"{kind} job terminal")
+    got = pool.get(job.job_id)
+    assert got.status == "done", got.error
+    return got
+
+
+def test_sharded_prove_bytes_identical_host(tiny_prove_setup,
+                                            monkeypatch):
+    """The tentpole invariant, host path: a prove whose commit columns,
+    quotient rows and opening folds fanned out to lent pool workers is
+    byte-identical to the direct single-worker prove_fast — with the
+    commit engine on AND off (the serial oracle path shards too)."""
+    pf, params, pk, cs = tiny_prove_setup
+
+    def prove(p):
+        return {"proof": pf.prove_fast(
+            params, pk, cs, randint=lambda: 424242).hex()}
+
+    pool = _sharded_prove_pool(pf, {"eigentrust": prove})
+    pool.start()
+    try:
+        for env in (None, "0"):
+            if env is None:
+                monkeypatch.delenv("PTPU_COMMIT_ENGINE", raising=False)
+            else:
+                monkeypatch.setenv("PTPU_COMMIT_ENGINE", env)
+            reference = pf.prove_fast(params, pk, cs,
+                                      randint=lambda: 424242)
+            got = _run_one(pool, "eigentrust")
+            assert bytes.fromhex(got.result["proof"]) == reference, \
+                f"sharded proof diverged (PTPU_COMMIT_ENGINE={env})"
+    finally:
+        assert pool.drain(5.0) is True
+
+
+def test_sharded_prove_bytes_identical_tpu(tiny_prove_setup,
+                                           monkeypatch):
+    """Same invariant on the TPU pipeline (commit flushes shard; the
+    quotient/fold stages stay device-resident there): sharded
+    prove_fast_tpu output equals the direct call, engine on and off."""
+    pytest.importorskip("jax")
+    pf, params, pk_coeff, cs = tiny_prove_setup
+    pk = pf.keygen_fast(params, cs, k=params.k, eval_pk=True)
+
+    def prove(p):
+        return {"proof": pf.prove_fast_tpu(
+            params, pk, cs, randint=lambda: 171717).hex()}
+
+    pool = _sharded_prove_pool(pf, {"eigentrust": prove})
+    pool.start()
+    try:
+        for env in (None, "0"):
+            if env is None:
+                monkeypatch.delenv("PTPU_COMMIT_ENGINE", raising=False)
+            else:
+                monkeypatch.setenv("PTPU_COMMIT_ENGINE", env)
+            reference = pf.prove_fast_tpu(params, pk, cs,
+                                          randint=lambda: 171717)
+            got = _run_one(pool, "eigentrust")
+            assert bytes.fromhex(got.result["proof"]) == reference, \
+                f"sharded TPU proof diverged (PTPU_COMMIT_ENGINE={env})"
+    finally:
+        assert pool.drain(5.0) is True
+
+
+def test_shard_rendezvous_two_workers_race():
+    """The shard-rendezvous race, made deterministic: units block until
+    TWO distinct workers are mid-unit (the submitting worker claiming
+    through the rendezvous plus an idle worker lending), then results
+    must come back in submission order with both workers recorded —
+    placement may race, the merge point may not."""
+    from protocol_tpu.zk import shards
+
+    seen = set()
+    seen_lock = threading.Lock()
+    two_workers = threading.Event()
+
+    def unit(i):
+        def fn():
+            with seen_lock:
+                seen.add(trace.current_worker())
+                if len(seen) >= 2:
+                    two_workers.set()
+            assert two_workers.wait(10), "second worker never lent"
+            return i * 10
+        return fn
+
+    def prover(params):
+        return {"res": shards.shard_map("race",
+                                        [unit(i) for i in range(6)])}
+
+    pool = ProofWorkerPool({"sharded": prover}, capacity=8, workers=2,
+                           faults=NO_FAULTS, shard_kinds={"sharded"})
+    pool.start()
+    got = _run_one(pool, "sharded", timeout=30.0)
+    assert got.result["res"] == [i * 10 for i in range(6)], \
+        "rendezvous broke submission order"
+    assert len(seen) >= 2, f"only {seen} executed units"
+    rows = pool.pool_status()["workers"]
+    assert sum(r["shards_run"] for r in rows) >= 1, rows
+    assert all(r["lent_to"] is None for r in rows), \
+        "lent_to must clear after the borrow"
+    assert pool.drain(5.0) is True
+
+
+def test_sigkill_mid_sharded_prove_rehydrates_one_job(tmp_path):
+    """SIGKILL while a prove's shards are spread across both workers:
+    the artifact store holds exactly ONE job record (shards are never
+    persisted), and a fresh pool rehydrates it as failed:lost with the
+    id counter advanced past it."""
+    store = ProofArtifactStore(str(tmp_path / "proofs"))
+    gate = threading.Event()
+
+    def prover(params):
+        from protocol_tpu.zk import shards
+
+        shards.shard_map("wedge",
+                         [lambda: (gate.wait(30), 1)[1]
+                          for _ in range(4)])
+        return {}
+
+    pool1 = ProofWorkerPool({"sharded": prover}, capacity=8, workers=2,
+                            faults=NO_FAULTS, shard_kinds={"sharded"},
+                            artifacts=store)
+    pool1.start()
+    job = pool1.submit("sharded", {})
+    _wait(lambda: any(w.lent_to == job.job_id for w in pool1.workers),
+          what="an idle worker lent to the sharded prove")
+    top_before = store.max_numeric_id()
+    # the daemon dies here: the prove and its in-flight shards vanish,
+    # leaving only the issue-time queued/running record
+    pool2 = ProofWorkerPool({"sharded": prover}, capacity=8, workers=2,
+                            faults=NO_FAULTS, shard_kinds={"sharded"},
+                            artifacts=store)
+    loaded = pool2.rehydrate()
+    assert loaded == 1 and len(store.job_ids()) == 1, \
+        "shards must not leave their own artifact records"
+    got = pool2.get(job.job_id)
+    assert got.status == "failed" and "lost" in got.error
+    pool2.start()
+    fresh = pool2.submit("sharded", {})
+    assert int(fresh.job_id.split("-")[1]) > top_before
+    gate.set()
+    _wait(lambda: pool2.get(fresh.job_id).status == "done",
+          what="fresh sharded job on pool2")
+    assert pool2.drain(5.0) is True
+    pool1.hard_kill()
+
+
+def test_shard_unit_error_fails_job_not_worker():
+    """A shard unit that raises poisons its own job (failed, the error
+    surfaced through the rendezvous) but never the lending worker or
+    the pool — later jobs still run on both workers."""
+    from protocol_tpu.zk import shards
+
+    def prover(params):
+        def boom():
+            raise RuntimeError("shard exploded")
+
+        shards.shard_map("boom", [boom, lambda: 1])
+        return {}
+
+    pool = ProofWorkerPool(
+        {"sharded": prover, "fast": lambda p: {"ok": True}},
+        capacity=8, workers=2, faults=NO_FAULTS,
+        shard_kinds={"sharded"})
+    pool.start()
+    bad = pool.submit("sharded", {})
+    _wait(lambda: pool.get(bad.job_id).status == "failed",
+          what="sharded job failed")
+    assert "shard exploded" in pool.get(bad.job_id).error
+    jobs = [pool.submit("fast", {}) for _ in range(4)]
+    _drain_all(pool, 5)
+    assert all(pool.get(j.job_id).status == "done" for j in jobs)
+    assert pool.drain(5.0) is True
+
+
 def test_worker_label_lands_on_stage_metrics(tiny_prove_setup):
     """PR 5 stage metrics gain a worker label inside pool workers: a
     prove run by wN records ptpu_prover_stage_seconds series carrying

@@ -126,7 +126,56 @@ def test_committed_baseline_is_loadable():
         data = json.load(f)
     assert data["schema"] == "ptpu-perf-gate-v1"
     assert set(data["workloads"]) == {"prove", "refresh", "delta",
-                                      "proofs", "commits"}
+                                      "proofs", "commits", "sublinear",
+                                      "sharded"}
+
+
+# --- bench trajectory --------------------------------------------------------
+
+
+def _trajectory_mod():
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "bench_trajectory",
+        os.path.join(REPO, "tools", "bench_trajectory.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_bench_trajectory_rows_cover_all_rounds(tmp_path):
+    """tools/bench_trajectory.py: every committed BENCH_rNN.json must
+    yield a row with a numeric headline value (rc 0), in round order —
+    the one-command view of the r01..r10 trajectory. Legacy records
+    without a ``parsed`` block recover the headline from the tail, and
+    an empty directory exits 1."""
+    mod = _trajectory_mod()
+    rows = mod.trajectory(REPO)
+    rounds = [r["round"] for r in rows]
+    assert rounds == sorted(rounds) and len(rounds) >= 10, rounds
+    for r in rows:
+        assert r["metric"], r
+        assert isinstance(r["value"], (int, float)), r
+        assert r["rc"] == 0, r
+    text = mod.render(rows)
+    assert len(text.splitlines()) == len(rows) + 1
+    # legacy layout: headline only in the tail
+    legacy = {"n": 99, "cmd": "x", "rc": 0,
+              "tail": 'noise\n{"metric": "m", "value": 2.5, '
+                      '"unit": "x", "vs_baseline": 1.9}\n'}
+    (tmp_path / "BENCH_r99.json").write_text(json.dumps(legacy))
+    got = mod.trajectory(str(tmp_path))
+    assert got[0]["value"] == 2.5 and got[0]["round"] == 99
+    # no bench files at all: rc 1, not an empty table
+    (tmp_path / "empty").mkdir()
+    assert mod.main(["--repo", str(tmp_path / "empty")]) == 1
+    out = subprocess.run(
+        [sys.executable,
+         os.path.join(REPO, "tools", "bench_trajectory.py"), "--json"],
+        capture_output=True, text=True)
+    assert out.returncode == 0
+    assert [r["round"] for r in json.loads(out.stdout)] == rounds
 
 
 # --- profile verb ------------------------------------------------------------

@@ -1,0 +1,105 @@
+#!/usr/bin/env python
+"""One-command table of the BENCH_r01..rNN headline trajectory.
+
+Every bench round lands as a ``BENCH_rNN.json`` at the repo root with
+the run's full tail plus a parsed headline ``{metric, value, unit,
+vs_baseline}`` — but the TRAJECTORY (how each round's headline moved
+against its acceptance floor) only existed by opening ten scattered
+files. This prints it as one table:
+
+    python tools/bench_trajectory.py            # aligned text table
+    python tools/bench_trajectory.py --json     # machine-readable rows
+
+Rounds whose file lacks the ``parsed`` block (older layouts) recover
+the headline by scanning the run tail for its final ``{"metric": ...}``
+line; a round with no recoverable headline still gets a row (value
+None) rather than vanishing from the trajectory. Exit 1 when no bench
+files are found at all.
+"""
+
+import argparse
+import json
+import os
+import re
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+_BENCH_RE = re.compile(r"^BENCH_r(\d+)\.json$")
+
+
+def load_headline(path: str) -> tuple:
+    """(raw record, parsed headline or None) for one bench file."""
+    with open(path) as f:
+        data = json.load(f)
+    parsed = data.get("parsed")
+    if not isinstance(parsed, dict) or "metric" not in parsed:
+        parsed = None
+        for line in reversed(data.get("tail", "").strip().splitlines()):
+            try:
+                obj = json.loads(line)
+            except ValueError:
+                continue
+            if isinstance(obj, dict) and "metric" in obj:
+                parsed = obj
+                break
+    return data, parsed
+
+
+def trajectory(repo: str) -> list:
+    """All bench rounds under ``repo``, sorted by round number."""
+    rows = []
+    for name in os.listdir(repo):
+        m = _BENCH_RE.match(name)
+        if not m:
+            continue
+        data, parsed = load_headline(os.path.join(repo, name))
+        parsed = parsed or {}
+        rows.append({
+            "round": int(m.group(1)),
+            "file": name,
+            "rc": data.get("rc"),
+            "metric": parsed.get("metric"),
+            "value": parsed.get("value"),
+            "unit": parsed.get("unit"),
+            "vs_baseline": parsed.get("vs_baseline"),
+        })
+    return sorted(rows, key=lambda r: r["round"])
+
+
+def render(rows: list, width: int = 100) -> str:
+    out = [f"{'r':>3}  {'value':>10}  {'vs_floor':>8}  metric"]
+    for r in rows:
+        value = ("-" if r["value"] is None
+                 else f"{r['value']:g}{r['unit'] or ''}")
+        vsb = ("-" if r["vs_baseline"] is None
+               else f"{r['vs_baseline']:g}")
+        metric = r["metric"] or "<no headline parsed>"
+        if len(metric) > width:
+            metric = metric[: width - 1] + "…"
+        out.append(f"{r['round']:>3}  {value:>10}  {vsb:>8}  {metric}")
+    return "\n".join(out)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="BENCH_r01..rNN headline trajectory in one table")
+    ap.add_argument("--repo", default=REPO,
+                    help="directory holding the BENCH_rNN.json files")
+    ap.add_argument("--json", action="store_true",
+                    help="emit the rows as JSON instead of a table")
+    args = ap.parse_args(argv)
+    rows = trajectory(args.repo)
+    if not rows:
+        print(f"no BENCH_r*.json files under {args.repo}",
+              file=sys.stderr)
+        return 1
+    if args.json:
+        print(json.dumps(rows, indent=2))
+    else:
+        print(render(rows))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -53,6 +53,9 @@ echo "serve_smoke --restart --churn: rc=${smoke_rc}"
 # routed their MSMs through the commit engine (commit.* stage samples
 # with batched="1" and a ptpu_commit_batch_size mean width > 1 on the
 # live daemon's /metrics).
+# SHARDED_PROVE_OK asserts one live-daemon prove (shard_proves=1)
+# fanned its work units across BOTH pool workers with proof bytes
+# identical to a direct single-worker prove.
 lint_rc=1
 grep -q SCRAPE_LINT_OK /tmp/_smoke.log \
     && grep -q TRACE_JOIN_OK /tmp/_smoke.log \
@@ -61,8 +64,9 @@ grep -q SCRAPE_LINT_OK /tmp/_smoke.log \
     && grep -q SUBLINEAR_OK /tmp/_smoke.log \
     && grep -q PROOF_POOL_OK /tmp/_smoke.log \
     && grep -q COMMIT_PIPE_OK /tmp/_smoke.log \
+    && grep -q SHARDED_PROVE_OK /tmp/_smoke.log \
     && grep -q "DELTA_OK" /tmp/_smoke.log && lint_rc=0
-echo "scrape-lint + trace-join + device-obs + delta + sublinear + pool + commit: rc=${lint_rc}"
+echo "scrape-lint + trace-join + device-obs + delta + sublinear + pool + commit + sharded: rc=${lint_rc}"
 
 # opt-in perf-regression gate (PTPU_PERF_GATE=1): per-stage timings of
 # the instrumented prove/refresh workloads vs tools/perf_baseline.json.

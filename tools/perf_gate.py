@@ -48,6 +48,7 @@ DELTA_KW = {"n": 4000, "m": 4, "batches": 10, "batch_edges": 200}
 SUBLINEAR_KW = {"n": 3000, "m": 4}
 PROOFS_KW = {"k": 7, "gates": 64, "jobs": 6, "workers": 2}
 COMMITS_KW = {"k": 13, "columns": 8}
+SHARDED_KW = {"k": 7, "gates": 64, "jobs": 3, "workers": 2}
 
 
 def _run_once() -> dict:
@@ -60,6 +61,7 @@ def _run_once() -> dict:
         run_proofs_workload,
         run_prove_workload,
         run_refresh_workload,
+        run_sharded_workload,
         run_sublinear_workload,
     )
     from protocol_tpu.utils import trace
@@ -106,6 +108,11 @@ def _run_once() -> dict:
     # where the MSM is the cost — locks the g1_msm_multi win (and the
     # engine's scheduling overhead) against the committed baseline
     measure("commits", lambda: run_commits_workload(**COMMITS_KW), ())
+    # intra-prove sharding: real proves fanned across 2 workers with
+    # byte parity asserted inside the workload — a rendezvous stall or
+    # fan-out serialization grows the total/shard-span times
+    measure("sharded", lambda: run_sharded_workload(**SHARDED_KW),
+            ("service.proof", "prove.shard"))
     return out
 
 
@@ -131,7 +138,8 @@ def run_workloads(runs: int) -> dict:
         "workload_params": {"prove": PROVE_KW, "refresh": REFRESH_KW,
                             "delta": DELTA_KW, "proofs": PROOFS_KW,
                             "commits": COMMITS_KW,
-                            "sublinear": SUBLINEAR_KW},
+                            "sublinear": SUBLINEAR_KW,
+                            "sharded": SHARDED_KW},
         "runs": runs,
         "workloads": best,
     }

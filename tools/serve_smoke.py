@@ -86,7 +86,13 @@ def _pool_provers():
     """The pool phase's job registry: REAL tiny host-path proves when
     the native toolchain is up (worker-labelled prover-stage samples
     land on /metrics), else 50 ms sleepers (worker labels still land
-    via proof_run_seconds). Two kinds → two affinity cache keys."""
+    via proof_run_seconds). Two kinds → two affinity cache keys. Also
+    returns the deterministic reference bytes per kind (fixed
+    blinding), so the sharded-prove phase can assert byte parity
+    against a direct single-worker prove. The ``sharded`` kind is a
+    somewhat larger circuit (k=9): its per-unit MSMs are milliseconds,
+    long enough that an idle worker reliably claims units under the
+    GIL released by the running worker's native calls."""
     import time as _time
 
     from protocol_tpu import native
@@ -95,13 +101,14 @@ def _pool_provers():
         def sleeper(p):
             _time.sleep(0.05)
             return {"ok": True}
-        return {"eigentrust": sleeper, "threshold": sleeper,
-                "noop": lambda p: {"ok": True}}
+        return ({"eigentrust": sleeper, "threshold": sleeper,
+                 "noop": lambda p: {"ok": True}}, {})
     from protocol_tpu.cli.profilecmd import synthetic_circuit
     from protocol_tpu.zk import prover_fast as pf
 
     params = pf.setup_params_fast(7, seed=b"smoke-pool")
     regs = {"noop": lambda p: {"ok": True}}
+    refs = {}
     for kind, seed in (("eigentrust", 3), ("threshold", 4)):
         cs = synthetic_circuit(gates=32, seed=seed, public_input=1)
         pk = pf.keygen_fast(params, cs)
@@ -111,7 +118,18 @@ def _pool_provers():
                                            randint=lambda: 7).hex()}
 
         regs[kind] = prove
-    return regs
+    params9 = pf.setup_params_fast(9, seed=b"smoke-shard")
+    cs9 = synthetic_circuit(gates=220, seed=9, lookup_row=True)
+    pk9 = pf.keygen_fast(params9, cs9, k=9)
+    refs["sharded"] = pf.prove_fast(params9, pk9, cs9,
+                                    randint=lambda: 7).hex()
+
+    def prove_sharded(p):
+        return {"proof": pf.prove_fast(params9, pk9, cs9,
+                                       randint=lambda: 7).hex()}
+
+    regs["sharded"] = prove_sharded
+    return regs, refs
 
 
 def inprocess_phase(node_url, chain, step) -> None:
@@ -128,6 +146,7 @@ def inprocess_phase(node_url, chain, step) -> None:
     config = ClientConfig(as_address="0x" + chain.contract_address.hex(),
                           node_url=node_url, domain="0x" + "00" * 20)
     client = Client(config, MNEMONIC)
+    pool_provers, prove_refs = _pool_provers()
     with tempfile.TemporaryDirectory(prefix="ptpu-smoke-") as tmp:
         # JSONL trace stream: the end-to-end trace-join assertion below
         # reads this file back
@@ -158,10 +177,13 @@ def inprocess_phase(node_url, chain, step) -> None:
                                   device_partial_threshold=0,
                                   # 2 host-path workers: the pool phase
                                   # below drives concurrent submissions
-                                  # through the full scheduler
-                                  pool_workers=2, queue_capacity=32),
+                                  # through the full scheduler; the
+                                  # sharded phase lends them to one
+                                  # prove's work units
+                                  pool_workers=2, queue_capacity=32,
+                                  shard_proves=1),
             os.path.join(tmp, "cursor"),
-            provers=_pool_provers(),
+            provers=pool_provers,
             faults=FaultInjector({"rpc": 0.0, "device": 0.0, "disk": 0.0}),
             state_dir=os.path.join(tmp, "state"))
         url = service.start()
@@ -245,6 +267,9 @@ def inprocess_phase(node_url, chain, step) -> None:
 
         # --- commit engine: batched commit stages on the live daemon ------
         commit_pipe_phase(url, step)
+
+        # --- intra-prove sharding: one prove across both workers ----------
+        sharded_prove_phase(url, prove_refs, step)
 
         # --- end-to-end trace join over the JSONL stream ------------------
         trace_join_phase(trace_path, chain, step)
@@ -690,6 +715,72 @@ def commit_pipe_phase(url, step) -> None:
     step(f"COMMIT_PIPE_OK ({int(batches)} MSM batches on the live "
          f"daemon, mean width {mean:.1f}, commit.* stages "
          f"batched=\"1\")")
+
+
+def sharded_prove_phase(url, refs, step) -> None:
+    """Intra-prove sharding on the LIVE daemon (``shard_proves=1``):
+    a ``sharded``-kind prove's work units must execute on BOTH pool
+    workers (the job's ``prove.shard`` spans carry ``worker=`` from
+    the executing thread), its proof bytes must equal the direct
+    single-worker ``prove_fast`` reference, and the shard counter +
+    wait histogram must land on /metrics → ``SHARDED_PROVE_OK``.
+    Placement is a race (the submitting worker claims whatever no one
+    lends a hand for), so a few proves may be needed before ONE job's
+    spans show both workers — every attempt's bytes are checked."""
+    import json as _json
+    import urllib.request
+
+    from protocol_tpu import native
+    from protocol_tpu.utils import trace
+
+    if not native.available():
+        step("SHARDED_PROVE_OK (skipped: no native toolchain — pool "
+             "provers are sleepers, nothing shards)")
+        return
+
+    def submit(kind):
+        req = urllib.request.Request(
+            url + "/proofs", method="POST",
+            data=_json.dumps({"kind": kind, "params": {}}).encode(),
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=30) as r:
+            assert r.status == 202, f"sharded submit got {r.status}"
+            return _json.loads(r.read())["job_id"]
+
+    both = None
+    tried = []
+    for _attempt in range(6):
+        jid = submit("sharded")
+        deadline = time.monotonic() + 120
+        job = None
+        while time.monotonic() < deadline:
+            job = _get_json(url, f"/proofs/{jid}")
+            if job["status"] in ("done", "failed"):
+                break
+            time.sleep(0.1)
+        assert job is not None and job["status"] == "done", job
+        assert job["result"]["proof"] == refs["sharded"], \
+            f"{jid}: sharded proof bytes diverged from the direct prove"
+        workers = {r.fields.get("worker") for r in trace.TRACER.spans
+                   if jid in r.trace_ids and r.name == "prove.shard"}
+        tried.append((jid, sorted(w for w in workers if w)))
+        if {"w0", "w1"} <= workers:
+            both = jid
+            break
+    assert both is not None, \
+        f"no single job's shards spread across both workers: {tried}"
+
+    metrics = _get_json(url, "/metrics")
+    shards = _series_sum(metrics, "ptpu_prove_shards_total")
+    assert shards > 0, "ptpu_prove_shards_total absent or zero"
+    assert "ptpu_prove_shard_wait_seconds" in metrics, \
+        "shard-wait histogram family missing from /metrics"
+    rows = _get_json(url, "/status")["pool"]["workers"]
+    assert all("lent_to" in r and "shards_run" in r for r in rows), rows
+    assert sum(r["shards_run"] for r in rows) > 0, \
+        f"no worker ever lent (shards_run all zero): {rows}"
+    step(f"SHARDED_PROVE_OK (job {both} sharded across both workers, "
+         f"{int(shards)} shard units total, bytes == direct prove)")
 
 
 def _counter_total(name) -> float:
