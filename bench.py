@@ -151,6 +151,24 @@ def main():
                              "measured inline commit wall, the "
                              "flagship regime where device quotient "
                              "and commit wall are comparable)")
+    parser.add_argument("--reads", action="store_true",
+                        help="BENCH_r11: read-path scale-out — read "
+                             "QPS vs follower-replica count under "
+                             "concurrent churn ingest on the leader, "
+                             "p95 replication lag, leader refresh "
+                             "interference, byte-equality asserted at "
+                             "the same WAL position (real CLI daemons "
+                             "over the mock devnet)")
+    parser.add_argument("--read-followers", default="0,1,2",
+                        help="comma-separated follower counts to sweep "
+                             "(0 = leader-only baseline)")
+    parser.add_argument("--read-seconds", type=float, default=8.0,
+                        help="measurement window per cell")
+    parser.add_argument("--read-clients", type=int, default=4,
+                        help="concurrent read clients")
+    parser.add_argument("--churn-rate", type=float, default=3.0,
+                        help="attestations/second posted to the "
+                             "leader during every measurement window")
     parser.add_argument("--device-window", type=float, default=1.2,
                         help="per-proof device-occupancy window in "
                              "seconds (GIL-released wait modeling the "
@@ -160,6 +178,9 @@ def main():
 
     if args.msm:
         return bench_msm(args)
+
+    if args.reads:
+        return bench_reads(args)
 
     if args.proofs:
         return bench_proofs(args)
@@ -761,6 +782,407 @@ def bench_churn(args) -> int:
         "unit": "s",
         "vs_baseline": round(build_s / wall, 1),
     }))
+    return 0
+
+
+def bench_reads(args) -> int:
+    """BENCH_r11: read-path scale-out over follower replicas.
+
+    Protocol: one real CLI leader daemon over the mock devnet, plus
+    ``--read-followers`` follower daemons (``serve --follow``) tailing
+    its shipped WAL. Per cell, ``--read-clients`` threads hammer
+    ``GET /score/<addr>`` for ``--read-seconds`` — against the LEADER
+    in the 0-follower baseline cell, round-robin across the FOLLOWERS
+    otherwise — while a churn thread posts ``--churn-rate``
+    attestations/second to the leader throughout, so the measurement
+    never sees an idle write path. Recorded per cell: read QPS, p95 of
+    the sampled ``ptpu_repl_lag_{records,seconds}`` gauges (follower
+    cells), and the leader's mean refresh wall over the window (the
+    interference signal: reads pointed at followers stop contending
+    with the refresh loop). After the sweep, churn stops and the
+    byte-equality criterion is ASSERTED: every follower's full
+    ``/scores`` vector must equal the leader's at the same WAL
+    position (all daemons run all-cold deterministic refreshes).
+
+    1-core honesty (the established methodology): every daemon shares
+    this box's single core, so follower serving steals cycles the
+    leader could have used — the QPS curve here measures that the
+    fabric WORKS under churn and what serving costs, not the N-core
+    speedup. Serving is I/O-wait-dominated (socket accept + JSON
+    encode interleave across processes), so scaling is real but
+    muted; on an N-core/N-box deployment each follower adds a full
+    core's serving capacity while the leader keeps its own — that
+    curve is owed to hardware. Headline ``value`` = the refresh-wall
+    interference ratio (leader refresh mean with reads at the leader /
+    with reads at the top follower count; floor 2×); the raw QPS
+    scaling is recorded in the meta, not gated.
+    """
+    import urllib.request
+
+    repo = os.path.dirname(os.path.abspath(__file__))
+    sys.path.insert(0, os.path.join(repo, "tools"))
+    import serve_smoke
+
+    from protocol_tpu.client import Client, ClientConfig
+    from protocol_tpu.client.chain import RpcChain
+    from protocol_tpu.client.eth import (
+        address_from_public_key,
+        ecdsa_keypairs_from_mnemonic,
+    )
+    from protocol_tpu.client.mocknode import MockNode
+
+    counts = sorted({int(x) for x in args.read_followers.split(",")
+                     if x != ""})
+    if not counts or counts[0] != 0:
+        print("BENCH FAILED: --read-followers must include 0 (the "
+              "leader-only baseline the headline divides by)",
+              file=sys.stderr)
+        return 1
+
+    def step(msg):
+        print(f"reads: {msg}", file=sys.stderr, flush=True)
+
+    node = MockNode()
+    node_url = node.start()
+    deployer = ecdsa_keypairs_from_mnemonic(serve_smoke.MNEMONIC, 1)[0]
+    chain = RpcChain.deploy_signed(node_url, deployer)
+    config = ClientConfig(
+        as_address="0x" + chain.contract_address.hex(),
+        node_url=node_url, domain="0x" + "00" * 20)
+    client = Client(config, serve_smoke.MNEMONIC)
+    kps = ecdsa_keypairs_from_mnemonic(serve_smoke.MNEMONIC, 3)
+    addrs = [address_from_public_key(kp.public_key) for kp in kps]
+
+    def get_json(url, path):
+        with urllib.request.urlopen(url + path, timeout=10) as r:
+            body = r.read()
+        return body.decode() if path == "/metrics" else json.loads(body)
+
+    def refresh_snapshot(lurl):
+        """(count, sum, {le: cum}) over every mode of
+        ptpu_refresh_seconds — histogram-bucket deltas between two
+        snapshots give the WINDOWED distribution (the Prometheus-side
+        quantile discipline, computed here without a server)."""
+        text = get_json(lurl, "/metrics")
+        count = serve_smoke._series_sum(text,
+                                        "ptpu_refresh_seconds_count")
+        total = serve_smoke._series_sum(text,
+                                        "ptpu_refresh_seconds_sum")
+        buckets: dict = {}
+        for line in text.splitlines():
+            if not line.startswith("ptpu_refresh_seconds_bucket"):
+                continue
+            le = line.split('le="', 1)[1].split('"', 1)[0]
+            buckets[le] = buckets.get(le, 0.0) + float(line.split()[-1])
+        return count or 0.0, total or 0.0, buckets
+
+    def hist_p95(b0, b1):
+        """p95 upper bound from two cumulative-bucket snapshots; the
+        +Inf bucket renders as the string "+Inf" (json.dumps would
+        emit non-standard Infinity for the float)."""
+        deltas = [(float("inf") if le == "+Inf" else float(le),
+                   b1.get(le, 0.0) - b0.get(le, 0.0))
+                  for le in b1]
+        deltas.sort()
+        total = deltas[-1][1] if deltas else 0.0
+        if total <= 0:
+            return None
+        for le, cum in deltas:
+            if cum >= 0.95 * total:
+                return "+Inf" if le == float("inf") else le
+        return None
+
+    # all-cold deterministic refreshes (the byte-equality contract)
+    det_env = {"PTPU_SERVE_COLD_EDIT_FRACTION": "0.0",
+               "PTPU_SERVE_SNAPSHOT_EVERY": "8"}
+    churn_round = [0]
+
+    def churn_once():
+        r = churn_round[0]
+        churn_round[0] += 1
+        i = r % 3
+        about = addrs[(r + 1) % 3]
+        client.keypairs[0] = kps[i]
+        client.attest(about, 2 + (r * 7) % 11)
+
+    procs = []
+    try:
+        return _bench_reads_body(args, node, config, client, kps,
+                                 addrs, det_env, churn_once, get_json,
+                                 refresh_snapshot, hist_p95, step,
+                                 procs)
+    finally:
+        # a failed cell must not leak live daemons onto the box (they
+        # would skew every later bench) or delete state dirs under a
+        # live WAL writer
+        for proc in procs:
+            if proc.returncode is None:
+                proc.terminate()
+        for proc in procs:
+            try:
+                proc.wait(timeout=60)
+            except Exception:  # noqa: BLE001 - teardown best-effort
+                proc.kill()
+        try:
+            node.stop()
+        except Exception:  # noqa: BLE001 - teardown best-effort
+            pass
+
+
+def _bench_reads_body(args, node, config, client, kps, addrs, det_env,
+                      churn_once, get_json, refresh_snapshot, hist_p95,
+                      step, procs) -> int:
+    import tempfile
+    import threading
+    import urllib.request
+
+    repo = os.path.dirname(os.path.abspath(__file__))
+    sys.path.insert(0, os.path.join(repo, "tools"))
+    import serve_smoke
+
+    from protocol_tpu.client.storage import JSONFileStorage
+
+    counts = sorted({int(x) for x in args.read_followers.split(",")
+                     if x != ""})
+    with tempfile.TemporaryDirectory(prefix="ptpu-bench-reads-") \
+            as assets:
+        JSONFileStorage(os.path.join(assets, "config.json")).save(
+            config.to_dict())
+        leader, lurl, _ = serve_smoke._spawn_daemon(
+            assets, det_env, step, "leader")
+        procs.append(leader)
+        for _ in range(6):
+            churn_once()
+        deadline = time.monotonic() + 120
+        while time.monotonic() < deadline:
+            try:
+                if get_json(lurl, "/scores")["scores"]:
+                    break
+            except Exception:
+                pass
+            time.sleep(0.2)
+        step("leader serving")
+
+        followers = []
+        raw_means: dict = {}  # follower count -> unrounded refresh mean
+
+        def caught_up(furl):
+            try:
+                fs = get_json(furl, "/status")
+                ls = get_json(lurl, "/status")
+                return (fs["repl"]["cursor"]
+                        == ls["store"]["wal_position"])
+            except Exception:
+                return False
+
+        def measure(n_f) -> dict:
+            targets = ([furl for _, furl in followers[:n_f]]
+                       if n_f else [lurl])
+            stop = threading.Event()
+            reads = [0] * args.read_clients
+            errors = [0]
+            lag_samples = []
+
+            def reader(c):
+                k = c
+                while not stop.is_set():
+                    url = targets[k % len(targets)]
+                    addr = addrs[k % len(addrs)]
+                    k += 1
+                    try:
+                        with urllib.request.urlopen(
+                                url + f"/score/0x{addr.hex()}",
+                                timeout=10) as r:
+                            r.read()
+                        reads[c] += 1
+                    except Exception:
+                        errors[0] += 1
+
+            def churner():
+                period = 1.0 / max(args.churn_rate, 0.1)
+                while not stop.is_set():
+                    try:
+                        churn_once()
+                    except Exception:
+                        pass
+                    stop.wait(period)
+
+            def sampler():
+                # every follower in the cell contributes samples —
+                # p95 over the fleet, not just replica 0
+                furls = [furl for _, furl in followers[:n_f]]
+                while not stop.is_set():
+                    for furl in furls:
+                        try:
+                            fs = get_json(furl, "/status")["repl"]
+                            lag_samples.append(
+                                (fs["lag_records"],
+                                 max(fs["lag_seconds"], 0.0)))
+                        except Exception:
+                            pass
+                    stop.wait(0.1)
+
+            c0, s0, b0 = refresh_snapshot(lurl)
+            threads = [threading.Thread(target=reader, args=(c,),
+                                        daemon=True)
+                       for c in range(args.read_clients)]
+            threads.append(threading.Thread(target=churner,
+                                            daemon=True))
+            if n_f:
+                threads.append(threading.Thread(target=sampler,
+                                                daemon=True))
+            t0 = time.perf_counter()
+            for t in threads:
+                t.start()
+            time.sleep(args.read_seconds)
+            stop.set()
+            for t in threads:
+                t.join(timeout=30)
+            wall = time.perf_counter() - t0
+            c1, s1, b1 = refresh_snapshot(lurl)
+            refreshes = c1 - c0
+            if refreshes <= 0:
+                raise RuntimeError(
+                    "no leader refreshes in the window — churn thread "
+                    "dead, interference cells would be vacuous")
+            mean_s = (s1 - s0) / refreshes
+            raw_means[n_f] = mean_s  # unrounded: the headline ratio
+            # must not divide by a 4-decimal-rounded (possibly 0.0)
+            # display value
+            cell = {
+                "followers": n_f,
+                "read_target": "followers" if n_f else "leader",
+                "reads": int(sum(reads)),
+                "read_errors": int(errors[0]),
+                "qps": round(sum(reads) / wall, 1),
+                "window_s": round(wall, 2),
+                "leader_refreshes_in_window": int(refreshes),
+                "leader_refresh_mean_s": round(mean_s, 4),
+                # windowed p95 upper bucket bound (log-spaced buckets:
+                # coarse, but windowed — the honest interference tail)
+                "leader_refresh_p95_le_s": hist_p95(b0, b1),
+            }
+            if lag_samples:
+                recs = sorted(r for r, _ in lag_samples)
+                secs = sorted(s for _, s in lag_samples)
+
+                def p95(xs):
+                    return xs[min(len(xs) - 1,
+                                  int(0.95 * (len(xs) - 1)))]
+                cell["repl_lag_records_p95"] = p95(recs)
+                cell["repl_lag_seconds_p95"] = round(p95(secs), 3)
+            return cell
+
+        curve = []
+        for n_f in counts:
+            while len(followers) < n_f:
+                i = len(followers)
+                proc, furl, _ = serve_smoke._spawn_daemon(
+                    assets, det_env, step, f"follower{i}",
+                    state_dir=f"fstate{i}",
+                    extra_args=("--follow", lurl))
+                procs.append(proc)
+                deadline = time.monotonic() + 120
+                while not caught_up(furl):
+                    if time.monotonic() > deadline:
+                        print("BENCH FAILED: follower never caught up",
+                              file=sys.stderr)
+                        return 1
+                    time.sleep(0.2)
+                followers.append((proc, furl))
+            cell = measure(n_f)
+            curve.append(cell)
+            print(json.dumps(cell), file=sys.stderr)
+
+        # quiesce, then ASSERT byte equality at the same WAL position
+        deadline = time.monotonic() + 120
+        while time.monotonic() < deadline:
+            ls = get_json(lurl, "/status")
+            if (ls["last_refresh"]["revision"]
+                    == ls["graph"]["revision"]
+                    and all(caught_up(furl)
+                            and get_json(furl, "/status")
+                            ["last_refresh"]["revision"]
+                            == get_json(furl, "/status")
+                            ["graph"]["revision"]
+                            for _, furl in followers)):
+                break
+            time.sleep(0.2)
+        lscores = get_json(lurl, "/scores")["scores"]
+        pos = get_json(lurl, "/status")["store"]["wal_position"]
+        for _, furl in followers:
+            fscores = get_json(furl, "/scores")["scores"]
+            if fscores != lscores:
+                print(f"BENCH FAILED: follower scores not byte-equal "
+                      f"to the leader at {pos}: {fscores} vs "
+                      f"{lscores}", file=sys.stderr)
+                return 1
+        step(f"byte-equality held across {len(followers)} "
+             f"follower(s) at {pos}")
+        # graceful teardown INSIDE the temp-dir scope: the state dirs
+        # must outlive their live WAL writers (the caller's finally
+        # re-terminates idempotently on the failure paths)
+        for proc in procs:
+            proc.terminate()
+        for proc in procs:
+            proc.wait(timeout=60)
+
+    by_count = {c["followers"]: c for c in curve}
+    top = max(counts)
+    qps_scaling = (by_count[top]["qps"] / by_count[0]["qps"]
+                   if by_count[0]["qps"] else 0.0)
+    # the headline on THIS box is interference, not capacity: reads
+    # pointed at followers stop contending with the leader's refresh
+    # loop (windowed mean ratio — every process shares one core, so
+    # raw QPS cannot scale here; see methodology); unrounded means, a
+    # sub-50µs cell must not divide-by-(rounded-)zero
+    value = raw_means[0] / max(raw_means[top], 1e-9)
+    meta = {
+        "mode": "reads",
+        "follower_counts": counts,
+        "read_clients": args.read_clients,
+        "window_s": args.read_seconds,
+        "churn_rate_per_s": args.churn_rate,
+        "curve": curve,
+        "qps_scaling_vs_leader_only": round(qps_scaling, 3),
+        "refresh_interference_ratio": round(value, 2),
+        "byte_equality": f"every follower /scores vector == leader at "
+                         f"WAL {pos} (asserted, full vector)",
+        "host_cores": os.cpu_count(),
+        "methodology": "real CLI daemons (leader + serve --follow "
+                       "followers) over the mock devnet, one box; "
+                       "reads are GET /score/<addr> over fresh "
+                       "connections; churn ingest runs on the leader "
+                       "through every window; all daemons refresh "
+                       "all-cold (deterministic trajectories) so byte "
+                       "equality is assertable; single-core caveat: "
+                       "all processes share 1 core, so follower "
+                       "serving steals cycles instead of adding them "
+                       "— qps_scaling_vs_leader_only measures that "
+                       "cost honestly, while the headline is the "
+                       "refresh-wall interference reads stop causing "
+                       "when pointed at followers; on N cores/boxes "
+                       "each follower adds a full core of serving "
+                       "capacity (curve owed to hardware)",
+    }
+    print(json.dumps(meta), file=sys.stderr)
+    print(json.dumps({
+        "metric": f"leader refresh-wall interference: mean refresh "
+                  f"wall with reads at the leader vs at {top} "
+                  f"followers, under {args.churn_rate:.0f}/s churn",
+        "value": round(value, 2),
+        "unit": "x",
+        "vs_baseline": round(value / 2.0, 3),
+    }))
+    if value < 2.0:
+        # advisory, not a gate: the interference ratio needs enough
+        # read pressure per window to inflate the leader cell (short
+        # --read-seconds runs legitimately measure ~1x); the HARD
+        # criterion of this bench is the byte-equality assert above,
+        # which already returned 1 on violation
+        print(f"reads: NOTE interference ratio {value:.2f}x under the "
+              "2x reference (window too short / box too quiet to "
+              "pressure the leader?)", file=sys.stderr)
     return 0
 
 

@@ -161,6 +161,13 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--checkpoint-dir", default=None,
                    help="block-cursor checkpoint directory "
                         "(default <state-dir>/cursor)")
+    p.add_argument("--follow", default=None, metavar="LEADER_URL",
+                   help="run as a READ REPLICA of a leader daemon: "
+                        "restore from its /repl/snapshot, tail its "
+                        "shipped WAL (/repl/wal), refresh and serve "
+                        "/scores //score/<addr> //bundle hermetically "
+                        "(no chain tailer, no proof pool; POST /proofs "
+                        "answers 503)")
 
     p = sub.add_parser(
         "obs",
@@ -843,13 +850,36 @@ def handle_serve(args, files, config):
         pool_workers=args.workers,
         shard_proves=args.shard_proves,
         proof_shape=args.shape, transcript=args.transcript,
-        state_dir=args.state_dir)
+        state_dir=args.state_dir, follow=args.follow)
     if svc_config.state_dir:
         state_dir = Path(svc_config.state_dir)
         if not state_dir.is_absolute():
             state_dir = files.assets / state_dir
     else:
         state_dir = files.service_state_dir()
+    if svc_config.follow:
+        # follower replica: no chain client at all — the leader's
+        # shipped WAL is the only upstream. The domain comes from the
+        # same config the leader reads, so records decode identically.
+        from ..service.follower import FollowerService
+
+        domain = bytes.fromhex(config.domain.removeprefix("0x"))
+        follower = FollowerService(
+            svc_config.follow, domain, svc_config, str(state_dir),
+            batched_ingest=None)
+        url = follower.start()
+        follower.install_signal_handlers()
+        print(f"trust-scores FOLLOWER listening on {url} "
+              f"(leader: {svc_config.follow}, state: {state_dir}, "
+              f"peers: {follower.graph.n}); SIGTERM drains",
+              flush=True)
+        follower.wait()
+        if follower.drain_clean:
+            print("follower drained", flush=True)
+            return 0
+        print("follower drained UNCLEAN (timeout or persist failure)",
+              flush=True)
+        return 1
     if args.checkpoint_dir:
         ck_dir = Path(args.checkpoint_dir)
         if not ck_dir.is_absolute():

@@ -58,11 +58,38 @@ from .partial import (
     PartialResult,
     _fanout,
     _member,
+    _member_pos,
     as_frontier_array,
     external_out_weight,
+    external_out_weight_rows,
     frontier_inedges,
     partial_refresh,
 )
+
+
+def _expand_ext_slots(eng, old_sorted, old_slots, ext, new_sorted,
+                      new_rows, in_edges) -> np.ndarray:
+    """Slot-ordered twin of ``partial.expand_out_weight``: the device
+    operands keep ext in INSERTION (slot) order with appended rows at
+    the end, so the boundary-crossing decrement maps each in-edge
+    source through sorted-rank → slot index, and the fresh walk of the
+    appended rows (against the EXPANDED membership) concatenates at
+    the tail. O(new rows' degree + |frontier|) vectorized index work —
+    never the O(frontier fan-out) whole-set recompute an expansion
+    used to pay."""
+    rows, srcs, w = in_edges
+    ext = ext.copy()
+    if len(srcs):
+        hit, pos = _member_pos(old_sorted, srcs)
+        if hit.any():
+            inv = np.empty(len(old_slots), dtype=np.int64)
+            inv[np.searchsorted(old_sorted, old_slots)] = \
+                np.arange(len(old_slots))
+            np.subtract.at(ext, inv[pos[hit]], w[hit])
+            # float dust: a fully-interior row telescopes to 0
+            np.maximum(ext, 0.0, out=ext)
+    ext_new = external_out_weight_rows(eng, new_sorted, new_rows)
+    return np.concatenate([ext, ext_new])
 
 
 def _pow2(x: int, floor: int = 16) -> int:
@@ -164,14 +191,18 @@ class _FrontierOperands:
             arr, jnp.asarray(upd, dtype=arr.dtype),
             (jnp.asarray(start, dtype=jnp.int32),)))
 
-    def append(self, new_rows: np.ndarray) -> None:
+    def append(self, new_rows: np.ndarray):
         """Extend the frontier by ``new_rows`` (sorted, disjoint from
         the current set): gather ONLY their in-edges and append both
-        row and edge operands in place on device."""
+        row and edge operands in place on device. Returns the gathered
+        ``(rows, srcs, w)`` triple — the caller's incremental
+        ext-weight update needs exactly these edges (they are the
+        boundary-crossing ones), so it must not gather them twice."""
         eng = self.eng
         new_rows = np.asarray(new_rows, dtype=np.int64)
         if not len(new_rows):
-            return
+            z = np.zeros(0, dtype=np.int64)
+            return z, z, np.zeros(0)
         self.gathered_rows += int(len(new_rows))
         rows, srcs, w = frontier_inedges(eng, new_rows)
         pad_f = _pow2(len(new_rows))
@@ -202,6 +233,7 @@ class _FrontierOperands:
         # concat-sort is O(F log F) per expansion for no reason
         pos = np.searchsorted(self.sorted, new_rows)
         self.sorted = np.insert(self.sorted, pos, new_rows)
+        return rows, srcs, w
 
 
 def _device_sweeps(eng, s0, F: np.ndarray, tol: float, max_sweeps: int,
@@ -358,9 +390,17 @@ def _device_sweeps(eng, s0, F: np.ndarray, tol: float, max_sweeps: int,
                 new = grown[~_member(ops.sorted, grown)]
                 if len(new):
                     # device-side append: gather ONLY the new rows'
-                    # in-edges — never rebuild the whole frontier
-                    ops.append(new)
-                    ext = None
+                    # in-edges — never rebuild the whole frontier —
+                    # and maintain ext incrementally from the SAME
+                    # gather: fresh out-edge walk for the appended
+                    # rows, subtraction for the boundary-crossing
+                    # ones (their destinations moved inside the set)
+                    old_sorted = ops.sorted
+                    old_slots = ops.slots
+                    in_edges = ops.append(new)
+                    ext = _expand_ext_slots(eng, old_sorted, old_slots,
+                                            ext, ops.sorted, new,
+                                            in_edges)
                     # new rows legitimately move the residual: the
                     # stall guard restarts on every expansion
                     best_residual = np.inf

@@ -213,6 +213,14 @@ class DeltaEngine:
         # O(tail) — asserted by tests/test_incremental.py)
         self.tail_fanin_visited = 0
         self.tail_fanout_visited = 0
+        # ext-weight recompute scope (ROADMAP 3 residual, closed in
+        # PR 13): rows whose external out-weight was computed from
+        # their out-edges. Frontier EXPANSIONS update incrementally —
+        # fresh computation only for the appended rows, a subtraction
+        # for the boundary-crossing rows — so this grows by
+        # O(new rows) per expansion, not O(frontier)
+        # (tests/test_sublinear.py asserts the scope).
+        self.ext_weight_rows_computed = 0
 
         # --- device state ---------------------------------------------
         arrs, static = routed_arrays(op, dtype=self.dtype, alpha=alpha)

@@ -186,31 +186,42 @@ def _tail_outedges(eng, S: np.ndarray):
             np.nonzero(live)[0][hit])
 
 
-def external_out_weight(eng, S: np.ndarray) -> np.ndarray:
-    """Per-row external out-weight of an observed row set: for each
-    r in S, the sum of r's TRUE normalized out-edge weights whose
-    destination lies OUTSIDE S — the multiplier that prices a row's
-    per-sweep change into neglected-propagation L1 mass (the operator
-    is row-stochastic, so a |Δr| change leaks at most |Δr|·ext_w(r)
-    of L1 outside the observed set per sweep). The observation-error
-    term of the partially-observed power-iteration footing (PAPERS.md,
-    arXiv 2606.11956), charged to the honesty budget by both the
-    truncated-expansion partial sweeps and the fixed-set sampled
-    mode."""
-    ext = np.zeros(len(S))
-    Sb = S[S < eng.n0]
-    if len(Sb):
-        rows, pos = expand_csr(eng.out_ptr, Sb)
+def external_out_weight_rows(eng, S: np.ndarray,
+                             R: np.ndarray) -> np.ndarray:
+    """Per-row external out-weight of ``R`` (sorted, ⊆ ``S``) against
+    the membership set ``S``: for each r in R, the sum of r's TRUE
+    normalized out-edge weights whose destination lies OUTSIDE S — the
+    multiplier that prices a row's per-sweep change into
+    neglected-propagation L1 mass (the operator is row-stochastic, so
+    a |Δr| change leaks at most |Δr|·ext_w(r) of L1 outside the
+    observed set per sweep). The observation-error term of the
+    partially-observed power-iteration footing (PAPERS.md, arXiv
+    2606.11956), charged to the honesty budget by both the
+    truncated-expansion partial sweeps and the fixed-set sampled mode.
+
+    Splitting the row set from the membership set is what makes
+    frontier expansions sublinear in frontier size: only the APPENDED
+    rows pay an out-edge walk (``R=new``), while existing rows update
+    by subtraction (:func:`expand_out_weight`). Cost: O(Σ out-degree
+    of R); ``eng.ext_weight_rows_computed`` counts the rows walked —
+    the regression signal that expansions stopped recomputing the
+    whole frontier."""
+    eng.ext_weight_rows_computed = getattr(
+        eng, "ext_weight_rows_computed", 0) + int(len(R))
+    ext = np.zeros(len(R))
+    Rb = R[R < eng.n0]
+    if len(Rb):
+        rows, pos = expand_csr(eng.out_ptr, Rb)
         if len(pos):
-            src = Sb[rows]
+            src = Rb[rows]
             denom = eng.row_sum_now[src]
             w = np.divide(eng.raw_val[pos], denom,
                           out=np.zeros(len(pos)), where=denom > 0)
             outside = ~_member(S, eng.fdst[pos])
             ext_b = np.bincount(rows, weights=w * outside,
-                                minlength=len(Sb))
-            ext[np.searchsorted(S, Sb)] += ext_b
-    rows2, tis = _tail_outedges(eng, S)
+                                minlength=len(Rb))
+            ext[np.searchsorted(R, Rb)] += ext_b
+    rows2, tis = _tail_outedges(eng, R)
     if len(tis):
         tsrc = eng.tail_src_np[tis]
         denom = eng.row_sum_now[tsrc]
@@ -219,6 +230,51 @@ def external_out_weight(eng, S: np.ndarray) -> np.ndarray:
         outside = ~_member(S, eng.tail_dst_np[tis])
         np.add.at(ext, rows2, w * outside)
     return ext
+
+
+def external_out_weight(eng, S: np.ndarray) -> np.ndarray:
+    """Full-set form: every row of ``S`` against ``S`` (the from-
+    scratch computation; expansions use the incremental pair
+    :func:`external_out_weight_rows` + :func:`expand_out_weight`)."""
+    return external_out_weight_rows(eng, S, S)
+
+
+def expand_out_weight(eng, S_old: np.ndarray, ext_old: np.ndarray,
+                      new_rows: np.ndarray, in_edges=None) -> tuple:
+    """Incremental ext-weight maintenance across a frontier expansion
+    (the ROADMAP 3 residual): ``S_new = S_old ∪ new_rows`` changes
+    per-row external out-weight in exactly two places —
+
+    - **appended rows** need a fresh walk of THEIR out-edges
+      (``external_out_weight_rows(eng, S_new, new_rows)``);
+    - **boundary-crossing rows** — existing rows with an out-edge INTO
+      a newly-observed row — lose that edge's weight from their
+      external sum (the destination moved inside the set). Those edges
+      are precisely the in-edges of ``new_rows``, which the caller
+      usually ALREADY gathered to build the expansion's operands —
+      pass them as ``in_edges=(rows, srcs, w)`` to avoid a second
+      gather.
+
+    Everything else is untouched, so an expansion costs O(new rows'
+    degree), not O(frontier fan-out). Returns ``(S_new, ext_new)``
+    with ``ext_new`` aligned to the sorted ``S_new``. ``new_rows``
+    must be sorted and disjoint from ``S_old`` (the caller's
+    ``~_member`` filter guarantees it)."""
+    if in_edges is None:
+        in_edges = frontier_inedges(eng, new_rows)
+    rows, srcs, w = in_edges
+    ext_dec = ext_old.copy()
+    if len(srcs):
+        hit, pos = _member_pos(S_old, srcs)
+        if hit.any():
+            np.subtract.at(ext_dec, pos[hit], w[hit])
+            # float dust: a fully-interior row's sum telescopes to 0
+            np.maximum(ext_dec, 0.0, out=ext_dec)
+    ins = np.searchsorted(S_old, new_rows)
+    S_new = np.insert(S_old, ins, new_rows)
+    ext_new_rows = external_out_weight_rows(eng, S_new, new_rows)
+    ext_new = np.insert(ext_dec, ins, ext_new_rows)
+    return S_new, ext_new
 
 
 def _tail_inedges(eng, F: np.ndarray):
@@ -383,10 +439,15 @@ def partial_refresh(eng, s0, frontier, tol: float, max_sweeps: int,
             return None  # truncated-expansion budget exhausted
         moved = F[big]
         if len(moved):
-            F2 = np.unique(np.concatenate([F, _fanout(eng, moved)]))
-            if len(F2) > len(F):
-                F = F2
-                ext = None
+            grown = _fanout(eng, moved)
+            new = grown[~_member(F, grown)]
+            if len(new):
+                # incremental ext-weight maintenance: fresh walk for
+                # the appended rows only, subtraction for the
+                # boundary-crossing ones — never a whole-frontier
+                # recompute per expansion (ext is non-None here: the
+                # pricing above always materializes it first)
+                F, ext = expand_out_weight(eng, F, ext, new)
     else:
         return None
     if uni != 0.0:

@@ -41,6 +41,16 @@ Components (one file each):
   making restarts lossless: snapshot restore + WAL replay + cursor
   resume, with the refresher warm-starting from the restored vector.
 
+- :class:`FollowerService` (``follower.py``) — the read-path scale-out
+  (PR 13): a ``serve --follow <leader-url>`` replica that bootstraps
+  from the leader's snapshot, tails its shipped WAL
+  (``replication.py``), applies edges through the same graph/refresh
+  ladder, and serves ``/scores``/``/score/<addr>``/``/bundle``
+  hermetically — read-only, with honest per-replica freshness and
+  ``ptpu_repl_lag_{records,seconds}`` gauges. ``bundle.py`` holds the
+  signed, cacheable score-bundle codec the leader serves at
+  ``GET /bundle``.
+
 Wired to the CLI as the ``serve`` verb plus the ``store``
 inspect/compact verbs (``cli/main.py``).
 """
@@ -48,6 +58,7 @@ inspect/compact verbs (``cli/main.py``).
 from .config import ServiceConfig
 from .daemon import TrustService
 from .faults import FaultInjector
+from .follower import FollowerService
 from .jobs import (
     ByteBudgetError,
     ProofJob,
@@ -64,6 +75,7 @@ __all__ = [
     "ByteBudgetError",
     "ChainTailer",
     "FaultInjector",
+    "FollowerService",
     "OpinionGraph",
     "ProofJob",
     "ProofJobQueue",

@@ -114,6 +114,27 @@ class ServiceConfig:
     # of the offline `store compact` verb. 0 disables auto-compaction.
     wal_compact_segments: int = 8
 
+    # --- read-path replication (PR 13) ------------------------------------
+    # follower mode: set to a leader's base URL (serve --follow) and
+    # the daemon boots as a READ REPLICA — restore from the leader's
+    # snapshot, tail its shipped WAL (/repl/wal), apply edges through
+    # the same OpinionGraph/refresh ladder, serve /scores //score/<addr>
+    # //healthz //metrics //bundle hermetically. No chain tailer, no
+    # proof pool: POST /proofs answers 503 read-only.
+    follow: str = ""
+    # stable follower identity reported to the leader (the shipping
+    # floor + /status repl rows key on it); "" derives one from the
+    # state dir so a restarted follower keeps its row
+    follower_id: str = ""
+    # max shipped bytes per /repl/wal fetch (whole frames; one
+    # oversized record still ships alone)
+    repl_max_bytes: int = 1 << 20
+    # leader side: followers seen within this window are ACTIVE — WAL
+    # compaction defers while an active follower is catching up (the
+    # ship floor); beyond it a dead replica stops pinning the log and
+    # re-tails the folded history (content-dedup-safe) when it returns
+    repl_follower_ttl: float = 120.0
+
     # --- proof pool -------------------------------------------------------
     # workers: 0 = one per jax device (host-path workers on a CPU box
     # give 1); an explicit count forces that many workers, each with

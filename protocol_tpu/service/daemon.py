@@ -56,13 +56,58 @@ from .config import ServiceConfig
 from .faults import FaultInjector
 from .pool import ProofWorkerPool
 from .refresh import ScoreRefresher, ScoreTable
-from .state import OpinionGraph, att_digest, recover_signers, trace_id_of
+from .state import (
+    FreshnessTracker,
+    OpinionGraph,
+    att_digest,
+    recover_signers,
+    trace_id_of,
+)
 from .tailer import ChainTailer
 
 # the dedup key (see state.att_digest: block + about + normalized
 # payload — the block matters because RFC 6979 re-attestations are
 # byte-identical in payload)
 _att_digest = att_digest
+
+
+def commit_service_snapshot(store, graph, refresher,
+                            n_attestations: int) -> bool:
+    """One consistent cut → atomic snapshot: the shared core of the
+    leader's and the follower's snapshot paths (the follower replays
+    the SAME store formats, so its durability discipline is this exact
+    code, not a reimplementation). Must run on the sink thread — the
+    only graph/WAL mutator — or after it stopped. The WAL is synced
+    first: the snapshot claims coverage up to ``position()``, and
+    under ``wal_fsync="never"`` those bytes may be page-cache only."""
+    from ..store import encode_service_state
+
+    n, src, dst, val, revision, edits = graph.snapshot()
+    addrs = graph.addresses()[:n]
+    invalid = graph.invalid
+    try:
+        store.wal.sync()
+    except OSError:
+        store.snapshot_failures += 1
+        trace.event("service.snapshot_failed", revision=revision)
+        return False
+    pos = store.wal.position()
+    arrays, meta = encode_service_state(
+        addrs, src, dst, val, revision, edits, invalid,
+        refresher.table, pos, n_attestations=n_attestations)
+    try:
+        with trace.span("service.snapshot", revision=revision,
+                        n=len(addrs), attestations=n_attestations):
+            store.snapshots.save(revision, arrays, meta)
+    except (EigenError, OSError):
+        # OSError too: CheckpointManager raises raw ENOSPC/EIO, and
+        # the farewell snapshot on the drain path must degrade to
+        # "longer replay next start", never abort the shutdown
+        store.snapshot_failures += 1
+        trace.event("service.snapshot_failed", revision=revision)
+        return False
+    trace.metric("service.snapshot_revision", revision)
+    return True
 
 
 class TrustService:
@@ -126,9 +171,21 @@ class TrustService:
         # the newest attestation in that batch). score_freshness_seconds
         # = now − the newest timestamp whose revision the published
         # table covers — the end-to-end ingest→served-scores lag
-        self._fresh_lock = threading.Lock()
-        self._fresh_pending: list = []
-        self._fresh_anchor: float | None = None
+        self.freshness = FreshnessTracker()
+        # read-path scale-out: the leader side of WAL segment shipping
+        # (followers tail /repl/wal; compaction respects their floor)
+        # and the signed score bundle cache (rebuilt per published
+        # table identity + latest ET proof id; RFC 6979 signing keeps
+        # an unchanged bundle byte-identical, so the ETag is strong)
+        self.repl_source = None
+        if self.store is not None:
+            from .replication import ReplicationSource
+
+            self.repl_source = ReplicationSource(
+                self.store, follower_ttl=config.repl_follower_ttl)
+        self._bundle_lock = threading.Lock()
+        # (table ref, proof_id, body, etag) — see bundle_response
+        self._bundle_cache: tuple | None = None
         if self.store is not None:
             self._restore()
         self.tailer = ChainTailer(
@@ -240,6 +297,18 @@ class TrustService:
         compaction degrades to a bigger log."""
         lim = self.config.wal_compact_segments
         if lim <= 0 or len(self.store.wal.segments()) < lim:
+            return
+        if self.repl_source is not None and self.repl_source.catching_up():
+            # the SHIP FLOOR (the replication twin of the cursor
+            # floor): compaction rewrites every segment, invalidating
+            # all shipped positions — folding now would force a
+            # catch-up follower to restart the tail it is mid-way
+            # through. Defer until active followers reach the tail;
+            # followers AT the tail just re-tail the folded log once
+            # (content dedup skips everything they hold), and
+            # followers past the TTL don't pin the log.
+            trace.event("service.wal_compact_deferred",
+                        reason="follower_catching_up")
             return
         try:
             records = [(blk, about, payload,
@@ -364,63 +433,27 @@ class TrustService:
 
     # --- durability: snapshot ---------------------------------------------
     def _take_snapshot(self, compact: bool = True) -> bool:
-        """One consistent cut → atomic snapshot. Runs on the sink
-        thread (the only graph/buffer mutator) or on the drain path
-        after the sink stopped.
-
-        Encode is O(graph): the raw attestation buffer is NOT
-        serialized — the snapshot records the WAL position it covers
-        and restore rebuilds the buffer from the log. The WAL is
-        therefore no longer pruned on snapshot (it IS the attestation
-        history now); instead, the periodic path folds it latest-wins
-        once it outgrows ``wal_compact_segments`` (``compact=False`` on
-        the drain path: a farewell snapshot must not spend the
+        """Periodic/farewell snapshot (the shared core is
+        :func:`commit_service_snapshot`). ``compact=True`` (the
+        periodic cadence; sink thread = the only WAL writer, so the
+        fold can't race an append) first bounds a long-lived daemon's
+        log growth the way the startup pass bounds it across restarts.
+        The fold floor is the last cursor KNOWN ON DISK — the
+        in-memory cursor can run ahead when a persist fails, and
+        folding a record a post-crash refetch could re-deliver would
+        delete the digest that dedups it. ``compact=False`` on the
+        drain path: a farewell snapshot must not spend the
         drain_timeout budget re-recovering signers — the next start
-        compacts)."""
-        from ..store import encode_service_state
-
+        compacts."""
         if compact:
-            # sink thread = the only WAL writer, so folding here can't
-            # race an append; bounds a long-lived daemon's log growth
-            # the way the startup pass bounds it across restarts. The
-            # floor is the last cursor KNOWN ON DISK — the in-memory
-            # cursor can run ahead when a persist fails, and folding a
-            # record a post-crash refetch could re-deliver would
-            # delete the digest that dedups it
             self._compact_wal(self.tailer.persisted_cursor)
-        n, src, dst, val, revision, edits = self.graph.snapshot()
-        addrs = self.graph.addresses()[:n]
-        invalid = self.graph.invalid
         with self._att_lock:
             n_atts = len(self._attestations)
-        try:
-            # the snapshot claims the WAL up to `pos` as covered — the
-            # restored buffer comes from those bytes, so they must be
-            # durable BEFORE the snapshot commits (under
-            # wal_fsync="never" they may still be page-cache only)
-            self.store.wal.sync()
-        except OSError:
-            self.store.snapshot_failures += 1
-            trace.event("service.snapshot_failed", revision=revision)
-            return False
-        pos = self.store.wal.position()
-        arrays, meta = encode_service_state(
-            addrs, src, dst, val, revision, edits, invalid,
-            self.refresher.table, pos, n_attestations=n_atts)
-        try:
-            with trace.span("service.snapshot", revision=revision,
-                            n=len(addrs), attestations=n_atts):
-                self.store.snapshots.save(revision, arrays, meta)
-        except (EigenError, OSError):
-            # OSError too: CheckpointManager raises raw ENOSPC/EIO, and
-            # the farewell snapshot on the drain path must degrade to
-            # "longer replay next start", never abort the shutdown
-            self.store.snapshot_failures += 1
-            trace.event("service.snapshot_failed", revision=revision)
-            return False
-        self._edits_since_snapshot = 0
-        trace.metric("service.snapshot_revision", revision)
-        return True
+        ok = commit_service_snapshot(self.store, self.graph,
+                                     self.refresher, n_atts)
+        if ok:
+            self._edits_since_snapshot = 0
+        return ok
 
     # --- ingest sink ------------------------------------------------------
     def _sink(self, batch: list, block: int, blocks: list | None = None) \
@@ -465,10 +498,7 @@ class TrustService:
             tids = list(trace.current_trace_ids())
         if tids:
             self.pending_traces.add(self.graph.revision, tids)
-        with self._fresh_lock:
-            self._fresh_pending.append((self.graph.revision, time.time()))
-            if len(self._fresh_pending) > 4096:
-                del self._fresh_pending[0]
+        self.freshness.record(self.graph.revision, time.time())
         self._dirty.set()
         if self.store is not None and changed:
             self._edits_since_snapshot += changed
@@ -515,6 +545,55 @@ class TrustService:
         except ValueError:
             return None
 
+    # --- signed score bundle ----------------------------------------------
+    def bundle_response(self) -> tuple | None:
+        """``(body_bytes, etag)`` for ``GET /bundle``: the canonical
+        signed bundle of the CURRENT published table + the newest done
+        EigenTrust proof id, cached per (table identity, proof id) —
+        steady-state reads are a dict hit, and RFC 6979 signing makes
+        the rebuild after a refresh byte-stable for its content, so
+        the ETag is a strong validator edges/CDNs can revalidate
+        against with ``If-None-Match``. None before the first publish
+        (there is nothing to sign yet)."""
+        import json
+
+        from ..client.eth import address_from_public_key
+        from .bundle import bundle_json, encode_bundle_payload, \
+            sign_bundle
+
+        table = self.refresher.table
+        if table.revision < 0:
+            return None
+        proof_id = self.jobs.latest_done("eigentrust") or ""
+        with self._bundle_lock:
+            cached = self._bundle_cache
+            # identity by reference, with the table HELD in the cache
+            # tuple: a bare id() key could collide after the old table
+            # is collected and a new one reuses its address, silently
+            # serving a stale signed bundle
+            if cached is not None and cached[0] is table \
+                    and cached[1] == proof_id:
+                return cached[2], cached[3]
+        wal_pos = (self.store.wal.committed_position()
+                   if self.store is not None else (0, 0))
+        signer = self.client.signer
+        leader = address_from_public_key(signer.public_key)
+        payload = encode_bundle_payload(
+            leader, table.revision, wal_pos, table.digest,
+            len(table.addresses), table.computed_at, proof_id)
+        signature = sign_bundle(signer, payload)
+        body = json.dumps(bundle_json(payload, signature)).encode()
+        # the payload digest IS the validator: any signed byte changing
+        # (table, proof id, signing position) changes it, and a
+        # restarted leader rebuilding the identical bundle reproduces
+        # it (RFC 6979) — process-stable, unlike hash()
+        import hashlib
+
+        etag = f'"bndl-{hashlib.sha256(payload).hexdigest()[:24]}"'
+        with self._bundle_lock:
+            self._bundle_cache = (table, proof_id, body, etag)
+        return body, etag
+
     # --- introspection ----------------------------------------------------
     def score_freshness_seconds(self) -> float:
         """Now − arrival time of the newest attestation REFLECTED in the
@@ -523,15 +602,8 @@ class TrustService:
         ingest→refresh→served lag. -1.0 until the first attestation is
         both ingested and published (the gauge is always present but
         clearly 'never')."""
-        revision = self.refresher.table.revision
-        now = time.time()
-        with self._fresh_lock:
-            while (self._fresh_pending
-                   and self._fresh_pending[0][0] <= revision):
-                self._fresh_anchor = self._fresh_pending.pop(0)[1]
-            if self._fresh_anchor is None:
-                return -1.0
-            return now - self._fresh_anchor
+        return self.freshness.seconds(self.refresher.table.revision,
+                                      time.time())
 
     def status(self) -> dict:
         """``GET /status``: one JSON page an operator (or a dashboard's
@@ -592,12 +664,17 @@ class TrustService:
             out["store"] = {
                 "wal_segments": wal["segments"],
                 "wal_bytes": wal["bytes"],
+                "wal_position": "%d:%d"
+                                % self.store.wal.committed_position(),
                 "snapshots": self.store.snapshots.count(),
                 "snapshot_age_seconds":
                     self.store.snapshots.age_seconds(),
                 "replayed_records": self.store.replayed_records,
                 "proof_artifacts": self.store.artifacts.count(),
             }
+        if self.repl_source is not None:
+            # the shipping side: per-follower positions + eof, totals
+            out["repl"] = self.repl_source.status()
         return out
 
     def health(self) -> dict:

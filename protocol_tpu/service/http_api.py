@@ -24,6 +24,20 @@ Routes:
   twin of the ``obs`` verb's offline stream summary, covering prover
   stages and converge sweeps once work has flowed through them
 - ``GET /metrics``        Prometheus text (``service/metrics.py``)
+- ``GET /bundle``         the signed score bundle (``bundle.py``) with
+  a strong ETag — verification-friendly, CDN/edge-cacheable; followers
+  serve the leader's bundle verbatim
+- ``GET /repl/wal``       leader only: committed WAL frames past
+  ``?from=seg:off`` (the shipping transport — on-disk framing
+  verbatim); ``X-Ptpu-Wal-Next``/``-Eof``/``-Gap``/``-Backlog`` headers
+  carry the cursor protocol
+- ``GET /repl/snapshot``  leader only: the newest snapshot payload
+  (npz) + its meta in headers — follower bootstrap
+
+``/scores`` and ``/score/<addr>`` carry a strong revision-derived ETag
+and honor ``If-None-Match`` (304, headers only) on leader and follower
+alike. On a follower replica ``service.jobs`` is None: ``POST /proofs``
+answers 503 read-only and ``GET /proofs/*`` 404s to the leader.
 
 Middleware (every request): a per-request trace id (``X-Request-Id``
 response header, ``trace_id`` on the request span in the JSONL stream)
@@ -41,6 +55,7 @@ from __future__ import annotations
 import json
 import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from urllib.parse import parse_qs
 
 from ..utils import trace
 from ..utils.errors import EigenError
@@ -59,7 +74,8 @@ def _parse_address(text: str) -> bytes | None:
 def _route_template(method: str, path: str) -> str:
     """Stable-cardinality route label: the template, never the raw
     path (addresses and job ids would explode the label space)."""
-    if path in ("/healthz", "/status", "/scores", "/metrics", "/stages"):
+    if path in ("/healthz", "/status", "/scores", "/metrics", "/stages",
+                "/bundle", "/repl/wal", "/repl/snapshot"):
         return path
     if path.startswith("/score/"):
         return "/score/{addr}"
@@ -99,11 +115,30 @@ def make_server(service, host: str, port: int) -> ThreadingHTTPServer:
             self.end_headers()
             self.wfile.write(body)
 
+        def _not_modified(self, etag: str) -> None:
+            """304 for a matched conditional GET: headers only, no
+            body — the cheap read-path win ETags buy."""
+            self._status = 304
+            self.send_response(304)
+            self.send_header("ETag", etag)
+            if self._request_id:
+                self.send_header("X-Request-Id", self._request_id)
+            self.end_headers()
+
+        def _etag_match(self, etag: str) -> bool:
+            got = self.headers.get("If-None-Match")
+            if not got:
+                return False
+            return etag in [v.strip() for v in got.split(",")] \
+                or got.strip() == "*"
+
         def _instrumented(self, method: str, handler) -> None:
             """Per-request middleware: assign the request id, bind it as
             the trace context, time the handler, record the
             route/status latency histogram."""
-            path = self.path.split("?", 1)[0].rstrip("/") or "/"
+            parts = self.path.split("?", 1)
+            path = parts[0].rstrip("/") or "/"
+            self._query = parse_qs(parts[1]) if len(parts) > 1 else {}
             route = _route_template(method, path)
             self._request_id = f"req-{trace.new_id()}"
             t0 = time.perf_counter()
@@ -137,6 +172,12 @@ def make_server(service, host: str, port: int) -> ThreadingHTTPServer:
                     content_type="text/plain; version=0.0.4")
             if path == "/scores":
                 table = service.refresher.table
+                # revision-derived strong ETag: a conditional scrape of
+                # an unchanged table costs headers, not an O(peers)
+                # JSON encode — on leader AND follower alike
+                etag = table.etag
+                if self._etag_match(etag):
+                    return self._not_modified(etag)
                 return self._reply(200, {
                     "revision": table.revision,
                     "computed_at": table.computed_at,
@@ -147,13 +188,16 @@ def make_server(service, host: str, port: int) -> ThreadingHTTPServer:
                         {"address": "0x" + a.hex(), "score": float(s)}
                         for a, s in zip(table.addresses, table.scores)
                     ],
-                })
+                }, headers={"ETag": etag})
             if path.startswith("/score/"):
                 addr = _parse_address(path[len("/score/"):])
                 if addr is None:
                     return self._reply(
                         400, {"error": "address must be 20 hex bytes"})
                 table = service.refresher.table
+                etag = table.etag
+                if self._etag_match(etag):
+                    return self._not_modified(etag)
                 score = table.score_of(addr)
                 if score is None:
                     return self._reply(
@@ -163,7 +207,68 @@ def make_server(service, host: str, port: int) -> ThreadingHTTPServer:
                     "address": "0x" + addr.hex(),
                     "score": score,
                     "revision": table.revision,
-                })
+                }, headers={"ETag": etag})
+            if path == "/bundle":
+                got = service.bundle_response()
+                if got is None:
+                    return self._reply(
+                        404, {"error": "no signed score bundle yet "
+                                       "(nothing published)"})
+                body, etag = got
+                if etag and self._etag_match(etag):
+                    return self._not_modified(etag)
+                headers = {"Cache-Control": "public, max-age=1"}
+                if etag:
+                    headers["ETag"] = etag
+                return self._reply(200, body, headers=headers)
+            if path == "/repl/wal":
+                src = getattr(service, "repl_source", None)
+                if src is None:
+                    return self._reply(
+                        404, {"error": "not a replication leader "
+                                       "(no state dir or follower "
+                                       "mode)"})
+                from .replication import format_position, parse_position
+
+                try:
+                    start = parse_position(
+                        (self._query.get("from") or ["0:0"])[0])
+                    max_bytes = int(
+                        (self._query.get("max") or ["1048576"])[0])
+                except (EigenError, ValueError) as e:
+                    return self._reply(400, {"error": str(e)})
+                follower = (self._query.get("follower") or [None])[0]
+                out = src.wal_chunk(start,
+                                    max_bytes=max(4096, max_bytes),
+                                    follower=follower)
+                return self._reply(
+                    200, out["data"],
+                    content_type="application/octet-stream",
+                    headers={
+                        "X-Ptpu-Wal-Next": format_position(out["next"]),
+                        "X-Ptpu-Repl-Eof": "1" if out["eof"] else "0",
+                        "X-Ptpu-Repl-Gap": "1" if out["gap"] else "0",
+                        "X-Ptpu-Repl-Records": str(out["records"]),
+                        "X-Ptpu-Repl-Backlog": str(out["backlog"]),
+                    })
+            if path == "/repl/snapshot":
+                src = getattr(service, "repl_source", None)
+                if src is None:
+                    return self._reply(
+                        404, {"error": "not a replication leader"})
+                got = src.snapshot_blob()
+                if got is None:
+                    return self._reply(
+                        404, {"error": "no snapshot yet — tail the "
+                                       "WAL from 0:0"})
+                step, meta, blob = got
+                return self._reply(
+                    200, blob,
+                    content_type="application/octet-stream",
+                    headers={
+                        "X-Ptpu-Snapshot-Step": str(step),
+                        "X-Ptpu-Snapshot-Meta": json.dumps(meta),
+                    })
             if path.startswith("/proofs/") and path.endswith("/proof.bin"):
                 job_id = path[len("/proofs/"):-len("/proof.bin")]
                 data = service.proof_bytes(job_id)
@@ -173,6 +278,10 @@ def make_server(service, host: str, port: int) -> ThreadingHTTPServer:
                 return self._reply(200, data,
                                    content_type="application/octet-stream")
             if path.startswith("/proofs/"):
+                if service.jobs is None:  # read-only follower
+                    return self._reply(
+                        404, {"error": "no proof queue on a follower "
+                                       "replica — ask the leader"})
                 job = service.jobs.get(path[len("/proofs/"):])
                 if job is None:
                     return self._reply(404, {"error": "unknown job"})
@@ -186,6 +295,12 @@ def make_server(service, host: str, port: int) -> ThreadingHTTPServer:
         def _handle_post(self, path: str):
             if path != "/proofs":
                 return self._reply(404, {"error": f"no route {path}"})
+            if service.jobs is None:
+                # follower replica: the read path scaled out, the
+                # write/prove path did not — clients go to the leader
+                return self._reply(
+                    503, {"error": "read-only follower replica: "
+                                   "submit proofs to the leader"})
             try:
                 length = int(self.headers.get("Content-Length", 0))
                 req = json.loads(self.rfile.read(length) or b"{}")

@@ -69,6 +69,9 @@ MONOTONIC_METRICS = frozenset({
     "store.snapshot_failures",
     "store.replayed_records",
     "store.proof_persist_failures",
+    "repl.records_applied",
+    "repl.polls",
+    "repl.gaps",
 })
 
 
@@ -112,6 +115,9 @@ HISTOGRAM_FAMILIES = {
     # start) — the lending latency of the sharded proving fabric;
     # stage is the work-unit family (commit | quotient | open_fold)
     "prove_shard_wait_seconds": ("stage",),
+    # one follower replication poll: shipped-chunk fetch + local WAL
+    # append + graph apply (the follower's ingest unit)
+    "repl_poll_seconds": (),
 }
 
 # typed counters/gauges of the device-observability layer, declared up
@@ -121,12 +127,14 @@ HISTOGRAM_FAMILIES = {
 DECLARED_COUNTERS = ("xla_compiles", "xla_steady_recompiles",
                      "operator_full_builds", "refresh_sweep_scope",
                      "proof_pool_shed", "proof_pool_affinity",
-                     "proof_pool_stolen", "prove_shards")
+                     "proof_pool_stolen", "prove_shards",
+                     "repl_chunks", "repl_records_shipped")
 DECLARED_GAUGES = ("converge_iterations", "converge_residual",
                    "proof_queue_depth", "dirty_rows",
                    "refresh_frontier_peak", "refresh_budget_spent",
                    "proof_pool_depth", "proof_pool_worker_depth",
-                   "proof_pool_queued_bytes", "proof_pool_workers")
+                   "proof_pool_queued_bytes", "proof_pool_workers",
+                   "repl_lag_records", "repl_lag_seconds")
 
 
 def declare_instruments() -> None:

@@ -445,6 +445,9 @@ class ProofWorkerPool:
         self.completed = 0
         self.failed = 0
         self.shed: dict = {}  # (kind, tier) -> count (status page copy)
+        self._last_done: dict = {}  # kind -> newest done job id (the
+        # signed score bundle attaches the latest ET proof id; tracked
+        # on completion + rehydration so a restart keeps serving it)
 
     # --- introspection ----------------------------------------------------
     def depth(self) -> int:
@@ -674,10 +677,20 @@ class ProofWorkerPool:
                     job.error = "lost: daemon restarted mid-job"
                     job.finished_at = time.time()
                     self.artifacts.persist(job)
+                if job.status == "done":
+                    # ids ascend, so the last done per kind survives
+                    self._last_done[job.kind] = jid
                 self._jobs[jid] = job
                 loaded += 1
             self._ids = itertools.count(top + 1)
         return loaded
+
+    def latest_done(self, kind: str) -> str | None:
+        """Newest successfully-completed job id of ``kind`` (this
+        process + rehydrated history) — what the signed score bundle
+        cites as the latest EigenTrust proof."""
+        with self._lock:
+            return self._last_done.get(kind)
 
     # --- workers ----------------------------------------------------------
     def start(self) -> None:
@@ -834,6 +847,7 @@ class ProofWorkerPool:
                 w.jobs_run += 1
                 if job.status == "done":
                     self.completed += 1
+                    self._last_done[job.kind] = job.job_id
                 else:
                     self.failed += 1
                 # EMA feeds the Retry-After estimate the shed path hands

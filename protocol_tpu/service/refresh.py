@@ -106,6 +106,32 @@ class ScoreTable:
         i = self._index.get(addr)
         return None if i is None else float(self.scores[i])
 
+    @property
+    def digest(self) -> bytes:
+        """sha256 over the served content (address list + float64
+        score bytes) — computed once per published table, shared by
+        the ``/scores`` ETag and the signed score bundle, so a cache
+        hit and a bundle signature commit to the same bytes."""
+        d = getattr(self, "_digest", None)
+        if d is None:
+            h = hashlib.sha256()
+            h.update(len(self.addresses).to_bytes(8, "little"))
+            for a in self.addresses:
+                h.update(a)
+            h.update(np.ascontiguousarray(
+                np.asarray(self.scores, dtype=np.float64)).tobytes())
+            d = h.digest()
+            object.__setattr__(self, "_digest", d)
+        return d
+
+    @property
+    def etag(self) -> str:
+        """Strong ETag of the published table: graph-revision-prefixed
+        (the cheap invalidation signal) + content digest (exactness —
+        a restored table after restart keeps its ETag, a republish at
+        a new revision changes it)."""
+        return f'"sc-{self.revision}-{self.digest[:12].hex()}"'
+
 
 _EMPTY = ScoreTable(addresses=(), scores=np.zeros(0), revision=-1,
                     iterations=0, delta=0.0, cold=True, computed_at=0.0)

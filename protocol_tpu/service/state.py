@@ -83,6 +83,39 @@ def recover_signers(attestations, batched: bool | None = None):
     return out
 
 
+class FreshnessTracker:
+    """End-to-end ingest→served-scores lag, shared by the leader and
+    the follower daemons (the split of PR 13): the sink records (graph
+    revision after apply, wall-clock arrival of the batch's newest
+    record); :meth:`seconds` pops everything the published table's
+    revision covers and reports now − the newest covered arrival —
+    -1.0 until the first record is both ingested and published (the
+    gauge is always present but clearly 'never')."""
+
+    BOUND = 4096  # pending entries kept (refresh outruns ingest in
+    # steady state; the bound only matters during a cold catch-up)
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._pending: list = []
+        self._anchor: float | None = None
+
+    def record(self, revision: int, arrived_at: float) -> None:
+        with self._lock:
+            self._pending.append((revision, arrived_at))
+            if len(self._pending) > self.BOUND:
+                del self._pending[0]
+
+    def seconds(self, table_revision: int, now: float) -> float:
+        with self._lock:
+            while (self._pending
+                   and self._pending[0][0] <= table_revision):
+                self._anchor = self._pending.pop(0)[1]
+            if self._anchor is None:
+                return -1.0
+            return now - self._anchor
+
+
 class OpinionGraph:
     """Mutable trust graph; snapshots are cheap numpy edge arrays."""
 

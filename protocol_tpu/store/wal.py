@@ -305,6 +305,143 @@ class AttestationWAL:
                 # corruption, not our own in-flight heal window
                 self.torn_skipped += 1
 
+    # --- replication shipping ---------------------------------------------
+    def earliest_position(self) -> tuple:
+        """Position of the first record still in the log — where a
+        replication consumer restarts after its cursor was invalidated
+        by compaction (replay from here + content dedup folds to the
+        identical state; see :meth:`compact`)."""
+        segs = self.segments()
+        first = segs[0] if segs else max(self._segment, 1)
+        return (first, len(SEGMENT_MAGIC))
+
+    def committed_position(self) -> tuple:
+        """Reader-thread-safe :meth:`position`: the writer updates
+        ``_segment`` then ``_pos`` non-atomically across a rotation, so
+        a concurrent reader re-reads until two CONSECUTIVE reads agree
+        — a same-order re-read of one field can't catch the (new seg,
+        stale pos) tear the writer's store order actually produces.
+        If the writer parks mid-transition past every retry, the torn
+        pair only ever mis-clamps toward bytes that are fully written
+        (a complete frame the writer is about to commit, which the
+        heal path would preserve across a crash) — the CRC framing
+        keeps any read safe regardless."""
+        prev = (self._segment, self._pos)
+        for _ in range(8):
+            cur = (self._segment, self._pos)
+            if cur == prev:
+                return cur
+            prev = cur
+        return prev
+
+    def read_chunk(self, start: tuple, max_bytes: int = 1 << 20) -> dict:
+        """Committed raw frame bytes past ``start`` from ONE segment —
+        the leader side of WAL segment shipping (``GET /repl/wal``).
+        Returns ``{"data", "next", "eof", "gap"}``:
+
+        - ``data``: whole frames, byte-identical to the on-disk
+          framing (``u32 len | u32 crc | body``) with the segment magic
+          stripped — the consumer parses with :func:`iter_frames`;
+          at least one frame is returned even when it alone exceeds
+          ``max_bytes``;
+        - ``next``: the position after the returned bytes (advanced to
+          the next segment's start when this one is consumed);
+        - ``eof``: ``next`` has reached the committed tail — nothing
+          more to ship until the next append;
+        - ``gap``: ``start`` points into a segment that no longer
+          exists (compacted away, or a fresh consumer at ``(0, 0)``) —
+          ``data`` is empty and ``next`` is :meth:`earliest_position`;
+          the consumer re-tails from there, deduping by content.
+
+        Lock-free against the single appender: the committed tail is
+        snapshotted FIRST, so the byte range read can never include an
+        in-flight partial frame (and the CRC scan would stop at one
+        regardless). Never blocks the sink thread."""
+        tail = self.committed_position()
+        segs = self.segments()
+        sseg, soff = int(start[0]), int(start[1])
+        empty = {"data": b"", "next": (sseg, soff), "eof": True,
+                 "gap": False}
+        if not segs:
+            return empty
+        if sseg not in segs:
+            return {"data": b"", "next": self.earliest_position(),
+                    "eof": False, "gap": True}
+        try:
+            with open(self._path(sseg), "rb") as f:
+                magic = f.read(len(SEGMENT_MAGIC))
+                later = [s for s in segs if s > sseg]
+                if magic != SEGMENT_MAGIC:
+                    # torn header: replay skips this segment; so does
+                    # shipping
+                    if later:
+                        return {"data": b"",
+                                "next": (later[0], len(SEGMENT_MAGIC)),
+                                "eof": False, "gap": False}
+                    return empty
+                size = os.fstat(f.fileno()).st_size
+                end = size
+                if sseg == tail[0]:
+                    end = min(end, tail[1])
+                off = max(soff, len(SEGMENT_MAGIC))
+                if off > end:
+                    # a position PAST the committed bytes of its
+                    # segment: the writer healed/truncated below a
+                    # previously-shipped offset (torn tail discarded
+                    # after a crash under fsync="never") — the
+                    # position no longer names a frame boundary, and
+                    # waiting at it would silently skip every later
+                    # record. Re-tail from the earliest position; the
+                    # consumer's content dedup folds the overlap.
+                    return {"data": b"",
+                            "next": self.earliest_position(),
+                            "eof": False, "gap": True}
+                # read ONLY the shippable range (+ one max-record
+                # slack so a frame straddling the cap still parses
+                # whole) — the steady-state eof poll reads 8 bytes of
+                # magic and an fstat, never the whole segment
+                want = min(end - off,
+                           max_bytes + _FRAME.size + MAX_RECORD_BYTES)
+                f.seek(off)
+                buf = f.read(want)
+        except OSError:  # raced a compaction removal
+            return {"data": b"", "next": self.earliest_position(),
+                    "eof": False, "gap": True}
+        last = 0
+        for fend, _ in iter_frames(buf):
+            if fend > max_bytes and last > 0:
+                break
+            last = fend
+            if last >= max_bytes:
+                break
+        data = bytes(buf[:last])
+        nxt = (sseg, off + last)
+        eof = sseg == tail[0] and off + last >= end
+        if not eof and off + last >= end and later:
+            # this segment is consumed; the next fetch starts clean on
+            # the following one
+            nxt = (later[0], len(SEGMENT_MAGIC))
+        return {"data": data, "next": nxt, "eof": eof, "gap": False}
+
+    def count_records(self, start: tuple) -> int:
+        """Records between ``start`` and the committed tail — the
+        shipping backlog a catch-up consumer is behind by. O(remaining
+        log); the steady state (``eof`` polls) never calls it."""
+        total = 0
+        pos = start
+        while True:
+            out = self.read_chunk(pos, max_bytes=4 << 20)
+            total += sum(1 for _ in iter_frames(out["data"]))
+            if out["eof"] or (not out["data"] and not out["gap"]):
+                return total
+            if out["gap"]:
+                pos = out["next"]
+                if pos == start:
+                    return total
+                start = pos
+                continue
+            pos = out["next"]
+
     # --- maintenance ------------------------------------------------------
     def prune_below(self, segment: int) -> int:
         """Remove segments strictly below ``segment``; returns how many

@@ -27,6 +27,23 @@ def test_serve_smoke_tool():
 
 
 @pytest.mark.slow
+def test_serve_smoke_replica():
+    """The read-path scale-out phase (real CLI leader + serve --follow
+    follower, byte-equal scores at the same WAL position, bundle 304)
+    — slow-marked like the restart phase; ``tools/check.sh`` runs it
+    on every one-command check via --replica."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "serve_smoke.py"),
+         "--replica"],
+        capture_output=True, text=True, timeout=560, env=env, cwd=REPO)
+    assert proc.returncode == 0, (
+        f"serve_smoke --replica failed\nstdout:\n{proc.stdout}\n"
+        f"stderr:\n{proc.stderr}")
+    assert "REPLICA_OK" in proc.stdout
+
+
+@pytest.mark.slow
 def test_serve_smoke_restart():
     """The kill-restart durability phase (two real CLI daemons, SIGKILL
     + replay + oracle re-check) — slow-marked: it boots two full jax

@@ -427,3 +427,67 @@ def test_device_rung_floors_tol_at_f32_and_charges_slack():
     assert res_h is not None
     assert _rel_l1(res.scores, res_h.scores) \
         <= (res.budget_spent + 2 * floor) / ALPHA
+
+
+def test_expand_out_weight_matches_full_recompute():
+    """Incremental ext-weight maintenance (the ROADMAP 3 residual):
+    expanding the observed set updates external out-weights by a fresh
+    walk of ONLY the appended rows plus a subtraction on the
+    boundary-crossing ones — and must agree with the from-scratch
+    computation over the expanded set, tail edges included."""
+    from protocol_tpu.incremental.device import _expand_ext_slots
+    from protocol_tpu.incremental.partial import (
+        expand_out_weight,
+        external_out_weight,
+        frontier_inedges,
+    )
+
+    rng = np.random.default_rng(5)
+    eng, edges = _anchored()
+    _published(eng)
+    # structural inserts so the tail side of the walk is exercised
+    _revise(eng, edges, rng, 8, inserts=6)
+    n = eng.n_now
+    S_old = np.unique(rng.choice(n, 40, replace=False)).astype(np.int64)
+    ext_old = external_out_weight(eng, S_old)
+    new = np.setdiff1d(
+        np.unique(rng.choice(n, 25, replace=False)).astype(np.int64),
+        S_old)
+    assert len(new)
+    S_new, ext_inc = expand_out_weight(eng, S_old, ext_old, new)
+    ext_full = external_out_weight(eng, S_new)
+    assert np.array_equal(S_new, np.union1d(S_old, new))
+    assert np.allclose(ext_inc, ext_full, atol=1e-12), \
+        np.max(np.abs(ext_inc - ext_full))
+    # the slot-ordered device twin (appended rows at the tail), fed
+    # the same gather the operand append produces
+    in_edges = frontier_inedges(eng, new)
+    ext_slots = _expand_ext_slots(eng, S_old, S_old, ext_old, S_new,
+                                  new, in_edges)
+    ref = np.concatenate(
+        [ext_full[np.searchsorted(S_new, S_old)],
+         ext_full[np.searchsorted(S_new, new)]])
+    assert np.allclose(ext_slots, ref, atol=1e-12)
+
+
+def test_ext_weight_recompute_scope_is_incremental():
+    """Regression for the expansion recompute scope: across a partial
+    refresh whose frontier expands sweep after sweep, the rows whose
+    out-edges were walked for ext-weight must equal the frontier PEAK
+    — each row pays exactly one walk when it enters the observed set,
+    never a whole-frontier recompute per expansion. Host and device
+    rungs both."""
+    for refresh_fn in (partial_refresh, device_partial_refresh):
+        rng = np.random.default_rng(11)
+        eng, edges = _anchored()
+        s_pub = _published(eng)
+        frontier = _revise(eng, edges, rng, 6, inserts=3)
+        eng.ext_weight_rows_computed = 0
+        res = refresh_fn(eng, s_pub, frontier, TOL, MAX_IT, eng.n_now)
+        assert res is not None
+        assert res.frontier_peak > len(frontier), \
+            "test topology never expanded — the scope assertion " \
+            "would be vacuous"
+        assert eng.ext_weight_rows_computed == res.frontier_peak, (
+            refresh_fn.__name__, eng.ext_weight_rows_computed,
+            res.frontier_peak)

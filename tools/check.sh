@@ -31,10 +31,10 @@ dots=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -cd . | wc -c)
 echo "tier1: rc=${t1_rc} DOTS_PASSED=${dots}"
 
 rm -f /tmp/_smoke.log
-env JAX_PLATFORMS=cpu python tools/serve_smoke.py --restart --churn 2>&1 \
-    | tee /tmp/_smoke.log
+env JAX_PLATFORMS=cpu python tools/serve_smoke.py --restart --churn \
+    --replica 2>&1 | tee /tmp/_smoke.log
 smoke_rc=${PIPESTATUS[0]}
-echo "serve_smoke --restart --churn: rc=${smoke_rc}"
+echo "serve_smoke --restart --churn --replica: rc=${smoke_rc}"
 
 # scrape-lint + trace-join + device-observability + delta + pool
 # phases must have actually run, not been skipped by an early exit
@@ -56,6 +56,11 @@ echo "serve_smoke --restart --churn: rc=${smoke_rc}"
 # SHARDED_PROVE_OK asserts one live-daemon prove (shard_proves=1)
 # fanned its work units across BOTH pool workers with proof bytes
 # identical to a direct single-worker prove.
+# REPLICA_OK asserts the read-path scale-out: a real CLI leader + one
+# serve --follow follower under churn — follower scores converge to
+# the leader oracle over the shipped WAL, lag gauge back to 0, score
+# vectors byte-equal at the same WAL position, signed-bundle ETag 304
+# revalidation on the follower, clean drains for both.
 lint_rc=1
 grep -q SCRAPE_LINT_OK /tmp/_smoke.log \
     && grep -q TRACE_JOIN_OK /tmp/_smoke.log \
@@ -65,8 +70,9 @@ grep -q SCRAPE_LINT_OK /tmp/_smoke.log \
     && grep -q PROOF_POOL_OK /tmp/_smoke.log \
     && grep -q COMMIT_PIPE_OK /tmp/_smoke.log \
     && grep -q SHARDED_PROVE_OK /tmp/_smoke.log \
+    && grep -q REPLICA_OK /tmp/_smoke.log \
     && grep -q "DELTA_OK" /tmp/_smoke.log && lint_rc=0
-echo "scrape-lint + trace-join + device-obs + delta + sublinear + pool + commit + sharded: rc=${lint_rc}"
+echo "scrape-lint + trace-join + device-obs + delta + sublinear + pool + commit + sharded + replica: rc=${lint_rc}"
 
 # opt-in perf-regression gate (PTPU_PERF_GATE=1): per-stage timings of
 # the instrumented prove/refresh workloads vs tools/perf_baseline.json.

@@ -27,6 +27,14 @@ The one-command liveness check for ``protocol_tpu.service`` (CI hook:
 delta apply ≥10× faster than a warm full build, scores matching a
 from-scratch rebuild within converge tolerance.
 
+``--replica`` appends the read-path scale-out phase (``REPLICA_OK``):
+a real CLI leader + a ``serve --follow`` follower under live churn —
+follower scores converge to the leader oracle over the shipped WAL,
+the replication-lag gauge returns to 0 at quiescence, the score
+vectors are asserted BYTE-equal at the same WAL position (all-cold
+deterministic refreshes), and the signed bundle 304-revalidates on the
+follower.
+
 ``--restart`` adds the kill-restart durability phase, driving the REAL
 CLI daemon as a subprocess:
 
@@ -909,8 +917,11 @@ def trace_join_phase(trace_path, chain, step) -> None:
          f"joinable end-to-end, e.g. {joined[0]})")
 
 
-def _spawn_daemon(assets, extra_env, step, tag):
-    """Start the real CLI serve verb; returns (proc, url, lines)."""
+def _spawn_daemon(assets, extra_env, step, tag, extra_args=(),
+                  state_dir="state"):
+    """Start the real CLI serve verb (leader, or — with
+    ``extra_args=("--follow", url)`` — a follower replica); returns
+    (proc, url, lines). ``bench.py --reads`` imports this too."""
     import re
     import subprocess
     import threading
@@ -919,8 +930,8 @@ def _spawn_daemon(assets, extra_env, step, tag):
                PTPU_SERVE_REFRESH_INTERVAL="0.1", **extra_env)
     proc = subprocess.Popen(
         [sys.executable, "-m", "protocol_tpu.cli", "--assets", assets,
-         "serve", "--port", "0", "--state-dir", "state",
-         "--poll-interval", "0.1"],
+         "serve", "--port", "0", "--state-dir", state_dir,
+         "--poll-interval", "0.1", *extra_args],
         stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
         cwd=REPO, env=env)
     lines = []
@@ -1042,11 +1053,159 @@ def restart_phase(node_url, chain, step) -> None:
         step("daemon#2 drained cleanly on SIGTERM")
 
 
+def replica_phase(node_url, chain, step) -> None:
+    """Read-path scale-out evidence over REAL CLI daemons
+    (``REPLICA_OK``): a leader + one ``serve --follow`` follower under
+    live churn — the follower's served scores must converge to the
+    leader oracle through the shipped WAL, its replication-lag gauge
+    must return to ~0 at quiescence, the signed bundle must round-trip
+    an ETag 304 revalidation on the follower, and at the same WAL
+    position the follower's score vector must BYTE-equal the leader's
+    (both daemons run all-cold refreshes — the deterministic trajectory
+    that makes byte equality assertable). Clean SIGTERM drains both."""
+    import json
+    import signal as _signal
+    import tempfile
+    import urllib.error
+    import urllib.request
+
+    from protocol_tpu.client import Client, ClientConfig
+    from protocol_tpu.client.eth import (
+        address_from_public_key,
+        ecdsa_keypairs_from_mnemonic,
+    )
+    from protocol_tpu.client.storage import JSONFileStorage
+
+    config = ClientConfig(as_address="0x" + chain.contract_address.hex(),
+                          node_url=node_url, domain="0x" + "00" * 20)
+    client = Client(config, MNEMONIC)
+    kps = ecdsa_keypairs_from_mnemonic(MNEMONIC, 3)
+    addrs = [address_from_public_key(kp.public_key) for kp in kps]
+
+    def oracle():
+        client.keypairs[0] = kps[0]
+        return {s.address: float(s.ratio)
+                for s in client.calculate_scores(
+                    client.get_attestations())}
+
+    def wait_scores(url, ref, tag, deadline_s=120):
+        deadline = time.monotonic() + deadline_s
+        while time.monotonic() < deadline:
+            try:
+                ok = all(
+                    abs(_get_json(url, f"/score/0x{a.hex()}")["score"]
+                        - r) <= 1e-3 * max(abs(r), 1.0)
+                    for a, r in ref.items())
+                if ok:
+                    return
+            except Exception:
+                pass
+            time.sleep(0.2)
+        raise AssertionError(f"{tag}: scores never matched the oracle")
+
+    # all-cold refreshes on BOTH daemons: cold converge from uniform
+    # is bit-deterministic on one box, which is what lets the phase
+    # assert byte equality instead of tolerance
+    det_env = {"PTPU_SERVE_COLD_EDIT_FRACTION": "0.0",
+               "PTPU_SERVE_SNAPSHOT_EVERY": "4"}
+    with tempfile.TemporaryDirectory(prefix="ptpu-smoke-repl-") as assets:
+        JSONFileStorage(os.path.join(assets, "config.json")).save(
+            config.to_dict())
+        leader, lurl, _ = _spawn_daemon(assets, det_env, step, "leader")
+        for i, about, value in ((0, addrs[1], 7), (1, addrs[0], 9),
+                                (0, addrs[2], 3)):
+            client.keypairs[0] = kps[i]
+            client.attest(about, value)
+        wait_scores(lurl, oracle(), "leader")
+        step("leader serves oracle scores")
+
+        follower, furl, flines = _spawn_daemon(
+            assets, det_env, step, "follower", state_dir="fstate",
+            extra_args=("--follow", lurl))
+
+        # live churn while the follower tails
+        for r in range(3):
+            for i, about, value in ((1, addrs[2], 4 + r),
+                                    (2, addrs[0], 6 + r)):
+                client.keypairs[0] = kps[i]
+                client.attest(about, value)
+            ref = oracle()
+            wait_scores(lurl, ref, f"leader round {r}")
+            wait_scores(furl, ref, f"follower round {r}")
+        step("follower tracked the oracle through 3 churn rounds")
+
+        # quiescence: same WAL position -> byte-equal score vectors
+        deadline = time.monotonic() + 60
+        while True:
+            ls = _get_json(lurl, "/status")
+            fs = _get_json(furl, "/status")
+            if (fs["repl"]["cursor"] == ls["store"]["wal_position"]
+                    and fs["last_refresh"]["revision"]
+                    == fs["graph"]["revision"]
+                    and ls["last_refresh"]["revision"]
+                    == ls["graph"]["revision"]):
+                break
+            assert time.monotonic() < deadline, \
+                f"follower never reached the leader position: " \
+                f"{fs['repl']} vs {ls['store']}"
+            time.sleep(0.2)
+        lscores = _get_json(lurl, "/scores")["scores"]
+        fscores = _get_json(furl, "/scores")["scores"]
+        assert lscores == fscores and lscores, \
+            f"scores not byte-equal at {ls['store']['wal_position']}: " \
+            f"{lscores} vs {fscores}"
+        lag = fs["repl"]["lag_records"]
+        assert lag == 0, f"replication lag stuck at {lag} records"
+        fmetrics = _get_json(furl, "/metrics")
+        assert _metric_value(fmetrics, "ptpu_repl_lag_records") == 0.0
+        lag_s = _metric_value(fmetrics, "ptpu_repl_lag_seconds")
+        assert lag_s is not None and 0.0 <= lag_s < 30.0, lag_s
+        repl = ls["repl"]
+        assert repl["followers"] and repl["followers"][0]["eof"], repl
+
+        # bundle: served on the follower, ETag 304 revalidation
+        deadline = time.monotonic() + 30
+        bundle = None
+        while bundle is None and time.monotonic() < deadline:
+            try:
+                req = urllib.request.Request(furl + "/bundle")
+                with urllib.request.urlopen(req, timeout=10) as resp:
+                    bundle = (resp.read(), resp.headers["ETag"])
+            except urllib.error.HTTPError:
+                time.sleep(0.3)  # leader bundle not fetched yet
+        assert bundle is not None, "follower never cached the bundle"
+        try:
+            req = urllib.request.Request(
+                furl + "/bundle", headers={"If-None-Match": bundle[1]})
+            urllib.request.urlopen(req, timeout=10)
+            raise AssertionError("bundle revalidation returned a body")
+        except urllib.error.HTTPError as e:
+            assert e.code == 304, e.code
+        from protocol_tpu.service.bundle import verify_bundle
+
+        bd = json.loads(bundle[0])
+        verify_bundle(bytes.fromhex(bd["payload"]),
+                      bytes.fromhex(bd["signature"]))
+        step(f"bundle verified + 304 revalidation on the follower "
+             f"(etag {bundle[1][:18]}…)")
+
+        follower.send_signal(_signal.SIGTERM)
+        rc = follower.wait(timeout=60)
+        assert rc == 0, \
+            f"follower drain rc={rc}:\n" + "\n".join(flines)
+        leader.send_signal(_signal.SIGTERM)
+        rc = leader.wait(timeout=60)
+        assert rc == 0, f"leader drain rc={rc}"
+        step(f"REPLICA_OK (byte-equal at {ls['store']['wal_position']}, "
+             f"lag 0, bundle 304, clean drains)")
+
+
 def main(argv=None) -> int:
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
     argv = sys.argv[1:] if argv is None else argv
     restart = "--restart" in argv
     churn = "--churn" in argv
+    replica = "--replica" in argv
 
     from protocol_tpu.client.chain import RpcChain
     from protocol_tpu.client.eth import ecdsa_keypairs_from_mnemonic
@@ -1071,6 +1230,11 @@ def main(argv=None) -> int:
         step(f"restart phase: AttestationStation at "
              f"0x{chain2.contract_address.hex()}")
         restart_phase(node_url, chain2, step)
+    if replica:
+        chain3 = RpcChain.deploy_signed(node_url, deployer)
+        step(f"replica phase: AttestationStation at "
+             f"0x{chain3.contract_address.hex()}")
+        replica_phase(node_url, chain3, step)
     node.stop()
     if churn:
         # offline ≥100k-edge delta-vs-rebuild evidence (no devnet)
