@@ -174,7 +174,34 @@ def main():
                              "seconds (GIL-released wait modeling the "
                              "device-resident phase of a real prove; "
                              "see bench_proofs docstring). 0 disables")
+    parser.add_argument("--scenario", action="store_true",
+                        help="BENCH_r12: adversarial robustness matrix "
+                             "— every {topology x semiring x scale} "
+                             "cell through protocol_tpu.scenarios "
+                             "(attacker mass capture, honest rank "
+                             "displacement, iterations vs the damped "
+                             "bound) — plus the topic-batch "
+                             "amortization headline: K topic vectors "
+                             "vmapped through ONE routed operator vs K "
+                             "sequential converges each paying its own "
+                             "plan build")
+    parser.add_argument("--scenario-peers", default="10000,100000,1000000",
+                        help="comma-separated scale sweep for the "
+                             "robustness matrix")
+    parser.add_argument("--scenario-topologies",
+                        default="sybil-ring,collusion,slander",
+                        help="comma-separated attack families")
+    parser.add_argument("--scenario-seed", type=int, default=7)
+    parser.add_argument("--scenario-topics", type=int, default=8,
+                        help="K for the topic-batch amortization cell")
+    parser.add_argument("--scenario-topic-peers", type=int, default=20_000,
+                        help="graph size for the topic-batch cell "
+                             "(routed engine: the plan build being "
+                             "amortized must be non-trivial)")
     args = parser.parse_args()
+
+    if args.scenario:
+        return bench_scenario(args)
 
     if args.msm:
         return bench_msm(args)
@@ -365,6 +392,150 @@ def main():
     if not meta["converged"]:
         print("BENCH FAILED: did not converge to tolerance", file=sys.stderr)
         return 1
+    return 0
+
+
+def bench_scenario(args) -> int:
+    """BENCH_r12: the adversarial robustness matrix + topic batching.
+
+    Part 1 — robustness matrix: every {topology × semiring × scale}
+    cell runs through ``protocol_tpu.scenarios.run_scenario`` (same
+    code path as the ``scenario`` CLI verb), recording attacker
+    score-mass capture, honest rank displacement vs the attack-free
+    baseline, and measured iterations vs the damped-bound prediction.
+    Cells stream to stderr as JSON; the matrix lands in the meta.
+
+    Part 2 — the headline: topic-batch amortization. K topic score
+    vectors vmapped through ONE routed operator (one routing-plan
+    build, one compiled sweep) against K sequential converges each
+    paying its own plan build — the TrustFlow-style amortization the
+    semiring seam's ``converge_topics`` exists for. Results are
+    asserted equal before timing counts.
+    """
+    from protocol_tpu.utils.platform import honor_jax_platforms_env
+
+    honor_jax_platforms_env()
+
+    import numpy as np
+
+    from protocol_tpu.backend import JaxRoutedBackend
+    from protocol_tpu.graph import barabasi_albert_edges
+    from protocol_tpu.scenarios import run_scenario
+
+    topologies = [t for t in args.scenario_topologies.split(",") if t]
+    scales = [int(s) for s in args.scenario_peers.split(",") if s]
+    matrix = []
+    for topo in topologies:
+        for semiring in ("plusmul", "maxplus"):
+            for peers in scales:
+                r = run_scenario(topo, peers=peers, semiring=semiring,
+                                 seed=args.scenario_seed, alpha=0.1,
+                                 timing=True)
+                rob = r["robustness"]
+                cell = {
+                    "topology": topo,
+                    "semiring": semiring,
+                    "peers": peers,
+                    "edges": r["edges"],
+                    "engine": r["engine"],
+                    "attacker_mass_capture":
+                        round(rob["attacker_mass_capture"], 6),
+                    "baseline_attacker_mass":
+                        round(rob["baseline_attacker_mass"], 6),
+                    "rank_disp_mean":
+                        round(rob["honest_rank_displacement"]["mean"], 3),
+                    "attackers_in_top100":
+                        rob["attackers_in_top"]["count"],
+                    "iterations": rob["iterations"],
+                    "iteration_bound": rob["iteration_bound"],
+                    "within_bound": rob["within_bound"],
+                    "converge_s": round(r["timing_s"]["attack_converge"], 3),
+                }
+                print(json.dumps(cell), file=sys.stderr, flush=True)
+                matrix.append(cell)
+
+    # --- part 2: topic-batch amortization --------------------------------
+    n, m, K = args.scenario_topic_peers, 4, args.scenario_topics
+    src, dst, val = barabasi_albert_edges(n, m, seed=args.scenario_seed)
+    valid = np.ones(n, dtype=bool)
+    rng = np.random.default_rng(args.scenario_seed)
+    s0k = rng.uniform(0.5, 1.5, size=(K, n)) * 1000.0
+    tol, max_iters = 1e-6, 200
+
+    t0 = time.perf_counter()
+    seq_scores = []
+    for k in range(K):
+        # a FRESH backend per topic: each sequential converge pays its
+        # own routing-plan build, which is exactly the cost the batched
+        # path amortizes
+        sk, _, _ = JaxRoutedBackend().converge_edges(
+            n, src, dst, val, valid, 1000.0, max_iters, tol=tol,
+            alpha=0.1, s0=s0k[k])
+        seq_scores.append(np.asarray(sk))
+    seq_s = time.perf_counter() - t0
+
+    t0 = time.perf_counter()
+    batch_scores, batch_iters, _ = JaxRoutedBackend().converge_topics(
+        n, src, dst, val, valid, s0k, max_iters, tol=tol, alpha=0.1)
+    batch_s = time.perf_counter() - t0
+
+    err = float(np.max(np.abs(np.stack(seq_scores) - batch_scores)))
+    rel = err / 1000.0
+    if rel > 1e-5:
+        print(f"BENCH FAILED: topic-batch scores diverge from the "
+              f"sequential oracle (rel {rel:.2e})", file=sys.stderr)
+        return 1
+    speedup = seq_s / batch_s if batch_s > 0 else float("inf")
+
+    # the honesty split: what the batch actually amortizes is the
+    # routing-plan build (K host builds -> 1), so the total-wall
+    # speedup is capped at 1 + build/converge on THIS box. On CPU the
+    # sweep dominates and the cap sits near 1.15x; at 10M peers the
+    # plan build is minutes (see `sparse-scores --operator-cache`)
+    # while a sweep is not, and the same code path approaches Kx.
+    from protocol_tpu.ops.routed import build_routed_operator
+
+    t0 = time.perf_counter()
+    build_routed_operator(n, src, dst, val, valid)
+    build_s = time.perf_counter() - t0
+    per_converge = max(seq_s / K - build_s, 1e-9)
+    ceiling = 1.0 + build_s / per_converge
+
+    meta = {
+        "matrix": matrix,
+        "seed": args.scenario_seed,
+        "topic_batch": {
+            "peers": n, "topics": K,
+            "sequential_s": round(seq_s, 3),
+            "batched_s": round(batch_s, 3),
+            "speedup": round(speedup, 2),
+            "plan_builds": {"sequential": K, "batched": 1},
+            "plan_build_s": round(build_s, 3),
+            "amortization_ceiling_x": round(ceiling, 2),
+            "max_rel_err": rel,
+            "iters": [int(i) for i in np.asarray(batch_iters)],
+        },
+        "note": "matrix cells are deterministic per seed (the scenario "
+                "runner's reproducibility contract); the topic-batch "
+                "headline is K topic vectors through ONE routed "
+                "operator build vs K sequential converges each paying "
+                "its own build — the batch eliminates K-1 plan builds "
+                "outright, so the wall speedup tracks the build/sweep "
+                "ratio (amortization_ceiling_x on this box; build is "
+                "minutes at 10M peers where the same path nears Kx)",
+    }
+    print(json.dumps(meta), file=sys.stderr)
+    print(json.dumps({
+        "metric": f"topic-batch amortization: {K} topic converges, "
+                  f"routing-plan builds {K}->1, at {_fmt_peers(n)} "
+                  f"peers (wall ceiling {ceiling:.2f}x on this box; "
+                  f"robustness matrix: {len(matrix)} cells, all within "
+                  f"the damped bound: "
+                  f"{all(c['within_bound'] for c in matrix)})",
+        "value": round(speedup, 2),
+        "unit": "x",
+        "vs_baseline": round(speedup, 2),
+    }))
     return 0
 
 

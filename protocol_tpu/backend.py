@@ -120,22 +120,33 @@ class JaxSparseBackend(ConvergeBackend):
 
     def converge_edges(
         self, n, src, dst, val, valid, initial_score, num_iterations, tol=None,
-        alpha: float = 0.0, s0=None,
+        alpha: float = 0.0, s0=None, semiring=None,
     ):
         """``s0`` (node-order, length n) warm-starts the power iteration —
         pair with :func:`ops.converge.warm_start_scores` to project a
         previous score vector onto the current peer set. Omitted, the
-        cold uniform start (valid·initial_score) is used."""
+        cold uniform start (valid·initial_score) is used.
+
+        ``semiring`` selects the sweep algebra (``ops.converge.SEMIRINGS``
+        name or a ``Semiring``). The default — ``None`` / ``"plusmul"``
+        — runs the PRE-EXISTING (+,×) kernels verbatim: same functions,
+        same jit signatures, byte-identical iterate trajectory. Named
+        variants (``"maxplus"`` bottleneck trust) run through the
+        semiring twins over the same operator layouts."""
         import jax.numpy as jnp
 
         from .graph import build_operator
         from .ops.converge import (
             converge_sparse_adaptive,
+            converge_sparse_adaptive_semiring,
             converge_sparse_fixed,
+            converge_sparse_fixed_semiring,
             operator_arrays,
+            resolve_semiring,
             timed_converge,
         )
 
+        sr = resolve_semiring(semiring)
         op = build_operator(n, src, dst, val, valid)
         arrs = operator_arrays(op, dtype=self.dtype, alpha=alpha)
         if s0 is None:
@@ -148,6 +159,21 @@ class JaxSparseBackend(ConvergeBackend):
         sig = ("sparse", n, tuple(b.shape for b in op.bucket_idx),
                str(s0.dtype), "fixed" if tol is None else "adaptive",
                int(num_iterations))
+        if sr.name != "plusmul":
+            sig = sig + (sr.name,)
+            if tol is None:
+                scores = timed_converge(
+                    "jax-sparse", n, len(src), sig,
+                    lambda: converge_sparse_fixed_semiring(
+                        arrs, s0, sr, num_iterations),
+                    fixed_iterations=num_iterations, semiring=sr.name)
+                return np.asarray(scores)
+            scores, iters, delta = timed_converge(
+                "jax-sparse", n, len(src), sig,
+                lambda: converge_sparse_adaptive_semiring(
+                    arrs, s0, sr, tol=tol, max_iterations=num_iterations),
+                semiring=sr.name)
+            return np.asarray(scores), int(iters), float(delta)
         if tol is None:
             scores = timed_converge(
                 "jax-sparse", n, len(src), sig,
@@ -160,6 +186,45 @@ class JaxSparseBackend(ConvergeBackend):
                 arrs, s0, tol=tol, max_iterations=num_iterations))
         return np.asarray(scores), int(iters), float(delta)
 
+    def converge_topics(
+        self, n, src, dst, val, valid, s0_topics, max_iterations,
+        tol=1e-6, alpha: float = 0.0, semiring=None,
+    ):
+        """Topic-batched adaptive converge: vmap the K node-order topic
+        vectors ``s0_topics[K, n]`` through ONE operator (one build,
+        one compiled sweep — the TrustFlow-style amortization). Each
+        topic's trajectory is independent (while_loop batching
+        select-masks converged topics). Returns
+        ``(scores[K, n], iters[K], delta[K])`` as numpy."""
+        import jax.numpy as jnp
+
+        from .graph import build_operator
+        from .ops.converge import (
+            converge_sparse_topics,
+            operator_arrays,
+            resolve_semiring,
+            timed_converge,
+        )
+
+        sr = resolve_semiring(semiring)
+        s0k = np.asarray(s0_topics, dtype=np.float64)
+        if s0k.ndim != 2 or s0k.shape[1] != n:
+            raise ValueError(
+                f"s0_topics must be [K, {n}] (got {s0k.shape})")
+        op = build_operator(n, src, dst, val, valid)
+        arrs = operator_arrays(op, dtype=self.dtype, alpha=alpha)
+        s0k = jnp.asarray(s0k, dtype=self.dtype)
+        sig = ("sparse-topics", n, int(s0k.shape[0]),
+               tuple(b.shape for b in op.bucket_idx), str(s0k.dtype),
+               int(max_iterations), sr.name)
+        scores, iters, delta = timed_converge(
+            "jax-sparse", n, len(src), sig,
+            lambda: converge_sparse_topics(
+                arrs, s0k, sr, tol=tol, max_iterations=max_iterations),
+            semiring=sr.name)
+        return (np.asarray(scores), np.asarray(iters),
+                np.asarray(delta))
+
 
 class JaxRoutedBackend(JaxSparseBackend):
     """Clos-routed SpMV power iteration (ops/routed.py) — the large-graph
@@ -170,18 +235,21 @@ class JaxRoutedBackend(JaxSparseBackend):
 
     def converge_edges(
         self, n, src, dst, val, valid, initial_score, num_iterations, tol=None,
-        alpha: float = 0.0, operator=None, s0=None,
+        alpha: float = 0.0, operator=None, s0=None, semiring=None,
     ):
         import jax.numpy as jnp
 
-        from .ops.converge import timed_converge
+        from .ops.converge import resolve_semiring, timed_converge
         from .ops.routed import (
             build_routed_operator,
             converge_routed_adaptive,
+            converge_routed_adaptive_semiring,
             converge_routed_fixed,
+            converge_routed_fixed_semiring,
             routed_arrays,
         )
 
+        sr = resolve_semiring(semiring)
         op = operator
         if op is None:
             op = build_routed_operator(n, src, dst, val, valid)
@@ -197,6 +265,25 @@ class JaxRoutedBackend(JaxSparseBackend):
         # construction) — plus dtype and the static loop bound
         sig = ("routed", static, str(s0.dtype),
                "fixed" if tol is None else "adaptive", int(num_iterations))
+        if sr.name != "plusmul":
+            # the named-variant path: the SAME compiled route plans,
+            # semiring twins for broadcast/reduce only
+            sig = sig + (sr.name,)
+            if tol is None:
+                scores = timed_converge(
+                    "jax-routed", n, int(op.nnz), sig,
+                    lambda: converge_routed_fixed_semiring(
+                        arrs, static, s0, sr, num_iterations),
+                    fixed_iterations=num_iterations, semiring=sr.name)
+                return op.scores_for_nodes(np.asarray(scores))
+            scores, iters, delta = timed_converge(
+                "jax-routed", n, int(op.nnz), sig,
+                lambda: converge_routed_adaptive_semiring(
+                    arrs, static, s0, sr, tol=tol,
+                    max_iterations=num_iterations),
+                semiring=sr.name)
+            return (op.scores_for_nodes(np.asarray(scores)), int(iters),
+                    float(delta))
         if tol is None:
             scores = timed_converge(
                 "jax-routed", n, int(op.nnz), sig,
@@ -210,3 +297,44 @@ class JaxRoutedBackend(JaxSparseBackend):
                 arrs, static, s0, tol=tol, max_iterations=num_iterations))
         return (op.scores_for_nodes(np.asarray(scores)), int(iters),
                 float(delta))
+
+    def converge_topics(
+        self, n, src, dst, val, valid, s0_topics, max_iterations,
+        tol=1e-6, alpha: float = 0.0, operator=None, semiring=None,
+    ):
+        """Routed topic batch: K node-order topic vectors vmapped
+        through ONE routed operator — exactly one routing-plan build
+        (one ``ptpu_routed_plan_build_seconds`` sample) serves all K
+        topics. Returns ``(scores[K, n], iters[K], delta[K])``."""
+        import jax.numpy as jnp
+
+        from .ops.converge import resolve_semiring, timed_converge
+        from .ops.routed import (
+            build_routed_operator,
+            converge_routed_topics,
+            routed_arrays,
+        )
+
+        sr = resolve_semiring(semiring)
+        s0k = np.asarray(s0_topics, dtype=np.float64)
+        if s0k.ndim != 2 or s0k.shape[1] != n:
+            raise ValueError(
+                f"s0_topics must be [K, {n}] (got {s0k.shape})")
+        op = operator
+        if op is None:
+            op = build_routed_operator(n, src, dst, val, valid)
+        arrs, static = routed_arrays(op, dtype=self.dtype, alpha=alpha)
+        s0k = jnp.asarray(
+            np.stack([op.scores_from_nodes(row, dtype=self.dtype)
+                      for row in s0k]))
+        sig = ("routed-topics", static, int(s0k.shape[0]),
+               str(s0k.dtype), int(max_iterations), sr.name)
+        scores, iters, delta = timed_converge(
+            "jax-routed", n, int(op.nnz), sig,
+            lambda: converge_routed_topics(
+                arrs, static, s0k, sr, tol=tol,
+                max_iterations=max_iterations),
+            semiring=sr.name)
+        return (np.stack([op.scores_for_nodes(row)
+                          for row in np.asarray(scores)]),
+                np.asarray(iters), np.asarray(delta))

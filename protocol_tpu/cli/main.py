@@ -11,7 +11,8 @@ chain (``node_url = "memory"``) so the full flow runs without an Ethereum
 node, and the ``serve`` verb — the long-running trust-scores service
 (``protocol_tpu.service``: chain tailer, incremental refresh, proof job
 queue, HTTP API) with its durable state store (``protocol_tpu.store``)
-maintained by the ``store`` inspect/compact verbs. The reference's
+maintained by the ``store`` inspect/compact verbs, and the ``scenario``
+verb — the adversarial robustness harness (``protocol_tpu.scenarios``). The reference's
 handle_update bug (writing ``domain`` into ``as_address``,
 cli.rs:639-643) is deliberately not replicated.
 """
@@ -227,6 +228,47 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--min-coverage", type=float, default=0.0,
                    help="exit 1 unless the named prover stages cover at "
                         "least this fraction of the prove wall time")
+
+    p = sub.add_parser(
+        "scenario",
+        help="adversarial scenario harness: list topologies, run a "
+             "seeded {topology x semiring} robustness experiment "
+             "(deterministic JSON), or render a saved report")
+    p.add_argument("action", choices=["list", "run", "report"],
+                   help="list: topology catalog + knobs; run: one "
+                        "seeded run (byte-identical JSON per seed); "
+                        "report: human summary of a saved run JSON")
+    p.add_argument("--topology", default="sybil-ring",
+                   help="attack family (see 'scenario list')")
+    p.add_argument("--peers", type=int, default=10_000,
+                   help="total peer count (honest + attackers)")
+    p.add_argument("--attacker-fraction", type=float, default=0.1,
+                   help="fraction of peers controlled by the attacker")
+    p.add_argument("--semiring", choices=["plusmul", "maxplus"],
+                   default="plusmul",
+                   help="sweep algebra: plusmul = EigenTrust mass "
+                        "propagation, maxplus = bottleneck (widest-"
+                        "path) trust through the same operator")
+    p.add_argument("--seed", type=int, default=0,
+                   help="topology RNG seed; same seed -> byte-identical "
+                        "report")
+    p.add_argument("--alpha", type=float, default=0.1,
+                   help="pre-trust damping (>0 makes the iteration "
+                        "bound spectrum-free)")
+    p.add_argument("--tol", type=float, default=1e-6)
+    p.add_argument("--max-iterations", type=int, default=100)
+    p.add_argument("--engine", choices=["auto", "sparse", "routed"],
+                   default="auto",
+                   help="SpMV engine; auto picks routed past 20M edges")
+    p.add_argument("--no-baseline", action="store_true",
+                   help="skip the attack-free control converge (rank "
+                        "displacement then reads as zero)")
+    p.add_argument("--timing", action="store_true",
+                   help="include wall-clock timing fields (breaks "
+                        "byte-identical reproducibility, so opt-in)")
+    p.add_argument("--out", help="also write the report JSON here "
+                                 "(relative to assets)")
+    p.add_argument("--json", help="report: saved run JSON to render")
 
     sub.add_parser("show", help="print the current config")
 
@@ -1148,6 +1190,12 @@ def handle_profile(args, files, config):
     return _handle(args, files, config)
 
 
+def handle_scenario(args, files, config):
+    from .scenariocmd import handle_scenario as _handle
+
+    return _handle(args, files, config)
+
+
 HANDLERS = {
     "attest": handle_attest,
     "serve": handle_serve,
@@ -1161,6 +1209,7 @@ HANDLERS = {
     "et-verify": handle_et_verify,
     "kzg-params": handle_kzg_params,
     "obs": handle_obs,
+    "scenario": handle_scenario,
     "show": handle_show,
     "sparse-scores": handle_sparse_scores,
     "store": handle_store,

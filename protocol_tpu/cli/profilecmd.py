@@ -215,6 +215,34 @@ def run_sublinear_workload(n: int = 3000, m: int = 4,
             "full_iterations": int(it_f)}
 
 
+def run_scenario_workload(peers: int = 4000, seed: int = 23) -> dict:
+    """One mid-scale adversarial scenario per semiring, stage-attributed
+    for the perf gate: a seeded sybil-ring build converged through the
+    ConvergeBackend seam under (+,*) and again under (max,min), each
+    with its attack-free baseline control — so the gated stages are the
+    whole semiring sweep surface (``scenario.run`` wrapping the
+    ``converge.edges`` sweeps for both algebras). A regression here —
+    the generalized sweep kernel slowing down, the seam forcing a
+    recompile per semiring, or the topology builder turning
+    superlinear — moves these stages against the committed baseline."""
+    from ..scenarios import run_scenario
+
+    # alpha matches the scenario harness default: the damped bound
+    # keeps iteration counts seed-stable (the gate times stages, not
+    # mixing rates), and both semiring runs share one graph build seed
+    reports = {
+        name: run_scenario("sybil-ring", peers=peers, seed=seed,
+                           semiring=name, alpha=0.1, engine="sparse")
+        for name in ("plusmul", "maxplus")
+    }
+    return {"workload": "scenario", "peers": peers,
+            "edges": reports["plusmul"]["edges"],
+            "iterations": {name: rep["scores"]["iterations"]
+                           for name, rep in reports.items()},
+            "capture": {name: rep["robustness"]["attacker_mass_capture"]
+                        for name, rep in reports.items()}}
+
+
 def run_commits_workload(k: int = 13, columns: int = 8,
                          seed: int = 23) -> dict:
     """The commit engine in isolation at a size where the MSM is the

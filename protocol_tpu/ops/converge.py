@@ -25,6 +25,7 @@ from __future__ import annotations
 
 import time
 from functools import partial
+from typing import Callable, NamedTuple
 
 import jax
 import jax.numpy as jnp
@@ -35,33 +36,105 @@ from ..graph import EllOperator
 from ..utils import trace
 
 
+class Semiring(NamedTuple):
+    """The pluggable (add, mul) algebra of one converge sweep.
+
+    The power iteration's inner product generalizes: a sweep computes
+    ``new_s[i] = add_j mul(w_ji, s[j])`` over the SAME compiled
+    operator layouts — only the combine/reduce ops change. Members are
+    module-level jnp callables, so the tuple is hashable and rides
+    through ``jax.jit`` as a static argument (one compile per algebra,
+    never per value).
+
+    - ``add``: binary combiner (the scatter/tail form of the reduce);
+    - ``mul``: edge-weight application to a source score;
+    - ``reduce``: the axis form of ``add`` (``jnp.sum`` / ``jnp.max``);
+    - ``zero``: identity of ``add`` — the value every pad lane must
+      yield. Both shipped semirings use 0.0, which is only an identity
+      for ``max`` over NONNEGATIVE scores: every non-(+,×) semiring
+      here assumes the trust invariant ``s >= 0`` (normalized weights,
+      nonnegative starts preserve it).
+
+    ``plusmul`` is classic EigenTrust; the DEFAULT converge entry
+    points never dispatch through this seam at all (the pre-existing
+    kernels run verbatim, same jit signatures). ``maxplus`` is
+    bottleneck trust (max-min / widest-path, the tropical variant of
+    arXiv 1906.05793): a peer's score is the best bottleneck over all
+    trust paths reaching it, ``s[i] = max_j min(w_ji, s[j])`` — no
+    dangling redistribution or damping (path semantics, not mass
+    conservation); invalid slots are masked to 0.
+    """
+
+    name: str
+    add: Callable
+    mul: Callable
+    reduce: Callable
+    zero: float
+
+
+PLUSMUL = Semiring("plusmul", jnp.add, jnp.multiply, jnp.sum, 0.0)
+MAXPLUS = Semiring("maxplus", jnp.maximum, jnp.minimum, jnp.max, 0.0)
+
+SEMIRINGS = {"plusmul": PLUSMUL, "maxplus": MAXPLUS}
+
+
+def resolve_semiring(semiring) -> Semiring:
+    """``None`` / name / ``Semiring`` → ``Semiring`` (default (+,×))."""
+    if semiring is None:
+        return PLUSMUL
+    if isinstance(semiring, Semiring):
+        return semiring
+    try:
+        return SEMIRINGS[semiring]
+    except KeyError:
+        raise ValueError(
+            f"unknown semiring {semiring!r} (have: "
+            f"{sorted(SEMIRINGS)})") from None
+
+
+def semiring_tail(sr: Semiring, arrs: dict, s, base):
+    """Post-reduce tail of one sweep under ``sr``: the (+,×) algebra
+    keeps the dangling-mass rank-1 correction + damping
+    (:func:`dangling_and_damping` — mass conservation); path algebras
+    have no mass to conserve, so the tail is just the valid mask.
+    ``sr`` is static under jit — this branch never appears in the
+    compiled graph."""
+    if sr.name == "plusmul":
+        return dangling_and_damping(arrs, s, base)
+    return base * arrs["valid"]
+
+
 def record_converge_stats(backend: str, iters: int, delta, seconds: float,
-                          n: int | None = None) -> None:
+                          n: int | None = None,
+                          semiring: str = "plusmul") -> None:
     """Shared converge observability: every backend (gather, routed,
     sharded) reports its exit through this one seam so the instruments
     cannot diverge. Emits
 
-    - ``ptpu_converge_iterations{backend}`` — the iteration count the
-      power method actually ran: the convergence signal the EigenTrust
-      analyses (arXiv:1603.00589, 2606.11956) say governs score
-      quality, previously observable nowhere;
-    - ``ptpu_converge_residual{backend}`` — the final relative-L1 delta
-      (adaptive runs only; fixed-iteration runs pass ``delta=None``);
-    - ``ptpu_converge_sweep_seconds{backend}`` — mean per-sweep
+    - ``ptpu_converge_iterations{backend,semiring}`` — the iteration
+      count the power method actually ran: the convergence signal the
+      EigenTrust analyses (arXiv:1603.00589, 2606.11956) say governs
+      score quality, previously observable nowhere;
+    - ``ptpu_converge_residual{backend,semiring}`` — the final
+      relative-L1 delta (adaptive runs only; fixed-iteration runs pass
+      ``delta=None``);
+    - ``ptpu_converge_sweep_seconds{backend,semiring}`` — mean per-sweep
       (operator-apply) wall time, total/iters. The sweeps run inside a
       jitted ``while_loop``, so per-sweep timing cannot be observed
       in-loop without breaking compilation — the mean is the honest
       host-side view.
     """
     iters = int(iters)
-    trace.gauge("converge_iterations").set(iters, backend=backend)
+    trace.gauge("converge_iterations").set(iters, backend=backend,
+                                           semiring=semiring)
     if delta is not None:
-        trace.gauge("converge_residual").set(float(delta), backend=backend)
+        trace.gauge("converge_residual").set(float(delta), backend=backend,
+                                             semiring=semiring)
     if iters > 0:
         trace.histogram("converge_sweep_seconds").observe(
-            seconds / iters, backend=backend)
+            seconds / iters, backend=backend, semiring=semiring)
     trace.event("converge.done", backend=backend, iterations=iters,
-                seconds=round(seconds, 6),
+                semiring=semiring, seconds=round(seconds, 6),
                 **({} if n is None else {"n": n}),
                 **({} if delta is None else {"residual": float(delta)}))
 
@@ -92,7 +165,8 @@ def record_refresh_scope(mode: str) -> None:
 
 
 def timed_converge(backend: str, n: int, edges: int, signature, call,
-                   fixed_iterations: int | None = None):
+                   fixed_iterations: int | None = None,
+                   semiring: str = "plusmul"):
     """The one instrumentation wrapper every ConvergeBackend runs its
     converge through (span + compile watch + stats — a single seam so
     the two backends cannot drift): executes ``call`` under the
@@ -120,10 +194,16 @@ def timed_converge(backend: str, n: int, edges: int, signature, call,
     compile_dt = trace.thread_compile_seconds() - c0
     dt = max(time.perf_counter() - t0 - compile_dt, 0.0)
     if fixed_iterations is not None:
-        record_converge_stats(backend, fixed_iterations, None, dt, n=n)
+        record_converge_stats(backend, fixed_iterations, None, dt, n=n,
+                              semiring=semiring)
     else:
         _, iters, delta = out
-        record_converge_stats(backend, int(iters), float(delta), dt, n=n)
+        # topic-batched calls return per-topic vectors; the recorded
+        # count/residual are the worst topic (the honest scalar view)
+        iters = np.max(np.asarray(iters))
+        delta = np.max(np.asarray(delta))
+        record_converge_stats(backend, int(iters), float(delta), dt, n=n,
+                              semiring=semiring)
     return out
 
 
@@ -381,6 +461,64 @@ def converge_sparse_adaptive(
     """
     return adaptive_loop(lambda s: spmv(arrs, s), s0, tol, max_iterations,
                          accel_every)
+
+
+def spmv_semiring(arrs: dict, s: jnp.ndarray, sr: Semiring) -> jnp.ndarray:
+    """One generalized sweep on the sparse (bucketed-ELL) operator:
+    ``new_s[i] = add_j mul(w_ji, s[j])`` + the semiring tail. The SAME
+    bucket layouts as :func:`spmv` — pad lanes carry ``idx=0, val=0``,
+    so ``mul`` yields ``min(0, s[0]) = 0`` (nonnegative scores) or
+    ``0·s[0] = 0``: exactly ``sr.zero``, and the reduce ignores them.
+    The DEFAULT (+,×) entry points never route through here — this is
+    the named-variant path only, so the existing jit signatures are
+    untouched."""
+    parts = [
+        sr.reduce(sr.mul(val, s[idx]), axis=-1)
+        for idx, val in zip(arrs["bucket_idx"], arrs["bucket_val"])
+    ]
+    parts.append(jnp.full((1,), sr.zero, dtype=s.dtype))
+    flat = jnp.concatenate(parts)
+    base = flat[arrs["row_pos"]]
+    return semiring_tail(sr, arrs, s, base)
+
+
+@partial(jax.jit, static_argnames=("sr", "num_iterations"))
+def converge_sparse_fixed_semiring(arrs: dict, s0: jnp.ndarray,
+                                   sr: Semiring, num_iterations: int):
+    """Fixed-iteration twin of :func:`converge_sparse_fixed` under a
+    pluggable semiring (static: one compile per algebra)."""
+    return lax.fori_loop(0, num_iterations,
+                         lambda _, s: spmv_semiring(arrs, s, sr), s0)
+
+
+@partial(jax.jit, static_argnames=("sr", "max_iterations", "accel_every"))
+def converge_sparse_adaptive_semiring(
+    arrs: dict, s0: jnp.ndarray, sr: Semiring, tol: float = 1e-6,
+    max_iterations: int = 100, accel_every: int = 0,
+):
+    """Adaptive twin of :func:`converge_sparse_adaptive` under a
+    pluggable semiring — the same :func:`adaptive_loop` (max-min
+    iteration is monotone per coordinate, so the L1 delta hits exactly
+    0 at the fixed point and the tolerance stop is well-defined)."""
+    return adaptive_loop(lambda s: spmv_semiring(arrs, s, sr), s0, tol,
+                         max_iterations, accel_every)
+
+
+@partial(jax.jit, static_argnames=("sr", "max_iterations"))
+def converge_sparse_topics(arrs: dict, s0k: jnp.ndarray, sr: Semiring,
+                           tol: float = 1e-6, max_iterations: int = 100):
+    """Topic-batched adaptive converge: vmap K topic score-vectors
+    ``s0k[K, n]`` through ONE sparse operator (TrustFlow-style
+    topic-aware reputation, arXiv 2603.19452 — K contexts share the
+    graph, differ in start/pre-trust vector). The while_loop batching
+    rule select-masks per-topic updates, so each topic's trajectory is
+    independent: a converged topic's vector stops changing while
+    slower topics keep sweeping. Returns ``(scores[K, n], iters[K],
+    delta[K])``; the operator (and its build cost) is paid once for
+    all K."""
+    return jax.vmap(
+        lambda s0: adaptive_loop(lambda s: spmv_semiring(arrs, s, sr),
+                                 s0, tol, max_iterations))(s0k)
 
 
 @partial(jax.jit, static_argnames=("num_iterations",))

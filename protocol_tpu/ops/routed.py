@@ -43,7 +43,12 @@ import numpy as np
 from jax import lax
 
 from .clos import _apply_route_jit, _use_pallas, plan_route, plan_routes
-from .converge import adaptive_loop, dangling_and_damping
+from .converge import (
+    Semiring,
+    adaptive_loop,
+    dangling_and_damping,
+    semiring_tail,
+)
 from ..graph import filter_edges, stable_argsort_bounded
 
 __all__ = [
@@ -55,6 +60,10 @@ __all__ = [
     "spmv_routed",
     "converge_routed_fixed",
     "converge_routed_adaptive",
+    "spmv_routed_semiring",
+    "converge_routed_fixed_semiring",
+    "converge_routed_adaptive_semiring",
+    "converge_routed_topics",
 ]
 
 
@@ -793,3 +802,134 @@ def converge_routed_adaptive(arrs, static: RoutedStatic, s0,
         lambda s: spmv_routed(arrs, static, s), s0, tol, max_iterations,
         accel_every,
     )
+
+
+# --- generalized-semiring routed sweep -------------------------------------
+#
+# The Clos routes are pure permutations — semiring-agnostic by
+# construction — so only the broadcast/reduce sides need algebra twins.
+# Pad discipline carries over: every pad slot holds ``sr.zero`` (= 0.0
+# for both shipped semirings — the max identity only because scores are
+# nonnegative), so routed pads and free-slot fills stay correct under a
+# max reduce exactly as they are under a sum.
+
+
+def blocked_broadcast_semiring(arrs: dict, s, widths: tuple, xs: tuple,
+                               total_len: int, sr: Semiring):
+    """Semiring twin of :func:`blocked_broadcast`: expand a state
+    vector into ``mul``-combined edge values. The 0/1 expansion einsum
+    of the (+,×) path is really a lane-wise row SELECT — here it runs
+    as an explicit repeat (w < 128: lane ``l`` takes grid row
+    ``l // w``, the same layout ``_expand_matrix`` encodes) so ``mul``
+    can be any binary op, not just multiply. Pad lanes carry weight 0
+    → ``mul`` yields 0 on them (min of a nonnegative score with 0, or
+    a product with 0)."""
+    parts = []
+    pos = 0
+    for bi, (w, X) in enumerate(zip(widths, xs)):
+        w_mat = arrs["out_weight"][bi]
+        if w < 128:
+            g = 128 // w
+            s2t = lax.slice_in_dim(s, pos, pos + g * X).reshape(g, X)
+            expanded = jnp.repeat(s2t.T, w, axis=1)   # [X, 128]
+            v = sr.mul(expanded, w_mat)
+            pos += g * X
+        else:
+            nb_pad = X * 128 // w
+            rows = lax.slice_in_dim(s, pos, pos + nb_pad)
+            expanded = jnp.broadcast_to(
+                rows[:, None], (nb_pad, w // 128)).reshape(X, 1)
+            v = sr.mul(jnp.broadcast_to(expanded, w_mat.shape), w_mat)
+            pos += nb_pad
+        parts.append(v.reshape(-1))
+    used = sum(X * 128 for X in xs)
+    parts.append(jnp.full((total_len - used,), sr.zero, dtype=s.dtype))
+    return jnp.concatenate(parts)
+
+
+def blocked_reduce_semiring(arrs: dict, y, widths: tuple, xs: tuple,
+                            n_pos: int, total_len: int, sr: Semiring):
+    """Semiring twin of :func:`blocked_reduce`: lane-segmented per-row
+    ``reduce``. The w < 128 layout packs logical row ``r`` (lane-row
+    ``x = r // g``, sub-row ``b = r % g``) into lanes
+    ``[b·w, (b+1)·w)`` with z position ``b·X + x`` — so
+    ``reshape(X, g, w) → reduce(-1) → transpose → flatten`` lands every
+    row sum in exactly the slot the (+,×) einsum puts it in."""
+    sums = []
+    off = 0
+    for bi, (w, X) in enumerate(zip(widths, xs)):
+        y2 = lax.slice_in_dim(y, off, off + X * 128).reshape(X, 128)
+        if w < 128:
+            g = 128 // w
+            z2 = sr.reduce(y2.reshape(X, g, w), axis=-1)   # [X, g]
+            sums.append(z2.T.reshape(-1))
+        else:
+            nb_pad = X * 128 // w
+            sums.append(sr.reduce(
+                sr.reduce(y2, axis=-1).reshape(nb_pad, w // 128),
+                axis=-1))
+        off += X * 128
+    sums.append(jnp.full((total_len - n_pos,), sr.zero, dtype=y.dtype))
+    return jnp.concatenate(sums)
+
+
+def spmv_routed_semiring(arrs: dict, static: RoutedStatic, s,
+                         sr: Semiring):
+    """One generalized sweep through the SAME compiled routed operator:
+    broadcast → route → reduce → route-back under ``sr``, then the
+    semiring tail. ``sr`` is static under jit, so the (+,×) branch
+    compiles to exactly :func:`spmv_routed` and every other algebra
+    reuses the operator's route plans untouched (routes are
+    permutations — no algebra appears in them). The delta engine's
+    patched-matvec keys (``inv_row_scale``/``tail_*``) are a (+,×)
+    normalization concept and never reach this path."""
+    if sr.name == "plusmul":
+        return spmv_routed(arrs, static, s)
+    x = blocked_broadcast_semiring(arrs, s, static.out_widths,
+                                   static.out_xs, 1 << static.edge_e, sr)
+    y = _apply_route_jit(x, arrs["edge_stages"], static.edge_e,
+                         static.edge_bits, static.pallas)
+    z = blocked_reduce_semiring(arrs, y, static.in_widths, static.in_xs,
+                                static.in_n_pos, 1 << static.state_e, sr)
+    base = _apply_route_jit(z, arrs["state_stages"], static.state_e,
+                            static.state_bits, static.pallas)
+    return semiring_tail(sr, arrs, s, base)
+
+
+@partial(jax.jit, static_argnames=("static", "sr", "num_iterations"))
+def converge_routed_fixed_semiring(arrs, static: RoutedStatic, s0,
+                                   sr: Semiring, num_iterations: int):
+    """Fixed-iteration routed power iteration under a pluggable
+    semiring (static: one compile per algebra per operator shape)."""
+    return lax.fori_loop(
+        0, num_iterations,
+        lambda _, s: spmv_routed_semiring(arrs, static, s, sr), s0)
+
+
+@partial(jax.jit, static_argnames=("static", "sr", "max_iterations",
+                                   "accel_every"))
+def converge_routed_adaptive_semiring(arrs, static: RoutedStatic, s0,
+                                      sr: Semiring, tol: float = 1e-6,
+                                      max_iterations: int = 100,
+                                      accel_every: int = 0):
+    """Adaptive routed converge under a pluggable semiring. Returns
+    (scores, iterations_run, final_relative_delta)."""
+    return adaptive_loop(
+        lambda s: spmv_routed_semiring(arrs, static, s, sr), s0, tol,
+        max_iterations, accel_every)
+
+
+@partial(jax.jit, static_argnames=("static", "sr", "max_iterations"))
+def converge_routed_topics(arrs, static: RoutedStatic, s0k, sr: Semiring,
+                           tol: float = 1e-6, max_iterations: int = 100):
+    """Topic-batched adaptive converge through ONE routed operator:
+    vmap K state-order topic vectors ``s0k[K, 2^state_e]`` over the
+    compiled sweep — the routing-plan build (the path's one-time cost,
+    ``ptpu_routed_plan_build_seconds``) is amortized across all K
+    contexts. while_loop batching select-masks per-topic updates, so
+    each topic's trajectory is independent of its batch neighbors.
+    Returns ``(scores[K, ·], iters[K], delta[K])``."""
+    return jax.vmap(
+        lambda s0: adaptive_loop(
+            lambda s: spmv_routed_semiring(arrs, static, s, sr), s0,
+            tol, max_iterations))(s0k)

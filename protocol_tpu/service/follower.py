@@ -35,18 +35,28 @@ local snapshots every ``snapshot_every`` edits
 (``daemon.commit_service_snapshot``), replication cursor (the leader
 WAL position) persisted through ``CheckpointManager`` AFTER the local
 append+apply — a SIGKILL between loses at most one chunk's cursor
-advance, and the refetch dedups by content. One deliberate
-divergence: the follower does NOT auto-compact its local WAL
-(``wal_compact_segments`` is leader-only) — folding a record whose
-digest the leader might re-ship after ITS compaction would re-apply a
-superseded value, and the follower has no refetch floor of its own
-yet; the local log therefore holds the unfolded shipped history (a
-leader-coordinated fold floor is the recorded ROADMAP residual). A leader compaction that
-invalidates the cursor (the follower was disconnected past the ship
-floor's TTL) comes back as a ``gap`` response: the follower re-tails
-the folded log from the earliest position, deduping everything it
-already holds — replay of old+folded folds to the identical state, the
-same argument that makes compaction crash-safe on the leader.
+advance, and the refetch dedups by content. The local WAL is bounded
+the same way the leader's is: once it holds ``wal_compact_segments``
+segments, latest-wins duplicates per recovered ``(signer, about)``
+fold into a fresh segment (startup after restore + the live snapshot
+cadence — the leader's exact cursor-floor discipline, transposed).
+The fold floor here is a LOCAL WAL position: the log position on disk
+at the last SUCCESSFUL replication-cursor persist, saved in the same
+checkpoint. Records past it may be refetched after a crash (the
+tail resumes from the persisted cursor) and are kept verbatim —
+folding one would delete exactly the digest that dedups its refetch.
+Records below it were shipped at-or-below the committed cursor, which
+the leader never re-ships in normal operation; the one path that can
+re-ship them — a leader compaction ``gap`` re-tail — ships the
+leader's FOLDED log, whose per-``(signer, about)`` survivor is the
+same latest record this follower's fold kept, so content dedup holds.
+A pre-existing cursor checkpoint without a floor restores the
+conservative ``(0, 0)`` — nothing folds until the first new-format
+persist. A gap response otherwise behaves as before: the follower
+re-tails the folded log from the earliest position, deduping
+everything it already holds — replay of old+folded folds to the
+identical state, the same argument that makes compaction crash-safe
+on the leader.
 """
 
 from __future__ import annotations
@@ -138,7 +148,15 @@ class FollowerService:
         # read-only surface markers the shared HTTP handler checks
         self.jobs = None
         self.repl_source = None
+        # local-WAL fold floor: the log position on disk at the last
+        # SUCCESSFUL cursor persist (see _compact_wal); conservative
+        # (0, 0) until _restore or the first persist raises it
+        self._local_floor: tuple = (0, 0)
         self._cursor = self._restore()
+        # after restore (the in-memory _seen covers the whole
+        # uncompacted log) and after the floor came back with the
+        # cursor checkpoint — the leader's constructor-path discipline
+        self._compact_wal()
         self._stop = threading.Event()
         self._dirty = threading.Event()
         if self.refresher.stale():
@@ -197,6 +215,11 @@ class FollowerService:
         if step is not None:
             _, arrays, _ = self._cursor_ckpt.restore(step)
             cursor = (int(arrays["cursor"][0]), int(arrays["cursor"][1]))
+            if "local_floor" in arrays:
+                self._local_floor = (int(arrays["local_floor"][0]),
+                                     int(arrays["local_floor"][1]))
+            # else: pre-floor checkpoint format — keep (0, 0), nothing
+            # folds until the first new-format persist
         elif self._seen:
             # applied records but no persisted cursor (crash before the
             # first persist): re-tail from scratch — dedup folds it
@@ -223,11 +246,81 @@ class FollowerService:
             cold=st["cold"], computed_at=st["computed_at"]))
 
     def _persist_cursor(self) -> None:
+        # the local log position NOW covers every record applied under
+        # this cursor (append-before-apply, persist after both) — it
+        # becomes the fold floor once this save is durable. The
+        # in-memory floor only advances on SUCCESS: a failed persist
+        # means a post-crash refetch past the OLD cursor, and those
+        # records must keep their digests (see _compact_wal)
+        pos = self.store.wal.position()
         self._cursor_ckpt.save(
             self.polls,
-            {"cursor": np.asarray(list(self._cursor), dtype=np.int64)},
+            {"cursor": np.asarray(list(self._cursor), dtype=np.int64),
+             "local_floor": np.asarray(list(pos), dtype=np.int64)},
             meta={"kind": "repl-cursor",
                   "position": format_position(self._cursor)})
+        self._local_floor = (int(pos[0]), int(pos[1]))
+
+    def _compact_wal(self) -> None:
+        """Local-WAL compaction — the leader's ``_compact_wal`` with
+        the fold floor transposed from a chain-block cursor to a LOCAL
+        log position: once the log holds ``wal_compact_segments``
+        segments, fold latest-wins duplicates per recovered
+        ``(signer, about)`` into a fresh segment, keeping every record
+        past ``self._local_floor`` verbatim.
+
+        Why the floor is a local position: the follower's refetch unit
+        is the shipped chunk past its persisted replication cursor,
+        and the local log position at the moment that cursor was
+        durably saved bounds exactly the records a post-crash re-tail
+        can re-deliver. Folding above it would delete the digest that
+        dedups the refetch — the leader's cursor-floor argument,
+        verbatim. Below it, normal shipping never re-delivers; a
+        leader-compaction ``gap`` re-tail re-ships the leader's FOLDED
+        log, whose latest-wins survivor per ``(signer, about)`` is the
+        same record this fold keeps, so content dedup still holds.
+
+        Runs at startup after ``_restore`` (the in-memory ``_seen``
+        covers the whole uncompacted log either way) and from the
+        snapshot cadence in ``_apply_records`` — the poll thread is
+        the only local-WAL writer, so no append can race the fold.
+        Never fatal: a failed compaction degrades to a bigger log."""
+        lim = self.config.wal_compact_segments
+        if lim <= 0 or len(self.store.wal.segments()) < lim:
+            return
+        floor = self._local_floor
+        try:
+            records = [(pos, blk, about, payload,
+                        self._decode_record(about, payload))
+                       for pos, (blk, about, payload)
+                       in self.store.wal.replay_frames()]
+            decoded = [r[4] for r in records if r[4] is not None]
+            signers = recover_signers(decoded,
+                                      batched=self.batched_ingest)
+            it = iter(signers)
+            key_map = {}
+            for pos, blk, about, payload, signed in records:
+                if signed is None:
+                    continue
+                signer = next(it)
+                if signer is None:
+                    continue  # unrecoverable: replay rejects it anyway
+                if pos > floor:  # refetchable: keep verbatim
+                    key_map[(blk, about, payload)] = (
+                        "nofold", blk, about, payload)
+                else:
+                    key_map[(blk, about, payload)] = (signer, about)
+            with trace.span("follower.wal_compact",
+                            records=len(records),
+                            floor=format_position(floor)):
+                out = self.store.wal.compact(
+                    lambda b, a, p: key_map.get((b, a, p)))
+            trace.event("follower.wal_compacted",
+                        records_in=out["records_in"],
+                        records_out=out["records_out"],
+                        segments_removed=out["segments_removed"])
+        except (EigenError, OSError):
+            trace.event("follower.wal_compact_failed")
 
     def _bootstrap(self) -> None:
         """First contact: adopt the leader's newest snapshot (or start
@@ -302,6 +395,11 @@ class FollowerService:
         if changed:
             self._edits_since_snapshot += changed
             if self._edits_since_snapshot >= self.config.snapshot_every:
+                # fold BEFORE the snapshot (the leader's cadence
+                # ordering): the fresh segment's position lands in the
+                # snapshot's WAL coverage, so restarts replay the
+                # folded suffix, not the removed segments
+                self._compact_wal()
                 if commit_service_snapshot(self.store, self.graph,
                                            self.refresher,
                                            self.records_applied):

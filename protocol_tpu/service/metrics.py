@@ -107,7 +107,7 @@ HISTOGRAM_FAMILIES = {
     # is the ladder rung that served (partial | device_partial |
     # sampled)
     "refresh_frontier_rows": ("mode",),
-    "converge_sweep_seconds": ("backend",),
+    "converge_sweep_seconds": ("backend", "semiring"),
     "routed_plan_build_seconds": (),
     "operator_delta_seconds": ("kind",),
     "xla_compile_seconds": ("site",),
@@ -128,7 +128,8 @@ DECLARED_COUNTERS = ("xla_compiles", "xla_steady_recompiles",
                      "operator_full_builds", "refresh_sweep_scope",
                      "proof_pool_shed", "proof_pool_affinity",
                      "proof_pool_stolen", "prove_shards",
-                     "repl_chunks", "repl_records_shipped")
+                     "repl_chunks", "repl_records_shipped",
+                     "scenario_runs")
 DECLARED_GAUGES = ("converge_iterations", "converge_residual",
                    "proof_queue_depth", "dirty_rows",
                    "refresh_frontier_peak", "refresh_budget_spent",

@@ -631,6 +631,11 @@ class ScoreRefresher:
             "reanchors": self.delta_reanchors,
             "frontier_peak": self.last_frontier_peak,
             "budget_spent": self.last_budget_spent,
+            # the DECLARED sublinearity price: serve_smoke's scenario
+            # phase holds served scores to this bound under adversarial
+            # churn, so it must be visible over the wire, not just in
+            # the operator's config file
+            "error_budget": self.config.refresh_error_budget,
         }
         if eng is not None:
             out.update({

@@ -36,6 +36,13 @@ def test_parser_accepts_all_verbs():
                      "--min-coverage", "0.9", "--xprof", "xp"]),
         ("profile", ["--workload", "daemon",
                      "--url", "http://127.0.0.1:1", "--seconds", "2"]),
+        ("scenario", ["list"]),
+        ("scenario", ["run", "--topology", "sybil-ring", "--peers",
+                      "1000", "--attacker-fraction", "0.2",
+                      "--semiring", "maxplus", "--seed", "7",
+                      "--engine", "sparse", "--no-baseline",
+                      "--out", "scn.json"]),
+        ("scenario", ["report", "--json", "scn.json"]),
         ("scores", ["--backend", "jax"]),
         ("serve", ["--port", "0", "--poll-interval", "0.5",
                    "--state-dir", "svc-state", "--workers", "2",

@@ -444,3 +444,71 @@ def test_leader_compaction_ship_floor(tmp_path, devnet):
         if fol2 is not None:
             fol2.shutdown()
         svc.shutdown()
+
+
+# --- follower local-WAL compaction -------------------------------------------
+
+
+def test_follower_local_wal_bounded_under_churn(tmp_path, devnet):
+    """A long-tailing follower compacts its OWN local WAL (startup +
+    snapshot cadence, fold floor = the local position at the last
+    persisted replication cursor): under sustained latest-wins churn
+    over a FIXED key set, the local segment count stays bounded
+    instead of growing with shipped history — and a restart on the
+    folded log still restores byte-equal scores."""
+    _, node_url = devnet
+    # leader: roomy segments, leader-side compaction OFF — the full
+    # unfolded history ships, so any boundedness below is the
+    # follower's own doing
+    svc, client = _leader(tmp_path, node_url,
+                          wal_segment_bytes=1_000_000,
+                          wal_compact_segments=0,
+                          snapshot_every=10_000)
+    url = svc.start()
+    fol2 = None
+    try:
+        kps = ecdsa_keypairs_from_mnemonic(MNEMONIC, 3)
+        addrs = [address_from_public_key(k.public_key) for k in kps]
+        fol = _follower(tmp_path, url, wal_segment_bytes=256,
+                        wal_compact_segments=2, snapshot_every=3)
+        furl = fol.start()
+        seg_counts = []
+        shipped = 0
+        for r in range(8):
+            # same 3 (signer, about) keys every round, round-unique
+            # values (a byte-identical re-attestation would dedup
+            # upstream): pure latest-wins churn — the log grows, the
+            # state doesn't
+            _attest_pairs(client, kps,
+                          [(0, addrs[1], 10 + r),
+                           (1, addrs[2], 40 + r),
+                           (2, addrs[0], 70 + r)])
+            shipped += 3
+            _wait(lambda: _settled(url, min_edges=3),
+                  what=f"leader settle round {r}")
+            _wait(lambda: _follower_caught_up(furl, url),
+                  what=f"follower catch-up round {r}")
+            seg_counts.append(len(fol.store.wal.segments()))
+        # 24 records at ~130 bytes against 256-byte segments is >10
+        # segments unfolded; the cadence fold must keep the tail flat
+        assert fol.records_applied == shipped
+        assert max(seg_counts[-3:]) <= 5, seg_counts
+        local_records = sum(1 for _ in fol.store.wal.replay())
+        assert local_records < shipped, (local_records, shipped)
+        # the folded log is still a complete restore source: SIGKILL →
+        # restart on the same state dir → byte-equal scores, no gap
+        _hard_kill_follower(fol)
+        fol2 = _follower(tmp_path, url)
+        assert fol2.follower_id == fol.follower_id
+        furl2 = fol2.start()
+        _wait(lambda: _follower_caught_up(furl2, url),
+              what="follower catch-up after restart on folded log")
+        assert fol2.gaps == 0
+        lbody = json.loads(_get(url + "/scores")[2])
+        fbody = json.loads(_get(furl2 + "/scores")[2])
+        assert {s["address"]: s["score"] for s in lbody["scores"]} \
+            == {s["address"]: s["score"] for s in fbody["scores"]}
+    finally:
+        if fol2 is not None:
+            fol2.shutdown()
+        svc.shutdown()

@@ -127,7 +127,7 @@ def test_committed_baseline_is_loadable():
     assert data["schema"] == "ptpu-perf-gate-v1"
     assert set(data["workloads"]) == {"prove", "refresh", "delta",
                                       "proofs", "commits", "sublinear",
-                                      "sharded"}
+                                      "sharded", "scenario"}
 
 
 # --- bench trajectory --------------------------------------------------------
@@ -158,8 +158,15 @@ def test_bench_trajectory_rows_cover_all_rounds(tmp_path):
         assert r["metric"], r
         assert isinstance(r["value"], (int, float)), r
         assert r["rc"] == 0, r
+    # every committed round must carry its curated ROUND_NOTES hook —
+    # a new BENCH_rNN.json without one fails HERE, so the trajectory
+    # table can never grow an unexplained row
+    assert mod.missing_notes(rows) == [], \
+        f"rounds missing ROUND_NOTES entries: {mod.missing_notes(rows)}"
     text = mod.render(rows)
-    assert len(text.splitlines()) == len(rows) + 1
+    assert len(text.splitlines()) == 2 * len(rows) + 1
+    assert "ROUND_NOTES" not in text, \
+        "render leaked the missing-note placeholder for a known round"
     # legacy layout: headline only in the tail
     legacy = {"n": 99, "cmd": "x", "rc": 0,
               "tail": 'noise\n{"metric": "m", "value": 2.5, '

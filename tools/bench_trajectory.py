@@ -27,6 +27,36 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 _BENCH_RE = re.compile(r"^BENCH_r(\d+)\.json$")
 
+# Curated one-line hook per committed round: WHAT moved that round, not
+# just the headline number (the raw metric strings above already carry
+# those). A new BENCH_rNN.json MUST land with its entry here — the
+# structural test fails the build otherwise, so the trajectory can
+# never silently grow an unexplained row.
+ROUND_NOTES = {
+    1: "baseline: default 10M-peer converge wall vs the 5s floor",
+    2: "steady re-measurement, no converge-path change that round",
+    3: "re-run over the durable store — WAL/snapshot layer costs the "
+       "sweep nothing",
+    4: "re-run under typed metrics/trace — instrumentation free on "
+       "the hot path",
+    5: "re-run with device-layer observability down the stack — still "
+       "flat",
+    6: "delta engine lands: 500-revision churn absorbed in place, "
+       "63x past the full-rebuild floor",
+    7: "multi-worker proof pool: ~1.9x proofs/hour on 2 workers",
+    8: "batched multi-column commit engine: 1.5x over serial MSM "
+       "commits at 2^20",
+    9: "sublinear refresh ladder at 10M peers: 11.9x worst "
+       "ladder-vs-full-sweep across frontier scales",
+    10: "intra-prove sharding across the pool: 1.9x flagship prove "
+        "wall, byte-identical transcripts",
+    11: "read-path scale-out: follower replicas absorb reads, 6.5x "
+        "leader refresh-wall relief",
+    12: "scenario harness + semiring seam: 18-cell robustness matrix "
+        "all within the damped bound, topic-batch plan builds 8->1 "
+        "(CPU wall ceiling 1.13x)",
+}
+
 
 def load_headline(path: str) -> tuple:
     """(raw record, parsed headline or None) for one bench file."""
@@ -63,12 +93,19 @@ def trajectory(repo: str) -> list:
             "value": parsed.get("value"),
             "unit": parsed.get("unit"),
             "vs_baseline": parsed.get("vs_baseline"),
+            "note": ROUND_NOTES.get(int(m.group(1))),
         })
     return sorted(rows, key=lambda r: r["round"])
 
 
+def missing_notes(rows: list) -> list:
+    """Rounds whose file exists but has no curated ROUND_NOTES entry —
+    the structural test turns a non-empty return into a failure."""
+    return [r["round"] for r in rows if not r.get("note")]
+
+
 def render(rows: list, width: int = 100) -> str:
-    out = [f"{'r':>3}  {'value':>10}  {'vs_floor':>8}  metric"]
+    out = [f"{'r':>3}  {'value':>10}  {'vs_floor':>8}  metric / note"]
     for r in rows:
         value = ("-" if r["value"] is None
                  else f"{r['value']:g}{r['unit'] or ''}")
@@ -78,6 +115,10 @@ def render(rows: list, width: int = 100) -> str:
         if len(metric) > width:
             metric = metric[: width - 1] + "…"
         out.append(f"{r['round']:>3}  {value:>10}  {vsb:>8}  {metric}")
+        note = r.get("note") or "<round missing its ROUND_NOTES entry>"
+        if len(note) > width:
+            note = note[: width - 1] + "…"
+        out.append(f"{'':>25}  ↳ {note}")
     return "\n".join(out)
 
 
@@ -94,6 +135,11 @@ def main(argv=None) -> int:
         print(f"no BENCH_r*.json files under {args.repo}",
               file=sys.stderr)
         return 1
+    gaps = missing_notes(rows)
+    if gaps:
+        print(f"warning: rounds {gaps} have no ROUND_NOTES entry "
+              "(tests/test_tools_obs.py fails on this)",
+              file=sys.stderr)
     if args.json:
         print(json.dumps(rows, indent=2))
     else:

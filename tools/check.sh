@@ -56,6 +56,10 @@ echo "serve_smoke --restart --churn --replica: rc=${smoke_rc}"
 # SHARDED_PROVE_OK asserts one live-daemon prove (shard_proves=1)
 # fanned its work units across BOTH pool workers with proof bytes
 # identical to a direct single-worker prove.
+# SCENARIO_OK asserts adversarial-churn honesty: a sybil-ring burst
+# through the live delta/ladder path with served scores held within
+# the daemon's DECLARED refresh_error_budget of the full-recompute
+# oracle (budget read back off /status, not assumed).
 # REPLICA_OK asserts the read-path scale-out: a real CLI leader + one
 # serve --follow follower under churn — follower scores converge to
 # the leader oracle over the shipped WAL, lag gauge back to 0, score
@@ -67,6 +71,7 @@ grep -q SCRAPE_LINT_OK /tmp/_smoke.log \
     && grep -q DEVICE_OBS_OK /tmp/_smoke.log \
     && grep -q DELTA_DAEMON_OK /tmp/_smoke.log \
     && grep -q SUBLINEAR_OK /tmp/_smoke.log \
+    && grep -q SCENARIO_OK /tmp/_smoke.log \
     && grep -q PROOF_POOL_OK /tmp/_smoke.log \
     && grep -q COMMIT_PIPE_OK /tmp/_smoke.log \
     && grep -q SHARDED_PROVE_OK /tmp/_smoke.log \

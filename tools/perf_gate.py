@@ -49,6 +49,7 @@ SUBLINEAR_KW = {"n": 3000, "m": 4}
 PROOFS_KW = {"k": 7, "gates": 64, "jobs": 6, "workers": 2}
 COMMITS_KW = {"k": 13, "columns": 8}
 SHARDED_KW = {"k": 7, "gates": 64, "jobs": 3, "workers": 2}
+SCENARIO_KW = {"peers": 4000, "seed": 23}
 
 
 def _run_once() -> dict:
@@ -61,6 +62,7 @@ def _run_once() -> dict:
         run_proofs_workload,
         run_prove_workload,
         run_refresh_workload,
+        run_scenario_workload,
         run_sharded_workload,
         run_sublinear_workload,
     )
@@ -113,6 +115,12 @@ def _run_once() -> dict:
     # fan-out serialization grows the total/shard-span times
     measure("sharded", lambda: run_sharded_workload(**SHARDED_KW),
             ("service.proof", "prove.shard"))
+    # the adversarial scenario harness: one seeded sybil-ring run per
+    # semiring through the ConvergeBackend seam — the generalized sweep
+    # kernel slowing down, or the seam forcing a per-semiring recompile,
+    # grows the scenario.run/converge.edges stages against the baseline
+    measure("scenario", lambda: run_scenario_workload(**SCENARIO_KW),
+            ("scenario.run", "converge.edges"))
     return out
 
 
@@ -139,7 +147,8 @@ def run_workloads(runs: int) -> dict:
                             "delta": DELTA_KW, "proofs": PROOFS_KW,
                             "commits": COMMITS_KW,
                             "sublinear": SUBLINEAR_KW,
-                            "sharded": SHARDED_KW},
+                            "sharded": SHARDED_KW,
+                            "scenario": SCENARIO_KW},
         "runs": runs,
         "workloads": best,
     }
