@@ -151,6 +151,19 @@ def main():
                              "measured inline commit wall, the "
                              "flagship regime where device quotient "
                              "and commit wall are comparable)")
+    parser.add_argument("--fabric", action="store_true",
+                        help="BENCH_r13: cross-process proving fabric "
+                             "— one flagship-shape prove's wall clock "
+                             "vs EXTERNAL prove-worker process count "
+                             "(units serialized through a FabricStore, "
+                             "executed by real OS processes), "
+                             "transcript digest asserted equal to the "
+                             "inline flush at every cell")
+    parser.add_argument("--fabric-workers", default="0,1,2,4",
+                        help="comma-separated external worker process "
+                             "counts for the fabric curve")
+    parser.add_argument("--fabric-reps", type=int, default=3,
+                        help="best-of-N per fabric cell")
     parser.add_argument("--reads", action="store_true",
                         help="BENCH_r11: read-path scale-out — read "
                              "QPS vs follower-replica count under "
@@ -214,6 +227,9 @@ def main():
 
     if args.sharded:
         return bench_sharded(args)
+
+    if args.fabric:
+        return bench_fabric(args)
 
     if args.ingest:
         # chip-measured att/s for hash + binding-checked GLV recovery;
@@ -1736,6 +1752,203 @@ def bench_sharded(args) -> int:
     if speedup_2w is not None and speedup_2w < 1.3:
         print("BENCH FAILED: 2-worker sharded speedup under the 1.3x "
               "floor", file=sys.stderr)
+        return 1
+    return 0
+
+
+def bench_fabric(args) -> int:
+    """BENCH_r13: the cross-process proving fabric — ONE prove's wall
+    clock vs EXTERNAL ``prove-worker`` process count, with the prove's
+    commit units serialized through a filesystem FabricStore and
+    executed by real OS processes sharing nothing but that directory.
+
+    Methodology (BENCH_r10's device-window discipline, across process
+    boundaries): the flagship-shape workload is a real CommitEngine
+    flush of ``--shard-cols`` columns at 2^``--shard-k`` over real SRS
+    bases, dispatched with ``flush_async()`` and merged through the
+    deterministic rendezvous, with a ``--shard-window`` seconds
+    device-occupancy window between dispatch and merge (``time.sleep``
+    standing in for the device-resident quotient/ext phase; it
+    auto-sizes to the measured inline commit wall). On this 1-core box
+    the window is what makes cross-process overlap physically possible:
+    the daemon process is IDLE inside it — not merely GIL-released —
+    so external worker processes get the core outright and chew the
+    published MSM units under it. At 0 external workers the same prove
+    must run the window THEN the units serially. On a multi-core box
+    the fleet overlaps with the daemon's own compute too; that curve is
+    owed to hardware, like BENCH_r07's and r10's. Every cell's
+    transcript digest must equal the inline (runner-free, fabric-free)
+    reference — the fabric may move units between processes, never a
+    transcript byte.
+
+    Proofs/hour saturation note: the fleet adds throughput only while
+    idle cores exist. On an N-core box, proofs/hour from fabric fan-out
+    saturates at ~N x the single-process rate; past that, workers
+    time-slice the same silicon and the curve flattens (here N=1, so 2
+    and 4 external workers measure protocol overhead and reclaim
+    correctness, not added silicon — the 1-worker cell under the
+    window is the honest overlap measurement).
+
+    Headline: flagship-shape wall at 0 external workers / wall at 2.
+    """
+    import contextlib
+    import shutil
+    import tempfile
+
+    from protocol_tpu.utils.platform import honor_jax_platforms_env
+
+    honor_jax_platforms_env()
+
+    from protocol_tpu.service.faults import FaultInjector
+    from protocol_tpu.service.pool import ProofWorkerPool
+    from protocol_tpu.zk import prover_fast as pf
+    from protocol_tpu.zk.commit_engine import CommitEngine
+    from protocol_tpu.zk.fabric import FabricStore
+    from protocol_tpu.zk.transcript import make_transcript
+
+    import random as _random
+
+    k, cols_n = args.shard_k, args.shard_cols
+    print(f"setup: params 2^{k}, {cols_n} columns", file=sys.stderr)
+    params = pf.setup_params_fast(k, seed=b"fabric-bench")
+    rng = _random.Random(17)
+    n = 1 << k
+    blob = np.frombuffer(
+        rng.getrandbits(8 * 32 * n * cols_n).to_bytes(
+            32 * n * cols_n, "little"),
+        dtype="<u8").reshape(cols_n, n, 4).copy()
+    blob[:, :, 3] &= (1 << 59) - 1  # keep scalars < R
+    cols = [np.ascontiguousarray(blob[i]) for i in range(cols_n)]
+    no_faults = FaultInjector({"rpc": 0.0, "device": 0.0, "disk": 0.0})
+
+    def flush_digest(window: float) -> tuple:
+        eng = CommitEngine(params)
+        for i, c in enumerate(cols):
+            eng.submit_coeffs(f"c{i}", c)
+        handle = eng.flush_async()
+        if window:
+            time.sleep(window)  # the device-occupancy stand-in
+        pts = handle.result()
+        tr = make_transcript("poseidon")
+        for pt in pts:
+            tr.absorb_point(pt)
+        return tr.challenge()
+
+    flush_digest(0.0)  # warm-up: one-time SRS limb conversion
+    t0 = time.perf_counter()
+    ref_digest = flush_digest(0.0)
+    t_flush = time.perf_counter() - t0
+    window = args.shard_window or round(t_flush, 3)
+    print(f"inline commit wall {t_flush:.3f}s -> window {window:.3f}s",
+          file=sys.stderr)
+
+    def spawn_worker(state_dir: str, name: str):
+        env = dict(os.environ, JAX_PLATFORMS="cpu",
+                   PYTHONPATH=os.path.dirname(os.path.abspath(__file__)))
+        return subprocess.Popen(
+            [sys.executable, "-m", "protocol_tpu.cli",
+             "--assets", os.path.join(state_dir, "assets"),
+             "prove-worker", "--state-dir", state_dir,
+             "--name", name, "--poll", "0.02"],
+            cwd=os.path.dirname(os.path.abspath(__file__)), env=env,
+            stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+
+    def run_cell(n_ext: int) -> dict:
+        state = tempfile.mkdtemp(prefix="ptpu-bench-fabric-")
+        fabric = FabricStore(os.path.join(state, "fabric"),
+                             lease_ttl=5.0)
+        pool = ProofWorkerPool(
+            {"flagship": lambda p: {"digest": str(flush_digest(window))}},
+            capacity=8, workers=1, faults=no_faults,
+            shard_kinds={"flagship"}, shard_cap=4, fabric=fabric)
+        pool.start()
+        procs = [spawn_worker(state, f"fw{i}") for i in range(n_ext)]
+        try:
+            deadline = time.monotonic() + 90.0
+            while fabric.workers_live() < n_ext:
+                fabric._workers_cache = (0.0, 0)
+                if time.monotonic() > deadline:
+                    raise RuntimeError(
+                        f"{n_ext} fabric workers never registered")
+                time.sleep(0.05)
+            best = None
+            for _ in range(max(1, args.fabric_reps)):
+                job = pool.submit("flagship", {})
+                stall = time.monotonic() + 600.0
+                while pool.get(job.job_id).status not in ("done",
+                                                          "failed"):
+                    if time.monotonic() > stall:
+                        raise RuntimeError("fabric prove stalled")
+                    time.sleep(0.01)
+                got = pool.get(job.job_id)
+                assert got.status == "done", got.error
+                assert got.result["digest"] == str(ref_digest), \
+                    f"{n_ext} ext workers: transcript digest diverged"
+                wall = got.finished_at - got.started_at
+                best = wall if best is None else min(best, wall)
+            status = pool.pool_status()["fabric"]
+        finally:
+            pool.drain(10.0)
+            for p in procs:
+                p.terminate()
+            for p in procs:
+                with contextlib.suppress(Exception):
+                    p.wait(timeout=30)
+            shutil.rmtree(state, ignore_errors=True)
+        return {
+            "ext_workers": n_ext,
+            "wall_s": round(best, 3),
+            "units_published": status["units_published"],
+            "units_applied_remote": status["results_applied"],
+        }
+
+    worker_counts = [int(x) for x in args.fabric_workers.split(",") if x]
+    if not {0, 2} <= set(worker_counts):
+        # the headline IS wall(0 ext)/wall(2 ext): without both cells
+        # the bench would fabricate a passing 1.0x — refuse instead
+        print("error: --fabric-workers must include 0 and 2 (the "
+              "headline cells)", file=sys.stderr)
+        return 1
+    run_cell(0)  # warm (base parse/limb caches, subprocess-free)
+    curve = [run_cell(nw) for nw in worker_counts]
+    by_workers = {c["ext_workers"]: c for c in curve}
+
+    speedup_2w = None
+    if 0 in by_workers and 2 in by_workers:
+        speedup_2w = by_workers[0]["wall_s"] / by_workers[2]["wall_s"]
+    meta = {
+        "mode": "fabric",
+        "shard_k": k,
+        "columns": cols_n,
+        "window_s": window,
+        "inline_commit_wall_s": round(t_flush, 3),
+        "curve": curve,
+        "transcript_parity": "digest identical to the inline "
+                             "(runner-free, fabric-free) flush at "
+                             "every cell",
+        "proofs_per_hour_note": "fabric fan-out adds proofs/hour only "
+                                "while idle cores exist; on an N-core "
+                                "box it saturates at ~N x the single-"
+                                "process rate, after which workers "
+                                "time-slice the same silicon "
+                                f"(host_cores here: {os.cpu_count()})",
+        "host_cores": os.cpu_count(),
+        "speedup_2w": (round(speedup_2w, 3)
+                       if speedup_2w is not None else None),
+    }
+    print(json.dumps(meta), file=sys.stderr)
+    value = speedup_2w if speedup_2w is not None else 1.0
+    print(json.dumps({
+        "metric": "cross-process fabric: flagship-shape prove wall, "
+                  f"0 external workers vs 2 (2^{k} x {cols_n} commit "
+                  f"columns, {window:.2f}s device window)",
+        "value": round(value, 3),
+        "unit": "x",
+        "vs_baseline": round(value / 1.3, 3),
+    }))
+    if speedup_2w is not None and speedup_2w < 1.3:
+        print("BENCH FAILED: 2-external-worker fabric speedup under "
+              "the 1.3x floor", file=sys.stderr)
         return 1
     return 0
 

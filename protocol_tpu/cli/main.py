@@ -149,6 +149,16 @@ def build_parser() -> argparse.ArgumentParser:
                    help="1: fan a single prove's commit/quotient/fold "
                         "work units out to idle pool workers "
                         "(byte-identical proofs; default 0)")
+    p.add_argument("--fabric", type=int, default=None, metavar="0|1",
+                   help="1: publish sharded-prove work units under "
+                        "<state-dir>/fabric/ so external prove-worker "
+                        "processes lend into running proves "
+                        "(needs --shard-proves 1 and a state dir; "
+                        "default 0)")
+    p.add_argument("--fabric-lease-ttl", type=float, default=None,
+                   help="seconds an external worker's unit lease "
+                        "lives without a heartbeat before the unit "
+                        "is reclaimed (default 5)")
     p.add_argument("--shape", choices=["default", "tiny"], default=None,
                    help="circuit shape served by proof jobs")
     p.add_argument("--transcript", choices=["poseidon", "keccak"],
@@ -169,6 +179,38 @@ def build_parser() -> argparse.ArgumentParser:
                         "/scores //score/<addr> //bundle hermetically "
                         "(no chain tailer, no proof pool; POST /proofs "
                         "answers 503)")
+
+    p = sub.add_parser(
+        "prove-worker",
+        help="lend this process into a serve --fabric daemon's running "
+             "proves: poll the fabric for published work units "
+             "(commit MSM batches, quotient row chunks, opening "
+             "folds), lease + execute + publish results — "
+             "byte-identical placement, lease-reclaim crash safety")
+    p.add_argument("--state-dir", default=None,
+                   help="the DAEMON's state dir (the fabric lives at "
+                        "<state-dir>/fabric; default "
+                        "<assets>/service-state) — same-box, "
+                        "shared-filesystem mode")
+    p.add_argument("--url", default=None,
+                   help="daemon base URL (http://host:port) — "
+                        "cross-box mode over the /fabric HTTP surface "
+                        "instead of a shared filesystem")
+    p.add_argument("--name", default=None,
+                   help="worker name carried on leases, results and "
+                        "the prove.shard spans of units this process "
+                        "executes (default fw<pid>)")
+    p.add_argument("--poll", type=float, default=0.05,
+                   help="seconds between idle fabric polls")
+    p.add_argument("--lease-ttl", type=float, default=5.0,
+                   help="lease/heartbeat TTL seconds (match the "
+                        "daemon's --fabric-lease-ttl)")
+    p.add_argument("--max-units", type=int, default=None,
+                   help="exit after executing this many units "
+                        "(default: run until signalled)")
+    p.add_argument("--idle-exit", type=float, default=None,
+                   help="exit after this many seconds with no "
+                        "claimable unit (default: poll forever)")
 
     p = sub.add_parser(
         "obs",
@@ -891,6 +933,7 @@ def handle_serve(args, files, config):
         queue_capacity=args.queue_capacity,
         pool_workers=args.workers,
         shard_proves=args.shard_proves,
+        fabric=args.fabric, fabric_lease_ttl=args.fabric_lease_ttl,
         proof_shape=args.shape, transcript=args.transcript,
         state_dir=args.state_dir, follow=args.follow)
     if svc_config.state_dir:
@@ -963,6 +1006,59 @@ def handle_serve(args, files, config):
     print("service drained UNCLEAN (timeout or persist failure)",
           flush=True)
     return 1
+
+
+def handle_prove_worker(args, files, config):
+    """Run one external fabric worker process (the worker half of
+    ``serve --fabric``): poll, lease, execute, publish — until
+    ``--max-units`` / ``--idle-exit`` / SIGINT/SIGTERM."""
+    import os as _os
+    import signal
+    import threading
+    from pathlib import Path
+
+    from ..utils.platform import honor_jax_platforms_env
+
+    honor_jax_platforms_env()
+
+    from ..zk.fabric import FabricStore, RemoteFabric, run_worker
+
+    name = args.name or f"fw{_os.getpid()}"
+    if args.url:
+        fabric = RemoteFabric(args.url)
+        fabric.lease_ttl = args.lease_ttl
+        where = args.url
+    else:
+        if args.state_dir:
+            state_dir = Path(args.state_dir)
+            if not state_dir.is_absolute():
+                state_dir = files.assets / state_dir
+        else:
+            state_dir = files.service_state_dir()
+        from ..service.faults import FaultInjector
+
+        root = Path(state_dir) / "fabric"
+        # env-gated fault injection (PTPU_FAULT_DISK): the lease-expiry
+        # fault test tears THIS process's result writes — production
+        # runs with the env unset pay nothing
+        fabric = FabricStore(str(root), lease_ttl=args.lease_ttl,
+                             faults=FaultInjector())
+        where = str(root)
+    stop = threading.Event()
+    for sig in (signal.SIGTERM, signal.SIGINT):
+        try:
+            signal.signal(sig, lambda *_: stop.set())
+        except (ValueError, OSError):  # non-main thread / platform
+            pass
+    print(f"prove-worker {name} polling {where} "
+          f"(lease ttl {args.lease_ttl:g}s)", flush=True)
+    executed = run_worker(fabric, name, poll=args.poll,
+                          lease_ttl=args.lease_ttl,
+                          max_units=args.max_units,
+                          idle_exit=args.idle_exit, stop=stop)
+    print(f"prove-worker {name} exiting after {executed} units",
+          flush=True)
+    return 0
 
 
 def handle_obs(args, files, config):
@@ -1209,6 +1305,7 @@ HANDLERS = {
     "et-verify": handle_et_verify,
     "kzg-params": handle_kzg_params,
     "obs": handle_obs,
+    "prove-worker": handle_prove_worker,
     "scenario": handle_scenario,
     "show": handle_show,
     "sparse-scores": handle_sparse_scores,

@@ -414,6 +414,93 @@ def run_sharded_workload(k: int = 7, gates: int = 64, workers: int = 2,
                      for w in status["workers"]}}
 
 
+def run_fabric_workload(k: int = 7, gates: int = 64, jobs: int = 3,
+                        seed: int = 9) -> dict:
+    """Real host-path proves sharded across the CROSS-PROCESS fabric
+    (``zk/fabric.py``): a 1-worker pool publishes portable units to a
+    throwaway FabricStore and an external worker loop (in-thread here
+    — the gate measures the serialization + rendezvous overhead, not
+    process spawn) claims, executes and returns them. Byte parity vs
+    the direct prove is asserted per job, and at least one unit must
+    have been applied from the fabric (``ptpu_fabric_units_total`` > 0)
+    — a publish/claim regression that silently degrades to all-local
+    would otherwise still pass. The perf gate tracks ``service.proof``,
+    ``prove.shard`` and ``fabric.unit`` spans against the baseline."""
+    import shutil
+    import tempfile
+    import threading
+
+    from .. import native
+    from ..service.faults import FaultInjector
+    from ..service.pool import ProofWorkerPool
+    from ..utils import trace
+    from ..zk import prover_fast as pf
+    from ..zk.fabric import FabricStore, run_worker
+
+    if not native.available():
+        raise EigenError("config_error",
+                         "the fabric workload needs the native "
+                         "toolchain")
+    cs = synthetic_circuit(gates=gates, seed=seed)
+    params = pf.setup_params_fast(k, seed=b"profile-shard")
+    pk = pf.keygen_fast(params, cs, k=k, eval_pk="auto")
+    reference = pf.prove_fast(params, pk, cs, randint=lambda: 424242)
+    units0 = trace.counter_total("fabric_units")
+
+    def prove(p):
+        return {"proof": pf.prove_fast(
+            params, pk, cs, randint=lambda: 424242).hex()}
+
+    root = tempfile.mkdtemp(prefix="ptpu-fabric-")
+    fabric = FabricStore(root, lease_ttl=5.0)
+    pool = ProofWorkerPool(
+        {"eigentrust": prove}, capacity=max(jobs, 8), workers=1,
+        faults=FaultInjector({"rpc": 0.0, "device": 0.0, "disk": 0.0}),
+        shard_kinds={"eigentrust"}, shard_cap=4,
+        worker_env=lambda w: pf.worker_isolation(w.name, w.device),
+        fabric=fabric)
+    pool.start()
+    stop = threading.Event()
+    worker = threading.Thread(
+        target=run_worker, args=(fabric, "fw-gate"),
+        kwargs={"poll": 0.01, "stop": stop}, daemon=True)
+    worker.start()
+    try:
+        deadline = time.monotonic() + 60.0
+        while fabric.workers_live() < 1:
+            fabric._workers_cache = (0.0, 0)
+            if time.monotonic() > deadline:
+                raise EigenError("read_write_error",
+                                 "fabric worker never registered")
+            time.sleep(0.01)
+        submitted = [pool.submit("eigentrust", {}) for _ in range(jobs)]
+        deadline = time.monotonic() + 300.0
+        while pool.completed + pool.failed < jobs:
+            if time.monotonic() > deadline:
+                raise EigenError("resource_error", "fabric pool stalled")
+            time.sleep(0.01)
+        for job in submitted:
+            got = pool.get(job.job_id)
+            if got.status != "done" or \
+                    bytes.fromhex(got.result["proof"]) != reference:
+                raise EigenError(
+                    "verification_error",
+                    f"fabric proof diverged from the direct prove "
+                    f"({got.status}: {got.error})")
+        units = trace.counter_total("fabric_units") - units0
+        if units <= 0:
+            raise EigenError("verification_error",
+                             "the fabric never engaged (0 units "
+                             "applied from the external worker)")
+    finally:
+        stop.set()
+        worker.join(timeout=10.0)
+        pool.drain(10.0)
+        shutil.rmtree(root, ignore_errors=True)
+    return {"workload": "fabric", "k": k, "gates": gates,
+            "jobs": jobs, "units": int(units)}
+
+
 def run_daemon_capture(url: str, seconds: float) -> dict:
     """Submit a ``profile`` job to a live daemon and wait for the
     capture window to close; returns the job result (xprof log dir on

@@ -161,9 +161,22 @@ class ServiceConfig:
     # worker (the PR 7 behavior).
     shard_proves: int = 0
     # fan-out cap per sharded stage; the effective fan-out is
-    # min(shard_cap, pool workers), so 1 disables splitting even with
-    # shard_proves=1
+    # min(shard_cap, pool workers + live fabric workers), so 1
+    # disables splitting even with shard_proves=1
     shard_cap: int = 4
+    # cross-process proving fabric (opt-in, needs a state dir): 1 =
+    # sharded proves ALSO publish their units under
+    # <state-dir>/fabric/ so external `prove-worker` processes (same
+    # box via the filesystem, other boxes via the /fabric HTTP
+    # surface) lend silicon into one prove. In-process lending keeps
+    # priority; with no external worker registered the fabric costs
+    # nothing per prove.
+    fabric: int = 0
+    # seconds an external worker's unit lease (and its registration
+    # heartbeat window) lives without renewal before the unit is
+    # reclaimable — the bound on how long a rendezvous waits on a
+    # SIGKILLed worker
+    fabric_lease_ttl: float = 5.0
 
     # --- lifecycle --------------------------------------------------------
     drain_timeout: float = 30.0     # SIGTERM: budget to finish in-flight

@@ -45,6 +45,7 @@ so even a SIGKILL loses at most one poll's worth of re-fetchable logs.
 
 from __future__ import annotations
 
+import os
 import threading
 import time
 
@@ -223,6 +224,16 @@ class TrustService:
             # affinity (the pool's default)
             cache_key_fn = make_cache_key_fn(
                 self, shape_name=config.proof_shape)
+        # cross-process proving fabric (opt-in + needs durable state:
+        # the fabric directory IS the worker rendezvous substrate, and
+        # a memory-only daemon has no filesystem to share)
+        self.fabric = None
+        if config.fabric and state_dir:
+            from ..zk.fabric import FabricStore
+
+            self.fabric = FabricStore(
+                os.path.join(str(state_dir), "fabric"),
+                lease_ttl=config.fabric_lease_ttl, faults=self.faults)
         self.jobs = ProofWorkerPool(
             provers, capacity=config.queue_capacity, faults=self.faults,
             artifacts=self.store.artifacts if self.store else None,
@@ -237,7 +248,8 @@ class TrustService:
             # eigentrust/threshold ones
             shard_kinds=(set(provers) - PROOF_SHARD_EXEMPT
                          if config.shard_proves else None),
-            shard_cap=config.shard_cap)
+            shard_cap=config.shard_cap,
+            fabric=self.fabric)
         if self.store is not None:
             rehydrated = self.jobs.rehydrate()
             if rehydrated:
@@ -709,6 +721,18 @@ class TrustService:
         # alert on (ptpu_score_freshness_seconds)
         trace.gauge("score_freshness_seconds").set(
             self.score_freshness_seconds())
+        if self.fabric is not None:
+            # fabric fleet state is filesystem state, not samples —
+            # refreshed per scrape like freshness above. A stuck lease
+            # age (sawtooth never resetting) is the SIGKILLed-worker
+            # signature before leases_expired even moves.
+            try:
+                trace.gauge("fabric_workers").set(
+                    float(self.fabric.workers_live()))
+                trace.gauge("fabric_lease_age_seconds").set(
+                    float(self.fabric.oldest_lease_age()))
+            except Exception:  # noqa: BLE001 - scrape must not 500
+                pass
         out = {
             "service.up": 0.0 if self.draining else 1.0,
             "service.queue_depth": float(self.jobs.depth()),

@@ -33,6 +33,12 @@ Routes:
   carry the cursor protocol
 - ``GET /repl/snapshot``  leader only: the newest snapshot payload
   (npz) + its meta in headers — follower bootstrap
+- ``GET /fabric/units`` / ``GET /fabric/blob/<digest>`` /
+  ``POST /fabric/claims`` / ``POST /fabric/results/<id>`` /
+  ``POST /fabric/workers``  the cross-box face of the proving fabric
+  (``serve --fabric``): remote ``prove-worker`` processes poll
+  claimable units, fetch content-addressed payloads, lease/heartbeat,
+  and upload CRC-framed results (``zk/fabric.py::RemoteFabric``)
 
 ``/scores`` and ``/score/<addr>`` carry a strong revision-derived ETag
 and honor ``If-None-Match`` (304, headers only) on leader and follower
@@ -75,8 +81,13 @@ def _route_template(method: str, path: str) -> str:
     """Stable-cardinality route label: the template, never the raw
     path (addresses and job ids would explode the label space)."""
     if path in ("/healthz", "/status", "/scores", "/metrics", "/stages",
-                "/bundle", "/repl/wal", "/repl/snapshot"):
+                "/bundle", "/repl/wal", "/repl/snapshot",
+                "/fabric/units", "/fabric/claims", "/fabric/workers"):
         return path
+    if path.startswith("/fabric/blob/"):
+        return "/fabric/blob/{digest}"
+    if path.startswith("/fabric/results/"):
+        return "/fabric/results/{id}"
     if path.startswith("/score/"):
         return "/score/{addr}"
     if path.startswith("/proofs/") and path.endswith("/proof.bin"):
@@ -269,6 +280,26 @@ def make_server(service, host: str, port: int) -> ThreadingHTTPServer:
                         "X-Ptpu-Snapshot-Step": str(step),
                         "X-Ptpu-Snapshot-Meta": json.dumps(meta),
                     })
+            if path == "/fabric/units" or path.startswith("/fabric/blob/"):
+                # the cross-box face of the proving fabric: remote
+                # prove-workers poll the claimable units and fetch
+                # payload blobs by content digest (zk/fabric.py
+                # RemoteFabric is the client)
+                fabric = getattr(service, "fabric", None)
+                if fabric is None:
+                    return self._reply(
+                        404, {"error": "proving fabric disabled "
+                                       "(serve --fabric + a state dir)"})
+                if path == "/fabric/units":
+                    return self._reply(200,
+                                       {"units": fabric.list_units()})
+                digest = path[len("/fabric/blob/"):]
+                try:
+                    data = fabric.get_blob(digest)
+                except EigenError:
+                    return self._reply(404, {"error": "unknown blob"})
+                return self._reply(200, data,
+                                   content_type="application/octet-stream")
             if path.startswith("/proofs/") and path.endswith("/proof.bin"):
                 job_id = path[len("/proofs/"):-len("/proof.bin")]
                 data = service.proof_bytes(job_id)
@@ -293,6 +324,9 @@ def make_server(service, host: str, port: int) -> ThreadingHTTPServer:
             self._instrumented("POST", self._handle_post)
 
         def _handle_post(self, path: str):
+            if path in ("/fabric/claims", "/fabric/workers") \
+                    or path.startswith("/fabric/results/"):
+                return self._handle_fabric_post(path)
             if path != "/proofs":
                 return self._reply(404, {"error": f"no route {path}"})
             if service.jobs is None:
@@ -335,6 +369,51 @@ def make_server(service, host: str, port: int) -> ThreadingHTTPServer:
                                             "over_capacity") else 400)
                 return self._reply(status, {"error": str(e)})
             return self._reply(202, job.to_json())
+
+        def _handle_fabric_post(self, path: str):
+            """Worker-side fabric writes over HTTP: lease claims and
+            heartbeats (``/fabric/claims``), registration heartbeats
+            (``/fabric/workers``, ttl 0 unregisters) and framed result
+            uploads (``/fabric/results/{id}`` — raw octet-stream, the
+            store re-verifies the frame CRC at the rendezvous so a
+            truncated upload reads as missing, never as data)."""
+            fabric = getattr(service, "fabric", None)
+            if fabric is None:
+                return self._reply(
+                    404, {"error": "proving fabric disabled "
+                                   "(serve --fabric + a state dir)"})
+            length = int(self.headers.get("Content-Length", 0))
+            body = self.rfile.read(length) if length else b""
+            try:
+                if path.startswith("/fabric/results/"):
+                    unit_id = path[len("/fabric/results/"):]
+                    # commit the pre-framed bytes verbatim: re-framing
+                    # would launder a torn upload into a valid CRC
+                    fabric._write(
+                        fabric._path("results", unit_id + ".bin"), body)
+                    return self._reply(200, {"ok": True})
+                req = json.loads(body or b"{}")
+                if not isinstance(req, dict) or "worker" not in req:
+                    raise ValueError("body must carry worker")
+                worker = str(req["worker"])
+                ttl = float(req.get("ttl") or fabric.lease_ttl)
+                if path == "/fabric/workers":
+                    if ttl <= 0:
+                        fabric.unregister_worker(worker)
+                    else:
+                        fabric.register_worker(worker, ttl=ttl)
+                    return self._reply(200, {"ok": True})
+                unit_id = str(req.get("unit") or "")
+                if req.get("renew"):
+                    fabric.heartbeat(unit_id, worker, ttl=ttl)
+                    return self._reply(200, {"ok": True})
+                granted = fabric.claim(unit_id, worker, ttl=ttl)
+                return self._reply(200, {"granted": bool(granted)})
+            except (ValueError, KeyError) as e:
+                return self._reply(400, {"error": f"bad fabric "
+                                                  f"request: {e}"})
+            except EigenError as e:
+                return self._reply(400, {"error": str(e)})
 
         def log_message(self, *a):  # quiet (the tracer is the log)
             pass

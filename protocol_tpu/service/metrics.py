@@ -115,6 +115,10 @@ HISTOGRAM_FAMILIES = {
     # start) — the lending latency of the sharded proving fabric;
     # stage is the work-unit family (commit | quotient | open_fold)
     "prove_shard_wait_seconds": ("stage",),
+    # publish → applied-at-rendezvous wall of one unit executed by an
+    # EXTERNAL prove-worker process over the cross-process fabric —
+    # the remote twin of prove_shard_wait_seconds
+    "fabric_unit_seconds": ("stage",),
     # one follower replication poll: shipped-chunk fetch + local WAL
     # append + graph apply (the follower's ingest unit)
     "repl_poll_seconds": (),
@@ -129,13 +133,15 @@ DECLARED_COUNTERS = ("xla_compiles", "xla_steady_recompiles",
                      "proof_pool_shed", "proof_pool_affinity",
                      "proof_pool_stolen", "prove_shards",
                      "repl_chunks", "repl_records_shipped",
-                     "scenario_runs")
+                     "scenario_runs", "fabric_units",
+                     "fabric_leases_expired")
 DECLARED_GAUGES = ("converge_iterations", "converge_residual",
                    "proof_queue_depth", "dirty_rows",
                    "refresh_frontier_peak", "refresh_budget_spent",
                    "proof_pool_depth", "proof_pool_worker_depth",
                    "proof_pool_queued_bytes", "proof_pool_workers",
-                   "repl_lag_records", "repl_lag_seconds")
+                   "repl_lag_records", "repl_lag_seconds",
+                   "fabric_workers", "fabric_lease_age_seconds")
 
 
 def declare_instruments() -> None:

@@ -321,7 +321,18 @@ class _ShardRunner:
     admitted (and still holds exactly one depth slot), and a pool deep
     enough to shed has no idle workers to lend anyway. They are never
     persisted: SIGKILL mid-sharded-prove rehydrates ONE failed:lost
-    job, not N sub-records."""
+    job, not N sub-records.
+
+    Cross-process fabric (``zk/fabric.py``): when the pool carries a
+    fabric with live external ``prove-worker`` registrations, dispatch
+    ALSO publishes each unit's portable form — external processes race
+    the in-process lenders for the same units. The rendezvous prefers a
+    valid remote result (applied on the submitting thread, in
+    submission order, so placement never moves a transcript byte),
+    waits briefly on a LIVE external lease, and claims anything
+    unleased or lease-lapsed for local execution — a SIGKILLed fleet
+    degrades to the serial in-process order, never a hang (the lease
+    TTL bounds every wait)."""
 
     def __init__(self, pool: "ProofWorkerPool", job: ProofJob,
                  fanout: int):
@@ -330,34 +341,122 @@ class _ShardRunner:
         self.fanout = fanout
 
     def dispatch(self, units: list) -> None:
+        fabric = self.pool.fabric
+        if fabric is not None:
+            # publish BEFORE the units become claimable in-process: a
+            # local run frees commit scalars as it finishes, and the
+            # payload build must see pristine inputs. Best-effort and
+            # gated on live external workers — with none registered
+            # there is no serialization tax at all.
+            try:
+                live = fabric.workers_live()
+            except Exception:  # noqa: BLE001 - fabric is optional
+                live = 0
+            if live > 0:
+                for u in units:
+                    if u.portable is None:
+                        continue
+                    try:
+                        fabric.publish(self.job.job_id, u)
+                    except Exception:  # noqa: BLE001 - local path wins
+                        u.fabric_id = None
         with self.pool._lock:
             for u in units:
                 u.job_id = self.job.job_id
                 self.pool._shards.append(u)
             self.pool._wake.notify_all()
 
+    def _claim(self, u) -> bool:
+        """Claim ``u`` for this thread (off the lending deque); False
+        when a lent worker beat us to it."""
+        with self.pool._lock:
+            if u.claimed:
+                return False
+            u.claimed = True
+            try:
+                self.pool._shards.remove(u)
+            except ValueError:  # pragma: no cover - already
+                pass            # off the queue (racing pop)
+            return True
+
+    def _apply_remote(self, unit, remote) -> None:
+        """Fold an external worker's result into the unit on the
+        submitting thread. Emits the same ``prove.shard`` span/counter
+        the local run would — under the EXTERNAL worker's name, so
+        `obs --trace-id <job>` shows which process computed the unit.
+        ANY decode/apply failure falls back to the local closure:
+        execution is deterministic, so the overwrite is byte-safe."""
+        obj, worker_name = remote
+        t0 = time.perf_counter()
+        try:
+            with contextlib.ExitStack() as stack:
+                if unit.trace_ids:
+                    stack.enter_context(
+                        trace.context(trace_ids=unit.trace_ids))
+                stack.enter_context(trace.worker_context(worker_name))
+                with trace.span("prove.shard", stage=unit.stage,
+                                index=unit.index, remote=1):
+                    trace.counter("prove_shards").inc(stage=unit.stage)
+                    unit.result = unit.portable.apply(obj)
+            trace.counter("fabric_units").inc(stage=unit.stage)
+            trace.histogram("fabric_unit_seconds").observe(
+                time.perf_counter() - t0, stage=unit.stage)
+            unit.done.set()
+        except BaseException:  # noqa: BLE001 - remote is best-effort
+            trace.event("fabric.apply_failed", unit=unit.fabric_id,
+                        stage=unit.stage)
+            unit.run()
+
     def rendezvous(self, units: list) -> None:
         pool = self.pool
+        fabric = pool.fabric if any(u.fabric_id is not None
+                                    for u in units) else None
         while True:
-            unit = None
-            with pool._lock:
-                for u in units:
-                    if not u.claimed:
-                        u.claimed = True
-                        try:
-                            pool._shards.remove(u)
-                        except ValueError:  # pragma: no cover - already
-                            pass            # off the queue (racing pop)
-                        unit = u
-                        break
-            if unit is None:
+            progress = False
+            waiting = False
+            for u in units:
+                if u.done.is_set() or u.claimed:
+                    continue
+                remote = None
+                lease = "none"
+                if fabric is not None and u.fabric_id is not None:
+                    try:
+                        remote = fabric.try_result(u.fabric_id)
+                        if remote is None:
+                            lease = fabric.lease_state(u.fabric_id)
+                    except Exception:  # noqa: BLE001 - run locally
+                        remote, lease = None, "none"
+                if remote is None and lease == "live":
+                    # an external worker owns the lease: give it its
+                    # TTL — a dead worker's lease lapses and the next
+                    # pass reclaims the unit, so this never hangs
+                    waiting = True
+                    continue
+                if not self._claim(u):
+                    continue  # a lent worker took it meanwhile
+                if remote is not None:
+                    self._apply_remote(u, remote)
+                else:
+                    if lease == "expired":
+                        trace.counter("fabric_leases_expired").inc()
+                        with contextlib.suppress(Exception):
+                            fabric.clear_lease(u.fabric_id)
+                    u.run()
+                progress = True
+            if not waiting:
                 break
-            unit.run()
+            if not progress:
+                time.sleep(pool.fabric_poll)
         for u in units:
             # claimed by a lent worker: the worker always completes a
             # claimed unit (the claim and the run are not separated by
             # a stop check), so this join cannot hang on hard_kill
             u.done.wait()
+        if fabric is not None:
+            with contextlib.suppress(Exception):
+                for u in units:
+                    if u.fabric_id is not None:
+                        fabric.retire(u.fabric_id)
         err = next((u.error for u in units if u.error is not None), None)
         if err is not None:
             raise err
@@ -390,7 +489,9 @@ class ProofWorkerPool:
                  resident_keys: int = 2,
                  worker_env=None,
                  shard_kinds=None,
-                 shard_cap: int = 4):
+                 shard_cap: int = 4,
+                 fabric=None,
+                 fabric_poll: float = 0.05):
         self.provers = dict(provers)
         self.capacity = capacity
         self.artifacts = artifacts
@@ -408,6 +509,12 @@ class ProofWorkerPool:
         self.shard_kinds = frozenset(shard_kinds or ())
         self.shard_cap = int(shard_cap)
         self._shards: deque = deque()  # pending ShardUnits (all jobs)
+        # cross-process fabric (zk/fabric.py FabricStore or None):
+        # dispatch publishes portable units when external prove-worker
+        # processes are registered; fabric_poll paces the rendezvous's
+        # wait on a live external lease
+        self.fabric = fabric
+        self.fabric_poll = float(fabric_poll)
         devices = _detect_devices()
         # clamp: a negative/zero explicit count must not build an empty
         # pool (healthy daemon, every submit crashing in _route)
@@ -472,6 +579,12 @@ class ProofWorkerPool:
 
     def pool_status(self) -> dict:
         """Per-worker rows + admission state for ``GET /status``."""
+        fabric_row = None
+        if self.fabric is not None:
+            try:  # outside the lock: status() walks the fabric dir
+                fabric_row = self.fabric.status()
+            except Exception:  # noqa: BLE001
+                fabric_row = {"error": "unreadable"}
         with self._lock:
             return {
                 "workers": [w.status_row() for w in self.workers],
@@ -484,6 +597,7 @@ class ProofWorkerPool:
                          for (kind, tier), n in sorted(self.shed.items())},
                 "shard_kinds": sorted(self.shard_kinds),
                 "shards_pending": len(self._shards),
+                "fabric": fabric_row,
             }
 
     # --- admission --------------------------------------------------------
@@ -803,13 +917,28 @@ class ProofWorkerPool:
                     continue
                 self._run_job(w, job)
 
+    def _fabric_workers(self) -> int:
+        """Live external prove-worker registrations (0 without a
+        fabric). Best-effort: a fabric read failure must never stall
+        the scheduler — it just means no external fan-out this pass."""
+        if self.fabric is None:
+            return 0
+        try:
+            return int(self.fabric.workers_live())
+        except Exception:  # noqa: BLE001
+            return 0
+
     def _shard_scope(self, job: ProofJob):
         """The worker-lending runner for a shardable job's prover call
         (no-op context otherwise). Imported lazily: a pool with
         sharding off — every jax-less injected-prover test — never
-        touches the zk layer. Fan-out 1 (single worker) installs
-        nothing: splitting work for no one costs slice copies."""
-        fanout = min(self.shard_cap, len(self.workers))
+        touches the zk layer. Fan-out 1 (single worker, no external
+        fleet) installs nothing: splitting work for no one costs slice
+        copies. External fabric workers COUNT toward the fan-out — a
+        1-worker daemon with 4 registered prove-workers must fan past
+        1 or the fleet never receives a unit."""
+        fanout = min(self.shard_cap,
+                     len(self.workers) + self._fabric_workers())
         if job.kind not in self.shard_kinds or fanout <= 1:
             return contextlib.nullcontext()
         from ..zk.shards import shard_scope

@@ -61,10 +61,11 @@ class ShardUnit:
     the unit."""
 
     __slots__ = ("stage", "fn", "index", "job_id", "trace_ids",
-                 "result", "error", "claimed", "done", "submitted_at")
+                 "result", "error", "claimed", "done", "submitted_at",
+                 "portable", "fabric_id")
 
     def __init__(self, stage: str, fn, index: int,
-                 trace_ids: tuple = ()):
+                 trace_ids: tuple = (), portable=None):
         self.stage = stage
         self.fn = fn
         self.index = index
@@ -75,6 +76,12 @@ class ShardUnit:
         self.claimed = False
         self.done = threading.Event()
         self.submitted_at = time.perf_counter()
+        # cross-process face (zk/fabric.py): a PortableUnit the runner
+        # MAY publish so an external prove-worker can execute this unit;
+        # None keeps the unit thread-only. fabric_id is stamped by the
+        # fabric store at publish time.
+        self.portable = portable
+        self.fabric_id = None
 
     def run(self) -> None:
         """Execute the unit on the CURRENT thread (the submitting
@@ -128,7 +135,7 @@ def shard_scope(runner):
         _TLS.runner = prev
 
 
-def shard_map(stage: str, fns: list) -> list:
+def shard_map(stage: str, fns: list, portables: list | None = None) -> list:
     """Run ``fns`` and return their results in submission order.
 
     With a runner installed and more than one unit, the units are
@@ -136,11 +143,18 @@ def shard_map(stage: str, fns: list) -> list:
     through ``rendezvous`` (it claims whatever no lent worker took, so
     progress never depends on anyone lending). Without a runner this
     is a plain in-order loop — the pre-sharding code path, no trace
-    noise, no threading."""
+    noise, no threading.
+
+    ``portables`` (parallel to ``fns``, entries may be None) gives
+    units a serializable face: when the pool has external fabric
+    workers registered, a runner may publish those units over
+    ``zk/fabric.py`` so another PROCESS executes them. Results still
+    merge in submission order — placement never moves a byte."""
     runner = current_runner()
     if runner is None or len(fns) <= 1:
         return [fn() for fn in fns]
-    units = [ShardUnit(stage, fn, i, trace_ids=trace.current_trace_ids())
+    units = [ShardUnit(stage, fn, i, trace_ids=trace.current_trace_ids(),
+                       portable=portables[i] if portables else None)
              for i, fn in enumerate(fns)]
     runner.dispatch(units)
     runner.rendezvous(units)

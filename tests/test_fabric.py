@@ -1,0 +1,434 @@
+"""Cross-process proving fabric tests (``zk/fabric.py``): the unit
+wire format (framed CRC codec, content-addressed payloads, envelope
+round-trip), the lease/reclaim protocol, and the hard invariant — a
+prove sharded across REAL OS processes is byte-identical to the direct
+single-process ``prove_fast``, and a SIGKILLed external worker never
+hangs or corrupts the prove (lease expiry reclaims the unit)."""
+
+import os
+import random
+import signal
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from protocol_tpu.service import FaultInjector
+from protocol_tpu.service.pool import ProofWorkerPool
+from protocol_tpu.utils import trace
+from protocol_tpu.zk import fabric as fab
+from protocol_tpu.zk.fabric import FabricError, FabricStore, PortableUnit, Shared
+from protocol_tpu.zk.shards import ShardUnit
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+NO_FAULTS = FaultInjector({"rpc": 0.0, "device": 0.0, "disk": 0.0})
+
+
+def _wait(cond, timeout=30.0, what="condition"):
+    deadline = time.monotonic() + timeout
+    while not cond():
+        assert time.monotonic() < deadline, f"timed out waiting: {what}"
+        time.sleep(0.02)
+
+
+@pytest.fixture(autouse=True)
+def _register_echo_executor():
+    fab.EXECUTORS["echo"] = lambda p: {"value": p.get("arr")}
+    yield
+    fab.EXECUTORS.pop("echo", None)
+
+
+# --- wire format -------------------------------------------------------------
+
+def test_frame_roundtrip():
+    """Nested dicts/lists of JSON scalars + numpy arrays survive the
+    framed codec bit-exactly, with dtype/shape and header meta."""
+    obj = {
+        "arrays": {"a": np.arange(24, dtype="<u8").reshape(2, 3, 4),
+                   "b": np.ones(5, dtype=np.float64)},
+        "scalars": {"big": str(2**254 - 3), "n": 7},
+        "list": [1, "x", np.zeros((2, 4), dtype="<u8")],
+    }
+    out, meta = fab.unframe(fab.frame(obj, meta={"worker": "fw9"}))
+    assert meta["worker"] == "fw9"
+    assert (out["arrays"]["a"] == obj["arrays"]["a"]).all()
+    assert out["arrays"]["a"].dtype == np.dtype("<u8")
+    assert out["arrays"]["a"].shape == (2, 3, 4)
+    assert (out["arrays"]["b"] == 1.0).all()
+    assert int(out["scalars"]["big"]) == 2**254 - 3
+    assert out["list"][1] == "x"
+    # decoded arrays own their memory (executors mutate in place)
+    out["arrays"]["a"][0, 0, 0] = 99
+
+
+def test_frame_detects_torn_and_corrupt():
+    """Truncated, bit-flipped, and bad-magic frames all raise — a torn
+    result must read as MISSING, never as data."""
+    data = fab.frame({"x": np.arange(8, dtype="<u8")})
+    for bad in (data[:-3],                        # truncated tail
+                data[: len(data) // 2],           # torn mid-buffer
+                b"NOPE" + data[4:],               # bad magic
+                data[:-4] + b"\x00\x00\x00\x00",  # CRC flip
+                b"",
+                data[:10]):
+        with pytest.raises(FabricError):
+            fab.unframe(bad)
+    flipped = bytearray(data)
+    flipped[len(data) // 2] ^= 0xFF
+    with pytest.raises(FabricError):
+        fab.unframe(bytes(flipped))
+
+
+def _echo_unit(stage="quotient", seq=0):
+    """A ShardUnit with a trivially serializable portable (the module's
+    'echo' executor) — the wire format tested without native kernels."""
+    payload = {
+        "arr": np.arange(16, dtype="<u8").reshape(4, 4),
+        "shared": Shared(np.full((3, 4), 7, dtype="<u8")),
+        "tag": "t",
+    }
+    return ShardUnit(stage, lambda: "local", seq,
+                     portable=PortableUnit("echo", lambda: payload))
+
+
+def test_envelope_publish_claim_roundtrip(tmp_path):
+    """Publisher → filesystem → worker: the envelope carries (job id,
+    stage, seq, kind, payload digest), the payload round-trips through
+    the content-addressed blobs (shared arrays resolved by digest), and
+    the result record comes back CRC-verified with the worker name."""
+    store = FabricStore(str(tmp_path / "fabric"), lease_ttl=5.0)
+    unit = _echo_unit()
+    fid = store.publish("j1", unit)
+    assert unit.fabric_id == fid
+
+    envs = store.list_units()
+    assert len(envs) == 1
+    env = envs[0]
+    assert env["unit"] == fid
+    assert env["job_id"] == "j1"
+    assert env["stage"] == "quotient"
+    assert env["seq"] == 0
+    assert env["kind"] == "echo"
+
+    payload = store.load_payload(env)
+    assert (payload["arr"] == np.arange(16, dtype="<u8").reshape(4, 4)).all()
+    assert (payload["shared"] == 7).all()  # Shared ref resolved by digest
+    assert payload["tag"] == "t"
+
+    assert store.claim(fid, "fw0") is True
+    assert store.claim(fid, "fw1") is False  # live lease excludes
+    assert store.lease_state(fid) == "live"
+    result = fab.execute_unit(env, payload)
+    store.put_result(fid, result, "fw0")
+    got = store.try_result(fid)
+    assert got is not None
+    obj, worker = got
+    assert worker == "fw0"
+    assert (obj["value"] == payload["arr"]).all()
+    # a resulted unit is no longer claimable work
+    assert store.list_units() == []
+    store.retire(fid, list(env["shared"]) + [env["payload"]])
+    assert store.try_result(fid) is None
+
+
+def test_torn_result_reads_as_missing(tmp_path):
+    """A torn/corrupt result file fails the frame CRC and try_result
+    answers None — the rendezvous treats it as absent and recomputes
+    locally, never absorbing damaged bytes."""
+    store = FabricStore(str(tmp_path / "fabric"), lease_ttl=5.0)
+    fid = store.publish("j1", _echo_unit())
+    good = fab.frame({"value": 1}, meta={"unit": fid, "worker": "fw0"})
+    path = store._path("results", fid + ".bin")
+    with open(path, "wb") as f:
+        f.write(good[: len(good) // 2])  # torn mid-frame
+    assert store.try_result(fid) is None
+    with open(path, "wb") as f:
+        f.write(b"garbage that is not a frame at all")
+    assert store.try_result(fid) is None
+    with open(path, "wb") as f:
+        f.write(good)
+    assert store.try_result(fid) is not None
+
+
+def test_duplicate_result_idempotent(tmp_path):
+    """Two workers racing one reclaimed unit: the loser's takeover of
+    an EXPIRED lease succeeds, both publish results, and the committed
+    record stays a single valid frame (execution is deterministic and
+    os.replace atomic — last writer wins with identical bytes)."""
+    store = FabricStore(str(tmp_path / "fabric"), lease_ttl=0.05)
+    fid = store.publish("j1", _echo_unit())
+    assert store.claim(fid, "fw0", ttl=0.05) is True
+    time.sleep(0.1)  # fw0 "dies": its lease lapses
+    assert store.lease_state(fid) == "expired"
+    assert store.claim(fid, "fw1", ttl=5.0) is True  # takeover
+    # both racers publish the (deterministic) result
+    store.put_result(fid, {"value": 42}, "fw0")
+    store.put_result(fid, {"value": 42}, "fw1")
+    obj, worker = store.try_result(fid)
+    assert obj["value"] == 42
+    assert worker in ("fw0", "fw1")
+
+
+def test_worker_registry_and_lease_age(tmp_path):
+    store = FabricStore(str(tmp_path / "fabric"), lease_ttl=5.0)
+    assert store.workers_live() == 0
+    store.register_worker("fw0", ttl=5.0)
+    store.register_worker("fw1", ttl=0.01)
+    time.sleep(0.05)
+    store._workers_cache = (0.0, 0)  # bust the freshness cache
+    assert store.workers_live() == 1  # fw1's heartbeat lapsed
+    assert store.oldest_lease_age() == 0.0
+    fid = store.publish("j1", _echo_unit())
+    store.claim(fid, "fw0", ttl=5.0)
+    time.sleep(0.05)
+    assert store.oldest_lease_age() > 0.0
+    store.unregister_worker("fw0")
+    store._workers_cache = (0.0, 0)
+    assert store.workers_live() == 0
+
+
+def test_execute_unit_unknown_kind():
+    with pytest.raises(FabricError):
+        fab.execute_unit({"kind": "no-such-kind"}, {})
+
+
+# --- scheduling: fan-out counts the external fleet (satellite fix) ----------
+
+def test_fanout_counts_live_fabric_workers(tmp_path):
+    """Regression for the fan-out bug: a 1-worker pool used to compute
+    fanout = min(shard_cap, len(workers)) = 1 and never install a shard
+    runner, so a registered external fleet NEVER received a unit. Live
+    fabric registrations must count toward the fan-out."""
+    trace.enable()
+    store = FabricStore(str(tmp_path / "fabric"), lease_ttl=5.0)
+
+    def prove(params):
+        from protocol_tpu.zk.shards import shard_map
+        return {"vals": shard_map("quotient", [lambda: 1, lambda: 2])}
+
+    pool = ProofWorkerPool({"eigentrust": prove}, capacity=8, workers=1,
+                           faults=NO_FAULTS,
+                           shard_kinds={"eigentrust"}, shard_cap=4,
+                           fabric=store)
+    pool.start()
+    try:
+        # no external workers: fan-out 1, shard_map runs inline
+        s0 = trace.counter_total("prove_shards")
+        job = pool.submit("eigentrust", {})
+        _wait(lambda: pool.get(job.job_id).status in ("done", "failed"))
+        assert pool.get(job.job_id).result == {"vals": [1, 2]}
+        assert trace.counter_total("prove_shards") - s0 == 0
+
+        # one live external registration: fan-out 2, runner installed
+        store.register_worker("fw-ext", ttl=30.0)
+        store._workers_cache = (0.0, 0)
+        s0 = trace.counter_total("prove_shards")
+        job = pool.submit("eigentrust", {})
+        _wait(lambda: pool.get(job.job_id).status in ("done", "failed"))
+        got = pool.get(job.job_id)
+        assert got.status == "done", got.error
+        assert got.result == {"vals": [1, 2]}
+        assert trace.counter_total("prove_shards") - s0 >= 2
+    finally:
+        pool.drain(5.0)
+
+
+# --- real proves across real processes --------------------------------------
+
+@pytest.fixture(scope="module")
+def fabric_prove_setup():
+    from protocol_tpu import native
+    from protocol_tpu.utils.fields import BN254_FR_MODULUS as R
+    from protocol_tpu.zk import prover_fast as pf
+    from protocol_tpu.zk.plonk import ConstraintSystem
+
+    if not native.available():
+        pytest.skip("native toolchain unavailable")
+    rng = random.Random(7)
+    cs = ConstraintSystem(lookup_bits=6)
+    for _ in range(24):
+        a, b = rng.randrange(50), rng.randrange(50)
+        cs.add_row([a, b, (a * b + a) % R], q_a=1, q_mul_ab=1, q_c=R - 1)
+    cs.public_input(12345)
+    cs.check_satisfied()
+    params = pf.setup_params_fast(7, seed=b"fabric")
+    pk = pf.keygen_fast(params, cs)
+    reference = pf.prove_fast(params, pk, cs, randint=lambda: 424242)
+    return pf, params, pk, cs, reference
+
+
+def _fabric_pool(pf, params, pk, cs, store):
+    def prove(p):
+        return {"proof": pf.prove_fast(
+            params, pk, cs, randint=lambda: 424242).hex()}
+
+    return ProofWorkerPool(
+        {"eigentrust": prove}, capacity=8, workers=1, faults=NO_FAULTS,
+        shard_kinds={"eigentrust"}, shard_cap=4,
+        worker_env=lambda w: pf.worker_isolation(w.name, w.device),
+        fabric=store)
+
+
+def _spawn_worker(state_dir, name, extra_env=None, lease_ttl="5",
+                  idle_exit="120"):
+    env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=REPO,
+               **(extra_env or {}))
+    return subprocess.Popen(
+        [sys.executable, "-m", "protocol_tpu.cli",
+         "--assets", os.path.join(str(state_dir), "assets"),
+         "prove-worker", "--state-dir", str(state_dir),
+         "--name", name, "--poll", "0.02",
+         "--lease-ttl", lease_ttl, "--idle-exit", idle_exit],
+        cwd=REPO, env=env,
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT)
+
+
+def _wait_workers_live(store, n, timeout=90.0):
+    def live():
+        store._workers_cache = (0.0, 0)
+        return store.workers_live() >= n
+    _wait(live, timeout, f"{n} external workers registered")
+
+
+def _run_prove(pool, timeout=240.0):
+    job = pool.submit("eigentrust", {})
+    _wait(lambda: pool.get(job.job_id).status in ("done", "failed"),
+          timeout, "fabric prove terminal")
+    got = pool.get(job.job_id)
+    assert got.status == "done", got.error
+    return got
+
+
+def test_cross_process_prove_byte_identical(fabric_prove_setup, tmp_path):
+    """THE tentpole invariant: a prove sharded across 2 real OS
+    processes (prove-worker subprocesses sharing only the filesystem
+    under ``<state-dir>/fabric/``) produces a transcript byte-identical
+    to the direct prove_fast, and at least one unit was actually
+    executed by an external process."""
+    pf, params, pk, cs, reference = fabric_prove_setup
+    trace.enable()
+    store = FabricStore(str(tmp_path / "fabric"), lease_ttl=5.0)
+    pool = _fabric_pool(pf, params, pk, cs, store)
+    pool.start()
+    procs = [_spawn_worker(tmp_path, f"fw{i}") for i in range(2)]
+    try:
+        _wait_workers_live(store, 2)
+        u0 = trace.counter_total("fabric_units")
+        got = _run_prove(pool)
+        assert bytes.fromhex(got.result["proof"]) == reference, \
+            "cross-process proof diverged from direct prove_fast"
+        assert trace.counter_total("fabric_units") - u0 > 0, \
+            "no unit was executed by an external process"
+        status = pool.pool_status()["fabric"]
+        assert status["units_published"] > 0
+    finally:
+        pool.drain(5.0)
+        for p in procs:
+            p.terminate()
+            p.communicate(timeout=30)
+
+
+def test_sigkill_worker_mid_unit_reclaims(tmp_path):
+    """The lease-expiry fault path: an external worker claims a unit,
+    stalls (PTPU_FABRIC_TEST_STALL), and is SIGKILLed mid-unit — with
+    PTPU_FAULT_DISK tearing its fabric writes for good measure. The
+    prove must still complete with the exact in-process result (the
+    lapsed lease is reclaimed and the unit runs locally, never a hang)
+    and ptpu_fabric_leases_expired_total must move.
+
+    The sharded job is arranged so the external claim deterministically
+    lands on a unit the submitting thread has not reached: unit 0 is
+    local-only (no portable — invisible to the fleet) and its closure
+    parks on a gate, so the worker's first claim — list order — falls
+    on unit 1 while the rendezvous is still inside unit 0. A real
+    prove's sub-millisecond units lose that race to the submitting
+    thread and the lease path would go silently unexercised."""
+    trace.enable()
+    store = FabricStore(str(tmp_path / "fabric"), lease_ttl=1.0)
+    gate = threading.Event()
+
+    def prove(p):
+        from protocol_tpu.zk.shards import shard_map
+
+        def gated():
+            gate.wait(timeout=120)
+            return 0
+
+        return {"vals": shard_map(
+            "quotient", [gated, lambda: 1, lambda: 2],
+            portables=[None,
+                       PortableUnit("echo", lambda: {"arr": 1}),
+                       PortableUnit("echo", lambda: {"arr": 2})])}
+
+    pool = ProofWorkerPool({"eigentrust": prove}, capacity=8, workers=1,
+                           faults=NO_FAULTS,
+                           shard_kinds={"eigentrust"}, shard_cap=4,
+                           fabric=store)
+    pool.start()
+    proc = _spawn_worker(
+        tmp_path, "fw-doomed", lease_ttl="1",
+        extra_env={"PTPU_FABRIC_TEST_STALL": "300",
+                   "PTPU_FAULT_DISK": "0.4", "PTPU_FAULT_SEED": "3"})
+    try:
+        _wait_workers_live(store, 1)
+        e0 = trace.counter_total("fabric_leases_expired")
+        job = pool.submit("eigentrust", {})
+
+        # SIGKILL the worker the moment it holds a lease (it stalls
+        # between claim and execute, so the unit is mid-flight)
+        leases = os.path.join(store.root, "leases")
+        _wait(lambda: any(n.endswith(".json") for n in os.listdir(leases)),
+              timeout=240, what="external worker claimed a unit")
+        os.kill(proc.pid, signal.SIGKILL)
+        gate.set()  # release unit 0; the rendezvous now meets the lease
+
+        _wait(lambda: pool.get(job.job_id).status in ("done", "failed"),
+              timeout=240, what="prove terminal after worker SIGKILL")
+        got = pool.get(job.job_id)
+        assert got.status == "done", got.error
+        assert got.result == {"vals": [0, 1, 2]}, \
+            "result diverged after mid-unit worker SIGKILL"
+        assert trace.counter_total("fabric_leases_expired") - e0 >= 1, \
+            "lease expiry was never observed"
+    finally:
+        pool.drain(5.0)
+        proc.wait(timeout=30)
+
+
+def test_remote_result_applied_with_worker_label(fabric_prove_setup,
+                                                 tmp_path):
+    """A remotely-executed unit lands as a ``prove.shard`` span under
+    the EXTERNAL worker's name with ``remote: 1`` — the observability
+    contract the smoke greps — and the executors are bit-exact (bytes
+    asserted via the whole proof)."""
+    pf, params, pk, cs, reference = fabric_prove_setup
+    from protocol_tpu.zk.fabric import run_worker
+
+    trace.enable()
+    store = FabricStore(str(tmp_path / "fabric"), lease_ttl=5.0)
+    pool = _fabric_pool(pf, params, pk, cs, store)
+    pool.start()
+    stop = threading.Event()
+    wt = threading.Thread(target=run_worker, args=(store, "fw-inproc"),
+                          kwargs={"poll": 0.01, "stop": stop}, daemon=True)
+    wt.start()
+    try:
+        _wait_workers_live(store, 1)
+        u0 = trace.counter_total("fabric_units")
+        n0 = len(trace.TRACER.spans)
+        got = _run_prove(pool)
+        assert bytes.fromhex(got.result["proof"]) == reference
+        assert trace.counter_total("fabric_units") - u0 > 0
+        remote_spans = [
+            s for s in list(trace.TRACER.spans)[n0:]
+            if s.name == "prove.shard" and s.fields.get("remote") == 1]
+        assert remote_spans, "no remote prove.shard span recorded"
+        assert all(s.fields.get("worker") == "fw-inproc"
+                   for s in remote_spans)
+    finally:
+        stop.set()
+        wt.join(timeout=10)
+        pool.drain(5.0)

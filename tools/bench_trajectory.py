@@ -55,6 +55,9 @@ ROUND_NOTES = {
     12: "scenario harness + semiring seam: 18-cell robustness matrix "
         "all within the damped bound, topic-batch plan builds 8->1 "
         "(CPU wall ceiling 1.13x)",
+    13: "cross-process proving fabric: external prove-worker processes "
+        "lend into a prove, 1.64x flagship wall at 2 workers, "
+        "byte-identical transcripts + SIGKILL lease reclaim",
 }
 
 

@@ -56,6 +56,11 @@ echo "serve_smoke --restart --churn --replica: rc=${smoke_rc}"
 # SHARDED_PROVE_OK asserts one live-daemon prove (shard_proves=1)
 # fanned its work units across BOTH pool workers with proof bytes
 # identical to a direct single-worker prove.
+# FABRIC_OK asserts the cross-process fabric: a REAL prove-worker
+# subprocess (serve fabric=1, <state-dir>/fabric) executed at least
+# one unit of a live-daemon prove (prove.shard spans with the external
+# worker's name and remote=1) with proof bytes identical to the direct
+# prove and the ptpu_fabric_* series live on /metrics.
 # SCENARIO_OK asserts adversarial-churn honesty: a sybil-ring burst
 # through the live delta/ladder path with served scores held within
 # the daemon's DECLARED refresh_error_budget of the full-recompute
@@ -75,9 +80,10 @@ grep -q SCRAPE_LINT_OK /tmp/_smoke.log \
     && grep -q PROOF_POOL_OK /tmp/_smoke.log \
     && grep -q COMMIT_PIPE_OK /tmp/_smoke.log \
     && grep -q SHARDED_PROVE_OK /tmp/_smoke.log \
+    && grep -q FABRIC_OK /tmp/_smoke.log \
     && grep -q REPLICA_OK /tmp/_smoke.log \
     && grep -q "DELTA_OK" /tmp/_smoke.log && lint_rc=0
-echo "scrape-lint + trace-join + device-obs + delta + sublinear + pool + commit + sharded + replica: rc=${lint_rc}"
+echo "scrape-lint + trace-join + device-obs + delta + sublinear + pool + commit + sharded + fabric + replica: rc=${lint_rc}"
 
 # opt-in perf-regression gate (PTPU_PERF_GATE=1): per-stage timings of
 # the instrumented prove/refresh workloads vs tools/perf_baseline.json.

@@ -49,6 +49,7 @@ SUBLINEAR_KW = {"n": 3000, "m": 4}
 PROOFS_KW = {"k": 7, "gates": 64, "jobs": 6, "workers": 2}
 COMMITS_KW = {"k": 13, "columns": 8}
 SHARDED_KW = {"k": 7, "gates": 64, "jobs": 3, "workers": 2}
+FABRIC_KW = {"k": 7, "gates": 64, "jobs": 3}
 SCENARIO_KW = {"peers": 4000, "seed": 23}
 
 
@@ -59,6 +60,7 @@ def _run_once() -> dict:
         fold_prover_stages,
         run_commits_workload,
         run_delta_workload,
+        run_fabric_workload,
         run_proofs_workload,
         run_prove_workload,
         run_refresh_workload,
@@ -115,6 +117,13 @@ def _run_once() -> dict:
     # fan-out serialization grows the total/shard-span times
     measure("sharded", lambda: run_sharded_workload(**SHARDED_KW),
             ("service.proof", "prove.shard"))
+    # the cross-process fabric: proves whose units are serialized to a
+    # FabricStore and executed by an external worker loop, byte parity
+    # asserted inside the workload — a publish/claim/rendezvous stall
+    # or a serialization blow-up grows the total and the fabric.unit /
+    # prove.shard span times against the baseline
+    measure("fabric", lambda: run_fabric_workload(**FABRIC_KW),
+            ("service.proof", "prove.shard", "fabric.unit"))
     # the adversarial scenario harness: one seeded sybil-ring run per
     # semiring through the ConvergeBackend seam — the generalized sweep
     # kernel slowing down, or the seam forcing a per-semiring recompile,
@@ -148,6 +157,7 @@ def run_workloads(runs: int) -> dict:
                             "commits": COMMITS_KW,
                             "sublinear": SUBLINEAR_KW,
                             "sharded": SHARDED_KW,
+                            "fabric": FABRIC_KW,
                             "scenario": SCENARIO_KW},
         "runs": runs,
         "workloads": best,

@@ -193,7 +193,11 @@ def inprocess_phase(node_url, chain, step) -> None:
                                   # sharded phase lends them to one
                                   # prove's work units
                                   pool_workers=2, queue_capacity=32,
-                                  shard_proves=1),
+                                  # fabric=1: publish sharded work
+                                  # units under state/fabric so the
+                                  # fabric phase's real prove-worker
+                                  # subprocess can lend into a prove
+                                  shard_proves=1, fabric=1),
             os.path.join(tmp, "cursor"),
             provers=pool_provers,
             faults=FaultInjector({"rpc": 0.0, "device": 0.0, "disk": 0.0}),
@@ -285,6 +289,10 @@ def inprocess_phase(node_url, chain, step) -> None:
 
         # --- intra-prove sharding: one prove across both workers ----------
         sharded_prove_phase(url, prove_refs, step)
+
+        # --- cross-process fabric: an external prove-worker lends in ------
+        fabric_prove_phase(url, prove_refs, os.path.join(tmp, "state"),
+                           step)
 
         # --- end-to-end trace join over the JSONL stream ------------------
         trace_join_phase(trace_path, chain, step)
@@ -906,6 +914,102 @@ def sharded_prove_phase(url, refs, step) -> None:
         f"no worker ever lent (shards_run all zero): {rows}"
     step(f"SHARDED_PROVE_OK (job {both} sharded across both workers, "
          f"{int(shards)} shard units total, bytes == direct prove)")
+
+
+def fabric_prove_phase(url, refs, state_dir, step) -> None:
+    """Cross-process lending on the LIVE daemon (``fabric=1``): a REAL
+    ``prove-worker`` subprocess polling ``<state-dir>/fabric`` must
+    execute at least one of a sharded prove's units — the job's
+    ``prove.shard`` spans carry the EXTERNAL worker's name with
+    ``remote=1`` — with proof bytes equal to the direct single-worker
+    reference and the fabric counters live on /metrics → ``FABRIC_OK``.
+    Which process wins each unit is a race (the daemon's own workers
+    claim whatever the fleet is slow to take), so a few proves may be
+    needed before one lands remotely — every attempt's bytes are
+    checked."""
+    import json as _json
+    import subprocess
+    import urllib.request
+
+    from protocol_tpu import native
+    from protocol_tpu.utils import trace
+
+    if not native.available():
+        step("FABRIC_OK (skipped: no native toolchain — pool provers "
+             "are sleepers, nothing shards)")
+        return
+
+    env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=REPO)
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "protocol_tpu.cli",
+         "--assets", os.path.join(state_dir, "assets"),
+         "prove-worker", "--state-dir", state_dir,
+         "--name", "fw-smoke", "--poll", "0.02"],
+        cwd=REPO, env=env,
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT)
+
+    def submit(kind):
+        req = urllib.request.Request(
+            url + "/proofs", method="POST",
+            data=_json.dumps({"kind": kind, "params": {}}).encode(),
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=30) as r:
+            assert r.status == 202, f"fabric submit got {r.status}"
+            return _json.loads(r.read())["job_id"]
+
+    try:
+        deadline = time.monotonic() + 90
+        while time.monotonic() < deadline:
+            row = _get_json(url, "/status")["pool"].get("fabric") or {}
+            if row.get("workers_live", 0) >= 1:
+                break
+            time.sleep(0.2)
+        else:
+            raise AssertionError(
+                "prove-worker subprocess never registered with the "
+                "daemon's fabric")
+
+        remote_job = None
+        tried = []
+        for _attempt in range(8):
+            jid = submit("sharded")
+            stall = time.monotonic() + 120
+            job = None
+            while time.monotonic() < stall:
+                job = _get_json(url, f"/proofs/{jid}")
+                if job["status"] in ("done", "failed"):
+                    break
+                time.sleep(0.1)
+            assert job is not None and job["status"] == "done", job
+            assert job["result"]["proof"] == refs["sharded"], \
+                f"{jid}: proof bytes diverged with the fabric active"
+            remote = {r.fields.get("worker") for r in trace.TRACER.spans
+                      if jid in r.trace_ids and r.name == "prove.shard"
+                      and r.fields.get("remote") == 1}
+            tried.append((jid, sorted(w for w in remote if w)))
+            if "fw-smoke" in remote:
+                remote_job = jid
+                break
+        assert remote_job is not None, \
+            f"no unit ever executed by the external worker: {tried}"
+
+        metrics = _get_json(url, "/metrics")
+        units = _series_sum(metrics, "ptpu_fabric_units_total")
+        assert units > 0, "ptpu_fabric_units_total absent or zero"
+        assert "ptpu_fabric_workers" in metrics, \
+            "fabric worker gauge missing from /metrics"
+        assert "ptpu_fabric_unit_seconds" in metrics, \
+            "fabric unit histogram family missing from /metrics"
+    finally:
+        proc.terminate()
+        try:
+            proc.communicate(timeout=30)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+            proc.communicate()
+    step(f"FABRIC_OK (job {remote_job}: units executed by the external "
+         f"prove-worker process, {int(units)} fabric units total, "
+         f"bytes == direct prove)")
 
 
 def _counter_total(name) -> float:
