@@ -219,6 +219,12 @@ def build_parser() -> argparse.ArgumentParser:
              "daemon's stream) and render its span-aggregate summary "
              "(count/p50/p95 per stage)")
     p.add_argument("path", help="JSONL trace stream to read")
+    p.add_argument("--jsonl", action="append", default=[],
+                   metavar="PATH", dest="extra_jsonl",
+                   help="merge additional JSONL streams into the view "
+                        "(repeatable) — e.g. a prove-worker's --trace "
+                        "stream joined with the leader's, so one job's "
+                        "trace id chains across processes")
     p.add_argument("--follow", action="store_true",
                    help="tail the stream, printing records as they land "
                         "(Ctrl-C to stop)")
@@ -227,6 +233,29 @@ def build_parser() -> argparse.ArgumentParser:
                         "(attestation digest prefix, job id — including "
                         "its prover-stage spans and the pool worker "
                         "that executed them, request id)")
+
+    p = sub.add_parser(
+        "fleet",
+        help="fleet observability: render a live leader's /fleet "
+             "registry as an operator table — one row per known "
+             "instance (leader, followers, prove-workers) with role, "
+             "freshness, repl lag and report age; dead instances stay "
+             "listed (staleness-honest), flagged inactive")
+    p.add_argument("--url", required=True,
+                   help="leader daemon base URL (http://host:port)")
+    p.add_argument("--json", action="store_true",
+                   help="print the raw /fleet JSON instead of the table")
+
+    p = sub.add_parser(
+        "slo",
+        help="SLO burn rates: render a live daemon's /slo evaluation — "
+             "per-objective fast/slow-window burn, in-budget flags and "
+             "latched alerts; exits 1 while any alert is latched")
+    p.add_argument("--url", required=True,
+                   help="daemon base URL (http://host:port) — leader "
+                        "or follower (each evaluates its own SLOs)")
+    p.add_argument("--json", action="store_true",
+                   help="print the raw /slo JSON instead of the table")
 
     p = sub.add_parser(
         "profile",
@@ -1050,12 +1079,37 @@ def handle_prove_worker(args, files, config):
             signal.signal(sig, lambda *_: stop.set())
         except (ValueError, OSError):  # non-main thread / platform
             pass
+    # fleet observability: workers used to emit NOTHING — now every
+    # span/event carries instance/role, ptpu_build_info is up from the
+    # first scrape, and a telemetry pusher ships the instrument state
+    # + recent span window to the leader (HTTP in --url mode, atomic
+    # file drop under <state-dir>/fabric/telemetry otherwise — the
+    # leader's observer thread sweeps the drop dir)
+    from ..service.telemetry import TelemetryPusher, set_build_info
+    from ..utils import trace as _trace
+
+    if not _trace.TRACER.enabled:
+        _trace.enable()  # in-memory: telemetry needs the instruments
+    set_build_info(name, "prove-worker")
+    telemetry_interval = float(
+        _os.environ.get("PTPU_SERVE_TELEMETRY_INTERVAL", "2.0") or 2.0)
+    target = args.url if args.url else str(Path(where) / "telemetry")
+    pusher = TelemetryPusher(
+        target, name, "prove-worker", interval=telemetry_interval,
+        summary=lambda: {"polling": where,
+                         "lease_ttl": args.lease_ttl})
+    threading.Thread(target=pusher.run, args=(stop,), daemon=True,
+                     name="ptpu-telemetry").start()
     print(f"prove-worker {name} polling {where} "
           f"(lease ttl {args.lease_ttl:g}s)", flush=True)
     executed = run_worker(fabric, name, poll=args.poll,
                           lease_ttl=args.lease_ttl,
                           max_units=args.max_units,
                           idle_exit=args.idle_exit, stop=stop)
+    stop.set()
+    # one farewell push so the final units' spans/instruments ship
+    # even on a quick exit (best-effort, like every push)
+    pusher.push_once()
     print(f"prove-worker {name} exiting after {executed} units",
           flush=True)
     return 0
@@ -1097,6 +1151,45 @@ def handle_obs(args, files, config):
     durations: dict = {}  # per-stage duration samples for p50/p95
     counts = {"span": 0, "event": 0, "metric": 0}
     chain: list = []
+
+    def ingest(obj) -> None:
+        counts[obj["type"]] += 1
+        if obj["type"] == "span":
+            a = agg.setdefault(obj["name"],
+                               {"count": 0, "total_s": 0.0,
+                                "max_s": 0.0})
+            a["count"] += 1
+            a["total_s"] += obj["duration_s"]
+            a["max_s"] = max(a["max_s"], obj["duration_s"])
+            # bounded per-name sample window for the percentile
+            # columns (a daemon stream can hold millions of spans;
+            # deque(maxlen) keeps the append O(1))
+            if obj["name"] not in durations:
+                durations[obj["name"]] = deque(maxlen=16384)
+            durations[obj["name"]].append(obj["duration_s"])
+        if args.trace_id and matches(obj, args.trace_id):
+            chain.append(obj)
+
+    # merged streams (--jsonl, repeatable): other processes' trace
+    # files fold into the same aggregate + chain view — the
+    # cross-process trace join (worker spans carry instance/role)
+    for extra in args.extra_jsonl:
+        try:
+            ef = open(extra)
+        except OSError as e:
+            raise EigenError("file_io_error",
+                             f"cannot open trace stream: {e}") from e
+        with ef:
+            e_lineno = 0
+            for line in ef:
+                e_lineno += 1
+                before = len(invalid)
+                obj = parse(line, e_lineno, invalid)
+                if obj is None:
+                    if len(invalid) > before:
+                        invalid[-1] = f"{extra} {invalid[-1]}"
+                    continue
+                ingest(obj)
     try:
         f = open(args.path)
     except OSError as e:
@@ -1109,24 +1202,10 @@ def handle_obs(args, files, config):
             obj = parse(line, lineno, invalid)
             if obj is None:
                 continue
-            counts[obj["type"]] += 1
-            if obj["type"] == "span":
-                a = agg.setdefault(obj["name"],
-                                   {"count": 0, "total_s": 0.0,
-                                    "max_s": 0.0})
-                a["count"] += 1
-                a["total_s"] += obj["duration_s"]
-                a["max_s"] = max(a["max_s"], obj["duration_s"])
-                # bounded per-name sample window for the percentile
-                # columns (a daemon stream can hold millions of spans;
-                # deque(maxlen) keeps the append O(1))
-                if obj["name"] not in durations:
-                    durations[obj["name"]] = deque(maxlen=16384)
-                durations[obj["name"]].append(obj["duration_s"])
-            if args.trace_id and matches(obj, args.trace_id):
-                chain.append(obj)
+            ingest(obj)
 
-        print(f"{args.path}: {counts['span']} span(s), "
+        shown = ", ".join([args.path, *args.extra_jsonl])
+        print(f"{shown}: {counts['span']} span(s), "
               f"{counts['event']} event(s), {counts['metric']} "
               f"metric(s), {len(invalid)} invalid record(s)")
         for msg in invalid[:20]:
@@ -1164,8 +1243,13 @@ def handle_obs(args, files, config):
                 # proof job's prover stages
                 who = (f" worker={obj['worker']}"
                        if obj.get("worker") else "")
+                # fleet attribution: which PROCESS emitted the record
+                # (merged streams / shipped span windows carry it)
+                inst = (f" instance={obj['instance']}"
+                        if obj.get("instance") else "")
+                rem = " remote=1" if obj.get("remote") else ""
                 print(f"  {obj.get('ts', 0.0):.6f} {obj['type']:<6} "
-                      f"{obj['name']}{dur}{ids}{who}")
+                      f"{obj['name']}{dur}{ids}{who}{inst}{rem}")
 
         if args.follow:
             print("following (Ctrl-C to stop)...", file=sys.stderr)
@@ -1280,6 +1364,78 @@ def handle_store(args, files, config):
     return 0
 
 
+def _fetch_json(url: str, path: str, timeout: float = 10.0):
+    import urllib.error
+    import urllib.request
+
+    try:
+        with urllib.request.urlopen(url.rstrip("/") + path,
+                                    timeout=timeout) as resp:
+            return json.loads(resp.read())
+    except (urllib.error.URLError, OSError, ValueError) as e:
+        raise EigenError("network_error",
+                         f"cannot fetch {path} from {url}: {e}") from e
+
+
+def _fmt_cell(value, unit: str = "") -> str:
+    if value is None:
+        return "-"  # no data (pre-publish sentinel): honest, not -1
+    if isinstance(value, float):
+        return f"{value:.2f}{unit}"
+    return f"{value}{unit}"
+
+
+def handle_fleet(args, files, config):
+    """Render a leader's /fleet registry as an operator table: one
+    row per known instance, dead ones flagged, never dropped."""
+    fleet = _fetch_json(args.url, "/fleet")
+    if args.json:
+        print(json.dumps(fleet, indent=2))
+        return 0
+    rows = fleet.get("instances", [])
+    counts = fleet.get("counts", {})
+    print(f"fleet @ {args.url}: {counts.get('active', 0)}/"
+          f"{counts.get('total', 0)} active "
+          f"(ttl {fleet.get('ttl_seconds', 0):g}s) "
+          f"roles={counts.get('by_role', {})}")
+    width = max([len(r.get("instance", "")) for r in rows] + [8])
+    print(f"{'instance':<{width}}  {'role':<12} {'up':<4} "
+          f"{'report_age':>10}  {'freshness':>9}  {'repl_lag':>8}")
+    for r in rows:
+        print(f"{r.get('instance', '?'):<{width}}  "
+              f"{r.get('role', '?'):<12} "
+              f"{'up' if r.get('active') else 'DEAD':<4} "
+              f"{_fmt_cell(r.get('report_age_seconds'), 's'):>10}  "
+              f"{_fmt_cell(r.get('score_freshness_seconds'), 's'):>9}  "
+              f"{_fmt_cell(r.get('repl_lag_seconds'), 's'):>8}")
+    return 0
+
+
+def handle_slo(args, files, config):
+    """Render a daemon's /slo evaluation; exit 1 while any alert is
+    latched (scriptable: the smoke and a pager check share it)."""
+    slo = _fetch_json(args.url, "/slo")
+    if args.json:
+        print(json.dumps(slo, indent=2))
+        return 1 if slo.get("alerting") else 0
+    rows = slo.get("slos", [])
+    print(f"slo @ {args.url}: {len(rows)} objective(s), "
+          f"alerts={slo.get('alerts', [])}")
+    if rows:
+        width = max(len(r.get("slo", "")) for r in rows)
+        print(f"{'slo':<{width}}  {'objective':>9}  {'fast_burn':>9}  "
+              f"{'slow_burn':>9}  {'budget':<10} {'alert':<5}")
+        for r in rows:
+            burn = r.get("burn", {})
+            print(f"{r.get('slo', '?'):<{width}}  "
+                  f"{r.get('objective', 0.0):>9.3f}  "
+                  f"{burn.get('fast', 0.0):>9.3f}  "
+                  f"{burn.get('slow', 0.0):>9.3f}  "
+                  f"{'in-budget' if r.get('in_budget') else 'BURNING':<10} "
+                  f"{'YES' if r.get('alerting') else 'no':<5}")
+    return 1 if slo.get("alerting") else 0
+
+
 def handle_profile(args, files, config):
     from .profilecmd import handle_profile as _handle
 
@@ -1303,8 +1459,10 @@ HANDLERS = {
     "et-verifier": handle_et_verifier,
     "et-proving-key": handle_et_pk,
     "et-verify": handle_et_verify,
+    "fleet": handle_fleet,
     "kzg-params": handle_kzg_params,
     "obs": handle_obs,
+    "slo": handle_slo,
     "prove-worker": handle_prove_worker,
     "scenario": handle_scenario,
     "show": handle_show,

@@ -178,6 +178,23 @@ class ServiceConfig:
     # SIGKILLed worker
     fabric_lease_ttl: float = 5.0
 
+    # --- fleet observability (PR 19) --------------------------------------
+    # stable fleet identity stamped on every trace record and telemetry
+    # report; "" derives one from the role + state dir (leader) or
+    # follower_id (follower) so a restart keeps its /fleet row
+    instance_id: str = ""
+    # non-leader processes push a telemetry snapshot (instrument state
+    # + recent span window) to the leader this often
+    telemetry_interval: float = 2.0
+    # leader side: an instance whose last report is older than this is
+    # rendered inactive on /fleet (staleness-honest: the row stays)
+    telemetry_ttl: float = 30.0
+    # SLO burn-rate engine cadence and its fast/slow windows (the
+    # multi-window AND-gate: both must burn >1x before an alert trips)
+    slo_interval: float = 5.0
+    slo_fast_window: float = 60.0
+    slo_slow_window: float = 300.0
+
     # --- lifecycle --------------------------------------------------------
     drain_timeout: float = 30.0     # SIGTERM: budget to finish in-flight
 

@@ -141,6 +141,27 @@ class TrustService:
         declare_instruments()
         trace.install_compile_tracking()
         state_dir = state_dir or config.state_dir or None
+        # fleet identity: stamped on every trace record this process
+        # emits and carried by ptpu_build_info from the first scrape
+        import hashlib
+
+        from .slo import SloEngine
+        from .telemetry import TelemetryRegistry, set_build_info
+
+        if config.instance_id:
+            self.instance = config.instance_id
+        elif state_dir:
+            self.instance = "ldr-" + hashlib.sha256(
+                os.path.abspath(str(state_dir)).encode()).hexdigest()[:8]
+        else:
+            self.instance = f"ldr-{os.getpid()}"
+        self.role = "leader"
+        set_build_info(self.instance, self.role)
+        # the leader-side fleet plane: TTL'd per-instance telemetry
+        # registry + the SLO burn-rate engine over fleet-wide gauges
+        self.telemetry = TelemetryRegistry(ttl=config.telemetry_ttl)
+        self.slo = SloEngine(fast_window=config.slo_fast_window,
+                             slow_window=config.slo_slow_window)
         self.store = None
         if state_dir:
             from ..store import StateStore
@@ -228,6 +249,11 @@ class TrustService:
         # the fabric directory IS the worker rendezvous substrate, and
         # a memory-only daemon has no filesystem to share)
         self.fabric = None
+        # filesystem-transport prove-workers drop their telemetry
+        # reports here (atomic rename); the observer thread sweeps it
+        self._telemetry_drop = (os.path.join(str(state_dir), "fabric",
+                                             "telemetry")
+                                if state_dir else None)
         if config.fabric and state_dir:
             from ..zk.fabric import FabricStore
 
@@ -687,6 +713,10 @@ class TrustService:
         if self.repl_source is not None:
             # the shipping side: per-follower positions + eof, totals
             out["repl"] = self.repl_source.status()
+        # the SLO engine's last evaluation: burn per window, in-budget
+        # flags, and the LATCHED alerts (stay up until both windows
+        # recover) — the /status face of /slo
+        out["slo"] = self.slo.status()
         return out
 
     def health(self) -> dict:
@@ -755,6 +785,78 @@ class TrustService:
             out.update(self.store.metrics())
         return out
 
+    # --- fleet observability ----------------------------------------------
+    def telemetry_report(self, obj: dict) -> dict:
+        """``POST /telemetry``: ingest one non-leader snapshot."""
+        return self.telemetry.report(obj)
+
+    def _local_fleet_row(self) -> dict:
+        from .. import __version__
+
+        freshness = self.score_freshness_seconds()
+        return {
+            "instance": self.instance,
+            "role": self.role,
+            "version": __version__,
+            # sentinel-honest: -1 pre-publish means "no data", never
+            # a negative freshness sample
+            "score_freshness_seconds":
+                freshness if freshness >= 0.0 else None,
+            "repl_lag_seconds": None,
+            "summary": {
+                "queue_depth": self.jobs.depth(),
+                "graph_revision": self.graph.revision,
+                "score_revision": self.refresher.table.revision,
+                "fabric_workers": (self.fabric.workers_live()
+                                   if self.fabric is not None else 0),
+                "followers": (len(self.repl_source.status()
+                                  .get("followers", []))
+                              if self.repl_source is not None else 0),
+            },
+        }
+
+    def fleet_status(self) -> dict:
+        """``GET /fleet``: per-instance operator rows, leader first."""
+        from .telemetry import fleet_rows
+
+        return fleet_rows(self.telemetry, self._local_fleet_row())
+
+    def fleet_metrics(self) -> str:
+        """``GET /fleet/metrics``: the federated exposition page."""
+        from .telemetry import render_fleet_metrics, update_fleet_gauges
+
+        update_fleet_gauges(self.telemetry)
+        return render_fleet_metrics(self.telemetry, self.instance,
+                                    self.role,
+                                    extra=self.extra_metrics())
+
+    def slo_status(self) -> dict:
+        """``GET /slo``: the engine's latest evaluation."""
+        return self.slo.status()
+
+    def _observe(self, stop: threading.Event) -> None:
+        """The observer thread: sweep file-dropped worker telemetry,
+        refresh the fleet gauges, and tick the SLO engine over the
+        fleet-wide (sentinel-honest) gauge view."""
+        from .telemetry import fleet_gauge_view, update_fleet_gauges
+
+        interval = max(0.05, min(self.config.slo_interval,
+                                 self.config.telemetry_interval))
+        while not stop.is_set():
+            try:
+                if self._telemetry_drop is not None:
+                    self.telemetry.sweep_dir(self._telemetry_drop)
+                update_fleet_gauges(self.telemetry)
+                freshness = self.score_freshness_seconds()
+                local = {"score_freshness_seconds":
+                         freshness if freshness >= 0.0 else None}
+                self.slo.sample(
+                    gauges=fleet_gauge_view(self.telemetry, local=local))
+                self.slo.evaluate()
+            except Exception:  # noqa: BLE001 - observability must not
+                pass           # take the service down
+            stop.wait(interval)
+
     @property
     def url(self) -> str:
         host, port = self._server.server_address[:2]
@@ -781,6 +883,11 @@ class TrustService:
             target=self.refresher.run,
             args=(self._stop, self._dirty, self.config.refresh_interval),
             daemon=True, name="ptpu-refresher")
+        t.start()
+        self._threads.append(t)
+        t = threading.Thread(
+            target=self._observe, args=(self._stop,),
+            daemon=True, name="ptpu-observer")
         t.start()
         self._threads.append(t)
         self._server = make_server(self, self.config.host,

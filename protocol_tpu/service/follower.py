@@ -129,6 +129,17 @@ class FollowerService:
                 os.path.abspath(str(state_dir)).encode()
             ).hexdigest()[:8]
         self.follower_id = follower_id
+        # fleet identity + the follower's own SLO engine (evaluated
+        # over ITS gauges — a replica's freshness includes repl lag)
+        from .slo import SloEngine
+        from .telemetry import set_build_info
+
+        self.instance = config.instance_id or follower_id
+        self.role = "follower"
+        set_build_info(self.instance, self.role)
+        self.slo = SloEngine(fast_window=config.slo_fast_window,
+                             slow_window=config.slo_slow_window)
+        self._last_slo_tick = 0.0
         self.ship = WalShipClient(self.leader_url, follower_id,
                                   max_bytes=config.repl_max_bytes)
         self._cursor_ckpt = CheckpointManager(
@@ -579,6 +590,7 @@ class FollowerService:
             },
             "delta": self.refresher.delta_status(),
             "repl": self.repl_status(),
+            "slo": self.slo.status(),
             "store": {
                 "wal_segments": wal["segments"],
                 "wal_bytes": wal["bytes"],
@@ -611,6 +623,38 @@ class FollowerService:
         out.update(self.store.metrics())
         return out
 
+    def slo_status(self) -> dict:
+        """``GET /slo`` on the replica: its own engine's evaluation."""
+        return self.slo.status()
+
+    def _fleet_summary(self) -> dict:
+        """The role-specific digest the leader's ``/fleet`` renders."""
+        lag = self.repl_lag_seconds()
+        return {
+            "leader": self.leader_url,
+            "lag_records": self.last_backlog,
+            "lag_seconds": lag if lag >= 0.0 else None,
+            "records_applied": self.records_applied,
+            "score_revision": self.refresher.table.revision,
+        }
+
+    def _slo_tick(self) -> None:
+        """Sample + evaluate this replica's SLOs (sentinel-honest:
+        -1 freshness/lag means "no data"), at most once per
+        ``slo_interval`` — threaded through the telemetry push loop."""
+        now = time.monotonic()
+        if now - self._last_slo_tick < self.config.slo_interval:
+            return
+        self._last_slo_tick = now
+        freshness = self.score_freshness_seconds()
+        lag = self.repl_lag_seconds()
+        self.slo.sample(gauges={
+            "score_freshness_seconds":
+                freshness if freshness >= 0.0 else None,
+            "repl_lag_seconds": lag if lag >= 0.0 else None,
+        })
+        self.slo.evaluate()
+
     # --- lifecycle --------------------------------------------------------
     @property
     def url(self) -> str:
@@ -633,6 +677,21 @@ class FollowerService:
             target=self.refresher.run,
             args=(self._stop, self._dirty, self.config.refresh_interval),
             daemon=True, name="ptpu-refresher")
+        t.start()
+        self._threads.append(t)
+        # telemetry shipping: periodic instrument + span-window push
+        # to the leader's /telemetry, with the SLO tick threaded
+        # through the same loop (push failures back off, never bite)
+        from .telemetry import TelemetryPusher
+
+        pusher = TelemetryPusher(
+            self.leader_url, self.instance, self.role,
+            interval=self.config.telemetry_interval,
+            collect=self.extra_metrics, summary=self._fleet_summary)
+        t = threading.Thread(
+            target=pusher.run, args=(self._stop,),
+            kwargs={"tick": self._slo_tick},
+            daemon=True, name="ptpu-telemetry")
         t.start()
         self._threads.append(t)
         self._server = make_server(self, self.config.host,

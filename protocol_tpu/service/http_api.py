@@ -39,6 +39,14 @@ Routes:
   (``serve --fabric``): remote ``prove-worker`` processes poll
   claimable units, fetch content-addressed payloads, lease/heartbeat,
   and upload CRC-framed results (``zk/fabric.py::RemoteFabric``)
+- ``POST /telemetry``      leader only: a non-leader process ships its
+  instrument snapshot + recent span window (``service/telemetry.py``)
+- ``GET /fleet``          leader only: aggregated operator JSON — one
+  staleness-honest row per known instance (dead rows stay, flagged)
+- ``GET /fleet/metrics``  leader only: the federated Prometheus page —
+  local + reported instrument state with ``instance``/``role`` labels
+- ``GET /slo``            the SLO burn-rate engine's current
+  evaluation (burn per window, in-budget flags, latched alerts)
 
 ``/scores`` and ``/score/<addr>`` carry a strong revision-derived ETag
 and honor ``If-None-Match`` (304, headers only) on leader and follower
@@ -82,7 +90,8 @@ def _route_template(method: str, path: str) -> str:
     path (addresses and job ids would explode the label space)."""
     if path in ("/healthz", "/status", "/scores", "/metrics", "/stages",
                 "/bundle", "/repl/wal", "/repl/snapshot",
-                "/fabric/units", "/fabric/claims", "/fabric/workers"):
+                "/fabric/units", "/fabric/claims", "/fabric/workers",
+                "/telemetry", "/fleet", "/fleet/metrics", "/slo"):
         return path
     if path.startswith("/fabric/blob/"):
         return "/fabric/blob/{digest}"
@@ -181,6 +190,31 @@ def make_server(service, host: str, port: int) -> ThreadingHTTPServer:
                 return self._reply(
                     200, render_prometheus(service.extra_metrics()),
                     content_type="text/plain; version=0.0.4")
+            if path == "/fleet/metrics":
+                # federated scrape: local + every reported instance's
+                # instrument state, instance/role-labelled (leader only
+                # — followers and workers report INTO the leader)
+                render = getattr(service, "fleet_metrics", None)
+                if render is None:
+                    return self._reply(
+                        404, {"error": "no fleet registry here — "
+                                       "scrape the leader"})
+                return self._reply(
+                    200, render(),
+                    content_type="text/plain; version=0.0.4")
+            if path == "/fleet":
+                fleet = getattr(service, "fleet_status", None)
+                if fleet is None:
+                    return self._reply(
+                        404, {"error": "no fleet registry here — "
+                                       "ask the leader"})
+                return self._reply(200, fleet())
+            if path == "/slo":
+                slo = getattr(service, "slo_status", None)
+                if slo is None:
+                    return self._reply(
+                        404, {"error": "no SLO engine on this process"})
+                return self._reply(200, slo())
             if path == "/scores":
                 table = service.refresher.table
                 # revision-derived strong ETag: a conditional scrape of
@@ -327,6 +361,21 @@ def make_server(service, host: str, port: int) -> ThreadingHTTPServer:
             if path in ("/fabric/claims", "/fabric/workers") \
                     or path.startswith("/fabric/results/"):
                 return self._handle_fabric_post(path)
+            if path == "/telemetry":
+                report = getattr(service, "telemetry_report", None)
+                if report is None:
+                    return self._reply(
+                        404, {"error": "no telemetry registry here — "
+                                       "report to the leader"})
+                try:
+                    length = int(self.headers.get("Content-Length", 0))
+                    obj = json.loads(self.rfile.read(length) or b"{}")
+                    return self._reply(200, report(obj))
+                except (ValueError, KeyError) as e:
+                    return self._reply(
+                        400, {"error": f"bad telemetry report: {e}"})
+                except EigenError as e:
+                    return self._reply(400, {"error": str(e)})
             if path != "/proofs":
                 return self._reply(404, {"error": f"no route {path}"})
             if service.jobs is None:

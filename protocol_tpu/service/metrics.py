@@ -115,10 +115,15 @@ HISTOGRAM_FAMILIES = {
     # start) — the lending latency of the sharded proving fabric;
     # stage is the work-unit family (commit | quotient | open_fold)
     "prove_shard_wait_seconds": ("stage",),
-    # publish → applied-at-rendezvous wall of one unit executed by an
-    # EXTERNAL prove-worker process over the cross-process fabric —
-    # the remote twin of prove_shard_wait_seconds
-    "fabric_unit_seconds": ("stage",),
+    # wall of one fabric unit executed by an EXTERNAL prove-worker
+    # process: source="remote" is the WORKER-measured execution wall
+    # (shipped back in the result frame's meta), source="local" is the
+    # submitting daemon's apply wall for that remote result — the
+    # honest split the fleet-observability plane aggregates
+    "fabric_unit_seconds": ("stage", "source"),
+    # wall of one telemetry snapshot push (follower / prove-worker →
+    # leader POST /telemetry or the fabric file drop)
+    "telemetry_push_seconds": (),
     # one follower replication poll: shipped-chunk fetch + local WAL
     # append + graph apply (the follower's ingest unit)
     "repl_poll_seconds": (),
@@ -134,14 +139,25 @@ DECLARED_COUNTERS = ("xla_compiles", "xla_steady_recompiles",
                      "proof_pool_stolen", "prove_shards",
                      "repl_chunks", "repl_records_shipped",
                      "scenario_runs", "fabric_units",
-                     "fabric_leases_expired")
+                     "fabric_leases_expired",
+                     "telemetry_reports", "telemetry_push_failures")
 DECLARED_GAUGES = ("converge_iterations", "converge_residual",
                    "proof_queue_depth", "dirty_rows",
                    "refresh_frontier_peak", "refresh_budget_spent",
                    "proof_pool_depth", "proof_pool_worker_depth",
                    "proof_pool_queued_bytes", "proof_pool_workers",
                    "repl_lag_records", "repl_lag_seconds",
-                   "fabric_workers", "fabric_lease_age_seconds")
+                   "fabric_workers", "fabric_lease_age_seconds",
+                   # info-style: build_info{role,instance,version} 1 —
+                   # every fleet process emits it at boot so federated
+                   # series are attributable before the first telemetry
+                   # report lands
+                   "build_info",
+                   # leader-side fleet registry + SLO engine state
+                   "fleet_instances", "fleet_instance_up",
+                   "fleet_report_age_seconds",
+                   "slo_burn_rate", "slo_in_budget", "slo_alert",
+                   "slo_objective")
 
 
 def declare_instruments() -> None:

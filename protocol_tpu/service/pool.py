@@ -386,7 +386,7 @@ class _ShardRunner:
         `obs --trace-id <job>` shows which process computed the unit.
         ANY decode/apply failure falls back to the local closure:
         execution is deterministic, so the overwrite is byte-safe."""
-        obj, worker_name = remote
+        obj, worker_name, remote_wall = remote
         t0 = time.perf_counter()
         try:
             with contextlib.ExitStack() as stack:
@@ -399,8 +399,17 @@ class _ShardRunner:
                     trace.counter("prove_shards").inc(stage=unit.stage)
                     unit.result = unit.portable.apply(obj)
             trace.counter("fabric_units").inc(stage=unit.stage)
+            # source="local" is THIS thread's decode+apply wall;
+            # source="remote" is the worker's own measured execution
+            # wall carried back in the result frame — the honest
+            # remote sample (absent only for older workers' frames)
             trace.histogram("fabric_unit_seconds").observe(
-                time.perf_counter() - t0, stage=unit.stage)
+                time.perf_counter() - t0, stage=unit.stage,
+                source="local")
+            if remote_wall is not None:
+                trace.histogram("fabric_unit_seconds").observe(
+                    float(remote_wall), stage=unit.stage,
+                    source="remote")
             unit.done.set()
         except BaseException:  # noqa: BLE001 - remote is best-effort
             trace.event("fabric.apply_failed", unit=unit.fabric_id,

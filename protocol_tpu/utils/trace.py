@@ -397,6 +397,13 @@ class Tracer:
         # boundaries for accurate stage attribution (PTPU_TRACE_SYNC's
         # first-class form; see module docstring)
         self.sync = False
+        # fleet identity: once a process knows its place in the fleet
+        # (leader daemon, follower replica, prove-worker) every emitted
+        # record carries instance/role — the cross-process trace join
+        # needs the attribution on the records themselves, because a
+        # merged view has no other way to tell the streams apart
+        self.instance: str | None = None
+        self.role: str | None = None
         self.compile_tracker = CompileTracker(self)
         self._lock = threading.Lock()
         self._emit_lock = threading.Lock()
@@ -447,6 +454,8 @@ class Tracer:
             self.metrics.clear()
             self._span_agg.clear()
             self._durations.clear()
+        self.instance = None
+        self.role = None
 
     def reset_instruments(self) -> None:
         with self._lock:
@@ -504,6 +513,12 @@ class Tracer:
         finally:
             self._local.trace = prev
 
+    def set_identity(self, instance: str, role: str) -> None:
+        """Declare this process's fleet identity. Idempotent;
+        subsequent spans/events carry ``instance``/``role``."""
+        self.instance = str(instance)
+        self.role = str(role)
+
     def _trace_fields(self) -> dict:
         ids = getattr(self._local, "trace", ())
         out: dict = {}
@@ -514,6 +529,9 @@ class Tracer:
         worker = getattr(self._local, "worker", None)
         if worker is not None:
             out["worker"] = worker
+        if self.instance is not None:
+            out["instance"] = self.instance
+            out["role"] = self.role
         return out
 
     # --- worker context ---------------------------------------------------
@@ -632,6 +650,44 @@ class Tracer:
                     stream.write(line)
                 except ValueError:  # stream closed under us (disable
                     pass            # racing a daemon thread's emit)
+
+    def emit_record(self, obj: dict) -> None:
+        """Append one FOREIGN record (a span shipped from another fleet
+        process via ``service/telemetry.py``) to this process's JSONL
+        stream verbatim — the cross-process trace join lands remote
+        spans next to local ones. No-op without an open stream."""
+        self._emit(obj)
+
+    def recent_spans(self, after_id: int = 0, limit: int = 256):
+        """``(records, cursor)``: the newest ≤ ``limit`` retained spans
+        whose numeric span id is > ``after_id``, serialized exactly like
+        :meth:`dump_jsonl` and stamped with this process's
+        instance/role. ``cursor`` is the highest id serialized (pass it
+        back as ``after_id`` to ship each span at most once) — span ids
+        are ``new_id()`` hex, monotonic for the process lifetime."""
+        with self._lock:
+            recs = [r for r in self.spans
+                    if r.span_id and int(r.span_id, 16) > after_id]
+        recs = recs[-int(limit):] if limit else []
+        out = []
+        cursor = after_id
+        for rec in recs:
+            obj = {"type": "span", "name": rec.name, "ts": rec.start,
+                   "duration_s": rec.duration, "depth": rec.depth,
+                   "span_id": rec.span_id}
+            if rec.parent_id is not None:
+                obj["parent_id"] = rec.parent_id
+            if len(rec.trace_ids) == 1:
+                obj["trace_id"] = rec.trace_ids[0]
+            elif rec.trace_ids:
+                obj["trace_ids"] = list(rec.trace_ids)
+            obj.update(rec.fields)
+            if self.instance is not None:
+                obj.setdefault("instance", self.instance)
+                obj.setdefault("role", self.role)
+            out.append(obj)
+            cursor = max(cursor, int(rec.span_id, 16))
+        return out, cursor
 
     # --- reporting --------------------------------------------------------
     def summary(self) -> dict:
@@ -781,6 +837,18 @@ def current_worker() -> str | None:
 
 def current_trace_ids() -> tuple:
     return TRACER.current_trace_ids()
+
+
+def set_identity(instance: str, role: str) -> None:
+    TRACER.set_identity(instance, role)
+
+
+def emit_record(obj: dict) -> None:
+    TRACER.emit_record(obj)
+
+
+def recent_spans(after_id: int = 0, limit: int = 256):
+    return TRACER.recent_spans(after_id=after_id, limit=limit)
 
 
 def new_id() -> str:

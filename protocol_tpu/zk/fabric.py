@@ -360,8 +360,12 @@ class FabricStore:
         return fabric_id
 
     def try_result(self, fabric_id: str):
-        """``(result obj, worker name)`` for a published unit, or None
-        (absent, torn, or corrupt — the CRC makes them equivalent)."""
+        """``(result obj, worker name, remote wall seconds)`` for a
+        published unit, or None (absent, torn, or corrupt — the CRC
+        makes them equivalent). The wall time is the WORKER's measured
+        execution seconds carried in the frame meta (None for frames
+        written by older workers) — the honest remote sample for
+        ``ptpu_fabric_unit_seconds{source="remote"}``."""
         try:
             with open(self._path("results", fabric_id + ".bin"),
                       "rb") as f:
@@ -374,7 +378,9 @@ class FabricStore:
             trace.event("fabric.result_corrupt", unit=fabric_id)
             return None
         self.results_applied += 1
-        return obj, str(meta.get("worker") or "fabric")
+        wall = meta.get("wall_s")
+        return (obj, str(meta.get("worker") or "fabric"),
+                float(wall) if wall is not None else None)
 
     def lease_state(self, fabric_id: str) -> str:
         """``live`` | ``expired`` | ``none`` for a unit's lease."""
@@ -541,13 +547,19 @@ class FabricStore:
 
         return _resolve(obj)
 
-    def put_result(self, fabric_id: str, result, worker: str) -> None:
-        """Frame + commit a unit's result. ``os.replace`` is atomic and
-        execution is deterministic, so duplicate writers converge on
-        identical bytes — idempotent by construction."""
+    def put_result(self, fabric_id: str, result, worker: str,
+                   wall: float | None = None) -> None:
+        """Frame + commit a unit's result (``wall``: the worker's
+        measured execution seconds, carried in the frame meta).
+        ``os.replace`` is atomic and execution is deterministic, so
+        duplicate writers converge on identical bytes — idempotent by
+        construction (wall jitter lives in meta, outside the result
+        object the rendezvous consumes)."""
+        meta = {"unit": fabric_id, "worker": worker}
+        if wall is not None:
+            meta["wall_s"] = round(float(wall), 6)
         self._write(self._path("results", fabric_id + ".bin"),
-                    frame(result, meta={"unit": fabric_id,
-                                        "worker": worker}))
+                    frame(result, meta=meta))
 
     def status(self) -> dict:
         try:
@@ -649,10 +661,13 @@ class RemoteFabric:
 
         return _resolve(obj)
 
-    def put_result(self, fabric_id: str, result, worker: str) -> None:
+    def put_result(self, fabric_id: str, result, worker: str,
+                   wall: float | None = None) -> None:
+        meta = {"unit": fabric_id, "worker": worker}
+        if wall is not None:
+            meta["wall_s"] = round(float(wall), 6)
         self._post(f"/fabric/results/{fabric_id}",
-                   frame(result, meta={"unit": fabric_id,
-                                       "worker": worker}),
+                   frame(result, meta=meta),
                    content_type="application/octet-stream")
 
 
@@ -729,12 +744,25 @@ def run_worker(fabric, name: str, poll: float = 0.05,
                                             name=f"fabric-beat-{name}")
                     beat.start()
                     try:
-                        payload = fabric.load_payload(envelope)
-                        with trace.span("fabric.unit",
-                                        stage=envelope.get("stage", ""),
-                                        unit=unit_id):
-                            result = execute_unit(envelope, payload)
-                        fabric.put_result(unit_id, result, name)
+                        # the unit's span joins the submitting job's
+                        # trace (job_id IS the proof job / trace id),
+                        # so a shipped worker span window chains into
+                        # the leader's tailer→pool→prove.shard view
+                        job_id = envelope.get("job_id") or None
+                        t0 = time.perf_counter()
+                        with trace.context(trace_id=job_id):
+                            payload = fabric.load_payload(envelope)
+                            with trace.span("fabric.unit",
+                                            stage=envelope.get("stage",
+                                                               ""),
+                                            unit=unit_id, remote=1):
+                                result = execute_unit(envelope, payload)
+                        # carry the measured wall back in the result
+                        # frame meta: the leader's pool observes it as
+                        # the honest source="remote" fabric sample
+                        fabric.put_result(
+                            unit_id, result, name,
+                            wall=time.perf_counter() - t0)
                         executed += 1
                         progressed = True
                         last_work = time.monotonic()

@@ -122,11 +122,13 @@ def test_envelope_publish_claim_roundtrip(tmp_path):
     assert store.claim(fid, "fw1") is False  # live lease excludes
     assert store.lease_state(fid) == "live"
     result = fab.execute_unit(env, payload)
-    store.put_result(fid, result, "fw0")
+    store.put_result(fid, result, "fw0", wall=0.125)
     got = store.try_result(fid)
     assert got is not None
-    obj, worker = got
+    obj, worker, wall = got
     assert worker == "fw0"
+    assert wall == pytest.approx(0.125)  # the worker's measured
+    # execution seconds ride the frame meta back to the rendezvous
     assert (obj["value"] == payload["arr"]).all()
     # a resulted unit is no longer claimable work
     assert store.list_units() == []
@@ -167,9 +169,10 @@ def test_duplicate_result_idempotent(tmp_path):
     # both racers publish the (deterministic) result
     store.put_result(fid, {"value": 42}, "fw0")
     store.put_result(fid, {"value": 42}, "fw1")
-    obj, worker = store.try_result(fid)
+    obj, worker, wall = store.try_result(fid)
     assert obj["value"] == 42
     assert worker in ("fw0", "fw1")
+    assert wall is None  # no wall reported by these writers
 
 
 def test_worker_registry_and_lease_age(tmp_path):

@@ -1,0 +1,457 @@
+"""Fleet observability plane tests (ISSUE 19): the telemetry snapshot
+codec + pusher transports, the leader's TTL'd instance registry and its
+staleness-honest ``/fleet`` views, the federated exposition's scrape
+grammar, the SLO burn-rate engine's window math (fast+slow AND-gate,
+exactly-at-budget boundary, empty-window behavior, alert latching), the
+``-1`` freshness-sentinel regression, and the merged ``obs --jsonl``
+cross-process chain view."""
+
+import json
+import re
+
+import pytest
+
+from protocol_tpu.service.metrics import lint_exposition
+from protocol_tpu.service.slo import SloEngine, SloSpec, default_slos
+from protocol_tpu.service.telemetry import (
+    MAX_INSTANCES,
+    TelemetryPusher,
+    TelemetryRegistry,
+    fleet_gauge_view,
+    fleet_rows,
+    render_fleet_metrics,
+    set_build_info,
+    snapshot,
+    update_fleet_gauges,
+)
+from protocol_tpu.utils import trace
+from protocol_tpu.utils.errors import EigenError
+
+
+@pytest.fixture(autouse=True)
+def clean_tracer():
+    was = trace.TRACER.enabled
+    trace.TRACER.disable()
+    trace.TRACER.reset()
+    trace.TRACER.reset_instruments()
+    trace.enable()  # in-memory: instruments only record when enabled
+    yield
+    trace.TRACER.disable()
+    trace.TRACER.reset()
+    trace.TRACER.reset_instruments()
+    if was:
+        trace.TRACER.enable()
+
+
+def _report(instance, role="follower", gauges=None, spans=None):
+    """A minimal valid telemetry report (the wire shape, by hand)."""
+    return {
+        "v": 1, "instance": instance, "role": role,
+        "instruments": [], "gauges": dict(gauges or {}),
+        "summary": {}, "spans": list(spans or []),
+    }
+
+
+# --- SLO burn-rate engine ----------------------------------------------------
+
+
+def _gauge_engine(objective=0.9, threshold=1.0):
+    return SloEngine(
+        specs=[SloSpec("g", "gauge", objective, source="x",
+                       threshold=threshold)],
+        fast_window=60.0, slow_window=300.0)
+
+
+def test_burn_rate_and_gate_then_latch_then_unlatch():
+    """The multi-window method end to end: a burst that only burns the
+    FAST window must not page (AND-gate); once the slow window burns
+    too the alert trips and LATCHES; it releases only after BOTH
+    windows are back in budget."""
+    eng = _gauge_engine()
+
+    # 240s of good history, one sample per 10s
+    t = 1000.0
+    while t <= 1280.0:
+        eng.sample(gauges={"x": 0.0}, now=t)
+        t += 10.0
+    # short burst: 2 bad samples at the very end
+    for t in (1290.0, 1300.0):
+        eng.sample(gauges={"x": 5.0}, now=t)
+
+    (r,) = eng.evaluate(now=1300.0)
+    # fast window (60s): 2 bad of 6 -> 0.333/0.1 = 3.3x burn
+    # slow window (300s): 2 bad of 30 -> 0.067/0.1 = 0.67x burn
+    assert r["burn"]["fast"] > 1.0
+    assert r["burn"]["slow"] <= 1.0
+    assert not r["alerting"], "fast-only burn must NOT page (AND-gate)"
+    assert not r["in_budget"]
+
+    # keep burning until the slow window exceeds budget too
+    for t in (1310.0, 1320.0, 1330.0, 1340.0):
+        eng.sample(gauges={"x": 5.0}, now=t)
+    (r,) = eng.evaluate(now=1340.0)
+    assert r["burn"]["fast"] > 1.0 and r["burn"]["slow"] > 1.0
+    assert r["alerting"] and not r["in_budget"]
+    assert r["alert_since"] is not None
+
+    # recovery: the fast window clears long before the slow one —
+    # the latch must hold while EITHER window is still burning
+    t = 1350.0
+    while t <= 1410.0:
+        eng.sample(gauges={"x": 0.0}, now=t)
+        t += 10.0
+    (r,) = eng.evaluate(now=1410.0)
+    assert r["burn"]["fast"] <= 1.0 < r["burn"]["slow"]
+    assert r["alerting"], "latch must hold until BOTH windows recover"
+
+    # ... and release once the bad samples age out of the slow window
+    while t <= 1700.0:
+        eng.sample(gauges={"x": 0.0}, now=t)
+        t += 10.0
+    (r,) = eng.evaluate(now=1700.0)
+    assert r["burn"]["fast"] <= 1.0 and r["burn"]["slow"] <= 1.0
+    assert not r["alerting"] and r["in_budget"]
+    assert r["alert_since"] is None
+
+
+def test_exactly_at_budget_does_not_page():
+    """Burn == 1.0 means spending the error budget exactly at the
+    sustainable rate: in budget, no alert (the gate is strictly >)."""
+    # objective 0.75 -> allowed bad fraction exactly 0.25 in floats
+    eng = _gauge_engine(objective=0.75)
+    eng.sample(gauges={"x": 0.0}, now=1000.0)  # cumulative baseline
+    eng.sample(gauges={"x": 5.0}, now=1010.0)  # 1 bad ...
+    for t in (1020.0, 1030.0, 1040.0):
+        eng.sample(gauges={"x": 0.0}, now=t)   # ... of 4 in-window
+    (r,) = eng.evaluate(now=1040.0)
+    assert r["burn"]["fast"] == pytest.approx(1.0)
+    assert r["burn"]["slow"] == pytest.approx(1.0)
+    assert r["in_budget"] and not r["alerting"]
+
+
+def test_empty_windows_are_in_budget():
+    """No traffic anywhere (empty histograms, no gauge data) must read
+    as burn 0.0 / in budget for every declared SLO — an idle fleet
+    never pages."""
+    eng = SloEngine()  # the real default specs
+    assert [s.name for s in eng.specs] == \
+        [s.name for s in default_slos()]
+    eng.sample(gauges={}, now=1000.0)
+    results = eng.evaluate(now=1000.0)
+    assert len(results) == len(default_slos())
+    for r in results:
+        assert r["burn"] == {"fast": 0.0, "slow": 0.0}
+        assert r["in_budget"] and not r["alerting"]
+
+
+def test_latency_slo_over_histogram_state_trips_and_exports():
+    """kind="latency" differences real histogram cumulative state; the
+    overflow bucket is always bad; tripping exports the ptpu_slo_*
+    gauges."""
+    hist = trace.histogram("lat_seconds")
+    bounds = hist.buckets
+    good_v, bad_v = bounds[0] / 2.0, bounds[-1] * 2.0
+    eng = SloEngine(
+        specs=[SloSpec("lat", "latency", 0.9, source="lat_seconds",
+                       threshold=bounds[len(bounds) // 2])],
+        fast_window=60.0, slow_window=300.0)
+
+    hist.observe(good_v)
+    eng.sample(now=1000.0)            # cumulative baseline point
+    for _ in range(8):
+        hist.observe(good_v)
+    hist.observe(bad_v)
+    hist.observe(bad_v)
+    eng.sample(now=1010.0)
+    (r,) = eng.evaluate(now=1010.0)
+    # delta: 2 bad of 10 -> 0.2/0.1 = 2x burn on both windows
+    assert r["burn"]["fast"] == pytest.approx(2.0)
+    assert r["burn"]["slow"] == pytest.approx(2.0)
+    assert r["alerting"] and not r["in_budget"]
+
+    by_labels = {tuple(sorted(items)): v
+                 for items, v in trace.gauge("slo_alert").samples()}
+    assert by_labels[(("slo", "lat"),)] == 1.0
+    burn_labels = {tuple(sorted(items))
+                   for items, _ in trace.gauge("slo_burn_rate").samples()}
+    assert (("slo", "lat"), ("window", "fast")) in burn_labels
+    assert (("slo", "lat"), ("window", "slow")) in burn_labels
+
+
+def test_ratio_slo_counts_bad_label_prefix():
+    """kind="ratio": 5xx-prefixed status labels burn the budget."""
+    hist = trace.histogram("rq_seconds")
+    eng = SloEngine(
+        specs=[SloSpec("err", "ratio", 0.9, source="rq_seconds",
+                       bad_label=("status", "5"))],
+        fast_window=60.0, slow_window=300.0)
+    hist.observe(0.01, status="200")
+    eng.sample(now=1000.0)
+    for _ in range(7):
+        hist.observe(0.01, status="200")
+    hist.observe(0.01, status="500")
+    hist.observe(0.01, status="503")
+    eng.sample(now=1010.0)
+    (r,) = eng.evaluate(now=1010.0)
+    # delta: 2 bad of 9 -> 0.222/0.1 = 2.2x burn
+    assert r["burn"]["fast"] == pytest.approx(2.0 / 0.9, rel=1e-6)
+    assert r["alerting"]
+
+
+# --- the -1 sentinel regression (satellite b) --------------------------------
+
+
+def test_freshness_sentinel_is_no_data_not_a_sample():
+    """The ``-1`` pre-publish freshness/lag sentinel must surface as
+    None ("no data") everywhere — never as a negative sample that
+    drags fleet aggregation or feeds the SLO engine a free pass."""
+    reg = TelemetryRegistry(ttl=30.0)
+    reg.report(_report("f-cold", gauges={
+        "score_freshness_seconds": -1.0, "repl_lag_seconds": -1.0}))
+    reg.report(_report("f-warm", gauges={
+        "score_freshness_seconds": 5.0, "repl_lag_seconds": 0.5}))
+
+    view = fleet_gauge_view(reg, local={"score_freshness_seconds": -1.0})
+    assert view["score_freshness_seconds"] == 5.0, \
+        "sentinel leaked into the fleet max"
+    assert view["repl_lag_seconds"] == 0.5
+
+    rows = fleet_rows(reg, {"instance": "ldr", "role": "leader"})
+    by_inst = {r["instance"]: r for r in rows["instances"]}
+    assert by_inst["f-cold"]["score_freshness_seconds"] is None
+    assert by_inst["f-warm"]["score_freshness_seconds"] == 5.0
+
+    # nobody has data at all -> None, and the SLO engine treats a
+    # None gauge sample as no data (no ring entry, burn stays 0)
+    empty = TelemetryRegistry(ttl=30.0)
+    empty.report(_report("f-cold", gauges={
+        "score_freshness_seconds": -1.0}))
+    view = fleet_gauge_view(empty)
+    assert view["score_freshness_seconds"] is None
+    eng = SloEngine(specs=[SloSpec(
+        "fresh", "gauge", 0.95, source="score_freshness_seconds",
+        threshold=60.0)])
+    eng.sample(gauges=view, now=1000.0)
+    eng.sample(gauges=view, now=1010.0)
+    (r,) = eng.evaluate(now=1010.0)
+    assert r["burn"] == {"fast": 0.0, "slow": 0.0} and r["in_budget"]
+
+
+# --- registry ----------------------------------------------------------------
+
+
+def test_registry_ttl_staleness_honest_and_cap_eviction():
+    reg = TelemetryRegistry(ttl=10.0)
+    reg.report(_report("f1"))
+    (row,) = reg.rows()
+    assert row["active"] and row["report_age_seconds"] < 10.0
+
+    # age the report past the TTL: inactive but NEVER dropped
+    reg._instances["f1"]["seen"] -= 25.0
+    (row,) = reg.rows()
+    assert not row["active"] and row["report_age_seconds"] >= 15.0
+    fleet = fleet_rows(reg, {"instance": "ldr", "role": "leader"})
+    assert fleet["counts"] == {"total": 2, "active": 1,
+                               "by_role": {"leader": 1, "follower": 1}}
+    # ... and the dead row contributes no instrument series, only the
+    # liveness meta-series
+    text = render_fleet_metrics(reg, "ldr", "leader")
+    assert 'ptpu_fleet_instance_up{instance="f1",role="follower"} 0' \
+        in text
+
+    # capacity is the ONLY forgetting mechanism, oldest report first
+    big = TelemetryRegistry(ttl=1e9)
+    for i in range(MAX_INSTANCES):
+        big.report(_report(f"i{i}"))
+    big._instances["i0"]["seen"] -= 100.0
+    big.report(_report("overflow"))
+    assert len(big._instances) == MAX_INSTANCES
+    assert "i0" not in big._instances and "overflow" in big._instances
+
+
+def test_registry_rejects_malformed_reports():
+    reg = TelemetryRegistry()
+    for bad in ([], {"role": "follower"}, {"instance": ""},
+                {"instance": "x"}, {"instance": "x", "role": "f",
+                                    "gauges": []}):
+        with pytest.raises(EigenError):
+            reg.report(bad)
+    assert reg.rows() == [] and reg.reports == 0
+
+
+# --- pusher transports + span shipping ---------------------------------------
+
+
+def test_pusher_file_drop_sweep_and_at_least_once_cursor(tmp_path):
+    """File-drop transport round trip: atomic drop, leader sweep,
+    spans stamped with instance/role; a failed push must NOT advance
+    the span cursor (at-least-once shipping)."""
+    trace.enable()
+    set_build_info("w1", "prove-worker")
+    with trace.context(trace_id="job-1"):
+        with trace.span("fabric.unit", unit="u0", remote=1):
+            pass
+
+    drop = tmp_path / "telemetry"
+    pusher = TelemetryPusher(str(drop), "w1", "prove-worker",
+                             interval=0.1)
+    assert pusher.push_once()
+    report = json.loads((drop / "w1.json").read_bytes())
+    assert report["instance"] == "w1" and report["role"] == "prove-worker"
+    names = [s.get("name") for s in report["spans"]]
+    assert "fabric.unit" in names
+    unit = next(s for s in report["spans"]
+                if s.get("name") == "fabric.unit")
+    assert unit["instance"] == "w1" and unit["role"] == "prove-worker"
+    ids = [unit.get("trace_id"), *(unit.get("trace_ids") or ())]
+    assert "job-1" in ids and unit["remote"] == 1
+
+    # cursor advanced: an immediate re-push ships no spans again
+    assert pusher.push_once()
+    report2 = json.loads((drop / "w1.json").read_bytes())
+    assert report2["spans"] == []
+
+    # leader sweep ingests + unlinks, registry row appears
+    reg = TelemetryRegistry(ttl=30.0)
+    assert reg.sweep_dir(str(drop)) == 1
+    assert list(drop.iterdir()) == []
+    (row,) = reg.rows()
+    assert row["instance"] == "w1" and row["active"]
+
+    # a failing transport must keep the window for the next attempt
+    with trace.span("fabric.unit", unit="u1", remote=1):
+        pass
+    broken = TelemetryPusher("http://127.0.0.1:9/", "w1",
+                             "prove-worker", timeout=0.2)
+    broken._span_cursor = pusher._span_cursor
+    assert not broken.push_once()
+    assert broken.failures == 1
+    assert trace.counter_total("telemetry_push_failures") >= 1.0
+    retry = broken.build()
+    assert any(s.get("fields", s).get("unit") == "u1"
+               or s.get("unit") == "u1" for s in retry["spans"]), \
+        "failed push advanced the span cursor"
+
+
+def test_registry_reemits_shipped_spans_into_local_stream(tmp_path):
+    """Shipped span windows must land in the leader's own JSONL stream
+    carrying the reporter's instance — the cross-process join seam."""
+    stream = tmp_path / "leader.jsonl"
+    trace.enable(str(stream))
+    span = {"type": "span", "name": "fabric.unit", "ts": 1000.0,
+            "duration_s": 0.25, "depth": 0, "span_id": "0000beef",
+            "trace_ids": ["job-9"], "instance": "fw9",
+            "role": "prove-worker", "remote": 1}
+    reg = TelemetryRegistry()
+    out = reg.report(_report("fw9", role="prove-worker", spans=[span]))
+    assert out["spans_accepted"] == 1
+    trace.disable()
+    records = [json.loads(ln) for ln in
+               stream.read_text().splitlines() if ln.strip()]
+    landed = [r for r in records if r.get("instance") == "fw9"
+              and "job-9" in (r.get("trace_ids") or ())]
+    assert landed and landed[0]["remote"] == 1
+
+
+# --- federated exposition ----------------------------------------------------
+
+
+def test_fleet_metrics_render_lints_clean_with_instance_labels():
+    """The union page must pass the exposition lint with every series
+    instance/role-labelled, one TYPE per family, histograms rendered
+    with +Inf closure, and the ptpu_fleet_*/ptpu_slo_* meta-series
+    present (the scrape-lint satellite for the new families)."""
+    set_build_info("ldr1", "leader")
+    trace.counter("service.refresh").inc()
+    trace.histogram("refresh_seconds").observe(0.05, mode="warm")
+
+    # a second process's report, built through the real codec
+    follower_snap, _ = snapshot("f1", "follower",
+                                extra={"repl_lag_seconds": 0.4})
+    follower_snap["instruments"] = [
+        i for i in follower_snap["instruments"]
+        if i["name"] != "build_info"]   # its own would carry f1 labels
+    reg = TelemetryRegistry(ttl=30.0)
+    reg.report(follower_snap)
+
+    update_fleet_gauges(reg)
+    eng = SloEngine()
+    eng.sample(gauges=fleet_gauge_view(reg), now=1000.0)
+    eng.evaluate(now=1000.0)
+
+    text = render_fleet_metrics(reg, "ldr1", "leader",
+                                extra={"score_freshness_seconds": 2.0})
+    errors = lint_exposition(text)
+    assert not errors, "\n".join(errors)
+
+    instances = set(re.findall(r'instance="([^"]+)"', text))
+    assert {"ldr1", "f1"} <= instances
+    assert 'ptpu_build_info{' in text and 'version=' in text
+    for family in ("ptpu_fleet_instances", "ptpu_fleet_instance_up",
+                   "ptpu_fleet_report_age_seconds", "ptpu_slo_burn_rate",
+                   "ptpu_slo_in_budget", "ptpu_slo_alert",
+                   "ptpu_slo_objective"):
+        assert f"# TYPE {family} gauge" in text, family
+    assert re.search(r"ptpu_fleet_instances 2(\.0)?\b", text)
+    # histogram closure under the federated labels
+    assert re.search(
+        r'ptpu_refresh_seconds_bucket\{[^}]*instance="ldr1"[^}]*'
+        r'le="\+Inf"[^}]*\} 1', text) or re.search(
+        r'ptpu_refresh_seconds_bucket\{[^}]*le="\+Inf"[^}]*'
+        r'instance="ldr1"[^}]*\} 1', text)
+    # each family's TYPE is declared exactly once
+    types = re.findall(r"# TYPE (\S+)", text)
+    assert len(types) == len(set(types))
+
+
+def test_build_info_identity_stamps_every_record(tmp_path):
+    stream = tmp_path / "t.jsonl"
+    trace.enable(str(stream))
+    set_build_info("inst-7", "follower")
+    with trace.span("poll.once"):
+        pass
+    trace.disable()
+    samples = dict(
+        (tuple(sorted(items)), v)
+        for items, v in trace.gauge("build_info").samples())
+    (labels,) = samples
+    assert dict(labels)["instance"] == "inst-7"
+    assert dict(labels)["role"] == "follower"
+    assert "version" in dict(labels)
+    rec = json.loads(stream.read_text().splitlines()[-1])
+    assert rec.get("instance") == "inst-7"
+    assert rec.get("role") == "follower"
+
+
+# --- merged obs chain view ---------------------------------------------------
+
+
+def test_obs_merges_streams_across_instances(tmp_path, capsys):
+    """``obs <leader> --jsonl <worker> --trace-id <job>`` joins one
+    job's chain across processes: both instances visible, the remote=1
+    shard span attributed."""
+    from protocol_tpu.cli.main import main
+
+    leader = tmp_path / "leader.jsonl"
+    worker = tmp_path / "worker.jsonl"
+    leader.write_text(json.dumps({
+        "type": "span", "name": "prove.shard", "ts": 1000.0,
+        "duration_s": 0.5, "depth": 0, "span_id": "00000001",
+        "trace_ids": ["jobx"], "instance": "ldr1", "role": "leader",
+        "worker": "fw1", "remote": 1}) + "\n")
+    worker.write_text(json.dumps({
+        "type": "span", "name": "fabric.unit", "ts": 1000.1,
+        "duration_s": 0.4, "depth": 0, "span_id": "00000002",
+        "trace_ids": ["jobx"], "instance": "fw1",
+        "role": "prove-worker"}) + "\n")
+
+    rc = main(["--assets", str(tmp_path), "obs", str(leader),
+               "--jsonl", str(worker), "--trace-id", "jobx"])
+    out = capsys.readouterr().out
+    assert rc == 0, out
+    assert "2 span(s)" in out and "0 invalid" in out
+    chain = [ln for ln in out.splitlines() if "instance=" in ln]
+    insts = {m.group(1) for ln in chain
+             for m in [re.search(r"instance=(\S+)", ln)] if m}
+    assert {"ldr1", "fw1"} <= insts, out
+    assert any("remote=1" in ln for ln in chain), out

@@ -32,9 +32,9 @@ echo "tier1: rc=${t1_rc} DOTS_PASSED=${dots}"
 
 rm -f /tmp/_smoke.log
 env JAX_PLATFORMS=cpu python tools/serve_smoke.py --restart --churn \
-    --replica 2>&1 | tee /tmp/_smoke.log
+    --replica --fleet 2>&1 | tee /tmp/_smoke.log
 smoke_rc=${PIPESTATUS[0]}
-echo "serve_smoke --restart --churn --replica: rc=${smoke_rc}"
+echo "serve_smoke --restart --churn --replica --fleet: rc=${smoke_rc}"
 
 # scrape-lint + trace-join + device-observability + delta + pool
 # phases must have actually run, not been skipped by an early exit
@@ -70,6 +70,12 @@ echo "serve_smoke --restart --churn --replica: rc=${smoke_rc}"
 # the leader oracle over the shipped WAL, lag gauge back to 0, score
 # vectors byte-equal at the same WAL position, signed-bundle ETag 304
 # revalidation on the follower, clean drains for both.
+# FLEET_OK asserts the fleet observability plane: a real CLI follower
+# (HTTP telemetry) + a real prove-worker (file-drop telemetry) report
+# into the leader; /fleet/metrics lints clean with >=3 instance labels
+# across the three roles, one sharded prove's trace id joins across
+# >=2 processes via the merged obs chain (remote=1 span included), and
+# every declared SLO evaluates in budget with no latched alert.
 lint_rc=1
 grep -q SCRAPE_LINT_OK /tmp/_smoke.log \
     && grep -q TRACE_JOIN_OK /tmp/_smoke.log \
@@ -82,8 +88,9 @@ grep -q SCRAPE_LINT_OK /tmp/_smoke.log \
     && grep -q SHARDED_PROVE_OK /tmp/_smoke.log \
     && grep -q FABRIC_OK /tmp/_smoke.log \
     && grep -q REPLICA_OK /tmp/_smoke.log \
+    && grep -q FLEET_OK /tmp/_smoke.log \
     && grep -q "DELTA_OK" /tmp/_smoke.log && lint_rc=0
-echo "scrape-lint + trace-join + device-obs + delta + sublinear + pool + commit + sharded + fabric + replica: rc=${lint_rc}"
+echo "scrape-lint + trace-join + device-obs + delta + sublinear + pool + commit + sharded + fabric + replica + fleet: rc=${lint_rc}"
 
 # opt-in perf-regression gate (PTPU_PERF_GATE=1): per-stage timings of
 # the instrumented prove/refresh workloads vs tools/perf_baseline.json.

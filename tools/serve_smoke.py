@@ -72,7 +72,9 @@ def _get_json(url, path):
 
     with urllib.request.urlopen(url + path, timeout=10) as r:
         body = r.read()
-    return json.loads(body) if path != "/metrics" else body.decode()
+    if path.endswith("/metrics"):  # exposition text, not JSON
+        return body.decode()
+    return json.loads(body)
 
 
 def _metric_value(metrics_text, name):
@@ -144,7 +146,7 @@ def _pool_provers():
     return regs, refs
 
 
-def inprocess_phase(node_url, chain, step) -> None:
+def inprocess_phase(node_url, chain, step, fleet=False) -> None:
     import tempfile
 
     from protocol_tpu.client import Client, ClientConfig
@@ -197,7 +199,12 @@ def inprocess_phase(node_url, chain, step) -> None:
                                   # units under state/fabric so the
                                   # fabric phase's real prove-worker
                                   # subprocess can lend into a prove
-                                  shard_proves=1, fabric=1),
+                                  shard_proves=1, fabric=1,
+                                  # fleet phase: sweep file-dropped
+                                  # telemetry + evaluate SLOs fast
+                                  # enough for the smoke's deadlines
+                                  telemetry_interval=0.2,
+                                  telemetry_ttl=15.0, slo_interval=0.5),
             os.path.join(tmp, "cursor"),
             provers=pool_provers,
             faults=FaultInjector({"rpc": 0.0, "device": 0.0, "disk": 0.0}),
@@ -293,6 +300,11 @@ def inprocess_phase(node_url, chain, step) -> None:
         # --- cross-process fabric: an external prove-worker lends in ------
         fabric_prove_phase(url, prove_refs, os.path.join(tmp, "state"),
                            step)
+
+        # --- fleet observability: follower + worker telemetry federated ---
+        if fleet:
+            fleet_phase(url, config, prove_refs,
+                        os.path.join(tmp, "state"), trace_path, step)
 
         # --- end-to-end trace join over the JSONL stream ------------------
         trace_join_phase(trace_path, chain, step)
@@ -1000,6 +1012,15 @@ def fabric_prove_phase(url, refs, state_dir, step) -> None:
             "fabric worker gauge missing from /metrics"
         assert "ptpu_fabric_unit_seconds" in metrics, \
             "fabric unit histogram family missing from /metrics"
+        # the worker publishes its own per-unit wall alongside each
+        # result, so the histogram must carry honest remote samples —
+        # not just the leader-side decode+apply wall
+        remote_samples = [
+            ln for ln in metrics.splitlines()
+            if ln.startswith("ptpu_fabric_unit_seconds_count")
+            and 'source="remote"' in ln and not ln.endswith(" 0")]
+        assert remote_samples, \
+            "no source=\"remote\" fabric unit samples on /metrics"
     finally:
         proc.terminate()
         try:
@@ -1010,6 +1031,223 @@ def fabric_prove_phase(url, refs, state_dir, step) -> None:
     step(f"FABRIC_OK (job {remote_job}: units executed by the external "
          f"prove-worker process, {int(units)} fabric units total, "
          f"bytes == direct prove)")
+
+
+def fleet_phase(url, config, refs, state_dir, trace_path, step) -> None:
+    """Fleet observability on the LIVE daemon: a REAL CLI follower
+    (HTTP telemetry) and a REAL prove-worker (atomic file-drop
+    telemetry under ``<state-dir>/fabric/telemetry``) report into the
+    leader's registry. ``/fleet/metrics`` must render a lint-clean
+    federated exposition with ≥3 distinct ``instance`` labels across
+    the three roles, one sharded prove's trace id must join across ≥2
+    processes through the merged ``obs`` chain view (including the
+    ``remote=1`` shard span), and every declared SLO must be in
+    budget → ``FLEET_OK``."""
+    import json as _json
+    import re
+    import subprocess
+    import tempfile
+    import urllib.request
+
+    from protocol_tpu import native
+    from protocol_tpu.client.storage import JSONFileStorage
+    from protocol_tpu.service.metrics import lint_exposition
+
+    if not native.available():
+        step("FLEET_OK (skipped: no native toolchain — pool provers "
+             "are sleepers, nothing shards)")
+        return
+
+    def submit(kind):
+        req = urllib.request.Request(
+            url + "/proofs", method="POST",
+            data=_json.dumps({"kind": kind, "params": {}}).encode(),
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=30) as r:
+            assert r.status == 202, f"fleet submit got {r.status}"
+            return _json.loads(r.read())["job_id"]
+
+    with tempfile.TemporaryDirectory(prefix="ptpu-smoke-fleet-") as tmp:
+        JSONFileStorage(os.path.join(tmp, "config.json")).save(
+            config.to_dict())
+        worker_jsonl = os.path.join(tmp, "worker.jsonl")
+        env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=REPO,
+                   PTPU_SERVE_TELEMETRY_INTERVAL="0.2")
+        worker = subprocess.Popen(
+            [sys.executable, "-m", "protocol_tpu.cli",
+             "--trace", worker_jsonl,
+             "--assets", os.path.join(state_dir, "assets"),
+             "prove-worker", "--state-dir", state_dir,
+             "--name", "fw-fleet", "--poll", "0.02"],
+            cwd=REPO, env=env,
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT)
+        follower = None
+        try:
+            follower, furl, flines = _spawn_daemon(
+                tmp, {"PTPU_SERVE_TELEMETRY_INTERVAL": "0.2",
+                      "PTPU_SERVE_SLO_INTERVAL": "0.5"},
+                step, "fleet follower", state_dir="fstate",
+                extra_args=("--follow", url))
+
+            # 1) federated registry: all three roles live on /fleet
+            deadline = time.monotonic() + 90
+            fleet = None
+            while time.monotonic() < deadline:
+                fleet = _get_json(url, "/fleet")
+                by_role = fleet["counts"]["by_role"]
+                if (fleet["counts"]["active"] >= 3
+                        and by_role.get("leader")
+                        and by_role.get("follower")
+                        and by_role.get("prove-worker")):
+                    break
+                time.sleep(0.2)
+            else:
+                raise AssertionError(
+                    f"fleet never converged to 3 live roles: {fleet}")
+            for row in fleet["instances"]:
+                # sentinel discipline: "no data yet" must surface as
+                # null, never as a negative age
+                fresh = row.get("score_freshness_seconds")
+                assert fresh is None or fresh >= 0.0, \
+                    f"freshness sentinel leaked into /fleet: {row}"
+            step(f"/fleet: {fleet['counts']['active']} live instances "
+                 f"({fleet['counts']['by_role']})")
+
+            # 2) federated scrape: lint-clean union with instance/role
+            # labels from every process
+            fm = _get_json(url, "/fleet/metrics")
+            errors = lint_exposition(fm)
+            assert not errors, \
+                "fleet scrape lint failed:\n" + "\n".join(errors)
+            instances = set(re.findall(r'instance="([^"]+)"', fm))
+            assert len(instances) >= 3, \
+                f"<3 instances on /fleet/metrics: {sorted(instances)}"
+            roles = set(re.findall(r'role="([^"]+)"', fm))
+            assert {"leader", "follower", "prove-worker"} <= roles, roles
+            assert "ptpu_build_info" in fm, "build info gauge missing"
+            metrics = _get_json(url, "/metrics")
+            for needle in ("ptpu_build_info", "ptpu_fleet_instances",
+                           "ptpu_fleet_instance_up",
+                           "ptpu_slo_burn_rate", "ptpu_slo_in_budget"):
+                assert needle in metrics, f"/metrics missing {needle}"
+            step(f"/fleet/metrics lint-clean "
+                 f"({len(fm.splitlines())} lines, "
+                 f"{len(instances)} instances, roles {sorted(roles)})")
+
+            # 3) a sharded prove lands units on the external worker
+            # (same race as the fabric phase: retry until one does)
+            from protocol_tpu.utils import trace as _trace
+
+            remote_job = None
+            tried = []
+            for _attempt in range(8):
+                jid = submit("sharded")
+                stall = time.monotonic() + 120
+                job = None
+                while time.monotonic() < stall:
+                    job = _get_json(url, f"/proofs/{jid}")
+                    if job["status"] in ("done", "failed"):
+                        break
+                    time.sleep(0.1)
+                assert job is not None and job["status"] == "done", job
+                assert job["result"]["proof"] == refs["sharded"], \
+                    f"{jid}: proof bytes diverged in the fleet phase"
+                remote = {r.fields.get("worker")
+                          for r in _trace.TRACER.spans
+                          if jid in r.trace_ids
+                          and r.name == "prove.shard"
+                          and r.fields.get("remote") == 1}
+                tried.append((jid, sorted(w for w in remote if w)))
+                if "fw-fleet" in remote:
+                    remote_job = jid
+                    break
+            assert remote_job is not None, \
+                f"no unit ever executed by fw-fleet: {tried}"
+
+            # 4) shipped span window: the worker's execution spans land
+            # in the LEADER's JSONL stream stamped instance=fw-fleet
+            deadline = time.monotonic() + 30
+            shipped = False
+            while not shipped and time.monotonic() < deadline:
+                with open(trace_path) as f:
+                    shipped = any(
+                        '"fw-fleet"' in line and remote_job in line
+                        for line in f)
+                if not shipped:
+                    time.sleep(0.2)
+            assert shipped, \
+                f"job {remote_job}: worker spans never shipped into " \
+                f"the leader stream"
+        finally:
+            worker.terminate()
+            try:
+                worker.communicate(timeout=30)
+            except subprocess.TimeoutExpired:
+                worker.kill()
+                worker.communicate()
+            if follower is not None and follower.poll() is None:
+                follower.send_signal(signal.SIGTERM)
+
+        rc = follower.wait(timeout=60)
+        assert rc == 0, \
+            f"fleet follower drain rc={rc}:\n" + "\n".join(flines)
+
+        # 5) cross-process trace join: one chain view over the merged
+        # leader + worker streams shows the job on BOTH instances,
+        # including the remote=1 shard span
+        cli_env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=REPO)
+        obs = subprocess.run(
+            [sys.executable, "-m", "protocol_tpu.cli", "obs",
+             trace_path, "--jsonl", worker_jsonl,
+             "--trace-id", remote_job],
+            cwd=REPO, env=cli_env, capture_output=True, text=True,
+            timeout=120)
+        assert obs.returncode == 0, \
+            f"obs merge rc={obs.returncode}:\n{obs.stdout}\n{obs.stderr}"
+        chain = [ln for ln in obs.stdout.splitlines()
+                 if " instance=" in ln]
+        chain_inst = set()
+        for ln in chain:
+            m = re.search(r"instance=(\S+)", ln)
+            if m:
+                chain_inst.add(m.group(1))
+        assert len(chain_inst) >= 2 and "fw-fleet" in chain_inst, \
+            f"trace {remote_job} did not join across processes: " \
+            f"{sorted(chain_inst)}\n{obs.stdout}"
+        assert "remote=1" in obs.stdout, \
+            f"no remote=1 shard span in the merged chain:\n{obs.stdout}"
+        step(f"trace {remote_job} joins across "
+             f"{sorted(chain_inst)} (remote=1 span present)")
+
+        # 6) SLO engine: everything in budget, nothing latched
+        slo = _get_json(url, "/slo")
+        assert slo["slos"], "SLO engine exposed no evaluations"
+        bad = [s["slo"] for s in slo["slos"] if not s["in_budget"]]
+        assert not bad, f"SLOs out of budget: {bad} :: {slo}"
+        assert not slo["alerting"], f"latched alerts: {slo['alerts']}"
+        status = _get_json(url, "/status")
+        assert status["slo"]["alerting"] is False, status["slo"]
+
+        # 7) the operator verbs against the live daemon
+        fleet_cli = subprocess.run(
+            [sys.executable, "-m", "protocol_tpu.cli",
+             "fleet", "--url", url],
+            cwd=REPO, env=cli_env, capture_output=True, text=True,
+            timeout=60)
+        assert fleet_cli.returncode == 0, fleet_cli.stdout
+        assert "fw-fleet" in fleet_cli.stdout, fleet_cli.stdout
+        slo_cli = subprocess.run(
+            [sys.executable, "-m", "protocol_tpu.cli",
+             "slo", "--url", url],
+            cwd=REPO, env=cli_env, capture_output=True, text=True,
+            timeout=60)
+        assert slo_cli.returncode == 0, \
+            f"slo verb rc={slo_cli.returncode} (alert latched?):\n" \
+            f"{slo_cli.stdout}"
+
+    step(f"FLEET_OK ({len(instances)} instances federated, trace "
+         f"{remote_job} joined across {len(chain_inst)} processes, "
+         f"{len(slo['slos'])} SLOs in budget)")
 
 
 def _counter_total(name) -> float:
@@ -1427,6 +1665,7 @@ def main(argv=None) -> int:
     restart = "--restart" in argv
     churn = "--churn" in argv
     replica = "--replica" in argv
+    fleet = "--fleet" in argv
 
     from protocol_tpu.client.chain import RpcChain
     from protocol_tpu.client.eth import ecdsa_keypairs_from_mnemonic
@@ -1444,7 +1683,7 @@ def main(argv=None) -> int:
     chain = RpcChain.deploy_signed(node_url, deployer)
     step(f"AttestationStation at 0x{chain.contract_address.hex()}")
 
-    inprocess_phase(node_url, chain, step)
+    inprocess_phase(node_url, chain, step, fleet=fleet)
     if restart:
         # a fresh contract so phase 1's attestations don't bleed in
         chain2 = RpcChain.deploy_signed(node_url, deployer)
