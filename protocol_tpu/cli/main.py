@@ -258,6 +258,22 @@ def build_parser() -> argparse.ArgumentParser:
                    help="print the raw /slo JSON instead of the table")
 
     p = sub.add_parser(
+        "incident",
+        help="incident autopsies: list a live daemon's captured "
+             "flight-recorder bundles (/incidents), or render one as a "
+             "human-readable autopsy — trigger, burn timeline, top "
+             "spans, device cost per compiled plan, named-thread "
+             "stacks")
+    p.add_argument("--url", required=True,
+                   help="daemon base URL (http://host:port) — leader "
+                        "or follower (each keeps its own store)")
+    p.add_argument("--id", default=None,
+                   help="incident id to render (default: list the "
+                        "index; 'latest' renders the newest bundle)")
+    p.add_argument("--json", action="store_true",
+                   help="print the raw JSON instead of the autopsy")
+
+    p = sub.add_parser(
         "profile",
         help="run a workload under sync-span tracing (+ optional xprof "
              "capture) and emit a merged per-stage report")
@@ -1100,13 +1116,30 @@ def handle_prove_worker(args, files, config):
                          "lease_ttl": args.lease_ttl})
     threading.Thread(target=pusher.run, args=(stop,), daemon=True,
                      name="ptpu-telemetry").start()
+    # stall watchdog (no incident store — the worker's gauges ship to
+    # the leader via telemetry, where the fleet-wide SLO path pages):
+    # the worker loop heartbeats, a wedged native call ages it out
+    import functools
+
+    from ..service.watchdog import Heartbeats, StallWatchdog
+
+    beats = Heartbeats()
+    loop_name = f"ptpu-worker-{name}"
+    beats.register(loop_name)
+    watchdog = StallWatchdog(
+        beats,
+        stall_after=float(_os.environ.get(
+            "PTPU_SERVE_WATCHDOG_STALL_AFTER", "30") or 30))
+    watchdog.start()
     print(f"prove-worker {name} polling {where} "
           f"(lease ttl {args.lease_ttl:g}s)", flush=True)
     executed = run_worker(fabric, name, poll=args.poll,
                           lease_ttl=args.lease_ttl,
                           max_units=args.max_units,
-                          idle_exit=args.idle_exit, stop=stop)
+                          idle_exit=args.idle_exit, stop=stop,
+                          beat=functools.partial(beats.beat, loop_name))
     stop.set()
+    watchdog.stop()
     # one farewell push so the final units' spans/instruments ship
     # even on a quick exit (best-effort, like every push)
     pusher.push_once()
@@ -1170,10 +1203,23 @@ def handle_obs(args, files, config):
         if args.trace_id and matches(obj, args.trace_id):
             chain.append(obj)
 
+    # size-rotation awareness (PTPU_TRACE_MAX_BYTES): a stream's `.1`
+    # sibling holds the OLDER records — fold it in first so aggregates
+    # cover the whole history and chains stay whole across a rotation
+    import os as _os
+
+    def _with_rotated(path: str) -> list:
+        sib = path + ".1"
+        return [sib, path] if _os.path.exists(sib) else [path]
+
     # merged streams (--jsonl, repeatable): other processes' trace
     # files fold into the same aggregate + chain view — the
-    # cross-process trace join (worker spans carry instance/role)
-    for extra in args.extra_jsonl:
+    # cross-process trace join (worker spans carry instance/role);
+    # the main stream's rotated sibling rides this loop too
+    extra_streams = [p for e in args.extra_jsonl
+                     for p in _with_rotated(e)]
+    extra_streams += _with_rotated(args.path)[:-1]
+    for extra in extra_streams:
         try:
             ef = open(extra)
         except OSError as e:
@@ -1436,6 +1482,42 @@ def handle_slo(args, files, config):
     return 1 if slo.get("alerting") else 0
 
 
+def handle_incident(args, files, config):
+    """List a daemon's incident bundles, or render one as the
+    human-readable autopsy (``service/recorder.py::render_autopsy``)."""
+    if args.id is None:
+        index = _fetch_json(args.url, "/incidents")
+        if args.json:
+            print(json.dumps(index, indent=2))
+            return 0
+        rows = index.get("incidents", [])
+        print(f"incidents @ {args.url}: {len(rows)} bundle(s)")
+        for r in rows:
+            import time as _time
+
+            ts = r.get("captured_at")
+            when = (_time.strftime("%Y-%m-%d %H:%M:%S",
+                                   _time.localtime(ts)) if ts else "?")
+            print(f"  {r.get('id', '?')}  {when}  "
+                  f"[{r.get('trigger', '?')}] {r.get('reason', '')}")
+        return 0
+    inc_id = args.id
+    if inc_id == "latest":
+        rows = _fetch_json(args.url, "/incidents").get("incidents", [])
+        if not rows:
+            print("no incidents captured", file=sys.stderr)
+            return 1
+        inc_id = rows[-1]["id"]
+    bundle = _fetch_json(args.url, f"/incidents/{inc_id}")
+    if args.json:
+        print(json.dumps(bundle, indent=2))
+        return 0
+    from ..service.recorder import render_autopsy
+
+    print(render_autopsy(bundle), end="")
+    return 0
+
+
 def handle_profile(args, files, config):
     from .profilecmd import handle_profile as _handle
 
@@ -1460,6 +1542,7 @@ HANDLERS = {
     "et-proving-key": handle_et_pk,
     "et-verify": handle_et_verify,
     "fleet": handle_fleet,
+    "incident": handle_incident,
     "kzg-params": handle_kzg_params,
     "obs": handle_obs,
     "slo": handle_slo,

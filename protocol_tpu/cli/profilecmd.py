@@ -463,7 +463,8 @@ def run_fabric_workload(k: int = 7, gates: int = 64, jobs: int = 3,
     stop = threading.Event()
     worker = threading.Thread(
         target=run_worker, args=(fabric, "fw-gate"),
-        kwargs={"poll": 0.01, "stop": stop}, daemon=True)
+        kwargs={"poll": 0.01, "stop": stop},
+        name="ptpu-profile-worker", daemon=True)
     worker.start()
     try:
         deadline = time.monotonic() + 60.0

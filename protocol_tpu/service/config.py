@@ -195,6 +195,25 @@ class ServiceConfig:
     slo_fast_window: float = 60.0
     slo_slow_window: float = 300.0
 
+    # --- incident flight recorder (ISSUE 20) ------------------------------
+    # bounded in-memory ring of notable moments (SLO transitions,
+    # stall dumps, compile events) frozen into every capture
+    incident_ring_cap: int = 2048
+    # bundles retained under <state-dir>/incidents (oldest evicted)
+    incident_retention: int = 16
+    # minimum seconds between automatic captures — a flapping SLO must
+    # not write bundles in a loop; operator POSTs bypass with force
+    incident_min_interval: float = 30.0
+    # stall watchdog: evaluation cadence and the heartbeat age past
+    # which a service thread is declared stalled (stack dumped into
+    # the ring + incident capture + ptpu_thread_stalled=1). Keep the
+    # stall threshold aligned with the thread_stall SLO threshold.
+    watchdog_interval: float = 1.0
+    watchdog_stall_after: float = 30.0
+    # test/smoke-only: 1 exposes POST /debug/fail (always answers 500)
+    # so an error-rate SLO burn can be forced on a live daemon
+    debug_faults: int = 0
+
     # --- lifecycle --------------------------------------------------------
     drain_timeout: float = 30.0     # SIGTERM: budget to finish in-flight
 

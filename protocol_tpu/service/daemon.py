@@ -162,6 +162,31 @@ class TrustService:
         self.telemetry = TelemetryRegistry(ttl=config.telemetry_ttl)
         self.slo = SloEngine(fast_window=config.slo_fast_window,
                              slow_window=config.slo_slow_window)
+        # the incident plane (ISSUE 20): an always-on flight-recorder
+        # ring, per-thread heartbeats + the stall watchdog, and — with
+        # a state dir — the rate-limited autopsy-bundle store under
+        # <state-dir>/incidents (memory-only daemons keep the ring and
+        # the watchdog; there is just nowhere durable to freeze it)
+        from .recorder import FlightRecorder, IncidentStore
+        from .watchdog import Heartbeats, StallWatchdog
+
+        self.recorder = FlightRecorder(cap=config.incident_ring_cap)
+        self.beats = Heartbeats()
+        self.incidents = (IncidentStore(
+            os.path.join(str(state_dir), "incidents"), self.recorder,
+            retention=config.incident_retention,
+            min_interval=config.incident_min_interval)
+            if state_dir else None)
+        self.watchdog = StallWatchdog(
+            self.beats, recorder=self.recorder, store=self.incidents,
+            interval=config.watchdog_interval,
+            stall_after=config.watchdog_stall_after)
+        if self.incidents is not None:
+            # the getattr-gated HTTP surfaces (absent → 404, the same
+            # pattern as the fleet registry on a follower)
+            self.incident_index = self.incidents.index
+            self.incident_bundle = self.incidents.load
+            self.incident_capture = self._capture_incident
         self.store = None
         if state_dir:
             from ..store import StateStore
@@ -182,7 +207,8 @@ class TrustService:
             self.graph, config, backend=backend, faults=self.faults,
             operator_cache_dir=(self.store.operators_dir
                                 if self.store else None),
-            pending_traces=self.pending_traces)
+            pending_traces=self.pending_traces,
+            recorder=self.recorder)
         self._attestations: list = []
         self._att_blocks: list = []  # parallel: block number per entry
         # (snapshots persist them so restart dedup keys stay exact)
@@ -717,6 +743,14 @@ class TrustService:
         # flags, and the LATCHED alerts (stay up until both windows
         # recover) — the /status face of /slo
         out["slo"] = self.slo.status()
+        # the incident plane: ring occupancy, currently-stalled
+        # threads, and (with a store) how many bundles are retained
+        out["incidents"] = {
+            "ring": len(self.recorder),
+            "stalled_threads": self.watchdog.stalled(),
+            "retained": (len(self.incidents.list_ids())
+                         if self.incidents is not None else None),
+        }
         return out
 
     def health(self) -> dict:
@@ -834,25 +868,82 @@ class TrustService:
         """``GET /slo``: the engine's latest evaluation."""
         return self.slo.status()
 
+    # --- incident plane -----------------------------------------------------
+    def _incident_context(self) -> dict:
+        """Everything an autopsy wants frozen alongside the ring: SLO
+        window state, the full operator status page, fleet rows, the
+        effective config, and the metrics exposition as text. Each
+        item best-effort — a sick subsystem is exactly when captures
+        happen, and a failing context getter must not void the bundle."""
+        from dataclasses import asdict
+
+        from .metrics import render_prometheus
+        from .telemetry import fleet_rows
+
+        ctx: dict = {}
+        for name, build in (
+                ("slo", self.slo.status),
+                ("status", self.status),
+                ("config", lambda: asdict(self.config)),
+                ("fleet", lambda: fleet_rows(self.telemetry,
+                                             self._local_fleet_row())),
+                ("metrics.txt", lambda: render_prometheus(
+                    self.extra_metrics()))):
+            try:
+                ctx[name] = build()
+            except Exception:  # noqa: BLE001 - see docstring
+                pass
+        return ctx
+
+    def _capture_incident(self, trigger: str, reason: str) -> str | None:
+        """SLO-latch / operator-POST capture with full daemon context;
+        operator captures bypass the rate limit (a human asked)."""
+        if self.incidents is None:
+            return None
+        return self.incidents.capture(
+            trigger, reason, context=self._incident_context(),
+            force=(trigger == "operator"))
+
     def _observe(self, stop: threading.Event) -> None:
         """The observer thread: sweep file-dropped worker telemetry,
         refresh the fleet gauges, and tick the SLO engine over the
         fleet-wide (sentinel-honest) gauge view."""
+        from .recorder import update_device_memory_gauges
         from .telemetry import fleet_gauge_view, update_fleet_gauges
 
         interval = max(0.05, min(self.config.slo_interval,
                                  self.config.telemetry_interval))
+        prev_alerts: set = set()
         while not stop.is_set():
+            self.beats.beat("ptpu-observer")
             try:
                 if self._telemetry_drop is not None:
                     self.telemetry.sweep_dir(self._telemetry_drop)
                 update_fleet_gauges(self.telemetry)
+                update_device_memory_gauges()
                 freshness = self.score_freshness_seconds()
                 local = {"score_freshness_seconds":
                          freshness if freshness >= 0.0 else None}
-                self.slo.sample(
-                    gauges=fleet_gauge_view(self.telemetry, local=local))
+                gauges = fleet_gauge_view(self.telemetry, local=local)
+                # feed the thread_stall SLO: the watchdog exports the
+                # per-thread gauges, the engine burns on the fleet max
+                age = self.beats.max_age()
+                if age is not None:
+                    gauges["thread_heartbeat_age_max_seconds"] = age
+                self.slo.sample(gauges=gauges)
                 self.slo.evaluate()
+                # SLO transitions into the ring; a NEW latch freezes
+                # it into a bundle (rate-limited by the store)
+                for name in self.slo.new_alerts():
+                    self.recorder.note("slo_latched", slo=name)
+                    self._capture_incident(
+                        "slo", f"SLO {name} latched "
+                               "(burn-rate alert tripped)")
+                cur = {r["slo"] for r in self.slo.status()["slos"]
+                       if r["alerting"]}
+                for name in sorted(prev_alerts - cur):
+                    self.recorder.note("slo_released", slo=name)
+                prev_alerts = cur
             except Exception:  # noqa: BLE001 - observability must not
                 pass           # take the service down
             stop.wait(interval)
@@ -872,16 +963,26 @@ class TrustService:
         if not trace.TRACER.enabled:
             trace.enable()  # e.g. the CLI's --trace teardown ran between
         self.started_at = time.time()
-        self.jobs.start()
+        self.jobs.start(beats=self.beats)
+        # register every long-lived loop BEFORE its thread starts, so
+        # a thread that wedges on its very first iteration still reads
+        # as a stall rather than never existing; then the watchdog
+        import functools
+
+        for name in ("ptpu-tailer", "ptpu-refresher", "ptpu-observer"):
+            self.beats.register(name)
+        self.watchdog.start()
         t = threading.Thread(
             target=self.tailer.run,
-            args=(self._stop, self.config.poll_interval),
+            args=(self._stop, self.config.poll_interval,
+                  functools.partial(self.beats.beat, "ptpu-tailer")),
             daemon=True, name="ptpu-tailer")
         t.start()
         self._threads.append(t)
         t = threading.Thread(
             target=self.refresher.run,
-            args=(self._stop, self._dirty, self.config.refresh_interval),
+            args=(self._stop, self._dirty, self.config.refresh_interval,
+                  functools.partial(self.beats.beat, "ptpu-refresher")),
             daemon=True, name="ptpu-refresher")
         t.start()
         self._threads.append(t)
@@ -913,9 +1014,14 @@ class TrustService:
         trace.event("service.draining", timeout_s=timeout)
         self._stop.set()
         self._dirty.set()  # unblock the refresher wait
+        # the watchdog goes first: joining threads stop beating, and a
+        # drain must never read as a thread-stall incident
+        self.watchdog.stop()
         deadline = time.monotonic() + timeout
         for t in self._threads:
             t.join(timeout=max(0.1, deadline - time.monotonic()))
+        for name in ("ptpu-tailer", "ptpu-refresher", "ptpu-observer"):
+            self.beats.unregister(name)
         clean = not any(t.is_alive() for t in self._threads)
         clean = self.jobs.drain(
             timeout=max(0.1, deadline - time.monotonic())) and clean

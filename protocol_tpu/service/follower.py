@@ -113,10 +113,29 @@ class FollowerService:
             faults=self.faults)
         self.graph = OpinionGraph()
         self.pending_traces = trace.PendingTraces()
+        # the incident plane (ISSUE 20), same shape as the leader's: a
+        # follower always has a state dir, so it always gets a store
+        from .recorder import FlightRecorder, IncidentStore
+        from .watchdog import Heartbeats, StallWatchdog
+
+        self.recorder = FlightRecorder(cap=config.incident_ring_cap)
+        self.beats = Heartbeats()
+        self.incidents = IncidentStore(
+            os.path.join(str(state_dir), "incidents"), self.recorder,
+            retention=config.incident_retention,
+            min_interval=config.incident_min_interval)
+        self.watchdog = StallWatchdog(
+            self.beats, recorder=self.recorder, store=self.incidents,
+            interval=config.watchdog_interval,
+            stall_after=config.watchdog_stall_after)
+        self.incident_index = self.incidents.index
+        self.incident_bundle = self.incidents.load
+        self.incident_capture = self._capture_incident
         self.refresher = ScoreRefresher(
             self.graph, config, backend=backend, faults=self.faults,
             operator_cache_dir=self.store.operators_dir,
-            pending_traces=self.pending_traces)
+            pending_traces=self.pending_traces,
+            recorder=self.recorder)
         self.freshness = FreshnessTracker()
         if config.follower_id:
             follower_id = config.follower_id
@@ -490,6 +509,7 @@ class FollowerService:
         """The ship-tail loop: the chain tailer's backoff discipline
         over :meth:`poll_once`, polling back-to-back while behind."""
         while not stop_event.is_set():
+            self.beats.beat("ptpu-ship-tail")
             try:
                 got = self.poll_once()
                 self.consecutive_failures = 0
@@ -591,6 +611,11 @@ class FollowerService:
             "delta": self.refresher.delta_status(),
             "repl": self.repl_status(),
             "slo": self.slo.status(),
+            "incidents": {
+                "ring": len(self.recorder),
+                "stalled_threads": self.watchdog.stalled(),
+                "retained": len(self.incidents.list_ids()),
+            },
             "store": {
                 "wal_segments": wal["segments"],
                 "wal_bytes": wal["bytes"],
@@ -638,22 +663,57 @@ class FollowerService:
             "score_revision": self.refresher.table.revision,
         }
 
+    def _incident_context(self) -> dict:
+        """The follower's autopsy context (best-effort per item)."""
+        from dataclasses import asdict
+
+        from .metrics import render_prometheus
+
+        ctx: dict = {}
+        for name, build in (
+                ("slo", self.slo.status),
+                ("status", self.status),
+                ("config", lambda: asdict(self.config)),
+                ("metrics.txt", lambda: render_prometheus(
+                    self.extra_metrics()))):
+            try:
+                ctx[name] = build()
+            except Exception:  # noqa: BLE001 - a failing context
+                pass           # getter must not void the bundle
+        return ctx
+
+    def _capture_incident(self, trigger: str, reason: str) -> str | None:
+        return self.incidents.capture(
+            trigger, reason, context=self._incident_context(),
+            force=(trigger == "operator"))
+
     def _slo_tick(self) -> None:
         """Sample + evaluate this replica's SLOs (sentinel-honest:
         -1 freshness/lag means "no data"), at most once per
         ``slo_interval`` — threaded through the telemetry push loop."""
+        # heartbeat every CALL (the pusher loop's cadence), before the
+        # SLO-cadence early return below
+        self.beats.beat("ptpu-telemetry")
         now = time.monotonic()
         if now - self._last_slo_tick < self.config.slo_interval:
             return
         self._last_slo_tick = now
         freshness = self.score_freshness_seconds()
         lag = self.repl_lag_seconds()
-        self.slo.sample(gauges={
+        gauges = {
             "score_freshness_seconds":
                 freshness if freshness >= 0.0 else None,
             "repl_lag_seconds": lag if lag >= 0.0 else None,
-        })
+        }
+        age = self.beats.max_age()
+        if age is not None:
+            gauges["thread_heartbeat_age_max_seconds"] = age
+        self.slo.sample(gauges=gauges)
         self.slo.evaluate()
+        for name in self.slo.new_alerts():
+            self.recorder.note("slo_latched", slo=name)
+            self._capture_incident(
+                "slo", f"SLO {name} latched (burn-rate alert tripped)")
 
     # --- lifecycle --------------------------------------------------------
     @property
@@ -667,6 +727,12 @@ class FollowerService:
         if not trace.TRACER.enabled:
             trace.enable()
         self.started_at = time.time()
+        import functools
+
+        for name in ("ptpu-ship-tail", "ptpu-refresher",
+                     "ptpu-telemetry"):
+            self.beats.register(name)
+        self.watchdog.start()
         t = threading.Thread(
             target=self.run_tail,
             args=(self._stop, self.config.poll_interval),
@@ -675,7 +741,8 @@ class FollowerService:
         self._threads.append(t)
         t = threading.Thread(
             target=self.refresher.run,
-            args=(self._stop, self._dirty, self.config.refresh_interval),
+            args=(self._stop, self._dirty, self.config.refresh_interval,
+                  functools.partial(self.beats.beat, "ptpu-refresher")),
             daemon=True, name="ptpu-refresher")
         t.start()
         self._threads.append(t)
@@ -713,9 +780,14 @@ class FollowerService:
         trace.event("follower.draining", timeout_s=timeout)
         self._stop.set()
         self._dirty.set()
+        # watchdog first: a drain must never read as a thread stall
+        self.watchdog.stop()
         deadline = time.monotonic() + timeout
         for t in self._threads:
             t.join(timeout=max(0.1, deadline - time.monotonic()))
+        for name in ("ptpu-ship-tail", "ptpu-refresher",
+                     "ptpu-telemetry"):
+            self.beats.unregister(name)
         clean = not any(t.is_alive() for t in self._threads)
         if clean:
             commit_service_snapshot(self.store, self.graph,

@@ -47,6 +47,15 @@ Routes:
   local + reported instrument state with ``instance``/``role`` labels
 - ``GET /slo``            the SLO burn-rate engine's current
   evaluation (burn per window, in-budget flags, latched alerts)
+- ``GET /incidents``      index of captured incident bundles (id,
+  trigger, reason, captured_at) — newest last
+- ``GET /incidents/<id>`` one full autopsy bundle as JSON (meta,
+  frozen flight-recorder ring, thread stacks, SLO window state,
+  metrics snapshot, plan costs) — the ``incident`` CLI verb renders it
+- ``POST /incidents/capture``  operator-forced capture (bypasses the
+  rate limit) → 201 + the new bundle id
+- ``POST /debug/fail``    always answers 500 — present ONLY with
+  ``debug_faults=1`` (smoke/test), to force an error-rate SLO burn
 
 ``/scores`` and ``/score/<addr>`` carry a strong revision-derived ETag
 and honor ``If-None-Match`` (304, headers only) on leader and follower
@@ -91,8 +100,11 @@ def _route_template(method: str, path: str) -> str:
     if path in ("/healthz", "/status", "/scores", "/metrics", "/stages",
                 "/bundle", "/repl/wal", "/repl/snapshot",
                 "/fabric/units", "/fabric/claims", "/fabric/workers",
-                "/telemetry", "/fleet", "/fleet/metrics", "/slo"):
+                "/telemetry", "/fleet", "/fleet/metrics", "/slo",
+                "/incidents", "/incidents/capture", "/debug/fail"):
         return path
+    if path.startswith("/incidents/"):
+        return "/incidents/{id}"
     if path.startswith("/fabric/blob/"):
         return "/fabric/blob/{digest}"
     if path.startswith("/fabric/results/"):
@@ -215,6 +227,24 @@ def make_server(service, host: str, port: int) -> ThreadingHTTPServer:
                     return self._reply(
                         404, {"error": "no SLO engine on this process"})
                 return self._reply(200, slo())
+            if path == "/incidents":
+                index = getattr(service, "incident_index", None)
+                if index is None:
+                    return self._reply(
+                        404, {"error": "no incident store on this "
+                                       "process (needs a state dir)"})
+                return self._reply(200, {"incidents": index()})
+            if path.startswith("/incidents/"):
+                load = getattr(service, "incident_bundle", None)
+                if load is None:
+                    return self._reply(
+                        404, {"error": "no incident store on this "
+                                       "process (needs a state dir)"})
+                bundle = load(path[len("/incidents/"):])
+                if bundle is None:
+                    return self._reply(
+                        404, {"error": "unknown incident id"})
+                return self._reply(200, bundle)
             if path == "/scores":
                 table = service.refresher.table
                 # revision-derived strong ETag: a conditional scrape of
@@ -361,6 +391,27 @@ def make_server(service, host: str, port: int) -> ThreadingHTTPServer:
             if path in ("/fabric/claims", "/fabric/workers") \
                     or path.startswith("/fabric/results/"):
                 return self._handle_fabric_post(path)
+            if path == "/incidents/capture":
+                capture = getattr(service, "incident_capture", None)
+                if capture is None:
+                    return self._reply(
+                        404, {"error": "no incident store on this "
+                                       "process (needs a state dir)"})
+                inc_id = capture("operator", "POST /incidents/capture")
+                if inc_id is None:
+                    return self._reply(
+                        500, {"error": "incident capture failed "
+                                       "(see ptpu_incidents_capture_"
+                                       "errors_total)"})
+                return self._reply(201, {"id": inc_id})
+            if path == "/debug/fail":
+                # smoke/test-only fault injection: burns the
+                # error_rate SLO through the real request path. Absent
+                # (404) unless explicitly enabled.
+                cfg = getattr(service, "config", None)
+                if not getattr(cfg, "debug_faults", 0):
+                    return self._reply(404, {"error": f"no route {path}"})
+                return self._reply(500, {"error": "injected debug fault"})
             if path == "/telemetry":
                 report = getattr(service, "telemetry_report", None)
                 if report is None:

@@ -140,7 +140,12 @@ DECLARED_COUNTERS = ("xla_compiles", "xla_steady_recompiles",
                      "repl_chunks", "repl_records_shipped",
                      "scenario_runs", "fabric_units",
                      "fabric_leases_expired",
-                     "telemetry_reports", "telemetry_push_failures")
+                     "telemetry_reports", "telemetry_push_failures",
+                     # incident plane: watchdog stall detections and
+                     # flight-recorder capture outcomes
+                     "thread_stalls", "incidents_captured",
+                     "incidents_rate_limited", "incidents_evicted",
+                     "incidents_capture_errors")
 DECLARED_GAUGES = ("converge_iterations", "converge_residual",
                    "proof_queue_depth", "dirty_rows",
                    "refresh_frontier_peak", "refresh_budget_spent",
@@ -157,7 +162,17 @@ DECLARED_GAUGES = ("converge_iterations", "converge_residual",
                    "fleet_instances", "fleet_instance_up",
                    "fleet_report_age_seconds",
                    "slo_burn_rate", "slo_in_budget", "slo_alert",
-                   "slo_objective")
+                   "slo_objective",
+                   # incident plane: per-thread heartbeat ages / stall
+                   # flags from the watchdog, retained-bundle count,
+                   # and the per-plan device-cost attribution series
+                   # (XLA cost_analysis at plan build; operand bytes
+                   # are the lowering-side resident estimate)
+                   "thread_heartbeat_age_seconds", "thread_stalled",
+                   "incidents_retained",
+                   "plan_flops", "plan_bytes_accessed",
+                   "plan_operand_bytes",
+                   "device_bytes_in_use", "device_peak_bytes_in_use")
 
 
 def declare_instruments() -> None:

@@ -816,9 +816,16 @@ class ProofWorkerPool:
             return self._last_done.get(kind)
 
     # --- workers ----------------------------------------------------------
-    def start(self) -> None:
+    def start(self, beats=None) -> None:
+        """``beats`` (optional ``watchdog.Heartbeats``): each worker
+        heartbeats at the top of every loop iteration — idle workers
+        wake at least every 0.5s, so only a wedged prove (native call
+        that never returns) ages a worker's heartbeat."""
+        self._beats = beats
         trace.gauge("proof_pool_workers").set(float(len(self.workers)))
         for w in self.workers:
+            if beats is not None:
+                beats.register(f"ptpu-proof-{w.name}")
             w.thread = threading.Thread(
                 target=self._run_worker, args=(w,), daemon=True,
                 name=f"ptpu-proof-{w.name}")
@@ -856,12 +863,19 @@ class ProofWorkerPool:
             with trace.worker_context(w.name):
                 self._worker_loop(w)
         finally:
+            beats = getattr(self, "_beats", None)
+            if beats is not None:
+                # a drained/killed worker is RETIRED, not stalled
+                beats.unregister(f"ptpu-proof-{w.name}")
             if env is not None:
                 with contextlib.suppress(Exception):
                     env.__exit__(None, None, None)
 
     def _worker_loop(self, w: PoolWorker) -> None:
+        beats = getattr(self, "_beats", None)
         while True:
+                if beats is not None:
+                    beats.beat(f"ptpu-proof-{w.name}")
                 unit = None
                 with self._lock:
                     if self._killed:

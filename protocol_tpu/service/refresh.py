@@ -143,7 +143,7 @@ class ScoreRefresher:
     def __init__(self, graph: OpinionGraph, config: ServiceConfig,
                  backend=None, faults: FaultInjector | None = None,
                  operator_cache_dir: str | None = None,
-                 pending_traces=None):
+                 pending_traces=None, recorder=None):
         """``pending_traces``: optional ``trace.PendingTraces`` — the
         ingest sink records applied attestations' trace ids there; each
         refresh takes the ids at-or-below the revision it publishes and
@@ -152,6 +152,9 @@ class ScoreRefresher:
         self.graph = graph
         self.config = config
         self.pending_traces = pending_traces
+        # optional FlightRecorder: plan builds note their device-cost
+        # row into the incident ring (ISSUE 20)
+        self.recorder = recorder
         self.faults = faults or FaultInjector({"rpc": 0.0, "device": 0.0})
         if backend is None:
             from ..backend import JaxSparseBackend
@@ -230,6 +233,7 @@ class ScoreRefresher:
                         op = RoutedOperator.load(path)
                     self._op, self._op_digest = op, digest
                     self.operator_hits += 1
+                    self._capture_plan_cost(op)
                     return op
                 except Exception:  # noqa: BLE001 - corrupt cache entry:
                     # rebuild rather than brick the refresh loop
@@ -246,7 +250,25 @@ class ScoreRefresher:
                 trace.event("service.operator_cache_write_failed",
                             path=path)
         self._op, self._op_digest = op, digest
+        self._capture_plan_cost(op)
         return op
+
+    def _capture_plan_cost(self, op) -> None:
+        """Device-cost attribution at plan adoption (fresh build OR
+        disk load — either way this is the plan served next): lower
+        one spmv at the plan's shapes, read XLA ``cost_analysis()``
+        into the ``ptpu_plan_*`` gauges. ``lower()`` only — the
+        steady-recompile latch cannot trip. Best-effort: cost capture
+        must never fail a refresh."""
+        try:
+            from ..ops.routed import routed_arrays
+            from .recorder import capture_routed_plan_cost
+
+            arrs, static = routed_arrays(op, alpha=self.config.alpha)
+            capture_routed_plan_cost(arrs, static, op.n_state,
+                                     recorder=self.recorder)
+        except Exception:  # noqa: BLE001 - attribution is advisory
+            pass
 
     def _prune_operator_cache(self, keep: int) -> None:
         """Drop all but the newest ``keep`` cached operators: under
@@ -646,12 +668,17 @@ class ScoreRefresher:
             })
         return out
 
-    def run(self, stop_event, dirty_event, refresh_interval: float) -> None:
+    def run(self, stop_event, dirty_event, refresh_interval: float,
+            beat=None) -> None:
         """Refresher loop: wake on new data (or the interval), refresh,
         repeat. Failures (injected device faults included) back off one
         interval and retry — the published table is never torn down on
-        failure."""
+        failure. ``beat`` (optional callable): stall-watchdog
+        heartbeat, called every wake — a device hang inside refresh()
+        reads as a stall, an idle interval does not."""
         while not stop_event.is_set():
+            if beat is not None:
+                beat()
             dirty_event.wait(refresh_interval)
             if stop_event.is_set():
                 return

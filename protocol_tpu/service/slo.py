@@ -139,6 +139,14 @@ def default_slos() -> list:
                 source="http_request_seconds",
                 bad_label=("status", "5"),
                 description="HTTP non-5xx response rate"),
+        # fed by the stall watchdog: the fleet-max heartbeat age of the
+        # long-lived service threads. A stalled thread pages through
+        # the SAME burn-rate path as every other objective — the
+        # watchdog has no parallel alerting channel.
+        SloSpec("thread_stall", "gauge", 0.95,
+                source="thread_heartbeat_age_max_seconds",
+                threshold=30.0,
+                description="max service-thread heartbeat age <= 30s"),
     ]
 
 
@@ -156,6 +164,10 @@ class SloEngine:
         # spec name -> {"since": wall ts, "trips": n}
         self._alerts: dict = {}
         self._last_eval: list = []
+        # spec names that latched during the most recent evaluate() —
+        # the flight recorder's capture trigger (read right after
+        # evaluate by the single observer/tick thread)
+        self._new_alerts: list = []
 
     # --- sampling ----------------------------------------------------------
 
@@ -203,6 +215,7 @@ class SloEngine:
     def evaluate(self, now: float | None = None) -> list:
         now = time.monotonic() if now is None else now
         results = []
+        new_alerts = []
         with self._lock:
             for spec in self.specs:
                 groups = []
@@ -231,6 +244,7 @@ class SloEngine:
                         "since": time.time(),
                         "trips": 1,
                     }
+                    new_alerts.append(spec.name)
                 elif latch is not None:
                     # latched: release only once BOTH windows recover
                     if worst_fast <= 1.0 and worst_slow <= 1.0:
@@ -252,8 +266,15 @@ class SloEngine:
                         self._alerts.get(spec.name, {}).get("since"),
                 })
             self._last_eval = results
+            self._new_alerts = new_alerts
         self._export(results)
         return results
+
+    def new_alerts(self) -> list:
+        """Spec names that latched during the most recent
+        :meth:`evaluate` — the incident-capture trigger."""
+        with self._lock:
+            return list(self._new_alerts)
 
     def _export(self, results) -> None:
         burn = trace.gauge("slo_burn_rate")

@@ -167,11 +167,17 @@ class ChainTailer:
         return len(batch)
 
     # --- supervised loop --------------------------------------------------
-    def run(self, stop_event, poll_interval: float = 1.0) -> None:
+    def run(self, stop_event, poll_interval: float = 1.0,
+            beat=None) -> None:
         """Poll until ``stop_event``; exponential backoff on failure,
         reset on success. The cursor survives every failure mode short
-        of losing the checkpoint directory."""
+        of losing the checkpoint directory. ``beat`` (optional
+        callable): stall-watchdog heartbeat, called every iteration —
+        backoff counts as alive, a wedged RPC inside poll_once does
+        not."""
         while not stop_event.is_set():
+            if beat is not None:
+                beat()
             try:
                 self.poll_once()
                 self.consecutive_failures = 0

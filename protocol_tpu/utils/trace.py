@@ -409,6 +409,11 @@ class Tracer:
         self._emit_lock = threading.Lock()
         self._local = threading.local()
         self._stream = None
+        self._stream_path: str | None = None
+        self._stream_bytes = 0
+        # size cap on the JSONL stream (PTPU_TRACE_MAX_BYTES; 0 =
+        # unbounded): past it the file rotates to <path>.1
+        self._stream_max_bytes = 0
         self.spans: list = []
         self.events: list = []
         # per-name duration windows for percentile estimates: bounded
@@ -433,6 +438,17 @@ class Tracer:
             # by a CLI --jsonl flag)
             old = self._stream
             self._stream = open(stream_path, "a", buffering=1)
+            self._stream_path = stream_path
+            try:
+                self._stream_bytes = os.fstat(
+                    self._stream.fileno()).st_size
+            except OSError:
+                self._stream_bytes = 0
+            env = os.environ.get("PTPU_TRACE_MAX_BYTES")
+            try:
+                self._stream_max_bytes = int(env) if env else 0
+            except ValueError:
+                self._stream_max_bytes = 0
             if old is not None:
                 with contextlib.suppress(OSError):
                     old.close()
@@ -442,6 +458,32 @@ class Tracer:
         if self._stream:
             self._stream.close()
             self._stream = None
+            self._stream_path = None
+
+    def _rotate_stream_locked(self) -> None:
+        """Size-based rotation of the JSONL stream: the current file
+        moves to ``<path>.1`` (one rotated sibling — ``obs --jsonl``
+        reads it back) and a fresh file takes its place. Called under
+        ``_emit_lock`` with the size cap already exceeded; any OS
+        failure leaves the original stream in place (an unbounded
+        trace beats a lost one)."""
+        path = self._stream_path
+        if not path:
+            return
+        old = self._stream
+        try:
+            os.replace(path, path + ".1")
+            new = open(path, "a", buffering=1)
+        except OSError:
+            # replace failed: keep appending to the original; replace
+            # succeeded but reopen failed: old fd still points at the
+            # rotated inode, so no record is ever dropped either way
+            return
+        self._stream = new
+        self._stream_bytes = 0
+        if old is not None:
+            with contextlib.suppress(OSError):
+                old.close()
 
     def reset(self) -> None:
         """Clear spans/events/metric histories. Typed instruments are
@@ -649,7 +691,11 @@ class Tracer:
                 try:
                     stream.write(line)
                 except ValueError:  # stream closed under us (disable
-                    pass            # racing a daemon thread's emit)
+                    return          # racing a daemon thread's emit)
+                if self._stream_max_bytes > 0:
+                    self._stream_bytes += len(line)
+                    if self._stream_bytes > self._stream_max_bytes:
+                        self._rotate_stream_locked()
 
     def emit_record(self, obj: dict) -> None:
         """Append one FOREIGN record (a span shipped from another fleet

@@ -689,7 +689,7 @@ def run_worker(fabric, name: str, poll: float = 0.05,
                lease_ttl: float | None = None,
                max_units: int | None = None,
                idle_exit: float | None = None,
-               stop=None) -> int:
+               stop=None, beat=None) -> int:
     """The ``prove-worker`` loop: register, poll for claimable units,
     lease + heartbeat + execute + publish, until ``stop`` is set,
     ``max_units`` have run, or the fabric stays idle past
@@ -711,6 +711,10 @@ def run_worker(fabric, name: str, poll: float = 0.05,
     try:
         with pf.worker_isolation(name), trace.worker_context(name):
             while True:
+                if beat is not None:
+                    # stall-watchdog heartbeat: a wedged claim/execute
+                    # (native MSM that never returns) ages this out
+                    beat()
                 if stop is not None and stop.is_set():
                     break
                 if max_units is not None and executed >= max_units:

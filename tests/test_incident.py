@@ -1,0 +1,506 @@
+"""Incident flight recorder tests (ISSUE 20): the bounded ring +
+freeze semantics, the rate-limited / retention-bounded bundle store,
+the thread-stall watchdog's latch/recover cycle and its SLO feed, the
+``new_alerts`` capture trigger, XLA device-cost attribution on a real
+routed plan, trace-stream size rotation (+ ``obs`` reading the rotated
+sibling), the thread-naming regression guard, and the live HTTP
+surface (``/incidents``, ``/incidents/{id}``, ``POST
+/incidents/capture``, the ``debug_faults``-gated ``POST /debug/fail``)
+against a daemon on the mock devnet."""
+
+import json
+import os
+import re
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+
+from protocol_tpu.service.metrics import (  # noqa: E402
+    declare_instruments,
+    lint_exposition,
+    render_prometheus,
+)
+from protocol_tpu.service.recorder import (  # noqa: E402
+    FlightRecorder,
+    IncidentStore,
+    PlanCostRegistry,
+    capture_routed_plan_cost,
+    render_autopsy,
+    thread_stacks,
+)
+from protocol_tpu.service.slo import (  # noqa: E402
+    SloEngine,
+    SloSpec,
+    default_slos,
+)
+from protocol_tpu.service.watchdog import (  # noqa: E402
+    Heartbeats,
+    StallWatchdog,
+)
+from protocol_tpu.utils import trace  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def clean_tracer():
+    was = trace.TRACER.enabled
+    trace.TRACER.disable()
+    trace.TRACER.reset()
+    trace.TRACER.reset_instruments()
+    trace.enable()  # in-memory: instruments only record when enabled
+    yield
+    trace.TRACER.disable()
+    trace.TRACER.reset()
+    trace.TRACER.reset_instruments()
+    if was:
+        trace.TRACER.enable()
+
+
+# --- flight recorder ring ----------------------------------------------------
+
+
+def test_ring_bounded_and_freeze_is_snapshot():
+    rec = FlightRecorder(cap=8)
+    for i in range(20):
+        rec.note("tick", i=i)
+    assert len(rec) == 8
+    frozen = rec.freeze()
+    assert [e["i"] for e in frozen] == list(range(12, 20))
+    # seq is process-monotonic, not ring-relative
+    assert frozen[-1]["seq"] == 20
+    # freeze is a snapshot: later notes must not mutate it
+    rec.note("tick", i=99)
+    assert [e["i"] for e in frozen][-1] == 19
+
+
+def test_thread_stacks_keyed_by_name():
+    stacks = thread_stacks()
+    me = threading.current_thread().name
+    assert me in stacks
+    assert any("test_thread_stacks_keyed_by_name" in ln
+               for ln in stacks[me]["stack"])
+
+
+# --- incident store ----------------------------------------------------------
+
+
+def test_capture_bundle_roundtrip_and_autopsy(tmp_path):
+    rec = FlightRecorder(cap=32)
+    rec.note("slo_latched", slo="error_rate")
+    store = IncidentStore(str(tmp_path / "incidents"), rec,
+                          retention=4, min_interval=0.0)
+    context = {
+        "slo": {"alerts": ["error_rate"], "slos": [
+            {"slo": "error_rate", "objective": 0.999,
+             "burn": {"fast": 9.0, "slow": 2.0}, "alerting": True}]},
+        "metrics.txt": "ptpu_service_up 1.0\n",
+        "config": {"port": 0},
+    }
+    inc_id = store.capture("slo", "SLO error_rate latched",
+                           context=context)
+    assert inc_id and inc_id.startswith("inc-")
+    assert store.list_ids() == [inc_id]
+    (row,) = store.index()
+    assert row["trigger"] == "slo"
+    bundle = store.load(inc_id)
+    assert bundle["meta"]["reason"] == "SLO error_rate latched"
+    # the frozen ring rode along (note + the capture's own entry)
+    kinds = [e["kind"] for e in bundle["ring"]]
+    assert "slo_latched" in kinds
+    assert bundle["metrics.txt"] == "ptpu_service_up 1.0\n"
+    assert threading.current_thread().name in bundle["threads"]
+    text = render_autopsy(bundle)
+    assert inc_id in text
+    assert "error_rate" in text and "burn fast=9.00" in text
+    assert "timeline" in text and "threads" in text
+
+
+def test_capture_rate_limit_and_operator_force(tmp_path):
+    rec = FlightRecorder()
+    store = IncidentStore(str(tmp_path), rec, retention=8,
+                          min_interval=3600.0)
+    first = store.capture("slo", "one")
+    assert first is not None
+    # within min_interval: rate-limited (counted + ring-noted) ...
+    assert store.capture("slo", "two") is None
+    assert trace.counter_total("incidents_rate_limited",
+                               trigger="slo") == 1.0
+    assert any(e["kind"] == "capture_rate_limited"
+               for e in rec.freeze())
+    # ... unless forced (the operator POST path)
+    forced = store.capture("operator", "three", force=True)
+    assert forced is not None and forced != first
+    assert len(store.list_ids()) == 2
+
+
+def test_retention_evicts_oldest(tmp_path):
+    rec = FlightRecorder()
+    store = IncidentStore(str(tmp_path), rec, retention=2,
+                          min_interval=0.0)
+    ids = [store.capture("slo", f"r{i}", force=True) for i in range(3)]
+    assert all(ids)
+    kept = store.list_ids()
+    assert len(kept) == 2
+    assert ids[0] not in kept and ids[2] in kept
+    assert trace.counter_total("incidents_evicted") >= 1.0
+
+
+def test_load_rejects_path_traversal(tmp_path):
+    store = IncidentStore(str(tmp_path), FlightRecorder(),
+                          min_interval=0.0)
+    store.capture("slo", "x")
+    assert store.load("../outside") is None
+    assert store.load("a/b") is None
+    assert store.load("inc-missing") is None
+
+
+# --- stall watchdog ----------------------------------------------------------
+
+
+def test_watchdog_fires_and_recovers(tmp_path):
+    rec = FlightRecorder()
+    store = IncidentStore(str(tmp_path), rec, min_interval=0.0)
+    beats = Heartbeats()
+    dog = StallWatchdog(beats, recorder=rec, store=store,
+                        stall_after=30.0)
+    beats.register("ptpu-loop")  # this thread's ident
+    now = time.monotonic()
+    assert dog.check(now=now) == []
+    assert dog.stalled() == []
+
+    # 100s without a beat: fires exactly once, with a stack dump, a
+    # counter, and an incident capture
+    fired = dog.check(now=now + 100.0)
+    assert fired == ["ptpu-loop"]
+    assert dog.stalled() == ["ptpu-loop"]
+    assert dog.check(now=now + 101.0) == []  # latched, no re-fire
+    assert trace.counter_total("thread_stalls",
+                               thread="ptpu-loop") == 1.0
+    (note,) = [e for e in rec.freeze() if e["kind"] == "thread_stalled"]
+    assert note["thread"] == "ptpu-loop" and note["age"] > 30.0
+    assert "test_watchdog_fires_and_recovers" in note["stack"]
+    (inc,) = store.index()
+    assert inc["trigger"] == "watchdog"
+    bundle = store.load(inc["id"])
+    assert bundle["meta"]["context"]["stalled_thread"]["thread"] \
+        == "ptpu-loop"
+
+    # the heartbeat returns: recovery latches down + is ring-noted
+    beats.beat("ptpu-loop")
+    assert dog.check(now=time.monotonic()) == []
+    assert dog.stalled() == []
+    assert any(e["kind"] == "thread_recovered" for e in rec.freeze())
+
+    # a RETIRED thread is not an eternal stall
+    beats.unregister("ptpu-loop")
+    assert dog.check(now=time.monotonic() + 1000.0) == []
+    assert dog.stalled() == []
+
+
+def test_heartbeat_gauges_exported():
+    beats = Heartbeats()
+    dog = StallWatchdog(beats, stall_after=5.0)
+    beats.register("ptpu-a")
+    now = time.monotonic()
+    dog.check(now=now + 2.0)
+    text = render_prometheus()
+    assert 'ptpu_thread_heartbeat_age_seconds{thread="ptpu-a"}' in text
+    assert 'ptpu_thread_stalled{thread="ptpu-a"} 0' in text
+    assert beats.max_age(now + 2.0) == pytest.approx(2.0, abs=0.5)
+    assert beats.max_age() is not None
+    beats.unregister("ptpu-a")
+    assert beats.max_age() is None
+
+
+def test_thread_stall_slo_declared():
+    """The watchdog pages through the burn-rate path: a gauge-kind SLO
+    over the max heartbeat age, threshold aligned with the watchdog's
+    default stall_after."""
+    (spec,) = [s for s in default_slos() if s.name == "thread_stall"]
+    assert spec.kind == "gauge"
+    assert spec.source == "thread_heartbeat_age_max_seconds"
+    assert spec.threshold == 30.0
+
+
+def test_slo_new_alerts_is_the_capture_trigger():
+    eng = SloEngine(
+        specs=[SloSpec("g", "gauge", 0.9, source="x", threshold=1.0)],
+        fast_window=60.0, slow_window=300.0)
+    t = 1000.0
+    while t <= 1300.0:
+        eng.sample(gauges={"x": 0.0}, now=t)
+        t += 10.0
+    eng.evaluate(now=1300.0)
+    assert eng.new_alerts() == []
+    while t <= 1400.0:
+        eng.sample(gauges={"x": 5.0}, now=t)
+        t += 10.0
+    (r,) = eng.evaluate(now=1400.0)
+    assert r["alerting"]
+    assert eng.new_alerts() == ["g"]  # newly latched THIS evaluate
+    eng.sample(gauges={"x": 5.0}, now=1410.0)
+    eng.evaluate(now=1410.0)
+    assert eng.new_alerts() == []  # still latched, not NEW — one
+    # latch must produce one capture, not one per tick
+
+
+# --- device-cost attribution -------------------------------------------------
+
+
+def test_plan_cost_capture_on_real_routed_plan():
+    from protocol_tpu.ops.routed import build_routed_operator
+
+    from protocol_tpu.ops.routed import routed_arrays
+
+    n = 8
+    src = np.arange(n, dtype=np.int64)
+    dst = (src + 1) % n
+    val = np.ones(n, dtype=np.float64)
+    valid = np.ones(n, dtype=bool)
+    op = build_routed_operator(n, src, dst, val, valid)
+    arrs, static = routed_arrays(op)
+    rec = FlightRecorder()
+    reg = PlanCostRegistry()
+    row = capture_routed_plan_cost(arrs, static, op.n_state,
+                                   registry=reg, recorder=rec)
+    assert row is not None
+    assert row["operand_bytes"] > 0
+    # lower()-only cost analysis: flops/bytes are backend-reported
+    assert row["flops"] is not None and row["flops"] > 0
+    assert row["n_state"] == op.n_state
+    assert reg.get("spmv_routed")["plan"] == "spmv_routed"
+    assert any(e["kind"] == "plan_cost" for e in rec.freeze())
+    # ... and the module-global registry path exports ptpu_plan_*
+    capture_routed_plan_cost(arrs, static, op.n_state)
+    declare_instruments()
+    text = render_prometheus()
+    assert lint_exposition(text) == []
+    assert 'ptpu_plan_flops{plan="spmv_routed"}' in text
+    assert 'ptpu_plan_operand_bytes{plan="spmv_routed"}' in text
+    # cost capture must never have tripped the steady-recompile latch
+    assert trace.compile_stats()["steady_recompiles"] == 0
+
+
+def test_plan_cost_capture_degrades_on_garbage():
+    """Cost capture must never raise — garbage arrays degrade to the
+    analytical operand-bytes row."""
+    reg = PlanCostRegistry()
+    row = capture_routed_plan_cost({"bogus": object()}, None, 4,
+                                   registry=reg)
+    assert row is not None
+    assert row["flops"] is None
+    assert row["operand_bytes"] == 0.0
+
+
+# --- trace stream rotation ---------------------------------------------------
+
+
+def test_trace_stream_rotation_and_obs_reads_sibling(
+        tmp_path, monkeypatch, capsys):
+    stream = tmp_path / "trace.jsonl"
+    monkeypatch.setenv("PTPU_TRACE_MAX_BYTES", "4096")
+    trace.TRACER.disable()
+    trace.enable(str(stream))
+    sib = tmp_path / "trace.jsonl.1"
+    # fill until the stream rotates once, then a handful more so both
+    # files hold records (a second rotation would need another ~4KiB,
+    # which 5 small events cannot reach)
+    total = 0
+    while not sib.exists():
+        trace.event("rotation.fill", i=total, pad="x" * 40)
+        total += 1
+        assert total < 500, "stream never rotated"
+    for _ in range(5):
+        trace.event("rotation.fill", i=total, pad="x" * 40)
+        total += 1
+    trace.TRACER.disable()
+    assert stream.exists()
+    n_live = sum(1 for ln in open(stream) if ln.strip())
+    n_rot = sum(1 for ln in open(sib) if ln.strip())
+    # exactly one rotation happened: no record lost across it
+    assert n_live + n_rot == total
+    assert n_rot > 0 and n_live > 0
+    for path in (stream, sib):
+        for ln in open(path):
+            json.loads(ln)  # every line whole — no torn writes
+
+    # obs folds the rotated sibling back in (the .1 records count)
+    from protocol_tpu.cli.main import main
+
+    rc = main(["--assets", str(tmp_path / "assets"), "obs",
+               str(stream)])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert f"{total} event(s)" in out
+    assert "0 invalid" in out
+
+
+def test_trace_rotation_disabled_without_env(tmp_path, monkeypatch):
+    stream = tmp_path / "t.jsonl"
+    monkeypatch.delenv("PTPU_TRACE_MAX_BYTES", raising=False)
+    trace.TRACER.disable()
+    trace.enable(str(stream))
+    for i in range(200):
+        trace.event("rotation.fill", i=i, pad="x" * 40)
+    trace.TRACER.disable()
+    assert not (tmp_path / "t.jsonl.1").exists()
+
+
+# --- thread-naming regression ------------------------------------------------
+
+
+def test_every_service_thread_is_named():
+    """Every ``threading.Thread(`` in the service layer (and the CLI /
+    fabric worker paths) must pass ``name=`` — the watchdog, the
+    autopsy's thread-stack section, and py-spy all key on it."""
+    root = os.path.join(os.path.dirname(__file__), os.pardir,
+                        "protocol_tpu")
+    offenders = []
+    for dirpath, _, files in os.walk(root):
+        for fname in files:
+            if not fname.endswith(".py"):
+                continue
+            path = os.path.join(dirpath, fname)
+            src = open(path).read()
+            for m in re.finditer(r"threading\.Thread\(", src):
+                window = src[m.start():m.start() + 400]
+                # the call's argument window: up to the thread start
+                # that follows it (heuristic, but stable in this repo)
+                if "name=" not in window:
+                    line = src[:m.start()].count("\n") + 1
+                    offenders.append(f"{path}:{line}")
+    assert not offenders, \
+        f"unnamed threading.Thread( calls: {offenders}"
+
+
+# --- live daemon surface -----------------------------------------------------
+
+
+MNEMONIC = "test test test test test test test test test test test junk"
+
+
+def _get(url, expect=200):
+    try:
+        with urllib.request.urlopen(url, timeout=10) as resp:
+            return resp.status, json.loads(resp.read())
+    except urllib.error.HTTPError as e:
+        assert e.code == expect, (e.code, e.read())
+        return e.code, json.loads(e.read())
+
+
+def _get_text(url):
+    with urllib.request.urlopen(url, timeout=10) as resp:
+        return resp.read().decode()
+
+
+def _post(url, obj=None, expect=(200,)):
+    req = urllib.request.Request(
+        url, data=json.dumps(obj or {}).encode(),
+        headers={"Content-Type": "application/json"})
+    try:
+        with urllib.request.urlopen(req, timeout=10) as resp:
+            assert resp.status in expect, resp.status
+            return resp.status, json.loads(resp.read())
+    except urllib.error.HTTPError as e:
+        assert e.code in expect, (e.code, e.read())
+        return e.code, json.loads(e.read())
+
+
+def test_incident_http_surface_end_to_end(tmp_path, capsys):
+    from protocol_tpu.client import Client, ClientConfig
+    from protocol_tpu.client.chain import RpcChain
+    from protocol_tpu.client.eth import ecdsa_keypairs_from_mnemonic
+    from protocol_tpu.client.mocknode import MockNode
+    from protocol_tpu.service import (
+        FaultInjector,
+        ServiceConfig,
+        TrustService,
+    )
+
+    node = MockNode()
+    node_url = node.start()
+    svc = None
+    try:
+        deployer = ecdsa_keypairs_from_mnemonic(MNEMONIC, 1)[0]
+        chain = RpcChain.deploy_signed(node_url, deployer)
+        client = Client(ClientConfig(
+            as_address="0x" + chain.contract_address.hex(),
+            node_url=node_url, domain="0x" + "00" * 20), MNEMONIC)
+        svc = TrustService(
+            client,
+            ServiceConfig(port=0, poll_interval=0.05,
+                          refresh_interval=0.05, drain_timeout=10.0,
+                          debug_faults=1, incident_min_interval=0.0,
+                          watchdog_interval=0.1),
+            str(tmp_path / "cursor"),
+            provers={"echo": lambda params: {"echo": params}},
+            faults=FaultInjector({"rpc": 0.0, "device": 0.0,
+                                  "disk": 0.0}, seed=7),
+            state_dir=str(tmp_path / "state"))
+        url = svc.start()
+
+        # debug fault injection is live (the smoke's SLO-burn lever)
+        status, body = _post(f"{url}/debug/fail", expect=(500,))
+        assert body["error"] == "injected debug fault"
+
+        # operator-forced capture → retrievable bundle
+        status, body = _post(f"{url}/incidents/capture", expect=(201,))
+        inc_id = body["id"]
+        _, index = _get(f"{url}/incidents")
+        assert [r["id"] for r in index["incidents"]] == [inc_id]
+        _, bundle = _get(f"{url}/incidents/{inc_id}")
+        assert bundle["meta"]["trigger"] == "operator"
+        # the daemon context rode along: SLO state, config, metrics
+        assert "slo" in bundle and "config" in bundle
+        assert bundle["config"]["debug_faults"] == 1
+        assert "ptpu_service_up" in bundle["metrics.txt"]
+        # named service threads in the stack dump
+        assert any(n.startswith("ptpu-") for n in bundle["threads"])
+        text = render_autopsy(bundle)
+        assert inc_id in text and "ptpu-tailer" in text
+
+        # unknown id → 404; flipping the debug gate off → route gone
+        _get(f"{url}/incidents/inc-nope", expect=404)
+        svc.config.debug_faults = 0
+        _post(f"{url}/debug/fail", expect=(404,))
+
+        # watchdog gauges are on /metrics and the exposition lints
+        deadline = time.monotonic() + 5.0
+        while True:
+            text = _get_text(f"{url}/metrics")
+            if "ptpu_thread_heartbeat_age_seconds{" in text:
+                break
+            assert time.monotonic() < deadline, \
+                "watchdog never exported heartbeat gauges"
+            time.sleep(0.05)
+        assert "ptpu_thread_heartbeat_age_seconds{" in text
+        assert 'thread="ptpu-tailer"' in text
+        assert lint_exposition(text) == []
+
+        # /status surfaces the incident plane
+        _, st = _get(f"{url}/status")
+        assert st["incidents"]["retained"] == 1
+        assert st["incidents"]["stalled_threads"] == []
+
+        # the incident CLI verb renders the live bundle
+        from protocol_tpu.cli.main import main
+
+        rc = main(["--assets", str(tmp_path / "assets"), "incident",
+                   "--url", url])
+        assert rc == 0
+        assert inc_id in capsys.readouterr().out
+        rc = main(["--assets", str(tmp_path / "assets"), "incident",
+                   "--url", url, "--id", "latest"])
+        assert rc == 0
+        out = capsys.readouterr().out
+        assert f"incident {inc_id}" in out
+        assert "threads" in out
+    finally:
+        if svc is not None:
+            svc.shutdown()
+        node.stop()

@@ -29,8 +29,10 @@ def _load_rules():
 
 def test_recording_rules_structure():
     """Pure-python structural validation: groups/interval/rules present,
-    record names follow the ``family:quantile`` convention, every expr
-    is a histogram_quantile over the family's ``_bucket`` rate."""
+    record names follow the ``family:quantile`` convention, every
+    recording expr is a histogram_quantile over the family's
+    ``_bucket`` rate; alerting rules (the incident plane's pager
+    surface) carry an alert name, an expr, and a summary annotation."""
     doc = _load_rules()
     assert isinstance(doc, dict) and "groups" in doc
     groups = doc["groups"]
@@ -38,6 +40,16 @@ def test_recording_rules_structure():
     for g in groups:
         assert re.match(r"^[a-zA-Z_][a-zA-Z0-9_]*$", g["name"])
         for rule in g["rules"]:
+            assert ("record" in rule) != ("alert" in rule), rule
+            if "alert" in rule:
+                assert set(rule) <= {"alert", "expr", "for",
+                                     "labels", "annotations"}, rule
+                assert re.match(r"^Ptpu[A-Za-z0-9]+$", rule["alert"])
+                assert rule["expr"].strip(), rule
+                assert rule["annotations"]["summary"].strip(), rule
+                assert rule["labels"]["severity"] in ("page",
+                                                      "ticket")
+                continue
             assert set(rule) == {"record", "expr"}, rule
             assert _RECORD_RE.match(rule["record"]), rule["record"]
             family = rule["record"].split(":")[0]
@@ -45,6 +57,35 @@ def test_recording_rules_structure():
             assert expr.startswith("histogram_quantile("), expr
             assert f"rate({family}_bucket[" in expr, expr
             assert "sum by (" in expr and "le" in expr, expr
+
+
+def test_alert_rules_reference_declared_series():
+    """Every ``ptpu_*`` series an alert expr reads must be declared by
+    the instrument layer (counter → ``_total``, gauge → bare) — the
+    pager and service/metrics.py cannot drift apart silently."""
+    from protocol_tpu.service.metrics import (
+        DECLARED_COUNTERS,
+        DECLARED_GAUGES,
+        HISTOGRAM_FAMILIES,
+    )
+
+    declared = (
+        {f"ptpu_{c}_total" for c in DECLARED_COUNTERS}
+        | {f"ptpu_{g}" for g in DECLARED_GAUGES}
+        | {f"ptpu_{h}_bucket" for h in HISTOGRAM_FAMILIES}
+    )
+    alerts = [r for g in _load_rules()["groups"] for r in g["rules"]
+              if "alert" in r]
+    assert alerts, "incident alert rules missing from ptpu_rules.yml"
+    names = {r["alert"] for r in alerts}
+    # the incident plane's core pages must exist
+    assert {"PtpuThreadStalled", "PtpuSloBurnLatched",
+            "PtpuIncidentCaptured"} <= names, names
+    for rule in alerts:
+        series = set(re.findall(r"ptpu_[a-z0-9_]+", rule["expr"]))
+        assert series, rule
+        undeclared = series - declared
+        assert not undeclared, (rule["alert"], sorted(undeclared))
 
 
 def test_recording_rules_cover_every_histogram_family():
@@ -57,6 +98,8 @@ def test_recording_rules_cover_every_histogram_family():
     by_family: dict = {}
     for g in doc["groups"]:
         for rule in g["rules"]:
+            if "alert" in rule:  # pager rules live in their own test
+                continue
             family, q = rule["record"].rsplit(":", 1)
             assert family.startswith("ptpu_")
             by_family.setdefault(family[len("ptpu_"):], []).append(
@@ -127,7 +170,7 @@ def test_committed_baseline_is_loadable():
     assert data["schema"] == "ptpu-perf-gate-v1"
     assert set(data["workloads"]) == {"prove", "refresh", "delta",
                                       "proofs", "commits", "sublinear",
-                                      "sharded", "scenario"}
+                                      "sharded", "scenario", "fabric"}
 
 
 # --- bench trajectory --------------------------------------------------------

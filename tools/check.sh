@@ -76,6 +76,12 @@ echo "serve_smoke --restart --churn --replica --fleet: rc=${smoke_rc}"
 # across the three roles, one sharded prove's trace id joins across
 # >=2 processes via the merged obs chain (remote=1 span included), and
 # every declared SLO evaluates in budget with no latched alert.
+# INCIDENT_OK asserts the incident flight recorder: a forced SLO burn
+# through the real request path latches error_rate, the latch freezes
+# the flight ring into a retrievable autopsy bundle (burn timeline,
+# named-thread stacks, ptpu_plan_* cost attribution), the incident
+# operator verb renders it, and the watchdog's per-thread heartbeat
+# gauges are live on a lint-clean /metrics.
 lint_rc=1
 grep -q SCRAPE_LINT_OK /tmp/_smoke.log \
     && grep -q TRACE_JOIN_OK /tmp/_smoke.log \
@@ -89,6 +95,7 @@ grep -q SCRAPE_LINT_OK /tmp/_smoke.log \
     && grep -q FABRIC_OK /tmp/_smoke.log \
     && grep -q REPLICA_OK /tmp/_smoke.log \
     && grep -q FLEET_OK /tmp/_smoke.log \
+    && grep -q INCIDENT_OK /tmp/_smoke.log \
     && grep -q "DELTA_OK" /tmp/_smoke.log && lint_rc=0
 echo "scrape-lint + trace-join + device-obs + delta + sublinear + pool + commit + sharded + fabric + replica + fleet: rc=${lint_rc}"
 

@@ -204,7 +204,14 @@ def inprocess_phase(node_url, chain, step, fleet=False) -> None:
                                   # telemetry + evaluate SLOs fast
                                   # enough for the smoke's deadlines
                                   telemetry_interval=0.2,
-                                  telemetry_ttl=15.0, slo_interval=0.5),
+                                  telemetry_ttl=15.0, slo_interval=0.5,
+                                  # incident phase: the debug fault
+                                  # route is the SLO-burn lever, and
+                                  # captures must not rate-limit away
+                                  # inside the smoke's timeline
+                                  debug_faults=1,
+                                  incident_min_interval=0.0,
+                                  watchdog_interval=0.2),
             os.path.join(tmp, "cursor"),
             provers=pool_provers,
             faults=FaultInjector({"rpc": 0.0, "device": 0.0, "disk": 0.0}),
@@ -305,6 +312,11 @@ def inprocess_phase(node_url, chain, step, fleet=False) -> None:
         if fleet:
             fleet_phase(url, config, prove_refs,
                         os.path.join(tmp, "state"), trace_path, step)
+
+        # --- incident flight recorder: forced SLO burn → autopsy ----------
+        # after fleet_phase (which asserts every SLO is still in
+        # budget) and before the drain
+        incident_phase(url, step)
 
         # --- end-to-end trace join over the JSONL stream ------------------
         trace_join_phase(trace_path, chain, step)
@@ -1248,6 +1260,124 @@ def fleet_phase(url, config, refs, state_dir, trace_path, step) -> None:
     step(f"FLEET_OK ({len(instances)} instances federated, trace "
          f"{remote_job} joined across {len(chain_inst)} processes, "
          f"{len(slo['slos'])} SLOs in budget)")
+
+
+def incident_phase(url, step) -> None:
+    """Incident flight recorder on the LIVE daemon: burn the
+    ``error_rate`` SLO through the real request path (the
+    ``debug_faults``-gated ``POST /debug/fail`` route), watch the
+    burn-rate alert latch, and assert the latch froze the flight ring
+    into a retrievable autopsy bundle — burn timeline, named-thread
+    stacks, and ``ptpu_plan_*`` device-cost attribution included —
+    rendered by the ``incident`` operator verb → ``INCIDENT_OK``."""
+    import json as _json
+    import subprocess
+    import urllib.error
+    import urllib.request
+
+    from protocol_tpu.service.metrics import lint_exposition
+
+    def post(path, expect):
+        req = urllib.request.Request(url + path, data=b"{}",
+                                     headers={"Content-Type":
+                                              "application/json"})
+        try:
+            with urllib.request.urlopen(req, timeout=10) as resp:
+                assert resp.status in expect, (path, resp.status)
+                return resp.status, _json.loads(resp.read())
+        except urllib.error.HTTPError as e:
+            assert e.code in expect, (path, e.code, e.read())
+            return e.code, _json.loads(e.read())
+
+    # 1) the watchdog is live: per-thread heartbeat gauges on /metrics
+    #    for the named service threads, exposition still lint-clean
+    metrics = _get_json(url, "/metrics")
+    assert "ptpu_thread_heartbeat_age_seconds{" in metrics, \
+        "watchdog heartbeat gauges missing from /metrics"
+    for thread in ("ptpu-tailer", "ptpu-refresher", "ptpu-observer"):
+        assert f'thread="{thread}"' in metrics, \
+            f"no heartbeat series for {thread}"
+    problems = lint_exposition(metrics)
+    assert not problems, f"exposition lint: {problems}"
+    step("watchdog heartbeats on /metrics for the named service "
+         "threads (exposition lint-clean)")
+
+    # 2) operator-forced capture works before anything burns
+    _, body = post("/incidents/capture", expect=(201,))
+    operator_id = body["id"]
+    step(f"operator capture → {operator_id}")
+
+    # 3) burn the error-rate SLO through the REAL request path: each
+    #    injected 500 lands in the http_request_seconds histogram the
+    #    ratio objective reads
+    for _ in range(25):
+        post("/debug/fail", expect=(500,))
+    deadline = time.monotonic() + 60
+    slo = None
+    while time.monotonic() < deadline:
+        slo = _get_json(url, "/slo")
+        if "error_rate" in slo.get("alerts", []):
+            break
+        time.sleep(0.3)
+    assert slo and "error_rate" in slo.get("alerts", []), \
+        f"error_rate never latched: {slo}"
+    (row,) = [s for s in slo["slos"] if s["slo"] == "error_rate"]
+    step(f"error_rate latched (burn fast={row['burn']['fast']:.1f} "
+         f"slow={row['burn']['slow']:.1f})")
+
+    # 4) the latch froze the ring into a bundle (trigger=slo)
+    deadline = time.monotonic() + 30
+    slo_inc = None
+    while time.monotonic() < deadline:
+        index = _get_json(url, "/incidents")["incidents"]
+        slo_rows = [r for r in index if r["trigger"] == "slo"]
+        if slo_rows:
+            slo_inc = slo_rows[-1]
+            break
+        time.sleep(0.3)
+    assert slo_inc is not None, "SLO latch produced no incident bundle"
+    bundle = _get_json(url, f"/incidents/{slo_inc['id']}")
+    assert "error_rate" in bundle["meta"]["reason"]
+    ring_kinds = {e["kind"] for e in bundle["ring"]}
+    assert "slo_latched" in ring_kinds, \
+        f"burn timeline missing from ring: {sorted(ring_kinds)}"
+    assert any(n.startswith("ptpu-") for n in bundle["threads"]), \
+        "no named service threads in the stack dump"
+    plans = {p["plan"] for p in bundle["plans"]}
+    assert "spmv_routed" in plans, \
+        f"no device-cost attribution for the served plan: {plans}"
+    step(f"bundle {slo_inc['id']}: burn timeline + "
+         f"{len(bundle['threads'])} thread stacks + cost rows "
+         f"for {sorted(plans)}")
+
+    # 5) the incident operator verb renders the autopsy
+    cli_env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=REPO)
+    autopsy = subprocess.run(
+        [sys.executable, "-m", "protocol_tpu.cli",
+         "incident", "--url", url, "--id", "latest"],
+        cwd=REPO, env=cli_env, capture_output=True, text=True,
+        timeout=60)
+    assert autopsy.returncode == 0, \
+        f"incident verb rc={autopsy.returncode}:\n{autopsy.stdout}\n" \
+        f"{autopsy.stderr}"
+    for needle in ("error_rate", "timeline", "ptpu-tailer",
+                   "spmv_routed"):
+        assert needle in autopsy.stdout, \
+            f"autopsy missing {needle!r}:\n{autopsy.stdout}"
+    listing = subprocess.run(
+        [sys.executable, "-m", "protocol_tpu.cli",
+         "incident", "--url", url],
+        cwd=REPO, env=cli_env, capture_output=True, text=True,
+        timeout=60)
+    assert listing.returncode == 0 and operator_id in listing.stdout
+
+    # 6) capture counters made it to the exposition
+    metrics = _get_json(url, "/metrics")
+    assert _series_sum(metrics, "ptpu_incidents_captured_total") >= 2
+
+    step(f"INCIDENT_OK (operator + SLO-latch bundles retained, "
+         f"autopsy renders burn timeline, thread stacks, and "
+         f"plan costs)")
 
 
 def _counter_total(name) -> float:
